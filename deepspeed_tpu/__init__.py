@@ -1,0 +1,113 @@
+"""deepspeed_tpu: a TPU-native training & inference framework with the capability
+surface of DeepSpeed (reference: carmocca/DeepSpeed v0.8.1), built on JAX/XLA —
+``jax.sharding`` meshes + jit for parallelism, ``jax.lax`` collectives over ICI/DCN
+in place of NCCL, Pallas kernels in place of CUDA.
+
+Top-level API parity with ``deepspeed/__init__.py``:
+- :func:`initialize` (``deepspeed/__init__.py:52``)
+- :func:`init_inference` (``:233``)
+- :func:`add_config_arguments` (``:210``)
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Any, Optional, Tuple
+
+__version__ = "0.1.0"
+
+from . import comm  # noqa: F401
+from .accelerator import get_accelerator  # noqa: F401
+from .models.api import Module  # noqa: F401
+from .runtime.config import DeepSpeedConfig  # noqa: F401
+from .runtime.engine import DeepSpeedEngine  # noqa: F401
+from .runtime.topology import MeshTopology  # noqa: F401
+from .utils.logging import log_dist, logger  # noqa: F401
+
+
+def initialize(
+    args: Optional[argparse.Namespace] = None,
+    model: Optional[Module] = None,
+    optimizer: Any = None,
+    model_parameters: Any = None,
+    training_data: Any = None,
+    lr_scheduler: Any = None,
+    topology: Optional[MeshTopology] = None,
+    dist_init_required: Optional[bool] = None,
+    config: Any = None,
+    config_params: Any = None,
+    seed: Optional[int] = None,
+) -> Tuple[DeepSpeedEngine, Any, Any, Any]:
+    """Create a training engine. Parity: ``deepspeed.initialize``
+    (``deepspeed/__init__.py:52``) — same return arity
+    ``(engine, optimizer, dataloader, lr_scheduler)``.
+
+    ``model`` is a :class:`deepspeed_tpu.Module` (functional init/apply/specs).
+    ``config`` is a DeepSpeed-style JSON dict or path (``config_params`` accepted as
+    the legacy alias). ``optimizer``/``lr_scheduler`` callables override the config
+    blocks (parity with passing a client optimizer/scheduler).
+    """
+    if model is None:
+        raise ValueError("deepspeed_tpu.initialize: model is required")
+    cfg = config if config is not None else config_params
+    if cfg is None and args is not None:
+        cfg = getattr(args, "deepspeed_config", None)
+    import jax
+
+    if dist_init_required is None or dist_init_required:
+        comm.init_distributed()
+
+    ds_config = cfg if isinstance(cfg, DeepSpeedConfig) else DeepSpeedConfig.load(
+        cfg, world_size=jax.device_count())
+    from .ops.optimizers import Optimizer as _Opt
+
+    engine = DeepSpeedEngine(
+        model=model,
+        config=ds_config,
+        topology=topology,
+        seed=seed,
+        lr_scheduler_fn=lr_scheduler if callable(lr_scheduler) else None,
+        client_optimizer=optimizer if isinstance(optimizer, _Opt) else None,
+    )
+    if optimizer is not None and not isinstance(optimizer, _Opt):
+        raise TypeError(
+            "client optimizer must be a deepspeed_tpu.ops.optimizers.Optimizer "
+            f"(got {type(optimizer)})")
+    training_dataloader = None
+    if training_data is not None:
+        from .runtime.dataloader import DeepSpeedDataLoader
+
+        # the engine consumes the per-process slice of the GLOBAL batch:
+        # micro_batch x (dp extent handled by this process)
+        per_process = (engine.micro_batch_size * engine.topo.data_parallel_size
+                       // jax.process_count())
+        training_dataloader = DeepSpeedDataLoader(
+            training_data, batch_size=per_process)
+    return engine, engine.optimizer, training_dataloader, engine.lr_fn
+
+
+def init_inference(model: Any = None, config: Any = None, **kwargs):
+    """Create an inference engine. Parity: ``deepspeed.init_inference``
+    (``deepspeed/__init__.py:233``)."""
+    from .inference.engine import InferenceEngine
+    from .inference.config import DeepSpeedInferenceConfig
+
+    if config is None:
+        config = kwargs
+    elif kwargs:
+        config = {**(config if isinstance(config, dict) else {}), **kwargs}
+    inf_cfg = (config if isinstance(config, DeepSpeedInferenceConfig)
+               else DeepSpeedInferenceConfig(**config))
+    return InferenceEngine(model, inf_cfg)
+
+
+def add_config_arguments(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    """Parity: ``deepspeed.add_config_arguments`` (``deepspeed/__init__.py:210``)."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed (helper flag, always on here)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to the DeepSpeed JSON config")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help=argparse.SUPPRESS)  # legacy alias
+    return parser

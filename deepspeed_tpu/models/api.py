@@ -1,0 +1,55 @@
+"""The module contract between models and the engine.
+
+The reference wraps ``torch.nn.Module``; the TPU-native contract is functional — a
+model is (init, apply, partition rules):
+
+- ``init(rng) -> params``: build the parameter pytree (fp32).
+- ``apply(params, batch, rngs, train) -> (loss, aux)``: pure forward + loss.
+- ``partition_specs(param_shapes) -> pytree of PartitionSpec``: the *model-parallel*
+  (tp/sp) placement of each leaf. ZeRO sharding is layered on top by the engine's
+  :class:`~deepspeed_tpu.runtime.zero.policy.ZeroShardingPolicy`; models never think
+  about data parallelism.
+
+``Module`` is a tiny carrier for those three functions so user code can also pass
+plain callables.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+Params = Any
+Batch = Any
+
+
+def maybe_shard(x, spec: P):
+    """``with_sharding_constraint`` that no-ops when no mesh is bound, so model code
+    runs identically inside the engine (mesh context) and standalone (tests, single
+    device)."""
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (RuntimeError, ValueError):
+        return x
+
+
+def replicated_specs(param_shapes) -> Any:
+    """Default partitioning: every leaf replicated (pure data parallelism)."""
+    return jax.tree_util.tree_map(lambda _: P(), param_shapes)
+
+
+@dataclasses.dataclass(frozen=True)
+class Module:
+    """A trainable model: functional (init, apply, partition_specs)."""
+
+    init: Callable[[jax.Array], Params]
+    apply: Callable[..., Tuple[jax.Array, Dict[str, Any]]]
+    partition_specs: Optional[Callable[[Any], Any]] = None
+
+    def specs(self, param_shapes) -> Any:
+        if self.partition_specs is None:
+            return replicated_specs(param_shapes)
+        return self.partition_specs(param_shapes)

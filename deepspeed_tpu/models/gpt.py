@@ -1,0 +1,353 @@
+"""GPT-family decoder-only language models, TPU-first.
+
+The reference keeps model definitions out-of-repo (Megatron-DeepSpeed / HF) and
+ships fixtures (``tests/unit/simple_model.py``) plus fused transformer kernels.
+This framework ships a first-class model family because the benchmarks
+(BASELINE.json: GPT-2 350M, GPT-NeoX 6.7B/20B, BLOOM-7B1) need runnable flagships.
+
+TPU-first design:
+- parameters are one pytree; per-layer weights are *stacked* on a leading ``L`` axis
+  and the block is applied with ``lax.scan`` — one compiled layer body regardless of
+  depth (fast compiles, natural unit for pipeline stages later);
+- Megatron-style tensor-parallel PartitionSpecs: column-parallel qkv/up projections,
+  row-parallel out/down projections, vocab-parallel embedding — XLA inserts exactly
+  the two all-reduces per block that Megatron does by hand;
+- activations are sharding-constrained to batch x sequence axes so sequence
+  parallelism ("sp") shards the residual stream;
+- rotary or learned positions (NeoX vs GPT-2), pre-LN, optional remat
+  (``jax.checkpoint``) = activation checkpointing parity
+  (``runtime/activation_checkpointing/checkpointing.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import multihead_attention
+from .api import Module, maybe_shard
+
+BATCH = ("dp", "ep")  # batch sharding axes (matches topology.BATCH_AXES)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    d_ff: Optional[int] = None  # default 4*d_model
+    max_seq_len: int = 1024
+    rotary: bool = False  # False: learned positions (GPT-2); True: RoPE (NeoX)
+    rotary_pct: float = 1.0
+    tie_embeddings: bool = True
+    dropout: float = 0.0
+    layer_norm_eps: float = 1e-5
+    remat: bool = False  # activation checkpointing per block
+    use_flash: Optional[bool] = None  # None = auto dispatch
+
+    @property
+    def ffn_dim(self) -> int:
+        return self.d_ff if self.d_ff is not None else 4 * self.d_model
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_head == 0
+        return self.d_model // self.n_head
+
+    def num_params(self) -> int:
+        d, f, v, l = self.d_model, self.ffn_dim, self.vocab_size, self.n_layer
+        per_layer = 4 * d * d + 2 * d * f + 13 * d  # qkv+out + mlp + ln/bias
+        emb = v * d + (0 if self.rotary else self.max_seq_len * d)
+        return l * per_layer + emb + 2 * d
+
+
+# Named presets used by benchmarks (sizes follow GPT-2/GPT-NeoX families).
+PRESETS: Dict[str, GPTConfig] = {
+    "gpt2-125m": GPTConfig(n_layer=12, n_head=12, d_model=768),
+    "gpt2-350m": GPTConfig(n_layer=24, n_head=16, d_model=1024),
+    "gpt2-760m": GPTConfig(n_layer=24, n_head=16, d_model=1536),
+    "gpt2-1.3b": GPTConfig(n_layer=24, n_head=32, d_model=2048),
+    "gpt-neox-1.3b": GPTConfig(n_layer=24, n_head=16, d_model=2048, rotary=True, rotary_pct=0.25),
+    "gpt-neox-6.7b": GPTConfig(n_layer=32, n_head=32, d_model=4096, rotary=True, rotary_pct=0.25),
+    "gpt-neox-20b": GPTConfig(
+        vocab_size=50432, n_layer=44, n_head=64, d_model=6144, max_seq_len=2048,
+        rotary=True, rotary_pct=0.25),
+    "tiny": GPTConfig(vocab_size=256, n_layer=2, n_head=4, d_model=64, max_seq_len=128),
+}
+
+
+# --------------------------------------------------------------------------- init
+def init_params(cfg: GPTConfig, rng: jax.Array) -> Dict[str, Any]:
+    d, f, v, l = cfg.d_model, cfg.ffn_dim, cfg.vocab_size, cfg.n_layer
+    k = jax.random.split(rng, 8)
+    std = 0.02
+    # residual-out projections scaled by 1/sqrt(2L) (GPT-2 init)
+    res_std = std / np.sqrt(2.0 * l)
+
+    def normal(key, shape, s):
+        return (jax.random.normal(key, shape, jnp.float32) * s)
+
+    params: Dict[str, Any] = {
+        "wte": normal(k[0], (v, d), std),
+        "blocks": {
+            "ln1_scale": jnp.ones((l, d)), "ln1_bias": jnp.zeros((l, d)),
+            "qkv_w": normal(k[1], (l, d, 3 * d), std), "qkv_b": jnp.zeros((l, 3 * d)),
+            "attn_out_w": normal(k[2], (l, d, d), res_std), "attn_out_b": jnp.zeros((l, d)),
+            "ln2_scale": jnp.ones((l, d)), "ln2_bias": jnp.zeros((l, d)),
+            "mlp_up_w": normal(k[3], (l, d, f), std), "mlp_up_b": jnp.zeros((l, f)),
+            "mlp_down_w": normal(k[4], (l, f, d), res_std), "mlp_down_b": jnp.zeros((l, d)),
+        },
+        "lnf_scale": jnp.ones((d,)),
+        "lnf_bias": jnp.zeros((d,)),
+    }
+    if not cfg.rotary:
+        params["wpe"] = normal(k[5], (cfg.max_seq_len, d), std)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = normal(k[6], (v, d), std)
+    return params
+
+
+def partition_specs(cfg: GPTConfig, param_shapes) -> Dict[str, Any]:
+    """Megatron-style TP specs. Stacked layer leaves carry a leading L axis."""
+    specs = {
+        "wte": P("tp", None),  # vocab-parallel embedding
+        "blocks": {
+            "ln1_scale": P(None, None), "ln1_bias": P(None, None),
+            "qkv_w": P(None, None, "tp"), "qkv_b": P(None, "tp"),
+            "attn_out_w": P(None, "tp", None), "attn_out_b": P(None, None),
+            "ln2_scale": P(None, None), "ln2_bias": P(None, None),
+            "mlp_up_w": P(None, None, "tp"), "mlp_up_b": P(None, "tp"),
+            "mlp_down_w": P(None, "tp", None), "mlp_down_b": P(None, None),
+        },
+        "lnf_scale": P(None),
+        "lnf_bias": P(None),
+    }
+    if not cfg.rotary:
+        specs["wpe"] = P(None, None)
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P("tp", None)
+    return specs
+
+
+# --------------------------------------------------------------------------- layers
+def layer_norm(x: jnp.ndarray, scale, bias, eps: float) -> jnp.ndarray:
+    # fp32 statistics regardless of compute dtype (reference normalize_kernels.cu
+    # accumulates in fp32 for the same reason).
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _rope(x: jnp.ndarray, positions: jnp.ndarray, rotary_dims: int) -> jnp.ndarray:
+    """Rotary embedding on the first ``rotary_dims`` of the head dim. x: [B,T,H,Dh]."""
+    if rotary_dims == 0:
+        return x
+    x_rot, x_pass = x[..., :rotary_dims], x[..., rotary_dims:]
+    half = rotary_dims // 2
+    freqs = 1.0 / (10000.0 ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[:, :, None].astype(jnp.float32) * freqs[None, None, :]  # [B,T,half]
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
+    x1, x2 = x_rot[..., :half], x_rot[..., half:]
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return jnp.concatenate([rotated, x_pass], axis=-1)
+
+
+def _block(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray],
+           positions: jnp.ndarray, dropout_rng, train: bool) -> jnp.ndarray:
+    B, T, D = x.shape
+    H, Dh = cfg.n_head, cfg.head_dim
+    h = layer_norm(x, w["ln1_scale"], w["ln1_bias"], cfg.layer_norm_eps)
+    qkv = h @ w["qkv_w"] + w["qkv_b"]
+    q, k_, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, T, H, Dh)
+    k_ = k_.reshape(B, T, H, Dh)
+    v = v.reshape(B, T, H, Dh)
+    if cfg.rotary:
+        rd = int(cfg.rotary_pct * Dh)
+        rd -= rd % 2
+        q = _rope(q, positions, rd)
+        k_ = _rope(k_, positions, rd)
+    attn = multihead_attention(q, k_, v, causal=True, use_flash=cfg.use_flash)
+    attn = attn.reshape(B, T, D)
+    attn = attn @ w["attn_out_w"] + w["attn_out_b"]
+    x = x + _dropout(attn, cfg.dropout, dropout_rng, train, salt=0)
+    h = layer_norm(x, w["ln2_scale"], w["ln2_bias"], cfg.layer_norm_eps)
+    h = h @ w["mlp_up_w"] + w["mlp_up_b"]
+    h = jax.nn.gelu(h, approximate=True)
+    h = h @ w["mlp_down_w"] + w["mlp_down_b"]
+    x = x + _dropout(h, cfg.dropout, dropout_rng, train, salt=1)
+    return x
+
+
+def _dropout(x, rate, rng, train, salt: int):
+    if rate == 0.0 or not train or rng is None:
+        return x
+    key = jax.random.fold_in(rng, salt)
+    keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+
+
+# --------------------------------------------------------------------------- forward
+def forward(cfg: GPTConfig, params: Dict[str, Any], input_ids: jnp.ndarray,
+            rngs: Optional[Dict[str, jax.Array]] = None, train: bool = True) -> jnp.ndarray:
+    """Return logits [B, T, V]."""
+    B, T = input_ids.shape
+    if T > cfg.max_seq_len:
+        raise ValueError(
+            f"sequence length {T} exceeds max_seq_len {cfg.max_seq_len} "
+            f"(out-of-range position lookups would return NaN)")
+    x = jnp.take(params["wte"], input_ids, axis=0)
+    positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+    if not cfg.rotary:
+        x = x + jnp.take(params["wpe"], positions, axis=0)
+    x = x.astype(params["blocks"]["qkv_w"].dtype)
+    # residual stream sharded over batch and (if sp>1) sequence
+    x = maybe_shard(x, P(BATCH, "sp", None))
+
+    drng = (rngs or {}).get("dropout")
+
+    def block_fn(x, layer_w, pos, lrng):
+        return _block(cfg, x, layer_w, pos, lrng, train)
+
+    if cfg.remat:
+        block_fn = jax.checkpoint(block_fn, policy=jax.checkpoint_policies.nothing_saveable)
+
+    def body(carry, layer_w):
+        x, i = carry
+        lrng = jax.random.fold_in(drng, i) if drng is not None else None
+        x = block_fn(x, layer_w, positions, lrng)
+        return (x, i + 1), None
+
+    (x, _), _ = jax.lax.scan(body, (x, jnp.int32(0)), params["blocks"])
+    x = layer_norm(x, params["lnf_scale"], params["lnf_bias"], cfg.layer_norm_eps)
+    head = params["wte"] if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
+    return logits
+
+
+def loss_fn(cfg: GPTConfig, params, batch: Dict[str, jnp.ndarray],
+            rngs=None, train: bool = True) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+    """Next-token cross entropy. ``batch``: {"input_ids": [B,T]} (+ optional
+    "labels"/"loss_mask")."""
+    input_ids = batch["input_ids"]
+    labels = batch.get("labels")
+    if labels is None:
+        labels = input_ids[:, 1:]
+        inputs = input_ids[:, :-1]
+    else:
+        inputs = input_ids
+    logits = forward(cfg, params, inputs, rngs=rngs, train=train)
+    logits32 = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits32, axis=-1)
+    gold = jnp.take_along_axis(logits32, labels[..., None], axis=-1)[..., 0]
+    nll = logz - gold
+    mask = batch.get("loss_mask")
+    if mask is not None:
+        mask = mask.astype(jnp.float32)
+        if labels.shape != batch["input_ids"].shape:
+            mask = mask[:, 1:]
+        loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    else:
+        loss = jnp.mean(nll)
+    return loss, {"num_tokens": nll.size}
+
+
+# --------------------------------------------------------------------- KV-cache decode
+def init_cache(cfg: GPTConfig, batch_size: int, max_len: int, dtype=jnp.bfloat16):
+    """Per-layer stacked KV cache. Parity: the reference's inference workspace
+    (``csrc/transformer/inference/includes/inference_context.h``) — here a pytree
+    of [L, B, S, H, Dh] arrays living in HBM."""
+    shape = (cfg.n_layer, batch_size, max_len, cfg.n_head, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
+            "pos": jnp.zeros((), jnp.int32)}
+
+
+def _block_with_cache(cfg: GPTConfig, x, w, k_cache, v_cache, pos):
+    """One transformer block consuming/updating a KV cache slice.
+
+    x: [B, T, D] new tokens (T=prompt len at prefill, 1 at decode);
+    k_cache/v_cache: [B, S, H, Dh]; pos: scalar — tokens already in the cache.
+    """
+    B, T, D = x.shape
+    H, Dh = cfg.n_head, cfg.head_dim
+    S = k_cache.shape[1]
+    h = layer_norm(x, w["ln1_scale"], w["ln1_bias"], cfg.layer_norm_eps)
+    qkv = h @ w["qkv_w"] + w["qkv_b"]
+    q, k_, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, T, H, Dh)
+    k_ = k_.reshape(B, T, H, Dh)
+    v = v.reshape(B, T, H, Dh)
+    positions = pos + jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+    if cfg.rotary:
+        rd = int(cfg.rotary_pct * Dh)
+        rd -= rd % 2
+        q = _rope(q, positions, rd)
+        k_ = _rope(k_, positions, rd)
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k_.astype(k_cache.dtype), (0, pos, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
+    # attend over the whole cache with a validity+causal mask
+    scale = 1.0 / np.sqrt(Dh)
+    logits = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                        k_cache.astype(jnp.float32)) * scale
+    s_idx = jnp.arange(S)[None, :]
+    t_idx = positions[:, :, None]  # absolute position of each query token
+    mask = s_idx <= t_idx  # [B, T, S]
+    logits = jnp.where(mask[:, None, :, :], logits, jnp.float32(-1e30))
+    probs = jax.nn.softmax(logits, axis=-1)
+    attn = jnp.einsum("bhts,bshd->bthd", probs.astype(v_cache.dtype), v_cache)
+    attn = attn.reshape(B, T, D).astype(x.dtype)
+    attn = attn @ w["attn_out_w"] + w["attn_out_b"]
+    x = x + attn
+    h = layer_norm(x, w["ln2_scale"], w["ln2_bias"], cfg.layer_norm_eps)
+    h = h @ w["mlp_up_w"] + w["mlp_up_b"]
+    h = jax.nn.gelu(h, approximate=True)
+    h = h @ w["mlp_down_w"] + w["mlp_down_b"]
+    return x + h, k_cache, v_cache
+
+
+def forward_with_cache(cfg: GPTConfig, params, input_ids: jnp.ndarray, cache):
+    """Prefill or decode: run ``input_ids`` [B, T] through the model appending to
+    ``cache``; returns (logits [B, T, V], new_cache)."""
+    B, T = input_ids.shape
+    pos = cache["pos"]
+    x = jnp.take(params["wte"], input_ids, axis=0)
+    positions = pos + jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+    if not cfg.rotary:
+        x = x + jnp.take(params["wpe"], positions, axis=0)
+    x = x.astype(params["blocks"]["qkv_w"].dtype)
+    x = maybe_shard(x, P(BATCH, None, None))
+
+    def body(carry, layer_in):
+        x = carry
+        layer_w, k_c, v_c = layer_in
+        x, k_c, v_c = _block_with_cache(cfg, x, layer_w, k_c, v_c, pos)
+        return x, (k_c, v_c)
+
+    x, (new_k, new_v) = jax.lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
+    x = layer_norm(x, params["lnf_scale"], params["lnf_bias"], cfg.layer_norm_eps)
+    head = params["wte"] if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
+    return logits, {"k": new_k, "v": new_v, "pos": pos + T}
+
+
+def build(cfg_or_name) -> Tuple[Module, GPTConfig]:
+    """Build a GPT :class:`Module` from a config or preset name."""
+    cfg = PRESETS[cfg_or_name] if isinstance(cfg_or_name, str) else cfg_or_name
+
+    return Module(
+        init=functools.partial(init_params, cfg),
+        apply=lambda params, batch, rngs=None, train=True: loss_fn(
+            cfg, params, batch, rngs=rngs, train=train),
+        partition_specs=functools.partial(partition_specs, cfg),
+    ), cfg

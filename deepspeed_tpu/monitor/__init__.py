@@ -1,0 +1,3 @@
+from .monitor import CallbackMonitor, CSVMonitor, MonitorMaster, TensorBoardMonitor
+
+__all__ = ["MonitorMaster", "TensorBoardMonitor", "CSVMonitor", "CallbackMonitor"]

@@ -1,0 +1,85 @@
+"""Metrics monitor fan-out.
+
+Parity: reference ``monitor/monitor.py:24`` (``MonitorMaster``) with TensorBoard
+(``monitor/tensorboard.py:8``) and CSV (``monitor/csv_monitor.py``) backends.
+wandb has no parity backend here (package not in the image); a custom callback
+backend fills that slot.
+Events are ``(name, value, step)`` tuples, written only from process 0 — same
+rank-filtering the reference does.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+
+from ..utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+class TensorBoardMonitor:
+    def __init__(self, output_path: str, job_name: str = "DeepSpeedJobName"):
+        from tensorboardX import SummaryWriter
+
+        path = os.path.join(output_path or "runs", job_name)
+        os.makedirs(path, exist_ok=True)
+        self.writer = SummaryWriter(path)
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        for name, value, step in events:
+            self.writer.add_scalar(name, value, step)
+        self.writer.flush()
+
+
+class CSVMonitor:
+    def __init__(self, output_path: str, job_name: str = "DeepSpeedJobName"):
+        self.dir = os.path.join(output_path or "csv_out", job_name)
+        os.makedirs(self.dir, exist_ok=True)
+        self._files = {}
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        for name, value, step in events:
+            fname = os.path.join(self.dir, name.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", name])
+                w.writerow([step, value])
+
+
+class CallbackMonitor:
+    def __init__(self, fn: Callable[[Sequence[Event]], None]):
+        self.fn = fn
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        self.fn(events)
+
+
+class MonitorMaster:
+    """Fan-out to every enabled backend; only process 0 writes."""
+
+    def __init__(self, monitor_config, extra_backends: Optional[List] = None):
+        self.backends: List = list(extra_backends or [])
+        self.enabled = jax.process_index() == 0
+        if not self.enabled:
+            return
+        tb = monitor_config.tensorboard
+        if tb.enabled:
+            try:
+                self.backends.append(TensorBoardMonitor(tb.output_path, tb.job_name))
+            except Exception as e:  # tensorboardX missing/broken shouldn't kill training
+                logger.warning(f"tensorboard monitor disabled: {e}")
+        cs = monitor_config.csv_monitor
+        if cs.enabled:
+            self.backends.append(CSVMonitor(cs.output_path, cs.job_name))
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        if not self.enabled:
+            return
+        for b in self.backends:
+            b.write_events(events)

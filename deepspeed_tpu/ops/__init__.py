@@ -1,0 +1,3 @@
+from .optimizers import adagrad, fused_adam, fused_lamb, get_optimizer, sgd
+
+__all__ = ["fused_adam", "fused_lamb", "adagrad", "sgd", "get_optimizer"]

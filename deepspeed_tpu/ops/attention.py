@@ -1,0 +1,82 @@
+"""Attention ops.
+
+Capability parity with the reference's fused attention kernels
+(``csrc/transformer/softmax_kernels.cu``, ``csrc/transformer/inference/csrc/softmax.cu``):
+on TPU the fused path is (a) XLA's automatic fusion of the QK^T -> masked softmax -> V
+chain for moderate sequence lengths, and (b) a Pallas flash-attention kernel
+(:mod:`deepspeed_tpu.ops.pallas.flash_attention`) for long sequences where
+materializing the [T, T] score matrix would blow HBM. This module is the dispatch
+point; models call :func:`multihead_attention` and never pick a kernel themselves.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def causal_mask(q_len: int, kv_len: int, dtype=jnp.float32) -> jnp.ndarray:
+    i = jnp.arange(q_len)[:, None] + (kv_len - q_len)
+    j = jnp.arange(kv_len)[None, :]
+    return (j <= i)  # [q, kv] bool
+
+
+def dot_product_attention(
+    q: jnp.ndarray,  # [B, T, H, Dh]
+    k: jnp.ndarray,  # [B, S, H, Dh]
+    v: jnp.ndarray,  # [B, S, H, Dh]
+    causal: bool = True,
+    bias: Optional[jnp.ndarray] = None,
+    softmax_scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Reference (XLA-fused) attention. fp32 softmax accumulation regardless of the
+    input dtype — same numerics stance as the reference's fused softmax kernels."""
+    *_, q_len, _, head_dim = q.shape
+    kv_len = k.shape[1]
+    scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(head_dim)
+    logits = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias
+    if causal:
+        mask = causal_mask(q_len, kv_len)
+        logits = jnp.where(mask[None, None, :, :], logits, jnp.float32(-1e30))
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", probs.astype(v.dtype), v)
+    return out
+
+
+def multihead_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    bias: Optional[jnp.ndarray] = None,
+    use_flash: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Kernel dispatch: Pallas flash attention on TPU when eligible, XLA otherwise."""
+    if use_flash is None:
+        use_flash = _flash_eligible(q, k, bias)
+    if use_flash:
+        try:
+            from .pallas.flash_attention import flash_attention
+        except ImportError:
+            from ..utils.logging import warning_once
+
+            warning_once("pallas flash attention unavailable; using XLA attention")
+        else:
+            return flash_attention(q, k, v, causal=causal)
+    return dot_product_attention(q, k, v, causal=causal, bias=bias)
+
+
+def _flash_eligible(q, k, bias) -> bool:
+    if bias is not None:
+        return False
+    if jax.default_backend() not in ("tpu",):
+        return False
+    head_dim = q.shape[-1]
+    # MXU-friendly tiles only; fall back otherwise.
+    return head_dim % 128 == 0 and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0

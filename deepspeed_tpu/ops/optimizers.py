@@ -1,0 +1,264 @@
+"""Fused optimizers.
+
+Capability parity with the reference's native optimizer kernels:
+- ``FusedAdam``   (CUDA multi-tensor Adam, ``csrc/adam/multi_tensor_adam.cu``,
+  wrapper ``ops/adam/fused_adam.py:16``)
+- ``FusedLamb``   (``csrc/lamb/fused_lamb_cuda_kernel.cu``, ``ops/lamb/fused_lamb.py:16``)
+- ``Adagrad``     (``csrc/adagrad/cpu_adagrad.cpp``)
+- ``SGD`` / momentum.
+
+TPU-native design: the reference needs hand-written multi-tensor CUDA kernels because
+eager torch launches one kernel per tensor per op. Under ``jit`` the whole update is
+one XLA program — tree-wide elementwise math fuses into a handful of kernels across
+all parameters automatically, which *is* the multi-tensor-apply optimization. The
+update math below is written tree-at-once and dtype-explicit (state in fp32, params
+may be bf16 masters handled by the precision layer).
+
+Interface: ``init(params) -> state`` and
+``update(grads, state, params, lr) -> (new_params, new_state)`` with ``lr`` a traced
+scalar so LR schedules live inside the compiled step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Any
+Grads = Any
+State = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class Optimizer:
+    """A pure optimizer: pytree-in, pytree-out, safe to call inside jit.
+
+    ``state_spec(param_like, scalar_like)`` maps a per-param-leaf value tree (e.g.
+    shardings) + a scalar value into the optimizer-state structure, so the engine can
+    place ZeRO-sharded optimizer state without knowing each optimizer's layout.
+    """
+
+    init: Callable[[Params], State]
+    update: Callable[[Grads, State, Params, jnp.ndarray], Tuple[Params, State]]
+    state_spec: Callable[[Any, Any], Any] = None
+    name: str = "optimizer"
+
+
+class AdamState(NamedTuple):
+    count: jnp.ndarray
+    mu: Params
+    nu: Params
+
+
+def _tree_zeros_like(params, dtype=jnp.float32):
+    return jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, dtype), params)
+
+
+def fused_adam(
+    betas: Tuple[float, float] = (0.9, 0.999),
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    adam_w_mode: bool = True,
+    bias_correction: bool = True,
+) -> Optimizer:
+    """Adam/AdamW. Parity: ``ops/adam/fused_adam.py:16`` (FusedAdam)."""
+    b1, b2 = betas
+
+    def init(params):
+        return AdamState(count=jnp.zeros((), jnp.int32),
+                         mu=_tree_zeros_like(params), nu=_tree_zeros_like(params))
+
+    def update(grads, state, params, lr):
+        count = state.count + 1
+        cf = count.astype(jnp.float32)
+        if bias_correction:
+            bc1 = 1.0 - b1 ** cf
+            bc2 = 1.0 - b2 ** cf
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+
+        def upd(g, m, v, p):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if weight_decay and not adam_w_mode:  # L2-style
+                g = g + weight_decay * p32
+            m_new = b1 * m + (1.0 - b1) * g
+            v_new = b2 * v + (1.0 - b2) * (g * g)
+            denom = jnp.sqrt(v_new / bc2) + eps
+            step_ = (m_new / bc1) / denom
+            if weight_decay and adam_w_mode:  # decoupled
+                step_ = step_ + weight_decay * p32
+            return (p32 - lr * step_).astype(p.dtype), m_new, v_new
+
+        flat = jax.tree_util.tree_map(upd, grads, state.mu, state.nu, params)
+        new_params = jax.tree_util.tree_map(lambda t: t[0], flat,
+                                            is_leaf=lambda x: isinstance(x, tuple))
+        new_mu = jax.tree_util.tree_map(lambda t: t[1], flat,
+                                        is_leaf=lambda x: isinstance(x, tuple))
+        new_nu = jax.tree_util.tree_map(lambda t: t[2], flat,
+                                        is_leaf=lambda x: isinstance(x, tuple))
+        return new_params, AdamState(count=count, mu=new_mu, nu=new_nu)
+
+    return Optimizer(init=init, update=update, name="FusedAdam",
+                     state_spec=lambda per_param, scalar: AdamState(
+                         count=scalar, mu=per_param, nu=per_param))
+
+
+def fused_lamb(
+    betas: Tuple[float, float] = (0.9, 0.999),
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    max_coeff: float = 10.0,
+    min_coeff: float = 0.01,
+    bias_correction: bool = True,
+) -> Optimizer:
+    """LAMB with per-tensor trust ratio. Parity: ``ops/lamb/fused_lamb.py:16``."""
+    b1, b2 = betas
+
+    def init(params):
+        return AdamState(count=jnp.zeros((), jnp.int32),
+                         mu=_tree_zeros_like(params), nu=_tree_zeros_like(params))
+
+    def update(grads, state, params, lr):
+        count = state.count + 1
+        cf = count.astype(jnp.float32)
+        bc1 = 1.0 - b1 ** cf if bias_correction else jnp.float32(1.0)
+        bc2 = 1.0 - b2 ** cf if bias_correction else jnp.float32(1.0)
+
+        def upd(g, m, v, p):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            m_new = b1 * m + (1.0 - b1) * g
+            v_new = b2 * v + (1.0 - b2) * (g * g)
+            upd_ = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+            if weight_decay:
+                upd_ = upd_ + weight_decay * p32
+            # NOTE: per-tensor norms; with ZeRO-sharded tensors these are norms of the
+            # full logical tensor because jnp reductions see the global array.
+            w_norm = jnp.linalg.norm(p32)
+            u_norm = jnp.linalg.norm(upd_)
+            trust = jnp.where(
+                (w_norm > 0) & (u_norm > 0),
+                jnp.clip(w_norm / u_norm, min_coeff, max_coeff), 1.0)
+            return (p32 - lr * trust * upd_).astype(p.dtype), m_new, v_new
+
+        flat = jax.tree_util.tree_map(upd, grads, state.mu, state.nu, params)
+        is_tup = lambda x: isinstance(x, tuple)  # noqa: E731
+        return (jax.tree_util.tree_map(lambda t: t[0], flat, is_leaf=is_tup),
+                AdamState(count=count,
+                          mu=jax.tree_util.tree_map(lambda t: t[1], flat, is_leaf=is_tup),
+                          nu=jax.tree_util.tree_map(lambda t: t[2], flat, is_leaf=is_tup)))
+
+    return Optimizer(init=init, update=update, name="FusedLamb",
+                     state_spec=lambda per_param, scalar: AdamState(
+                         count=scalar, mu=per_param, nu=per_param))
+
+
+class AdagradState(NamedTuple):
+    count: jnp.ndarray
+    accum: Params
+
+
+def adagrad(eps: float = 1e-10, weight_decay: float = 0.0,
+            initial_accumulator_value: float = 0.0) -> Optimizer:
+    """Parity: ``ops/adagrad/cpu_adagrad.py`` (DeepSpeedCPUAdagrad math)."""
+
+    def init(params):
+        return AdagradState(
+            count=jnp.zeros((), jnp.int32),
+            accum=jax.tree_util.tree_map(
+                lambda p: jnp.full(p.shape, initial_accumulator_value, jnp.float32), params))
+
+    def update(grads, state, params, lr):
+        def upd(g, a, p):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if weight_decay:
+                g = g + weight_decay * p32
+            a_new = a + g * g
+            return (p32 - lr * g / (jnp.sqrt(a_new) + eps)).astype(p.dtype), a_new
+
+        flat = jax.tree_util.tree_map(upd, grads, state.accum, params)
+        is_tup = lambda x: isinstance(x, tuple)  # noqa: E731
+        return (jax.tree_util.tree_map(lambda t: t[0], flat, is_leaf=is_tup),
+                AdagradState(count=state.count + 1,
+                             accum=jax.tree_util.tree_map(lambda t: t[1], flat, is_leaf=is_tup)))
+
+    return Optimizer(init=init, update=update, name="Adagrad",
+                     state_spec=lambda per_param, scalar: AdagradState(
+                         count=scalar, accum=per_param))
+
+
+class SGDState(NamedTuple):
+    momentum: Optional[Params]
+
+
+def sgd(momentum: float = 0.0, weight_decay: float = 0.0, nesterov: bool = False) -> Optimizer:
+    def init(params):
+        return SGDState(momentum=_tree_zeros_like(params) if momentum else None)
+
+    def update(grads, state, params, lr):
+        def upd(g, m, p):
+            g = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            if weight_decay:
+                g = g + weight_decay * p32
+            if momentum:
+                m_new = momentum * m + g
+                g_eff = g + momentum * m_new if nesterov else m_new
+            else:
+                m_new, g_eff = m, g
+            return (p32 - lr * g_eff).astype(p.dtype), m_new
+
+        if momentum:
+            flat = jax.tree_util.tree_map(upd, grads, state.momentum, params)
+            is_tup = lambda x: isinstance(x, tuple)  # noqa: E731
+            return (jax.tree_util.tree_map(lambda t: t[0], flat, is_leaf=is_tup),
+                    SGDState(momentum=jax.tree_util.tree_map(lambda t: t[1], flat, is_leaf=is_tup)))
+        new_params = jax.tree_util.tree_map(
+            lambda g, p: (p.astype(jnp.float32) - lr * g.astype(jnp.float32)).astype(p.dtype),
+            grads, params)
+        return new_params, state
+
+    return Optimizer(init=init, update=update, name="SGD",
+                     state_spec=lambda per_param, scalar: SGDState(
+                         momentum=per_param if momentum else None))
+
+
+# --------------------------------------------------------------------------- registry
+def get_optimizer(name: str, params: Dict[str, Any]) -> Optimizer:
+    """Build an optimizer from a DeepSpeed ``"optimizer"`` config block.
+
+    Parity: ``runtime/engine.py:1315`` (_configure_basic_optimizer) name dispatch.
+    1-bit variants currently fall back to their dense counterparts (the
+    error-feedback compressed collective is a later milestone); the fallback warns.
+    """
+    from ..utils.logging import warning_once
+
+    name_l = name.lower()
+    lr_ignored = {k: v for k, v in params.items() if k != "lr"}
+    betas = tuple(lr_ignored.get("betas", (0.9, 0.999)))
+    eps = lr_ignored.get("eps", 1e-8)
+    wd = lr_ignored.get("weight_decay", 0.0)
+    if name_l in ("adam", "adamw", "fusedadam"):
+        return fused_adam(betas=betas, eps=eps, weight_decay=wd,
+                          adam_w_mode=(name_l != "adam") or lr_ignored.get("adam_w_mode", True),
+                          bias_correction=lr_ignored.get("bias_correction", True))
+    if name_l in ("onebitadam", "zerooneadam"):
+        warning_once(f"{name}: compressed collectives not yet enabled; using dense FusedAdam")
+        return fused_adam(betas=betas, eps=eps, weight_decay=wd)
+    if name_l in ("lamb", "fusedlamb", "onebitlamb"):
+        if name_l == "onebitlamb":
+            warning_once("OneBitLamb: compressed collectives not yet enabled; using dense LAMB")
+        return fused_lamb(betas=betas, eps=eps, weight_decay=wd,
+                          max_coeff=lr_ignored.get("max_coeff", 10.0),
+                          min_coeff=lr_ignored.get("min_coeff", 0.01))
+    if name_l == "adagrad":
+        return adagrad(eps=lr_ignored.get("eps", 1e-10), weight_decay=wd)
+    if name_l == "sgd":
+        return sgd(momentum=lr_ignored.get("momentum", 0.0), weight_decay=wd,
+                   nesterov=lr_ignored.get("nesterov", False))
+    raise ValueError(f"unknown optimizer type {name!r}")

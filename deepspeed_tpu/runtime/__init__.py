@@ -1,0 +1,4 @@
+from .config import DeepSpeedConfig
+from .topology import MeshTopology, ProcessTopology, get_topology, set_topology
+
+__all__ = ["DeepSpeedConfig", "MeshTopology", "ProcessTopology", "get_topology", "set_topology"]

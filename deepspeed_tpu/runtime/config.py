@@ -1,0 +1,315 @@
+"""DeepSpeed-JSON-compatible runtime configuration.
+
+Parity: reference ``runtime/config.py:702`` (``DeepSpeedConfig``) plus its satellite
+blocks — fp16/bf16 (``runtime/config.py``), zero (``runtime/zero/config.py``),
+monitor (``monitor/config.py``), comms logger (``comm/config.py``), flops profiler
+(``profiling/config.py``), activation checkpointing
+(``runtime/activation_checkpointing/checkpointing.py:830``), gradient clipping et al.
+
+A DeepSpeed JSON config (path or dict) parses unchanged; unknown keys warn rather
+than error. The batch-size triangle (train_batch = micro_batch x grad_accum x
+dp_world, ``runtime/config.py`` batch validation) is enforced/completed identically.
+
+TPU-specific additions live under the ``"mesh"`` key (tp/pp/ep/sp extents) — absent
+means pure data parallelism, which is what the reference defaults to as well.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from pydantic import Field
+
+from ..utils.logging import logger
+from .config_utils import DeepSpeedConfigModel
+from .zero.config import DeepSpeedZeroConfig, ZeroStageEnum
+
+TRAIN_BATCH_SIZE = "train_batch_size"
+TRAIN_MICRO_BATCH_SIZE_PER_GPU = "train_micro_batch_size_per_gpu"
+GRADIENT_ACCUMULATION_STEPS = "gradient_accumulation_steps"
+
+
+class FP16Config(DeepSpeedConfigModel):
+    """Parity: the ``"fp16"`` block (loss-scaling mixed precision)."""
+
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = 0.0  # 0 = dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = 1.0
+
+    @property
+    def dynamic_loss_scale(self) -> bool:
+        return self.loss_scale == 0.0
+
+
+class BF16Config(DeepSpeedConfigModel):
+    """Parity: the ``"bf16"`` block. The TPU-preferred precision mode."""
+
+    enabled: bool = False
+    # Keep a full-precision master copy + fp32 grad accumulation (reference
+    # BF16_Optimizer behavior, runtime/bf16_optimizer.py:38).
+    master_weights: bool = True
+
+
+class OptimizerConfig(DeepSpeedConfigModel):
+    """Parity: the ``"optimizer"`` block ({type, params})."""
+
+    type: str = "Adam"
+    params: Dict[str, Any] = Field(default_factory=dict)
+    legacy_fusion: bool = False
+
+
+class SchedulerConfig(DeepSpeedConfigModel):
+    """Parity: the ``"scheduler"`` block ({type, params})."""
+
+    type: str = "WarmupLR"
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+class CommsLoggerConfig(DeepSpeedConfigModel):
+    """Parity: ``comm/config.py`` (comms_logger block)."""
+
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: List[str] = Field(default_factory=list)
+
+
+class FlopsProfilerConfig(DeepSpeedConfigModel):
+    """Parity: ``profiling/config.py``."""
+
+    enabled: bool = False
+    recompute_fwd_factor: float = 0.0
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+class ActivationCheckpointingConfig(DeepSpeedConfigModel):
+    """Parity: ``runtime/activation_checkpointing/checkpointing.py:830`` (configure).
+
+    On TPU, recompute is ``jax.checkpoint`` policies; ``partition_activations`` maps
+    to sharding saved residuals over the tp/sp axes.
+    """
+
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+
+
+class TensorBoardConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class CSVConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+
+
+class MonitorConfig(DeepSpeedConfigModel):
+    """Parity: ``monitor/config.py`` (tensorboard/wandb/csv fan-out)."""
+
+    tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
+    csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
+
+    @property
+    def enabled(self) -> bool:
+        return self.tensorboard.enabled or self.csv_monitor.enabled
+
+
+class MeshTopologyConfig(DeepSpeedConfigModel):
+    """TPU-native block: requested mesh extents. dp=-1 means all remaining devices."""
+
+    dp: int = -1
+    tp: int = 1
+    pp: int = 1
+    ep: int = 1
+    sp: int = 1
+
+
+class PipelineConfig(DeepSpeedConfigModel):
+    """Parity: engine pipeline knobs (``runtime/pipe/module.py:86`` args)."""
+
+    stages: int = 1
+    partition_method: str = "parameters"
+    activation_checkpoint_interval: int = 0
+
+
+class EigenvalueConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    max_iter: int = 100
+    tol: float = 1e-2
+    stability: float = 1e-6
+    gas_boundary_resolution: int = 1
+    layer_name: str = "bert.encoder.layer"
+    layer_num: int = 0
+
+
+class DeepSpeedConfig(DeepSpeedConfigModel):
+    """Top-level config. Accepts a DeepSpeed JSON dict or file path via ``load``."""
+
+    # ---- batch triangle -------------------------------------------------------
+    train_batch_size: Optional[int] = None
+    train_micro_batch_size_per_gpu: Optional[int] = None
+    gradient_accumulation_steps: Optional[int] = None
+
+    # ---- core knobs -----------------------------------------------------------
+    steps_per_print: int = 10
+    wall_clock_breakdown: bool = False
+    dump_state: bool = False
+    prescale_gradients: bool = False
+    gradient_predivide_factor: float = 1.0
+    gradient_clipping: float = 0.0
+    sparse_gradients: bool = False
+    memory_breakdown: bool = False
+    disable_allgather: bool = False
+    communication_data_type: Optional[str] = None
+    seed: int = 1234
+
+    # ---- precision ------------------------------------------------------------
+    fp16: FP16Config = Field(default_factory=FP16Config)
+    bf16: BF16Config = Field(default_factory=BF16Config, alias="bf16")
+
+    # ---- subsystems -----------------------------------------------------------
+    optimizer: Optional[OptimizerConfig] = None
+    scheduler: Optional[SchedulerConfig] = None
+    zero_optimization: DeepSpeedZeroConfig = Field(default_factory=DeepSpeedZeroConfig)
+    activation_checkpointing: ActivationCheckpointingConfig = Field(
+        default_factory=ActivationCheckpointingConfig)
+    comms_logger: CommsLoggerConfig = Field(default_factory=CommsLoggerConfig)
+    flops_profiler: FlopsProfilerConfig = Field(default_factory=FlopsProfilerConfig)
+    monitor_config: MonitorConfig = Field(default_factory=MonitorConfig)
+    tensorboard: Optional[TensorBoardConfig] = None  # legacy top-level block
+    csv_monitor: Optional[CSVConfig] = None
+    eigenvalue: EigenvalueConfig = Field(default_factory=EigenvalueConfig)
+    pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
+    mesh: MeshTopologyConfig = Field(default_factory=MeshTopologyConfig)
+
+    # data efficiency / curriculum (parity: runtime/data_pipeline) — parsed, consumed
+    # by the data_pipeline module.
+    data_efficiency: Dict[str, Any] = Field(default_factory=dict)
+    curriculum_learning: Dict[str, Any] = Field(default_factory=dict)
+
+    # elasticity (parity: elasticity/config.py) — consumed by elasticity module.
+    elasticity: Dict[str, Any] = Field(default_factory=dict)
+    autotuning: Dict[str, Any] = Field(default_factory=dict)
+    compression_training: Dict[str, Any] = Field(default_factory=dict)
+    aio: Dict[str, Any] = Field(default_factory=dict)
+
+    zero_allow_untested_optimizer: bool = True
+    checkpoint: Dict[str, Any] = Field(default_factory=dict)
+    load_universal_checkpoint: bool = False
+
+    # ------------------------------------------------------------------ loading
+    @classmethod
+    def load(
+        cls,
+        config: Union[str, Dict[str, Any], None],
+        world_size: int = 1,
+    ) -> "DeepSpeedConfig":
+        if config is None:
+            config = {}
+        if isinstance(config, (str, os.PathLike)):
+            with open(config, "r") as f:
+                config = json.load(f)
+        if not isinstance(config, dict):
+            raise TypeError(f"config must be a dict or path, got {type(config)}")
+        # The batch triangle counts *data-parallel* replicas, not devices: divide the
+        # device count by the model-parallel extents (tp/pp/sp; ep is data-carrying).
+        # Parity: the reference divides world_size by mpu model-parallel size.
+        mesh = config.get("mesh", {}) or {}
+        mp = (int(mesh.get("tp", 1)) * int(mesh.get("pp", 1)) * int(mesh.get("sp", 1)))
+        if mp > 1:
+            if world_size % mp != 0:
+                raise ValueError(
+                    f"device count {world_size} not divisible by tp*pp*sp={mp}")
+            world_size = world_size // mp
+        known = set()
+        for name, field in cls.model_fields.items():
+            known.add(field.alias or name)
+            known.add(name)
+        for key in config:
+            if key not in known:
+                logger.warning(f"DeepSpeedConfig: ignoring unrecognized key {key!r}")
+        self = cls(**config)
+        self._resolve_batch(world_size)
+        self._validate(world_size)
+        return self
+
+    # The reference's batch triangle (train = micro * gas * dp_world) — fill any one
+    # missing vertex, default gas=1.
+    def _resolve_batch(self, world_size: int) -> None:
+        train, micro, gas = (
+            self.train_batch_size,
+            self.train_micro_batch_size_per_gpu,
+            self.gradient_accumulation_steps,
+        )
+        if train is not None and micro is not None and gas is None:
+            gas = train // (micro * world_size)
+        elif train is not None and micro is None and gas is not None:
+            micro = train // (gas * world_size)
+        elif train is not None and micro is None and gas is None:
+            gas = 1
+            micro = train // world_size
+        elif train is None and micro is not None:
+            gas = gas or 1
+            train = micro * gas * world_size
+        elif train is None and micro is None:
+            # only gas (or nothing) specified — micro defaults to 1, keep user's gas
+            micro = 1
+            gas = gas or 1
+            train = micro * gas * world_size
+        self.train_batch_size = train
+        self.train_micro_batch_size_per_gpu = micro
+        self.gradient_accumulation_steps = gas
+
+    def _validate(self, world_size: int) -> None:
+        train = self.train_batch_size
+        micro = self.train_micro_batch_size_per_gpu
+        gas = self.gradient_accumulation_steps
+        if train != micro * gas * world_size:
+            raise ValueError(
+                f"batch triangle violated: train_batch_size={train} != "
+                f"micro({micro}) * gas({gas}) * world({world_size})")
+        if self.fp16.enabled and self.bf16.enabled:
+            raise ValueError("fp16 and bf16 cannot both be enabled")
+        if self.zero_optimization.stage > ZeroStageEnum.disabled and not (
+            self.fp16.enabled or self.bf16.enabled
+        ):
+            # The reference requires fp16 for ZeRO; on TPU bf16 is the norm. Pure
+            # fp32 ZeRO is allowed but unusual — warn, don't fail.
+            logger.warning("ZeRO enabled without fp16/bf16: running fp32 sharded training")
+
+    # ------------------------------------------------------------------ helpers
+    @property
+    def zero_enabled(self) -> bool:
+        return self.zero_optimization.stage > ZeroStageEnum.disabled
+
+    @property
+    def monitor(self) -> MonitorConfig:
+        # merge legacy top-level tensorboard/csv blocks
+        mc = self.monitor_config
+        if self.tensorboard is not None and self.tensorboard.enabled:
+            mc = MonitorConfig(tensorboard=self.tensorboard, csv_monitor=mc.csv_monitor)
+        if self.csv_monitor is not None and self.csv_monitor.enabled:
+            mc = MonitorConfig(tensorboard=mc.tensorboard, csv_monitor=self.csv_monitor)
+        return mc
+
+    def print_config(self) -> None:
+        logger.info(json.dumps(self.model_dump(mode="json"), indent=2, default=str))

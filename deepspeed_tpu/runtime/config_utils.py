@@ -1,0 +1,57 @@
+"""Config plumbing shared by every subsystem config.
+
+Parity: reference ``runtime/config_utils.py:16`` (``DeepSpeedConfigModel`` — a pydantic
+base with deprecated-field migration) — rebuilt on pydantic v2.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from pydantic import BaseModel, ConfigDict, model_validator
+
+from ..utils.logging import logger
+
+
+class DeepSpeedConfigModel(BaseModel):
+    """Pydantic base for all config blocks.
+
+    Supports the reference's deprecated-field pattern: declare a field with
+    ``json_schema_extra={"deprecated": True, "new_param": "other_field"}`` and a value
+    assigned to it is migrated (with a warning) to the replacement field.
+    """
+
+    model_config = ConfigDict(
+        extra="ignore",
+        populate_by_name=True,
+        validate_assignment=True,
+        arbitrary_types_allowed=True,
+        protected_namespaces=(),
+    )
+
+    @model_validator(mode="before")
+    @classmethod
+    def _migrate_deprecated(cls, values: Any) -> Any:
+        if not isinstance(values, dict):
+            return values
+        for name, field in cls.model_fields.items():
+            extra = field.json_schema_extra or {}
+            if not isinstance(extra, dict) or not extra.get("deprecated"):
+                continue
+            key = field.alias or name
+            if key in values and values[key] is not None:
+                new_param = extra.get("new_param")
+                if new_param and new_param not in values:
+                    logger.warning(
+                        f"Config field '{key}' is deprecated; use '{new_param}'")
+                    values[new_param] = values[key]
+        return values
+
+    def dict(self, **kwargs) -> Dict[str, Any]:  # pydantic-v1-style alias
+        return self.model_dump(**kwargs)
+
+
+def get_scalar_param(d: Dict, key: str, default):
+    """Parity: the reference's ~90 legacy getter helpers (``runtime/config.py:93-632``)
+    collapse to this one function."""
+    return d.get(key, default)

@@ -1,0 +1,110 @@
+"""Mixed precision: bf16 master-weight training and fp16 dynamic loss scaling.
+
+Capability parity:
+- ``runtime/bf16_optimizer.py:38`` (``BF16_Optimizer``): bf16 params for compute,
+  fp32 master copy + fp32 grad accumulation for the update. Here the master copy is
+  part of the train state; the precision policy decides dtypes and the engine wires
+  the cast points into the jitted step.
+- ``runtime/fp16/loss_scaler.py:54,77`` (``LossScaler``/``DynamicLossScaler``): the
+  scaler is a tiny pure state machine (scale, good-step counter) evolved with
+  ``lax.cond`` inside the compiled step — overflow skips the update exactly like the
+  reference's ``step`` overflow path (``runtime/fp16/fused_optimizer.py``).
+
+On TPU, bf16 is the native fast dtype and needs no loss scaling; fp16 is supported
+for config compatibility.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionConfig:
+    """Resolved precision mode for the engine."""
+
+    compute_dtype: Any  # dtype params are stored/computed in (bf16/fp16/fp32)
+    master_weights: bool  # keep an fp32 master copy in the optimizer state
+    loss_scaling: bool  # fp16-style dynamic loss scaling
+    initial_scale: float = 2.0 ** 16
+    scale_window: int = 1000
+    hysteresis: int = 2
+    min_scale: float = 1.0
+    static_scale: Optional[float] = None
+
+    @classmethod
+    def from_ds_config(cls, cfg) -> "PrecisionConfig":
+        if cfg.bf16.enabled:
+            return cls(compute_dtype=jnp.bfloat16, master_weights=cfg.bf16.master_weights,
+                       loss_scaling=False)
+        if cfg.fp16.enabled:
+            return cls(
+                compute_dtype=jnp.float16, master_weights=True,
+                loss_scaling=True,  # static or dynamic, fp16 always scales + overflow-skips
+                initial_scale=2.0 ** cfg.fp16.initial_scale_power,
+                scale_window=cfg.fp16.loss_scale_window,
+                hysteresis=cfg.fp16.hysteresis,
+                min_scale=cfg.fp16.min_loss_scale,
+                static_scale=None if cfg.fp16.dynamic_loss_scale else cfg.fp16.loss_scale)
+        return cls(compute_dtype=jnp.float32, master_weights=False, loss_scaling=False)
+
+
+class ScalerState(NamedTuple):
+    scale: jnp.ndarray  # f32 scalar
+    good_steps: jnp.ndarray  # i32 consecutive non-overflow steps
+    hysteresis: jnp.ndarray  # i32 remaining tolerated overflows before scale cut
+
+
+def init_scaler_state(pc: PrecisionConfig) -> ScalerState:
+    scale = pc.static_scale if pc.static_scale else pc.initial_scale
+    return ScalerState(scale=jnp.asarray(scale, jnp.float32),
+                       good_steps=jnp.zeros((), jnp.int32),
+                       hysteresis=jnp.asarray(pc.hysteresis, jnp.int32))
+
+
+def grads_finite(grads) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(grads)
+    return jnp.all(jnp.stack([jnp.all(jnp.isfinite(g)) for g in leaves]))
+
+
+def update_scaler(pc: PrecisionConfig, state: ScalerState, finite: jnp.ndarray) -> ScalerState:
+    """Dynamic loss-scale evolution. Parity: ``runtime/fp16/loss_scaler.py:77``.
+
+    With a static scale (``LossScaler``, ``loss_scaler.py:54``) the scale never
+    moves; overflow steps are still skipped by the engine."""
+    if not pc.loss_scaling or pc.static_scale is not None:
+        return state
+
+    def on_good(s: ScalerState) -> ScalerState:
+        grown = s.good_steps + 1 >= pc.scale_window
+        new_scale = jnp.where(grown, s.scale * 2.0, s.scale)
+        new_good = jnp.where(grown, 0, s.good_steps + 1)
+        return ScalerState(scale=new_scale, good_steps=new_good,
+                           hysteresis=jnp.asarray(pc.hysteresis, jnp.int32))
+
+    def on_overflow(s: ScalerState) -> ScalerState:
+        cut = s.hysteresis <= 1
+        new_scale = jnp.where(cut, jnp.maximum(s.scale / 2.0, pc.min_scale), s.scale)
+        return ScalerState(scale=new_scale, good_steps=jnp.zeros((), jnp.int32),
+                           hysteresis=jnp.maximum(s.hysteresis - 1, 0))
+
+    return jax.lax.cond(finite, on_good, on_overflow, state)
+
+
+def cast_to_compute(params, pc: PrecisionConfig):
+    return jax.tree_util.tree_map(
+        lambda p: p.astype(pc.compute_dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p,
+        params)
+
+
+def make_master(params, pc: PrecisionConfig):
+    """fp32 master copy (or None when params are already full precision)."""
+    if not pc.master_weights:
+        return None
+    return jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.float32) if jnp.issubdtype(p.dtype, jnp.floating) else p,
+        params)

@@ -1,0 +1,51 @@
+"""Engine math helpers.
+
+Parity: reference ``runtime/utils.py`` — ``clip_grad_norm_``/``get_global_norm``
+(mpu-aware global grad norm + clipping), ``see_memory_usage``. In JAX the "mpu
+awareness" (avoiding double-counting tensor-parallel shards) is automatic: reductions
+over sharded arrays see the global logical array, so a tree-wide norm is exact under
+any sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..accelerator import get_accelerator
+from ..utils.logging import log_dist
+
+
+def global_norm(tree: Any) -> jnp.ndarray:
+    """L2 norm over every leaf of a pytree (fp32 accumulation)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves))
+
+
+def clip_by_global_norm(tree: Any, max_norm: float,
+                        norm: Optional[jnp.ndarray] = None) -> Tuple[Any, jnp.ndarray]:
+    """Parity: ``runtime/utils.py`` clip_grad_norm_. Returns (clipped, pre-clip norm)."""
+    norm = norm if norm is not None else global_norm(tree)
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    clipped = jax.tree_util.tree_map(lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), tree)
+    return clipped, norm
+
+
+def count_parameters(params: Any) -> int:
+    import numpy as np
+
+    return int(sum(np.prod(l.shape) for l in jax.tree_util.tree_leaves(params)))
+
+
+def see_memory_usage(message: str, force: bool = False) -> None:
+    """Parity: ``runtime/utils.py`` see_memory_usage (device HBM breadcrumbs)."""
+    if not force:
+        return
+    stats = get_accelerator().memory_stats()
+    in_use = stats.get("bytes_in_use", 0) / 2**30
+    limit = stats.get("bytes_limit", 0) / 2**30
+    log_dist(f"{message} | HBM in use: {in_use:.2f} GB / {limit:.2f} GB")

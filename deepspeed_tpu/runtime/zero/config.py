@@ -1,0 +1,103 @@
+"""ZeRO configuration.
+
+Parity: reference ``runtime/zero/config.py:78`` (``DeepSpeedZeroConfig``),
+``runtime/zero/offload_config.py`` (offload sub-configs). The JSON schema is the
+DeepSpeed ``"zero_optimization"`` block, so existing DeepSpeed configs parse
+unchanged. Knobs that only exist to schedule CUDA streams (``overlap_comm``,
+bucket sizes) are accepted and recorded — on TPU, XLA's static schedule already
+overlaps collectives, so they inform the partitioning policy rather than stream
+management.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Optional
+
+from pydantic import Field
+
+from ..config_utils import DeepSpeedConfigModel
+
+
+class ZeroStageEnum(int, Enum):
+    """Parity: ``runtime/zero/config.py:69``."""
+
+    disabled = 0
+    optimizer_states = 1
+    gradients = 2
+    weights = 3
+    max_stage = 3
+
+
+class OffloadDeviceEnum(str, Enum):
+    none = "none"
+    cpu = "cpu"
+    nvme = "nvme"
+
+
+class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
+    """Parity: ``runtime/zero/offload_config.py`` (param offload)."""
+
+    device: OffloadDeviceEnum = OffloadDeviceEnum.none
+    nvme_path: Optional[str] = None
+    buffer_count: int = Field(5, ge=0)
+    buffer_size: int = Field(int(1e8), ge=0)
+    max_in_cpu: int = Field(int(1e9), ge=0)
+    pin_memory: bool = False
+
+
+class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
+    """Parity: ``runtime/zero/offload_config.py`` (optimizer offload)."""
+
+    device: OffloadDeviceEnum = OffloadDeviceEnum.none
+    nvme_path: Optional[str] = None
+    buffer_count: int = Field(4, ge=0)
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+
+
+class DeepSpeedZeroConfig(DeepSpeedConfigModel):
+    """The ``"zero_optimization"`` JSON block."""
+
+    stage: ZeroStageEnum = ZeroStageEnum.disabled
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = Field(int(5e8), ge=0)
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = Field(int(5e8), ge=0)
+    overlap_comm: Optional[bool] = None
+    load_from_fp32_weights: bool = True
+    elastic_checkpoint: bool = False
+    # legacy flat key — migrated into offload_optimizer in model_post_init (not a
+    # straight rename: bool -> sub-config)
+    cpu_offload: Optional[bool] = None
+    offload_param: Optional[DeepSpeedZeroOffloadParamConfig] = None
+    offload_optimizer: Optional[DeepSpeedZeroOffloadOptimizerConfig] = None
+    sub_group_size: int = Field(int(1e9), ge=0)
+    stage3_max_live_parameters: int = Field(int(1e9), ge=0)
+    stage3_max_reuse_distance: int = Field(int(1e9), ge=0)
+    stage3_prefetch_bucket_size: int = Field(int(5e7), ge=0)
+    stage3_param_persistence_threshold: int = Field(int(1e5), ge=0)
+    stage3_gather_16bit_weights_on_model_save: bool = False
+    ignore_unused_parameters: bool = True
+    round_robin_gradients: bool = False
+    zero_hpz_partition_size: int = Field(1, ge=0)
+    zero_quantized_weights: bool = False
+    zero_quantized_gradients: bool = False
+
+    def model_post_init(self, __context) -> None:
+        # legacy cpu_offload=true means offload_optimizer={"device": "cpu"}
+        if self.cpu_offload and self.offload_optimizer is None:
+            object.__setattr__(
+                self, "offload_optimizer",
+                DeepSpeedZeroOffloadOptimizerConfig(device=OffloadDeviceEnum.cpu))
+
+    @property
+    def offload_optimizer_device(self) -> str:
+        return self.offload_optimizer.device.value if self.offload_optimizer else "none"
+
+    @property
+    def offload_param_device(self) -> str:
+        return self.offload_param.device.value if self.offload_param else "none"

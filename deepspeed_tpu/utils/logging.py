@@ -1,0 +1,56 @@
+"""Rank-filtered logging.
+
+Capability parity with the reference's ``deepspeed/utils/logging.py`` (``log_dist``,
+``logger``): a process-wide logger plus helpers that only emit on selected ranks so
+multi-host TPU jobs don't produce world_size copies of every line.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Iterable, Optional
+
+_LOG_FORMAT = "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s"
+
+
+def _create_logger(name: str = "deepspeed_tpu", level: int = logging.INFO) -> logging.Logger:
+    lg = logging.getLogger(name)
+    if not lg.handlers:
+        lg.setLevel(level)
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setFormatter(logging.Formatter(_LOG_FORMAT))
+        lg.addHandler(handler)
+        lg.propagate = False
+    return lg
+
+
+logger = _create_logger()
+
+
+def _process_index() -> int:
+    # Lazy: jax.process_index() requires jax to be initialised; fall back to env.
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return int(os.environ.get("RANK", "0"))
+
+
+def log_dist(message: str, ranks: Optional[Iterable[int]] = None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the given process ranks (default: rank 0 only).
+
+    ``ranks=[-1]`` logs on every process.
+    """
+    ranks = list(ranks) if ranks is not None else [0]
+    me = _process_index()
+    if -1 in ranks or me in ranks:
+        logger.log(level, message)
+
+
+def warning_once(message: str, _seen=set()) -> None:  # noqa: B006 - intentional cache
+    if message not in _seen:
+        _seen.add(message)
+        logger.warning(message)

@@ -1,0 +1,127 @@
+"""Wall-clock and throughput timers.
+
+Parity: reference ``utils/timer.py:33`` (``SynchronizedWallClockTimer``) and ``:137``
+(``ThroughputTimer``). CUDA events become ``jax.block_until_ready`` fences: on TPU the
+only way to get honest wall-clock numbers through async dispatch is to synchronize at
+the timer boundary, so ``stop()`` optionally blocks on a supplied array.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+from .logging import log_dist
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self.elapsed_ = 0.0
+        self.started_ = False
+        self.start_time = 0.0
+        self.count = 0
+
+    def start(self) -> None:
+        assert not self.started_, f"timer {self.name} already started"
+        self.start_time = time.perf_counter()
+        self.started_ = True
+
+    def stop(self, sync_on=None) -> None:
+        assert self.started_, f"timer {self.name} not started"
+        if sync_on is not None:
+            jax.block_until_ready(sync_on)
+        self.elapsed_ += time.perf_counter() - self.start_time
+        self.count += 1
+        self.started_ = False
+
+    def reset(self) -> None:
+        self.elapsed_ = 0.0
+        self.count = 0
+        self.started_ = False
+
+    def elapsed(self, reset: bool = True) -> float:
+        e = self.elapsed_
+        if reset:
+            self.reset()
+        return e
+
+    def mean(self) -> float:
+        return self.elapsed_ / max(self.count, 1)
+
+
+class SynchronizedWallClockTimer:
+    """Named-timer registry. Parity: ``utils/timer.py:33``."""
+
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def has(self, name: str) -> bool:
+        return name in self.timers
+
+    def log(self, names: Optional[List[str]] = None, reset: bool = True, memory_breakdown=False) -> str:
+        names = names or list(self.timers)
+        parts = []
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset=reset) * 1000.0
+                parts.append(f"{name}: {ms:.2f}ms")
+        msg = "time (ms) | " + " | ".join(parts)
+        log_dist(msg)
+        return msg
+
+
+class ThroughputTimer:
+    """Samples/sec + tokens/sec accounting across steps. Parity: ``utils/timer.py:137``."""
+
+    def __init__(self, batch_size: int, start_step: int = 2, steps_per_output: int = 50,
+                 monitor_memory: bool = False, logging_fn=None):
+        self.batch_size = max(batch_size, 1)
+        self.start_step = start_step
+        self.steps_per_output = steps_per_output if steps_per_output else 0
+        self.logging = logging_fn or log_dist
+        self.epoch_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self.started = False
+        self.start_time = 0.0
+
+    def update_epoch_count(self) -> None:
+        self.epoch_count += 1
+
+    def start(self) -> None:
+        self.started = True
+        self.start_time = time.perf_counter()
+
+    def stop(self, global_step: bool = True, report_speed: bool = True, sync_on=None) -> None:
+        if not self.started:
+            return
+        self.started = False
+        if sync_on is not None:
+            jax.block_until_ready(sync_on)
+        duration = time.perf_counter() - self.start_time
+        if global_step:
+            self.global_step_count += 1
+            if self.global_step_count >= self.start_step:
+                self.total_elapsed_time += duration
+                self.step_elapsed_time += duration
+                if (report_speed and self.steps_per_output
+                        and self.global_step_count % self.steps_per_output == 0):
+                    self.logging(
+                        f"epoch={self.epoch_count}/micro_step={self.global_step_count} "
+                        f"samples/sec={self.avg_samples_per_sec():.2f}")
+                    self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self) -> float:
+        counted = max(self.global_step_count - self.start_step + 1, 1)
+        if self.total_elapsed_time == 0:
+            return 0.0
+        return self.batch_size / (self.total_elapsed_time / counted)

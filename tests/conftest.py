@@ -1,0 +1,44 @@
+"""Test harness: simulated 8-device CPU mesh.
+
+The reference's trick (SURVEY.md §4) is ``DistributedTest`` spawning N real processes
+over NCCL on one box. The TPU-native equivalent is *simpler*: JAX can present N
+virtual CPU devices in a single process (``xla_force_host_platform_device_count``),
+so every sharding/collective path compiles and runs exactly as it would on an N-chip
+mesh — no process spawning, no fake backends. These env vars MUST be set before jax
+is imported anywhere in the test process.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["DS_TPU_ACCELERATOR"] = "cpu"
+
+import jax  # noqa: E402
+
+# The image's sitecustomize imports jax at interpreter start (latching
+# JAX_PLATFORMS from the outer env), so the env var alone is too late — force the
+# platform through the config as well, before any backend is initialized.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 simulated devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)
+
+
+def random_batch(rng, batch_size: int, seq_len: int, vocab: int = 256, gas: int = 1):
+    shape = (batch_size, seq_len) if gas == 1 else (gas, batch_size, seq_len)
+    return {"input_ids": rng.integers(0, vocab, size=shape, dtype=np.int32)}

@@ -1,0 +1,82 @@
+"""Checkpoint round-trip tests. Parity model: tests/unit/checkpoint/ in the
+reference — bitwise state match after save/load, topology-change reload."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPTConfig, build_gpt
+
+TINY = GPTConfig(vocab_size=256, n_layer=2, n_head=4, d_model=64, max_seq_len=64)
+
+
+def make_engine(stage, tmp_seed=0, mesh=None):
+    model, _ = build_gpt(TINY)
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": stage},
+        "steps_per_print": 0,
+    }
+    if mesh:
+        cfg["mesh"] = mesh
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    return engine
+
+
+def batch(seed=0, n=16):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, 256, size=(n, 32), dtype=np.int32)}
+
+
+def tree_equal(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_roundtrip_bitwise(tmp_path, devices):
+    e = make_engine(stage=2)
+    for i in range(3):
+        e.train_batch(batch(i))
+    e.save_checkpoint(str(tmp_path), client_state={"note": "hi"})
+
+    e2 = make_engine(stage=2)
+    path, client = e2.load_checkpoint(str(tmp_path))
+    assert path is not None and client == {"note": "hi"}
+    tree_equal(e.state["params"], e2.state["params"])
+    tree_equal(e.state["opt"], e2.state["opt"])
+    assert int(e2.state["step"]) == 3
+
+    # training continues identically from the restore point
+    m1 = e.train_batch(batch(99))
+    m2 = e2.train_batch(batch(99))
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-6)
+
+
+def test_topology_free_reload(tmp_path, devices):
+    """A checkpoint from a stage-3 sharded engine loads into a stage-0 engine
+    (the reference needs the universal-checkpoint converter for this)."""
+    e3 = make_engine(stage=3)
+    e3.train_batch(batch(0))
+    e3.save_checkpoint(str(tmp_path))
+
+    e0 = make_engine(stage=0)
+    e0.load_checkpoint(str(tmp_path))
+    tree_equal(e3.state["params"], e0.state["params"])
+    # and into a tp=2 mesh
+    etp = make_engine(stage=0, mesh={"tp": 2})
+    etp.load_checkpoint(str(tmp_path))
+    tree_equal(e3.state["params"], etp.state["params"])
+
+
+def test_latest_tag_and_missing(tmp_path, devices):
+    e = make_engine(stage=1)
+    e.train_batch(batch(0))
+    e.save_checkpoint(str(tmp_path), tag="my_tag")
+    assert (tmp_path / "latest").read_text() == "my_tag"
+    path, _ = e.load_checkpoint(str(tmp_path))
+    assert path.endswith("my_tag")
+    path, client = e.load_checkpoint(str(tmp_path / "nonexistent"))
+    assert path is None

@@ -1,0 +1,86 @@
+"""Config-system tests: DeepSpeed JSON compatibility + batch triangle."""
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.zero.config import ZeroStageEnum
+
+
+def test_batch_triangle_completion():
+    c = DeepSpeedConfig.load({"train_batch_size": 32}, world_size=8)
+    assert c.train_micro_batch_size_per_gpu == 4
+    assert c.gradient_accumulation_steps == 1
+
+    c = DeepSpeedConfig.load(
+        {"train_batch_size": 64, "train_micro_batch_size_per_gpu": 2}, world_size=8)
+    assert c.gradient_accumulation_steps == 4
+
+    c = DeepSpeedConfig.load(
+        {"train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 4},
+        world_size=8)
+    assert c.train_batch_size == 64
+
+
+def test_batch_triangle_violation():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig.load(
+            {"train_batch_size": 100, "train_micro_batch_size_per_gpu": 3,
+             "gradient_accumulation_steps": 7}, world_size=8)
+
+
+def test_deepspeed_json_parses():
+    """A realistic DeepSpeed config from the wild parses unchanged."""
+    ds_json = {
+        "train_batch_size": 16,
+        "steps_per_print": 2000,
+        "optimizer": {
+            "type": "Adam",
+            "params": {"lr": 0.001, "betas": [0.8, 0.999], "eps": 1e-8,
+                       "weight_decay": 3e-7},
+        },
+        "scheduler": {
+            "type": "WarmupLR",
+            "params": {"warmup_min_lr": 0, "warmup_max_lr": 0.001,
+                       "warmup_num_steps": 1000},
+        },
+        "gradient_clipping": 1.0,
+        "prescale_gradients": False,
+        "fp16": {"enabled": True, "loss_scale": 0, "loss_scale_window": 500,
+                 "hysteresis": 2, "min_loss_scale": 1, "initial_scale_power": 15},
+        "zero_optimization": {
+            "stage": 2,
+            "allgather_partitions": True,
+            "allgather_bucket_size": 2.5e8,
+            "overlap_comm": True,
+            "reduce_scatter": True,
+            "reduce_bucket_size": 5e8,
+            "contiguous_gradients": True,
+            "cpu_offload": False,
+        },
+        "wall_clock_breakdown": False,
+    }
+    c = DeepSpeedConfig.load(ds_json, world_size=8)
+    assert c.zero_optimization.stage == ZeroStageEnum.gradients
+    assert c.fp16.enabled and c.fp16.dynamic_loss_scale
+    assert c.fp16.initial_scale_power == 15
+    assert c.optimizer.params["betas"] == [0.8, 0.999]
+    assert c.scheduler.type == "WarmupLR"
+
+
+def test_legacy_cpu_offload_migration():
+    c = DeepSpeedConfig.load(
+        {"train_batch_size": 8,
+         "zero_optimization": {"stage": 2, "cpu_offload": True}}, world_size=8)
+    assert c.zero_optimization.offload_optimizer_device == "cpu"
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig.load(
+            {"train_batch_size": 8, "fp16": {"enabled": True},
+             "bf16": {"enabled": True}}, world_size=8)
+
+
+def test_unknown_key_warns_not_fails():
+    c = DeepSpeedConfig.load({"train_batch_size": 8, "bogus_key": 1}, world_size=8)
+    assert c.train_batch_size == 8

@@ -1,0 +1,60 @@
+"""Dataloader determinism/sharding + precision edge cases from review findings."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader, RepeatingLoader
+from deepspeed_tpu.runtime.precision import PrecisionConfig
+
+
+def test_dataloader_rank_sharding():
+    data = [{"x": np.array([i])} for i in range(32)]
+    seen = []
+    for rank in range(4):
+        dl = DeepSpeedDataLoader(data, batch_size=2, shuffle=True, seed=7,
+                                 num_replicas=4, rank=rank)
+        for b in dl:
+            seen.extend(b["x"].ravel().tolist())
+    assert sorted(seen) == list(range(32))  # disjoint cover
+
+
+def test_dataloader_deterministic():
+    data = [np.array([i]) for i in range(16)]
+    a = [b.tolist() for b in DeepSpeedDataLoader(data, 4, seed=3, num_replicas=1, rank=0)]
+    b = [b.tolist() for b in DeepSpeedDataLoader(data, 4, seed=3, num_replicas=1, rank=0)]
+    assert a == b
+
+
+def test_repeating_loader():
+    data = [np.array([i]) for i in range(8)]
+    dl = RepeatingLoader(DeepSpeedDataLoader(data, 4, shuffle=False, num_replicas=1, rank=0))
+    got = [next(dl) for _ in range(5)]  # 2 batches/epoch -> wraps twice
+    assert len(got) == 5
+
+
+def test_fp16_static_scale_still_scales():
+    """Review finding: static loss_scale must still scale + overflow-skip."""
+    cfg = DeepSpeedConfig.load(
+        {"train_batch_size": 8, "fp16": {"enabled": True, "loss_scale": 4096}},
+        world_size=8)
+    pc = PrecisionConfig.from_ds_config(cfg)
+    assert pc.loss_scaling is True
+    assert pc.static_scale == 4096
+
+
+def test_gas_only_config_respected():
+    """Review finding: gradient_accumulation_steps alone must be honored."""
+    c = DeepSpeedConfig.load({"gradient_accumulation_steps": 8}, world_size=4)
+    assert c.gradient_accumulation_steps == 8
+    assert c.train_micro_batch_size_per_gpu == 1
+    assert c.train_batch_size == 32
+
+
+def test_batch_triangle_uses_dp_extent():
+    """Review finding: with tp=2 on 8 devices, dp extent is 4."""
+    c = DeepSpeedConfig.load(
+        {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4,
+         "mesh": {"tp": 2}}, world_size=8)
+    assert c.gradient_accumulation_steps == 2  # 32 = 4 * 2 * 4

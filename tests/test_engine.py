@@ -1,0 +1,147 @@
+"""End-to-end engine tests on the simulated 8-device mesh.
+
+Mirrors the reference's test discipline (SURVEY.md §4): assert *mechanics* — losses
+decrease, ZeRO stages agree with each other, fwd/bwd/step API matches train_batch —
+on small fixture models, not convergence.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPTConfig, build_gpt
+
+TINY = GPTConfig(vocab_size=256, n_layer=2, n_head=4, d_model=64, max_seq_len=64)
+
+
+def base_config(stage=0, gas=1, micro=4, **over):
+    cfg = {
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": stage},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 0,
+    }
+    cfg.update(over)
+    return cfg
+
+
+def make_batch(seed, micro, seq=32, gas=1, world=8):
+    rng = np.random.default_rng(seed)
+    n = micro * world
+    shape = (n, seq) if gas == 1 else (gas, n, seq)
+    return {"input_ids": rng.integers(0, 256, size=shape, dtype=np.int32)}
+
+
+def make_engine(stage=0, gas=1, micro=4, **over):
+    model, _ = build_gpt(TINY)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config=base_config(stage=stage, gas=gas, micro=micro, **over))
+    return engine
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_train_batch_loss_decreases(stage, devices):
+    engine = make_engine(stage=stage)
+    losses = []
+    for i in range(8):
+        m = engine.train_batch(make_batch(i % 2, 4))
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(losses).all()
+
+
+def test_zero_stages_agree(devices):
+    """ZeRO is an exact re-layout: every stage must produce identical losses."""
+    traces = {}
+    for stage in [0, 1, 2, 3]:
+        engine = make_engine(stage=stage)
+        losses = []
+        for i in range(4):
+            m = engine.train_batch(make_batch(i, 4))
+            losses.append(float(m["loss"]))
+        traces[stage] = losses
+    for stage in [1, 2, 3]:
+        np.testing.assert_allclose(traces[stage], traces[0], rtol=2e-4), stage
+
+
+def test_zero_shardings_actually_shard(devices):
+    engine3 = make_engine(
+        stage=3,
+        zero_optimization={"stage": 3, "stage3_param_persistence_threshold": 0})
+    qkv = engine3.state["params"]["blocks"]["qkv_w"]
+    assert not qkv.sharding.is_fully_replicated
+    engine1 = make_engine(stage=1)
+    qkv1 = engine1.state["params"]["blocks"]["qkv_w"]
+    assert qkv1.sharding.is_fully_replicated
+    mu = engine1.state["opt"].mu["blocks"]["qkv_w"]
+    assert not mu.sharding.is_fully_replicated
+
+
+def test_forward_backward_step_matches_train_batch(devices):
+    e1 = make_engine(stage=1, gas=2, micro=2)
+    e2 = make_engine(stage=1, gas=2, micro=2)
+    batch = make_batch(0, 2, gas=2)
+    m = e1.train_batch(batch)
+    # same data through the imperative API
+    mb0 = {k: v[0] for k, v in batch.items()}
+    mb1 = {k: v[1] for k, v in batch.items()}
+    l0 = e2.forward(mb0)
+    e2.backward(l0)
+    e2.step()  # not at boundary: no-op
+    assert int(e2.state["step"]) == 0
+    l1 = e2.forward(mb1)
+    e2.backward(l1)
+    e2.step()
+    assert int(e2.state["step"]) == 1
+    np.testing.assert_allclose(
+        float(m["loss"]), (float(l0) + float(l1)) / 2, rtol=1e-5)
+    # params must match bitwise-ish between the two paths
+    p1 = jax.tree_util.tree_leaves(e1.state["params"])
+    p2 = jax.tree_util.tree_leaves(e2.state["params"])
+    for a, b in zip(p1, p2):
+        np.testing.assert_allclose(np.asarray(a, np.float32), np.asarray(b, np.float32),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_bf16_master_weights(devices):
+    engine = make_engine(stage=2, bf16={"enabled": True})
+    assert engine.state["params"]["wte"].dtype == jnp.bfloat16
+    assert engine.state["master"]["wte"].dtype == jnp.float32
+    m = engine.train_batch(make_batch(0, 4))
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_fp16_loss_scaling_overflow_skip(devices):
+    engine = make_engine(stage=0, fp16={"enabled": True, "initial_scale_power": 4})
+    s0 = engine.get_loss_scale()
+    assert s0 == 2.0 ** 4
+    m = engine.train_batch(make_batch(0, 4))
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_tp_mesh_training(devices):
+    model, _ = build_gpt(TINY)
+    cfg = base_config(stage=1)
+    cfg["mesh"] = {"tp": 2}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    assert engine.topo.axes["tp"] == 2 and engine.topo.axes["dp"] == 4
+    qkv = engine.state["params"]["blocks"]["qkv_w"]
+    assert not qkv.sharding.is_fully_replicated  # tp-sharded
+    m = engine.train_batch(make_batch(0, 4, world=4))
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_lr_schedule_in_step(devices):
+    engine = make_engine(
+        stage=0,
+        scheduler={"type": "WarmupLR",
+                   "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 1e-3,
+                              "warmup_num_steps": 10}})
+    m1 = engine.train_batch(make_batch(0, 4))
+    m2 = engine.train_batch(make_batch(1, 4))
+    assert float(m2["lr"]) > float(m1["lr"])
