@@ -1,0 +1,107 @@
+"""Benchmark: GPT-2 350M training throughput on the available TPU chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+
+Baseline anchor (BASELINE.md): the reference's published BERT-class single-V100
+kernel numbers don't map 1:1 to a v5e chip, so the baseline here is the
+BASELINE.json north-star framing — model FLOPs utilization (MFU). vs_baseline is
+measured MFU / 0.45 (the 45% MFU target the reference stack achieves at scale);
+1.0 means on-target.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+
+def peak_flops_per_chip() -> float:
+    """bf16 peak for the local chip generation."""
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    table = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
+    for k, v in table.items():
+        if gen.startswith(k):
+            return v
+    return 197e12
+
+
+def main():
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import build_gpt
+
+    model_name = os.environ.get("BENCH_MODEL", "gpt2-350m")
+    micro_bs = int(os.environ.get("BENCH_BS", "16"))
+    seq = int(os.environ.get("BENCH_SEQ", "1024"))
+    stage = int(os.environ.get("BENCH_ZERO_STAGE", "2"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+
+    import dataclasses
+
+    from deepspeed_tpu.models import gpt as gpt_mod
+
+    cfg = gpt_mod.PRESETS[model_name]
+    if os.environ.get("BENCH_REMAT", "1") == "1":
+        cfg = dataclasses.replace(cfg, remat=True)
+    model, cfg = build_gpt(cfg)
+    n_chips = len(jax.devices())
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        config={
+            "train_micro_batch_size_per_gpu": micro_bs,
+            "optimizer": {"type": "AdamW",
+                          "params": {"lr": 3e-4, "weight_decay": 0.1}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": stage},
+            "gradient_clipping": 1.0,
+            "steps_per_print": 0,
+        })
+
+    rng = np.random.default_rng(0)
+
+    def make_batch(i):
+        return {"input_ids": rng.integers(
+            0, cfg.vocab_size, size=(micro_bs * n_chips, seq), dtype=np.int32)}
+
+    # warmup (compile)
+    m = engine.train_batch(make_batch(0))
+    float(m["loss"])
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        m = engine.train_batch(make_batch(i + 1))
+    # force a host transfer of an end-of-step output: device_get cannot return
+    # until every step in the dependency chain has executed (block_until_ready is
+    # not trustworthy through remote-dispatch tunnels)
+    float(m["loss"])
+    _ = np.asarray(jax.device_get(m["grad_norm"]))
+    dt = time.perf_counter() - t0
+
+    tokens = steps * micro_bs * n_chips * (seq - 1)
+    tok_per_sec_chip = tokens / dt / n_chips
+    # 6*N FLOPs/token (fwd+bwd) + attention term 12*L*d*T per token
+    n_params = cfg.num_params()
+    flops_per_token = 6 * n_params + 12 * cfg.n_layer * cfg.d_model * seq
+    mfu = tok_per_sec_chip * flops_per_token / peak_flops_per_chip()
+    result = {
+        "metric": f"{model_name} ZeRO-{stage} bf16 training tokens/sec/chip",
+        "value": round(tok_per_sec_chip, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(mfu / 0.45, 3),
+        "mfu": round(mfu, 4),
+        "chips": n_chips,
+        "micro_bs": micro_bs,
+        "seq": seq,
+        "loss": round(float(m["loss"]), 4),
+        "step_ms": round(dt / steps * 1e3, 1),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -41,9 +41,14 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         os.makedirs(ckpt_dir, exist_ok=True)
     # collective: every process participates in gathering sharded leaves
     save_pytree(engine.state, os.path.join(ckpt_dir, "state"), write=is_writer)
+    # mid-accumulation save: the imperative API's gradient buffer is live state
+    mid_accum = getattr(engine, "_grad_acc", None) is not None and int(engine.state["micro"]) > 0
+    if mid_accum:
+        save_pytree(engine._grad_acc, os.path.join(ckpt_dir, "grad_acc"), write=is_writer)
     if is_writer:
         meta = {
             "tag": tag,
+            "has_grad_acc": mid_accum,
             "global_steps": engine.global_steps,
             "micro_steps": engine.micro_steps,
             "skipped_steps": engine.skipped_steps,
@@ -78,6 +83,9 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     engine.state = state
     with open(os.path.join(ckpt_dir, "meta.json")) as f:
         meta = json.load(f)
+    if meta.get("has_grad_acc"):
+        engine._grad_acc = load_pytree(
+            engine._fresh_grad_acc(), os.path.join(ckpt_dir, "grad_acc"))
     engine.global_steps = int(meta.get("global_steps", 0))
     engine.micro_steps = int(meta.get("micro_steps", 0))
     engine.skipped_steps = int(meta.get("skipped_steps", 0))

@@ -51,6 +51,7 @@ class GPTConfig:
     dropout: float = 0.0
     layer_norm_eps: float = 1e-5
     remat: bool = False  # activation checkpointing per block
+    remat_policy: str = "nothing_saveable"  # jax.checkpoint_policies name
     use_flash: Optional[bool] = None  # None = auto dispatch
 
     @property
@@ -221,7 +222,8 @@ def forward(cfg: GPTConfig, params: Dict[str, Any], input_ids: jnp.ndarray,
         return _block(cfg, x, layer_w, pos, lrng, train)
 
     if cfg.remat:
-        block_fn = jax.checkpoint(block_fn, policy=jax.checkpoint_policies.nothing_saveable)
+        policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
+        block_fn = jax.checkpoint(block_fn, policy=policy)
 
     def body(carry, layer_w):
         x, i = carry
@@ -244,10 +246,15 @@ def loss_fn(cfg: GPTConfig, params, batch: Dict[str, jnp.ndarray],
     labels = batch.get("labels")
     if labels is None:
         labels = input_ids[:, 1:]
-        inputs = input_ids[:, :-1]
+        if input_ids.shape[1] > cfg.max_seq_len:
+            # seq+1 token packing: slice inputs to max_seq_len (labels align 1:1)
+            logits = forward(cfg, params, input_ids[:, :-1], rngs=rngs, train=train)
+        else:
+            # keep the full (tile-friendly) length through attention; drop the
+            # last logit instead of the last input token
+            logits = forward(cfg, params, input_ids, rngs=rngs, train=train)[:, :-1]
     else:
-        inputs = input_ids
-    logits = forward(cfg, params, inputs, rngs=rngs, train=train)
+        logits = forward(cfg, params, input_ids, rngs=rngs, train=train)
     logits32 = logits.astype(jnp.float32)
     logz = jax.scipy.special.logsumexp(logits32, axis=-1)
     gold = jnp.take_along_axis(logits32, labels[..., None], axis=-1)[..., 0]

@@ -77,6 +77,6 @@ def _flash_eligible(q, k, bias) -> bool:
         return False
     if jax.default_backend() not in ("tpu",):
         return False
-    head_dim = q.shape[-1]
-    # MXU-friendly tiles only; fall back otherwise.
-    return head_dim % 128 == 0 and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
+    # block tiling needs 128-divisible sequence lengths; any head_dim works
+    # (lanes are padded), but tiny dims aren't worth the kernel
+    return q.shape[-1] >= 64 and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0

@@ -173,14 +173,10 @@ class DeepSpeedEngine:
                     if s is not None else x,
                     opt, opt_shardings,
                     is_leaf=lambda x: x is None)
-            grad_acc = jax.tree_util.tree_map(
-                lambda x: jnp.zeros(x.shape, jnp.float32), params)
-            grad_acc = _constrain(grad_acc, self.grad_shardings)
             return {
                 "params": params,
                 "master": master if master is not None else {},
                 "opt": opt,
-                "grad_acc": grad_acc,
                 "step": jnp.zeros((), jnp.int32),
                 "micro": jnp.zeros((), jnp.int32),
                 "scaler": init_scaler_state(self.pc),
@@ -203,9 +199,13 @@ class DeepSpeedEngine:
         grads = _constrain(grads, self.grad_shardings)
         return loss, aux, grads
 
-    def _micro_step(self, state, batch, rng):
-        """fwd+bwd for one micro-batch, accumulate grads. Parity: engine.forward +
-        engine.backward pre-boundary behavior (grads summed into flat buffers)."""
+    def _micro_step(self, state, grad_acc, batch, rng):
+        """fwd+bwd for one micro-batch, accumulate into ``grad_acc``. Parity:
+        engine.forward + engine.backward pre-boundary behavior (grads summed into
+        flat buffers). The buffer is NOT part of persistent state — the fused
+        train_batch path carries it in-program only, so it occupies memory solely
+        between fwd/bwd and the update (a full param-sized fp32 saving vs keeping
+        it resident)."""
         scale = state["scaler"].scale if self.pc.loss_scaling else jnp.float32(1.0)
         rngs = {"dropout": rng}
         loss, aux, grads = self._loss_and_grads(state["params"], batch, scale, rngs)
@@ -213,16 +213,14 @@ class DeepSpeedEngine:
         # engine.py:1945; scaling the grads is numerically identical)
         inv_gas = 1.0 / float(self.gas)
         grad_acc = jax.tree_util.tree_map(
-            lambda a, g: a + g * inv_gas, state["grad_acc"], grads)
+            lambda a, g: a + g * inv_gas, grad_acc, grads)
         new_state = dict(state)
-        new_state["grad_acc"] = grad_acc
         new_state["micro"] = state["micro"] + 1
-        return new_state, loss
+        return new_state, grad_acc, loss
 
-    def _boundary_step(self, state):
+    def _boundary_step(self, state, grads):
         """Optimizer step at the gradient-accumulation boundary. Parity:
         ``_take_model_step`` (``runtime/engine.py:2063``) incl. overflow skip."""
-        grads = state["grad_acc"]
         finite = grads_finite(grads) if self.pc.loss_scaling else jnp.bool_(True)
         gnorm = global_norm(grads)
         if self.config.gradient_clipping and self.config.gradient_clipping > 0:
@@ -253,12 +251,10 @@ class DeepSpeedEngine:
             new_params = _constrain(new_target, self.param_shardings)
 
         new_scaler = update_scaler(self.pc, state["scaler"], finite)
-        zero_acc = jax.tree_util.tree_map(jnp.zeros_like, state["grad_acc"])
         new_state = {
             "params": new_params,
             "master": new_master,
             "opt": new_opt,
-            "grad_acc": zero_acc,
             "step": state["step"] + 1,
             "micro": jnp.zeros((), jnp.int32),
             "scaler": new_scaler,
@@ -271,38 +267,48 @@ class DeepSpeedEngine:
         }
         return new_state, metrics
 
+    def _zero_grads(self, params):
+        """fp32 zeros shaped like params, constrained to the ZeRO grad shardings.
+        Used inside the fused step (transient buffer) and, jitted once, to (re)build
+        the imperative API's persistent accumulation buffer."""
+        zero = jax.tree_util.tree_map(
+            lambda x: jnp.zeros(x.shape, jnp.float32), params)
+        return _constrain(zero, self.grad_shardings)
+
+    def _fresh_grad_acc(self):
+        if self._zero_jit is None:
+            self._zero_jit = jax.jit(
+                lambda: self._zero_grads(self.state["params"]),
+                out_shardings=self.grad_shardings)
+        with mesh_context(self.mesh):
+            return self._zero_jit()
+
     def _compile_steps(self) -> None:
         ss = self.state_shardings
-
-        self._micro_jit = jax.jit(
-            self._micro_step,
-            in_shardings=(ss, self.batch_sharding, None),
-            out_shardings=(ss, None),
-            donate_argnums=(0,),
-        )
-        self._boundary_jit = jax.jit(
-            self._boundary_step,
-            in_shardings=(ss,),
-            out_shardings=(ss, None),
-            donate_argnums=(0,),
-        )
+        self._micro_jit = None   # imperative-API jits are compiled lazily on first
+        self._boundary_jit = None  # forward()/step() use (train_batch never pays)
+        self._zero_jit = None
+        self._grad_acc = None
 
         def fused(state, batch, rng):
-            # single-program micro+boundary for gas==1 (and the scan path for gas>1)
+            # single-program micro+boundary; grad buffer lives only in-program
             if self.gas == 1:
-                state, loss = self._micro_step(state, batch, rng)
-                state, metrics = self._boundary_step(state)
+                zero = self._zero_grads(state["params"])
+                state, grads, loss = self._micro_step(state, zero, batch, rng)
+                state, metrics = self._boundary_step(state, grads)
                 metrics["loss"] = loss
                 return state, metrics
             rngs = jax.random.split(rng, self.gas)
 
-            def body(st, xs):
+            def body(carry, xs):
+                st, acc = carry
                 mb, r = xs
-                st, loss = self._micro_step(st, mb, r)
-                return st, loss
+                st, acc, loss = self._micro_step(st, acc, mb, r)
+                return (st, acc), loss
 
-            state, losses = jax.lax.scan(body, state, (batch, rngs))
-            state, metrics = self._boundary_step(state)
+            zero = self._zero_grads(state["params"])
+            (state, grads), losses = jax.lax.scan(body, (state, zero), (batch, rngs))
+            state, metrics = self._boundary_step(state, grads)
             metrics["loss"] = jnp.mean(losses)
             return state, metrics
 
@@ -335,8 +341,19 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown():
             self.timers("forward").start()
         batch = self._place_batch(batch)
+        if self._micro_jit is None:
+            ss = self.state_shardings
+            gs = self.grad_shardings
+            self._micro_jit = jax.jit(
+                self._micro_step,
+                in_shardings=(ss, gs, self.batch_sharding, None),
+                out_shardings=(ss, gs, None),
+                donate_argnums=(0, 1))
+        if self._grad_acc is None:
+            self._grad_acc = self._fresh_grad_acc()
         with mesh_context(self.mesh):
-            self.state, loss = self._micro_jit(self.state, batch, self._next_rng())
+            self.state, self._grad_acc, loss = self._micro_jit(
+                self.state, self._grad_acc, batch, self._next_rng())
         self._last_loss = loss
         if self.wall_clock_breakdown():
             self.timers("forward").stop(sync_on=loss)
@@ -357,8 +374,23 @@ class DeepSpeedEngine:
             return
         if self.wall_clock_breakdown():
             self.timers("step").start()
+        if self._boundary_jit is None:
+            ss = self.state_shardings
+            self._boundary_jit = jax.jit(
+                self._boundary_step,
+                in_shardings=(ss, self.grad_shardings),
+                out_shardings=(ss, None),
+                donate_argnums=(0, 1))
+        if self._grad_acc is None:
+            # load_checkpoint restores mid-accumulation buffers when present;
+            # reaching a boundary with no buffer at all means no grads were ever
+            # produced — refuse rather than silently stepping on zeros
+            raise RuntimeError(
+                "step(): gradient-accumulation boundary reached with no accumulated "
+                "gradients (no forward() ran and none were restored)")
         with mesh_context(self.mesh):
-            self.state, metrics = self._boundary_jit(self.state)
+            self.state, metrics = self._boundary_jit(self.state, self._grad_acc)
+        self._grad_acc = self._fresh_grad_acc()
         self._finish_step(metrics)
         if self.wall_clock_breakdown():
             self.timers("step").stop(sync_on=self.state["step"])

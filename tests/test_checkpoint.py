@@ -80,3 +80,28 @@ def test_latest_tag_and_missing(tmp_path, devices):
     assert path.endswith("my_tag")
     path, client = e.load_checkpoint(str(tmp_path / "nonexistent"))
     assert path is None
+
+
+def test_mid_accumulation_roundtrip(tmp_path, devices):
+    """Saving between forward() calls must preserve accumulated grads (review
+    finding): resumed training matches uninterrupted training exactly."""
+    model, _ = build_gpt(TINY)
+    mk = lambda: deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 0})[0]
+    b0, b1 = batch(0), batch(1)
+
+    e_ref = mk()
+    l = e_ref.forward(b0); e_ref.backward(l); e_ref.step()
+    l = e_ref.forward(b1); e_ref.backward(l); e_ref.step()
+    assert int(e_ref.state["step"]) == 1
+
+    e_a = mk()
+    l = e_a.forward(b0); e_a.backward(l); e_a.step()
+    e_a.save_checkpoint(str(tmp_path))  # micro=1: mid-accumulation
+    e_b = mk()
+    e_b.load_checkpoint(str(tmp_path))
+    l = e_b.forward(b1); e_b.backward(l); e_b.step()
+    assert int(e_b.state["step"]) == 1
+    tree_equal(e_ref.state["params"], e_b.state["params"])
