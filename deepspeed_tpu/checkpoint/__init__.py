@@ -86,6 +86,10 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     if meta.get("has_grad_acc"):
         engine._grad_acc = load_pytree(
             engine._fresh_grad_acc(), os.path.join(ckpt_dir, "grad_acc"))
+    else:
+        # boundary checkpoint: drop any pre-load accumulation so the next
+        # window starts from zeros (forward() lazily rebuilds the buffer)
+        engine._grad_acc = None
     engine.global_steps = int(meta.get("global_steps", 0))
     engine.micro_steps = int(meta.get("micro_steps", 0))
     engine.skipped_steps = int(meta.get("skipped_steps", 0))

@@ -1,4 +1,9 @@
 from .api import Module, replicated_specs
 from .gpt import GPTConfig, PRESETS, build as build_gpt
+from .gpt_moe import GPTMoEConfig, build as build_gpt_moe
+from .gpt_moe import PRESETS as MOE_PRESETS
 
-__all__ = ["Module", "replicated_specs", "GPTConfig", "PRESETS", "build_gpt"]
+__all__ = [
+    "Module", "replicated_specs", "GPTConfig", "PRESETS", "build_gpt",
+    "GPTMoEConfig", "MOE_PRESETS", "build_gpt_moe",
+]

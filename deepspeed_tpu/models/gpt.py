@@ -164,8 +164,9 @@ def _rope(x: jnp.ndarray, positions: jnp.ndarray, rotary_dims: int) -> jnp.ndarr
     return jnp.concatenate([rotated, x_pass], axis=-1)
 
 
-def _block(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray],
-           positions: jnp.ndarray, dropout_rng, train: bool) -> jnp.ndarray:
+def attention_sublayer(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray],
+                       positions: jnp.ndarray, dropout_rng, train: bool) -> jnp.ndarray:
+    """Pre-LN self-attention + residual (shared by dense and MoE blocks)."""
     B, T, D = x.shape
     H, Dh = cfg.n_head, cfg.head_dim
     h = layer_norm(x, w["ln1_scale"], w["ln1_bias"], cfg.layer_norm_eps)
@@ -182,7 +183,12 @@ def _block(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray],
     attn = multihead_attention(q, k_, v, causal=True, use_flash=cfg.use_flash)
     attn = attn.reshape(B, T, D)
     attn = attn @ w["attn_out_w"] + w["attn_out_b"]
-    x = x + _dropout(attn, cfg.dropout, dropout_rng, train, salt=0)
+    return x + _dropout(attn, cfg.dropout, dropout_rng, train, salt=0)
+
+
+def _block(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray],
+           positions: jnp.ndarray, dropout_rng, train: bool) -> jnp.ndarray:
+    x = attention_sublayer(cfg, x, w, positions, dropout_rng, train)
     h = layer_norm(x, w["ln2_scale"], w["ln2_bias"], cfg.layer_norm_eps)
     h = h @ w["mlp_up_w"] + w["mlp_up_b"]
     h = jax.nn.gelu(h, approximate=True)

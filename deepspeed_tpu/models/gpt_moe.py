@@ -1,0 +1,245 @@
+"""GPT with Mixture-of-Experts MLPs (DeepSpeed-MoE capability).
+
+Parity target: the reference's MoE training path — ``deepspeed/moe/layer.py`` wired
+into a Megatron-style GPT where every ``moe_freq``-th MLP is a gated expert bank
+(BASELINE.json config #4: "DeepSpeed-MoE GShard 350M x 64-expert"). PR-MoE's
+residual experts (``moe/layer.py:34``) are available via ``use_residual``.
+
+TPU-first structure: like :mod:`.gpt`, per-layer weights are stacked and scanned —
+here over *super-blocks* of (``moe_freq - 1`` dense blocks, 1 MoE block), so one
+compiled body serves any depth. The MoE load-balance aux loss is accumulated in the
+scan carry and surfaced through the loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..moe.layer import MoEConfig, apply_moe, init_moe, moe_specs
+from .api import Module, maybe_shard
+from .gpt import GPTConfig, _block, _dropout, attention_sublayer, layer_norm
+from .gpt import init_params as gpt_init_params
+from .gpt import partition_specs as gpt_partition_specs
+
+BATCH = ("dp", "ep")
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTMoEConfig:
+    base: GPTConfig = dataclasses.field(default_factory=GPTConfig)
+    num_experts: int = 8
+    moe_freq: int = 2           # every moe_freq-th layer is MoE (1 = all layers)
+    k: int = 1
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_rts: bool = True
+    use_residual: bool = False  # PR-MoE
+    aux_loss_coef: float = 0.01
+    num_groups: int = 1         # gating groups; set ~ dp*ep for rank-local gating
+
+    def __post_init__(self):
+        assert self.base.n_layer % self.moe_freq == 0, (
+            f"n_layer {self.base.n_layer} must divide by moe_freq {self.moe_freq}")
+
+    @property
+    def n_super(self) -> int:
+        return self.base.n_layer // self.moe_freq
+
+    def moe_config(self) -> MoEConfig:
+        return MoEConfig(
+            d_model=self.base.d_model, d_ff=self.base.ffn_dim,
+            num_experts=self.num_experts, k=self.k,
+            capacity_factor=self.capacity_factor,
+            eval_capacity_factor=self.eval_capacity_factor,
+            min_capacity=self.min_capacity,
+            noisy_gate_policy=self.noisy_gate_policy,
+            drop_tokens=self.drop_tokens, use_rts=self.use_rts,
+            use_residual=self.use_residual, num_groups=self.num_groups)
+
+
+PRESETS: Dict[str, GPTMoEConfig] = {
+    # BASELINE.json config #4 flagship
+    "moe-350m-64e": GPTMoEConfig(
+        base=GPTConfig(n_layer=24, n_head=16, d_model=1024), num_experts=64),
+    "moe-125m-8e": GPTMoEConfig(
+        base=GPTConfig(n_layer=12, n_head=12, d_model=768), num_experts=8),
+    "tiny-moe": GPTMoEConfig(
+        base=GPTConfig(vocab_size=256, n_layer=2, n_head=4, d_model=64,
+                       max_seq_len=128),
+        num_experts=4, moe_freq=2, capacity_factor=2.0),
+}
+
+
+def _stack_init(rng: jax.Array, n: int, init_one):
+    """Stack n independently-initialized param trees on a leading axis."""
+    keys = jax.random.split(rng, n)
+    trees = [init_one(k) for k in keys]
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def init_params(cfg: GPTMoEConfig, rng: jax.Array) -> Dict[str, Any]:
+    b = cfg.base
+    k_base, k_moe = jax.random.split(rng)
+    # dense skeleton: embeddings/lns from gpt init at the DENSE layer count
+    dense_layers = b.n_layer - cfg.n_super  # layers keeping a dense MLP
+    base_cfg = dataclasses.replace(b, n_layer=max(dense_layers, 1))
+    params = gpt_init_params(base_cfg, k_base)
+    if dense_layers == 0:
+        # all layers MoE: the dense block stack is empty but attention weights are
+        # still needed per layer — keep one stacked block set of attention-only use
+        params_blocks = params["blocks"]
+        params["blocks"] = jax.tree_util.tree_map(
+            lambda x: x[:0], params_blocks)
+    res_std = 0.02 / np.sqrt(2.0 * b.n_layer)
+    # MoE blocks: attention weights + moe mlp, stacked over n_super
+    moe_cfg = cfg.moe_config()
+
+    def init_moe_block(key):
+        ka, km = jax.random.split(key)
+        kq, ko = jax.random.split(ka)
+        d = b.d_model
+        blk = {
+            "ln1_scale": jnp.ones((d,)), "ln1_bias": jnp.zeros((d,)),
+            "qkv_w": jax.random.normal(kq, (d, 3 * d), jnp.float32) * 0.02,
+            "qkv_b": jnp.zeros((3 * d,)),
+            "attn_out_w": jax.random.normal(ko, (d, d), jnp.float32) * res_std,
+            "attn_out_b": jnp.zeros((d,)),
+            "ln2_scale": jnp.ones((d,)), "ln2_bias": jnp.zeros((d,)),
+            "moe": init_moe(km, moe_cfg, std=0.02, res_std=res_std),
+        }
+        return blk
+
+    params["moe_blocks"] = _stack_init(k_moe, cfg.n_super, init_moe_block)
+    return params
+
+
+def partition_specs(cfg: GPTMoEConfig, param_shapes) -> Dict[str, Any]:
+    b = cfg.base
+    dense_layers = b.n_layer - cfg.n_super
+    base_cfg = dataclasses.replace(b, n_layer=max(dense_layers, 1))
+    specs = gpt_partition_specs(base_cfg, None)
+    mspecs = moe_specs(cfg.moe_config())
+
+    def prepend(spec: P) -> P:
+        return P(None, *tuple(spec))
+
+    specs["moe_blocks"] = {
+        "ln1_scale": P(None, None), "ln1_bias": P(None, None),
+        "qkv_w": P(None, None, "tp"), "qkv_b": P(None, "tp"),
+        "attn_out_w": P(None, "tp", None), "attn_out_b": P(None, None),
+        "ln2_scale": P(None, None), "ln2_bias": P(None, None),
+        "moe": jax.tree_util.tree_map(
+            prepend, mspecs, is_leaf=lambda x: isinstance(x, P)),
+    }
+    return specs
+
+
+def _moe_block(cfg: GPTMoEConfig, x, w, positions, rng, train):
+    b = cfg.base
+    x = attention_sublayer(b, x, w, positions, rng, train)
+    h = layer_norm(x, w["ln2_scale"], w["ln2_bias"], b.layer_norm_eps)
+    y, aux, _counts = apply_moe(cfg.moe_config(), w["moe"], h, rng=rng, train=train)
+    x = x + _dropout(y, b.dropout, rng, train, salt=1)
+    return x, aux
+
+
+def forward(cfg: GPTMoEConfig, params, input_ids: jnp.ndarray,
+            rngs=None, train: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (logits [B,T,V], aux_loss)."""
+    b = cfg.base
+    B, T = input_ids.shape
+    x = jnp.take(params["wte"], input_ids, axis=0)
+    positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+    if not b.rotary:
+        x = x + jnp.take(params["wpe"], positions, axis=0)
+    x = x.astype(params["moe_blocks"]["qkv_w"].dtype)
+    x = maybe_shard(x, P(BATCH, "sp", None))
+    drng = (rngs or {}).get("dropout")
+
+    n_dense_per_super = cfg.moe_freq - 1
+
+    def super_block(x, dense_ws, moe_w, idx):
+        # dense blocks of this super-block
+        if n_dense_per_super > 0:
+            def dense_body(carry, layer_w):
+                xx, i = carry
+                lrng = jax.random.fold_in(drng, i) if drng is not None else None
+                xx = _block(b, xx, layer_w, positions, lrng, train)
+                return (xx, i + 1), None
+
+            (x, idx), _ = jax.lax.scan(dense_body, (x, idx), dense_ws)
+        lrng = jax.random.fold_in(drng, idx) if drng is not None else None
+        x, aux = _moe_block(cfg, x, moe_w, positions, lrng, train)
+        return x, idx + 1, aux
+
+    if cfg.base.remat:
+        policy = getattr(jax.checkpoint_policies, cfg.base.remat_policy)
+        super_block = jax.checkpoint(super_block, policy=policy, static_argnums=())
+
+    # reshape stacked dense blocks [L_dense, ...] -> [n_super, n_dense_per_super, ...]
+    dense_stack = params["blocks"]
+    if n_dense_per_super > 0:
+        dense_stack = jax.tree_util.tree_map(
+            lambda a: a.reshape(cfg.n_super, n_dense_per_super, *a.shape[1:]),
+            dense_stack)
+
+    if n_dense_per_super > 0:
+        def body(carry, layer_in):
+            x, idx, aux_sum = carry
+            dense_ws, moe_w = layer_in
+            x, idx, aux = super_block(x, dense_ws, moe_w, idx)
+            return (x, idx, aux_sum + aux), None
+
+        xs = (dense_stack, params["moe_blocks"])
+    else:
+        def body(carry, moe_w):
+            x, idx, aux_sum = carry
+            x, idx, aux = super_block(x, None, moe_w, idx)
+            return (x, idx, aux_sum + aux), None
+
+        xs = params["moe_blocks"]
+
+    (x, _, aux_sum), _ = jax.lax.scan(
+        body, (x, jnp.int32(0), jnp.float32(0.0)), xs)
+
+    x = layer_norm(x, params["lnf_scale"], params["lnf_bias"], b.layer_norm_eps)
+    head = params["wte"] if b.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
+    return logits, aux_sum / cfg.n_super
+
+
+def loss_fn(cfg: GPTMoEConfig, params, batch, rngs=None, train: bool = True):
+    input_ids = batch["input_ids"]
+    logits, aux = forward(cfg, params, input_ids[:, :-1]
+                          if input_ids.shape[1] > cfg.base.max_seq_len
+                          else input_ids, rngs=rngs, train=train)
+    if input_ids.shape[1] <= cfg.base.max_seq_len:
+        logits = logits[:, :-1]
+    labels = input_ids[:, 1:]
+    logits32 = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits32, axis=-1)
+    gold = jnp.take_along_axis(logits32, labels[..., None], axis=-1)[..., 0]
+    lm_loss = jnp.mean(logz - gold)
+    loss = lm_loss + cfg.aux_loss_coef * aux
+    return loss, {"lm_loss": lm_loss, "moe_aux_loss": aux}
+
+
+def build(cfg_or_name) -> Tuple[Module, GPTMoEConfig]:
+    cfg = PRESETS[cfg_or_name] if isinstance(cfg_or_name, str) else cfg_or_name
+    return Module(
+        init=functools.partial(init_params, cfg),
+        apply=lambda params, batch, rngs=None, train=True: loss_fn(
+            cfg, params, batch, rngs=rngs, train=train),
+        partition_specs=functools.partial(partition_specs, cfg),
+    ), cfg

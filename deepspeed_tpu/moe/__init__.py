@@ -1,0 +1,15 @@
+"""Mixture-of-Experts with expert parallelism over the ``ep`` mesh axis.
+
+Parity: ``deepspeed/moe/`` (layer.py, sharded_moe.py, experts.py, utils.py).
+"""
+
+from .experts import apply_experts, expert_specs, init_experts  # noqa: F401
+from .layer import MoE, MoEConfig, apply_moe, init_moe, moe_specs  # noqa: F401
+from .sharded_moe import (  # noqa: F401
+    GateConfig,
+    compute_capacity,
+    gate,
+    top1gating,
+    top2gating,
+)
+from .utils import count_moe_params, is_moe_path, split_moe_params  # noqa: F401

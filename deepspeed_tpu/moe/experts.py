@@ -1,0 +1,49 @@
+"""Expert FFN bank.
+
+Parity: ``deepspeed/moe/experts.py:9`` (``Experts`` — a ModuleList of per-rank local
+experts). TPU-native: the bank is ONE stacked pytree with a leading expert axis
+``E``, sharded ``P("ep", ...)`` — each ep-mesh slice holds ``E/ep`` experts, the
+exact analog of the reference's ``num_local_experts`` ModuleList, but a single
+einsum applies all local experts at once on the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def init_experts(rng: jax.Array, num_experts: int, d_model: int, d_ff: int,
+                 std: float = 0.02, res_std: float = None) -> Dict[str, Any]:
+    """Per-expert FFN weights stacked on a leading E axis."""
+    k = jax.random.split(rng, 2)
+    res_std = res_std if res_std is not None else std
+    return {
+        "up_w": jax.random.normal(k[0], (num_experts, d_model, d_ff), jnp.float32) * std,
+        "up_b": jnp.zeros((num_experts, d_ff)),
+        "down_w": jax.random.normal(k[1], (num_experts, d_ff, d_model), jnp.float32) * res_std,
+        "down_b": jnp.zeros((num_experts, d_model)),
+    }
+
+
+def expert_specs() -> Dict[str, P]:
+    """Expert dim over ``ep``; hidden dim over ``tp`` (experts can themselves be
+    tensor-parallel, like the reference's Megatron-MoE integration)."""
+    return {
+        "up_w": P("ep", None, "tp"),
+        "up_b": P("ep", "tp"),
+        "down_w": P("ep", "tp", None),
+        "down_b": P("ep", None),
+    }
+
+
+def apply_experts(w: Dict[str, jnp.ndarray], x: jnp.ndarray) -> jnp.ndarray:
+    """Apply each expert to its capacity slice. ``x``: [E, GC, D] -> [E, GC, D]."""
+    h = (jnp.einsum("ecd,edf->ecf", x, w["up_w"].astype(x.dtype))
+         + w["up_b"].astype(x.dtype)[:, None, :])
+    h = jax.nn.gelu(h, approximate=True)
+    return (jnp.einsum("ecf,efd->ecd", h, w["down_w"].astype(x.dtype))
+            + w["down_b"].astype(x.dtype)[:, None, :])

@@ -1,0 +1,165 @@
+"""The MoE layer facade.
+
+Capability parity with the reference's ``deepspeed/moe/layer.py:15`` (``MoE``) and
+``sharded_moe.py:419`` (``MOELayer``): top-k gated routing into a bank of expert
+FFNs with capacity-factor dropping, an auxiliary load-balance loss, and expert
+parallelism over a dedicated process dimension — plus PR-MoE's residual-expert
+variant (``moe/layer.py:34``, ``use_residual``).
+
+TPU-native dataflow (one jitted program, no explicit all-to-all calls):
+
+    x [B,S,D]  --reshape-->  [G, N, D]      G groups ~ dp*ep ranks (gating local)
+    gate: combine/dispatch [G, N, E, C]     fp32 gate math
+    dispatch einsum -> [E, G*C, D]          sharding-constrained to P("ep",...)
+                                            => XLA emits all-to-all over ICI
+                                            (parity: _AllToAll, sharded_moe.py:89)
+    expert FFN bank einsum                  each ep slice computes its E/ep experts
+    combine einsum -> [G, N, D]             transpose all-to-all back
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..models.api import maybe_shard
+from .experts import apply_experts, expert_specs, init_experts
+from .sharded_moe import GateConfig, gate
+
+BATCH = ("dp", "ep")
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    """Parity: ``MoE.__init__`` kwargs (``moe/layer.py:15-46``)."""
+
+    d_model: int
+    d_ff: int
+    num_experts: int
+    ep_size: int = 1
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_rts: bool = True
+    use_residual: bool = False  # PR-MoE: dense MLP in parallel, learned mix
+    num_groups: int = 1  # gating groups (>= dp*ep extent for rank-local parity)
+
+    def gate_config(self) -> GateConfig:
+        return GateConfig(
+            num_experts=self.num_experts, k=self.k,
+            capacity_factor=self.capacity_factor,
+            eval_capacity_factor=self.eval_capacity_factor,
+            min_capacity=self.min_capacity,
+            noisy_gate_policy=self.noisy_gate_policy,
+            drop_tokens=self.drop_tokens, use_rts=self.use_rts)
+
+
+def init_moe(rng: jax.Array, cfg: MoEConfig, std: float = 0.02,
+             res_std: Optional[float] = None) -> Dict[str, Any]:
+    k = jax.random.split(rng, 3)
+    params = {
+        "gate_w": jax.random.normal(
+            k[0], (cfg.d_model, cfg.num_experts), jnp.float32) * std,
+        "experts": init_experts(
+            k[1], cfg.num_experts, cfg.d_model, cfg.d_ff, std=std, res_std=res_std),
+    }
+    if cfg.use_residual:
+        kk = jax.random.split(k[2], 2)
+        params["residual_mlp"] = {
+            "up_w": jax.random.normal(kk[0], (cfg.d_model, cfg.d_ff), jnp.float32) * std,
+            "up_b": jnp.zeros((cfg.d_ff,)),
+            "down_w": jax.random.normal(kk[1], (cfg.d_ff, cfg.d_model), jnp.float32)
+            * (res_std if res_std is not None else std),
+            "down_b": jnp.zeros((cfg.d_model,)),
+        }
+        params["coefficient"] = jnp.zeros((cfg.d_model, 2))
+    return params
+
+
+def moe_specs(cfg: MoEConfig) -> Dict[str, Any]:
+    specs: Dict[str, Any] = {
+        "gate_w": P(None, None),  # gate replicated (fp32, tiny)
+        "experts": expert_specs(),
+    }
+    if cfg.use_residual:
+        specs["residual_mlp"] = {
+            "up_w": P(None, "tp"), "up_b": P("tp"),
+            "down_w": P("tp", None), "down_b": P(None),
+        }
+        specs["coefficient"] = P(None, None)
+    return specs
+
+
+def apply_moe(
+    cfg: MoEConfig,
+    params: Dict[str, Any],
+    x: jnp.ndarray,
+    rng: Optional[jax.Array] = None,
+    train: bool = True,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Run the MoE layer. ``x``: [B, S, D] (or [N, D]).
+
+    Returns (y, aux_loss, exp_counts). Parity: ``MoELayer.forward``
+    (``sharded_moe.py:491-560``) + residual mixing (``moe/layer.py:115-128``).
+    """
+    orig_shape = x.shape
+    D = orig_shape[-1]
+    tokens = x.reshape(-1, D)
+    N_total = tokens.shape[0]
+    G = cfg.num_groups
+    if N_total % G != 0:
+        G = 1
+    xg = tokens.reshape(G, N_total // G, D)
+
+    aux, combine, dispatch, exp_counts = gate(
+        cfg.gate_config(), params["gate_w"], xg, rng=rng, train=train)
+
+    # dispatch: [G,N,E,C] x [G,N,D] -> [E, G, C, D], folded to [E, G*C, D]
+    dispatched = jnp.einsum(
+        "gnec,gnd->egcd", dispatch.astype(x.dtype), xg)
+    E, _, C, _ = dispatched.shape
+    dispatched = dispatched.reshape(E, G * C, D)
+    # land the routed tokens on the expert-parallel axis: XLA inserts the
+    # all-to-all here (and its transpose in backward)
+    dispatched = maybe_shard(dispatched, P("ep", None, None))
+
+    out = apply_experts(params["experts"], dispatched)
+    out = out.reshape(E, G, C, D)
+
+    y = jnp.einsum("gnec,egcd->gnd", combine.astype(x.dtype), out)
+    y = y.reshape(orig_shape)
+    y = maybe_shard(y, P(BATCH, *([None] * (len(orig_shape) - 2))))
+
+    if cfg.use_residual:
+        w = params["residual_mlp"]
+        h = x @ w["up_w"].astype(x.dtype) + w["up_b"].astype(x.dtype)
+        h = jax.nn.gelu(h, approximate=True)
+        res = h @ w["down_w"].astype(x.dtype) + w["down_b"].astype(x.dtype)
+        coef = jax.nn.softmax(
+            (x @ params["coefficient"].astype(x.dtype)), axis=-1)
+        y = y * coef[..., 0:1] + res * coef[..., 1:2]
+
+    return y, aux, exp_counts
+
+
+@dataclasses.dataclass(frozen=True)
+class MoE:
+    """User-facing carrier mirroring the reference's ``deepspeed.moe.layer.MoE``."""
+
+    config: MoEConfig
+
+    def init(self, rng: jax.Array) -> Dict[str, Any]:
+        return init_moe(rng, self.config)
+
+    def specs(self) -> Dict[str, Any]:
+        return moe_specs(self.config)
+
+    def __call__(self, params, x, rng=None, train=True):
+        return apply_moe(self.config, params, x, rng=rng, train=train)

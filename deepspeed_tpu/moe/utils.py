@@ -1,0 +1,46 @@
+"""MoE parameter bookkeeping.
+
+Parity: ``deepspeed/moe/utils.py`` (``is_moe_param``, ``split_params_into_
+different_moe_groups_for_optimizer``) — the reference tags expert parameters so
+ZeRO partitions them over the *expert-data-parallel* group instead of the full DP
+world. Here the analog is spec-level: expert leaves already carry ``P("ep", ...)``
+on their expert axis, and these helpers let policies and optimizers treat
+expert/non-expert subtrees differently by path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+
+
+def is_moe_path(path: Tuple) -> bool:
+    """True if a tree path addresses an expert-parallel leaf ("experts" or "gate"
+    subtree, the reference's ``allreduce=False`` params)."""
+    for p in path:
+        key = getattr(p, "key", getattr(p, "name", None))
+        if key is not None and "expert" in str(key):
+            return True
+    return False
+
+
+def split_moe_params(tree: Any) -> Tuple[Any, Any]:
+    """Split a pytree into (dense, expert) subtrees (None where absent in each).
+    Parity: ``split_params_into_different_moe_groups_for_optimizer``."""
+    dense = jax.tree_util.tree_map_with_path(
+        lambda path, x: None if is_moe_path(path) else x, tree)
+    moe = jax.tree_util.tree_map_with_path(
+        lambda path, x: x if is_moe_path(path) else None, tree)
+    return dense, moe
+
+
+def count_moe_params(tree: Any) -> Dict[str, int]:
+    dense = moe = 0
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+        n = leaf.size
+        if is_moe_path(path):
+            moe += n
+        else:
+            dense += n
+    return {"dense": int(dense), "expert": int(moe)}

@@ -390,7 +390,9 @@ class DeepSpeedEngine:
                 "gradients (no forward() ran and none were restored)")
         with mesh_context(self.mesh):
             self.state, metrics = self._boundary_jit(self.state, self._grad_acc)
-        self._grad_acc = self._fresh_grad_acc()
+        # lazily rebuilt by the next forward(): keeps the param-sized fp32 buffer
+        # out of HBM during the inter-step window
+        self._grad_acc = None
         self._finish_step(metrics)
         if self.wall_clock_breakdown():
             self.timers("step").stop(sync_on=self.state["step"])

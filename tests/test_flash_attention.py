@@ -40,7 +40,7 @@ def test_backward_matches_reference():
     g_fl = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     for a, b, name in zip(g_ref, g_fl, "qkv"):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=2e-2, atol=2e-2), name
+                                   rtol=2e-2, atol=2e-2, err_msg=name)
 
 
 def test_uneven_blocks_rejected():
