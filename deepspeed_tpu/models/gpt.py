@@ -86,12 +86,14 @@ PRESETS: Dict[str, GPTConfig] = {
 
 
 # --------------------------------------------------------------------------- init
-def init_params(cfg: GPTConfig, rng: jax.Array) -> Dict[str, Any]:
+def init_params(cfg: GPTConfig, rng: jax.Array,
+                total_depth: Optional[int] = None) -> Dict[str, Any]:
     d, f, v, l = cfg.d_model, cfg.ffn_dim, cfg.vocab_size, cfg.n_layer
     k = jax.random.split(rng, 8)
     std = 0.02
-    # residual-out projections scaled by 1/sqrt(2L) (GPT-2 init)
-    res_std = std / np.sqrt(2.0 * l)
+    # residual-out projections scaled by 1/sqrt(2L) (GPT-2 init); total_depth
+    # overrides L when this stack is a slice of a deeper model (MoE interleave)
+    res_std = std / np.sqrt(2.0 * (total_depth or l))
 
     def normal(key, shape, s):
         return (jax.random.normal(key, shape, jnp.float32) * s)
@@ -244,23 +246,24 @@ def forward(cfg: GPTConfig, params: Dict[str, Any], input_ids: jnp.ndarray,
     return logits
 
 
-def loss_fn(cfg: GPTConfig, params, batch: Dict[str, jnp.ndarray],
-            rngs=None, train: bool = True) -> Tuple[jnp.ndarray, Dict[str, Any]]:
-    """Next-token cross entropy. ``batch``: {"input_ids": [B,T]} (+ optional
-    "labels"/"loss_mask")."""
+def next_token_loss(forward_fn, max_seq_len: int, batch: Dict[str, jnp.ndarray]
+                    ) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+    """Shared next-token cross-entropy: handles the optional "labels"/"loss_mask"
+    keys and the seq-vs-seq+1 packing cases identically for every GPT variant
+    (dense / MoE / pipelined). ``forward_fn(input_ids) -> logits``."""
     input_ids = batch["input_ids"]
     labels = batch.get("labels")
     if labels is None:
         labels = input_ids[:, 1:]
-        if input_ids.shape[1] > cfg.max_seq_len:
+        if input_ids.shape[1] > max_seq_len:
             # seq+1 token packing: slice inputs to max_seq_len (labels align 1:1)
-            logits = forward(cfg, params, input_ids[:, :-1], rngs=rngs, train=train)
+            logits = forward_fn(input_ids[:, :-1])
         else:
             # keep the full (tile-friendly) length through attention; drop the
             # last logit instead of the last input token
-            logits = forward(cfg, params, input_ids, rngs=rngs, train=train)[:, :-1]
+            logits = forward_fn(input_ids)[:, :-1]
     else:
-        logits = forward(cfg, params, input_ids, rngs=rngs, train=train)
+        logits = forward_fn(input_ids)
     logits32 = logits.astype(jnp.float32)
     logz = jax.scipy.special.logsumexp(logits32, axis=-1)
     gold = jnp.take_along_axis(logits32, labels[..., None], axis=-1)[..., 0]
@@ -274,6 +277,15 @@ def loss_fn(cfg: GPTConfig, params, batch: Dict[str, jnp.ndarray],
     else:
         loss = jnp.mean(nll)
     return loss, {"num_tokens": nll.size}
+
+
+def loss_fn(cfg: GPTConfig, params, batch: Dict[str, jnp.ndarray],
+            rngs=None, train: bool = True) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+    """Next-token cross entropy. ``batch``: {"input_ids": [B,T]} (+ optional
+    "labels"/"loss_mask")."""
+    return next_token_loss(
+        lambda ids: forward(cfg, params, ids, rngs=rngs, train=train),
+        cfg.max_seq_len, batch)
 
 
 # --------------------------------------------------------------------- KV-cache decode

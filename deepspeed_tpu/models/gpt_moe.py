@@ -25,7 +25,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..moe.layer import MoEConfig, apply_moe, init_moe, moe_specs
 from .api import Module, maybe_shard
-from .gpt import GPTConfig, _block, _dropout, attention_sublayer, layer_norm
+from .gpt import (GPTConfig, _block, _dropout, attention_sublayer, layer_norm,
+                  next_token_loss)
 from .gpt import init_params as gpt_init_params
 from .gpt import partition_specs as gpt_partition_specs
 
@@ -94,7 +95,8 @@ def init_params(cfg: GPTMoEConfig, rng: jax.Array) -> Dict[str, Any]:
     # dense skeleton: embeddings/lns from gpt init at the DENSE layer count
     dense_layers = b.n_layer - cfg.n_super  # layers keeping a dense MLP
     base_cfg = dataclasses.replace(b, n_layer=max(dense_layers, 1))
-    params = gpt_init_params(base_cfg, k_base)
+    # total_depth: residual-out init scales with the FULL depth, not the dense count
+    params = gpt_init_params(base_cfg, k_base, total_depth=b.n_layer)
     if dense_layers == 0:
         # all layers MoE: the dense block stack is empty but attention weights are
         # still needed per layer — keep one stacked block set of attention-only use
@@ -149,7 +151,10 @@ def _moe_block(cfg: GPTMoEConfig, x, w, positions, rng, train):
     b = cfg.base
     x = attention_sublayer(b, x, w, positions, rng, train)
     h = layer_norm(x, w["ln2_scale"], w["ln2_bias"], b.layer_norm_eps)
-    y, aux, _counts = apply_moe(cfg.moe_config(), w["moe"], h, rng=rng, train=train)
+    # decorrelate gating noise/RTS draws from the dropout mask (both fold small
+    # constants into their key; give the gate its own subtree of the key space)
+    moe_rng = jax.random.fold_in(rng, 0x6A7E) if rng is not None else None
+    y, aux, _counts = apply_moe(cfg.moe_config(), w["moe"], h, rng=moe_rng, train=train)
     x = x + _dropout(y, b.dropout, rng, train, salt=1)
     return x, aux
 
@@ -159,6 +164,10 @@ def forward(cfg: GPTMoEConfig, params, input_ids: jnp.ndarray,
     """Returns (logits [B,T,V], aux_loss)."""
     b = cfg.base
     B, T = input_ids.shape
+    if T > b.max_seq_len:
+        raise ValueError(
+            f"sequence length {T} exceeds max_seq_len {b.max_seq_len} "
+            f"(out-of-range position lookups would return NaN)")
     x = jnp.take(params["wte"], input_ids, axis=0)
     positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
     if not b.rotary:
@@ -220,17 +229,15 @@ def forward(cfg: GPTMoEConfig, params, input_ids: jnp.ndarray,
 
 
 def loss_fn(cfg: GPTMoEConfig, params, batch, rngs=None, train: bool = True):
-    input_ids = batch["input_ids"]
-    logits, aux = forward(cfg, params, input_ids[:, :-1]
-                          if input_ids.shape[1] > cfg.base.max_seq_len
-                          else input_ids, rngs=rngs, train=train)
-    if input_ids.shape[1] <= cfg.base.max_seq_len:
-        logits = logits[:, :-1]
-    labels = input_ids[:, 1:]
-    logits32 = logits.astype(jnp.float32)
-    logz = jax.scipy.special.logsumexp(logits32, axis=-1)
-    gold = jnp.take_along_axis(logits32, labels[..., None], axis=-1)[..., 0]
-    lm_loss = jnp.mean(logz - gold)
+    aux_box = []
+
+    def fwd(ids):
+        logits, aux = forward(cfg, params, ids, rngs=rngs, train=train)
+        aux_box.append(aux)
+        return logits
+
+    lm_loss, _ = next_token_loss(fwd, cfg.base.max_seq_len, batch)
+    aux = aux_box[0]
     loss = lm_loss + cfg.aux_loss_coef * aux
     return loss, {"lm_loss": lm_loss, "moe_aux_loss": aux}
 

@@ -115,6 +115,12 @@ def apply_moe(
     N_total = tokens.shape[0]
     G = cfg.num_groups
     if N_total % G != 0:
+        from ..utils.logging import warning_once
+
+        warning_once(
+            f"MoE: token count {N_total} not divisible by num_groups {G}; "
+            f"falling back to global gating (num_groups=1) — capacity per group "
+            f"changes from {N_total // max(G, 1)} to {N_total}")
         G = 1
     xg = tokens.reshape(G, N_total // G, D)
 

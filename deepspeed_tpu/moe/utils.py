@@ -16,8 +16,10 @@ import jax
 
 
 def is_moe_path(path: Tuple) -> bool:
-    """True if a tree path addresses an expert-parallel leaf ("experts" or "gate"
-    subtree, the reference's ``allreduce=False`` params)."""
+    """True if a tree path addresses an expert-parallel leaf (any path component
+    containing "expert"). Gate weights are NOT expert-parallel — they are dense
+    params replicated over ep, matching the reference where only ``is_moe_param``
+    tensors (``allreduce=False``) join the expert group."""
     for p in path:
         key = getattr(p, "key", getattr(p, "name", None))
         if key is not None and "expert" in str(key):

@@ -1,0 +1,9 @@
+"""Sequence/context parallelism (the long-context pillar, SURVEY.md §5).
+
+The reference has no sequence parallelism (it predates Ulysses/ring attention);
+its long-context capability is blocksparse attention. This package provides the
+modern capability equivalents over the ``sp`` mesh axis.
+"""
+
+from .ring_attention import ring_attention  # noqa: F401
+from .ulysses import ulysses_attention  # noqa: F401

@@ -1,0 +1,8 @@
+"""Pipeline parallelism. Parity: reference ``deepspeed/runtime/pipe/``."""
+
+from .module import (LayerSpec, PipelineModule, TiedLayerSpec,  # noqa: F401
+                     partition_balanced, partition_uniform)
+from .schedule import (DataParallelSchedule, InferenceSchedule,  # noqa: F401
+                       PipeSchedule, TrainSchedule, bubble_fraction)
+from .spmd import (merge_microbatches, pipelined_apply,  # noqa: F401
+                   split_microbatches, stack_stage_params, unstack_stage_params)

@@ -1,0 +1,236 @@
+"""Pipeline model description: layer specs and stage partitioning.
+
+Capability parity with the reference's ``runtime/pipe/module.py`` (``LayerSpec:24``,
+``TiedLayerSpec:68``, ``PipelineModule:86`` with stage partitioning ``_partition_layers
+:365`` using ``partition_uniform``/``partition_balanced`` from ``runtime/utils.py``).
+
+TPU-native shape: a ``LayerSpec`` carries pure functions (init, apply) instead of a
+torch class; ``PipelineModule`` assigns layers to ``num_stages`` pipeline stages and
+produces a functional :class:`~deepspeed_tpu.models.api.Module`. Execution:
+
+- ``pp == 1`` or heterogeneous stages: layers run sequentially in one program (the
+  partitioning still matters for activation-checkpoint granularity).
+- homogeneous stacked stages (the transformer case): the SPMD executor in
+  :mod:`.spmd` pipelines micro-batches over the ``pp`` mesh axis with
+  collective-permutes; tied weights (``TiedLayerSpec``) need no special grad
+  allreduce — autodiff sums the contributions of every use site (the reference
+  does this by hand at ``runtime/pipe/module.py:421``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...models.api import Module
+from ...utils.logging import logger
+
+
+class LayerSpec:
+    """Deferred layer: built per-stage so no stage materializes other stages'
+    params. Parity: ``runtime/pipe/module.py:24``.
+
+    ``init(rng) -> params`` and ``apply(params, x, **kw) -> y``; ``param_count``
+    lets ``partition_method="parameters"`` balance stages without materializing.
+    """
+
+    def __init__(self, init: Callable, apply: Callable, name: str = "layer",
+                 param_count: int = 0):
+        self.init = init
+        self.apply = apply
+        self.name = name
+        self.param_count = int(param_count)
+
+    def build(self, rng) -> Any:
+        return self.init(rng)
+
+    def __repr__(self):
+        return f"LayerSpec({self.name}, params={self.param_count})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """A layer whose parameters are shared with every other TiedLayerSpec of the
+    same ``key`` (e.g. embedding/unembedding). Parity: ``runtime/pipe/module.py:68``.
+    Tied params are stored once in the param tree under ``tied/<key>``."""
+
+    def __init__(self, key: str, init: Callable, apply: Callable, name: str = "tied",
+                 param_count: int = 0):
+        super().__init__(init, apply, name=name, param_count=param_count)
+        self.key = key
+
+    def __repr__(self):
+        return f"TiedLayerSpec({self.key}, params={self.param_count})"
+
+
+# ----------------------------------------------------------------- partitioning
+def partition_uniform(num_items: int, num_parts: int) -> List[int]:
+    """Boundaries assigning ``num_items`` into ``num_parts`` near-equal contiguous
+    ranges. Parity: ``runtime/utils.py`` ``partition_uniform``. Returns
+    ``num_parts+1`` boundaries."""
+    parts = [0] * (num_parts + 1)
+    chunk, residual = divmod(num_items, num_parts)
+    for p in range(num_parts):
+        parts[p + 1] = parts[p] + chunk + (1 if p < residual else 0)
+    return parts
+
+def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
+    """Contiguous partition of ``weights`` minimizing the max part weight
+    (binary search over the bottleneck + greedy check). Parity:
+    ``runtime/utils.py`` ``partition_balanced`` (reference uses the same
+    prefix-sum + bisection idea)."""
+    weights = [float(w) for w in weights]
+    n = len(weights)
+    if num_parts >= n:
+        # one item per part (plus empty tail parts)
+        return partition_uniform(n, num_parts)
+    prefix = np.concatenate([[0.0], np.cumsum(weights)])
+
+    def can(limit: float) -> Optional[List[int]]:
+        bounds = [0]
+        start = 0
+        for _ in range(num_parts):
+            # furthest end such that sum(start:end) <= limit
+            hi = int(np.searchsorted(prefix, prefix[start] + limit, side="right")) - 1
+            if hi <= start:
+                return None  # single item exceeds limit
+            hi = min(hi, n)
+            bounds.append(hi)
+            start = hi
+            if hi == n:
+                break
+        if bounds[-1] != n:
+            return None
+        while len(bounds) < num_parts + 1:
+            bounds.append(n)
+        return bounds
+
+    lo = max(weights) if weights else 0.0
+    hi = float(prefix[-1])
+    best = can(hi)
+    for _ in range(50):
+        mid = (lo + hi) / 2
+        b = can(mid)
+        if b is not None:
+            best, hi = b, mid
+        else:
+            lo = mid
+    assert best is not None
+    return best
+
+
+class PipelineModule:
+    """Partition a layer list over pipeline stages; build per-stage params.
+
+    Parity: ``runtime/pipe/module.py:86``. ``partition_method``:
+    - ``"uniform"``: equal layer counts;
+    - ``"parameters"``: balance by per-layer param counts;
+    - ``"type:<regex>"``: balance count of layers whose name matches.
+    """
+
+    def __init__(self, layers: Sequence[LayerSpec], num_stages: int,
+                 partition_method: str = "parameters",
+                 loss_fn: Optional[Callable] = None,
+                 activation_checkpoint_interval: int = 0):
+        self.specs = list(layers)
+        self.num_stages = int(num_stages)
+        self.partition_method = partition_method
+        self.loss_fn = loss_fn
+        self.activation_checkpoint_interval = int(activation_checkpoint_interval)
+        self.parts = self._partition_layers()
+        logger.info(f"PipelineModule: {len(self.specs)} layers -> {self.num_stages} "
+                    f"stages at bounds {self.parts}")
+
+    # ------------------------------------------------------------ partitioning
+    def _partition_layers(self) -> List[int]:
+        method = self.partition_method.lower()
+        n = len(self.specs)
+        if method == "uniform":
+            return partition_uniform(n, self.num_stages)
+        if method == "parameters":
+            weights = [max(1, s.param_count) for s in self.specs]
+            return partition_balanced(weights, self.num_stages)
+        if method.startswith("type:"):
+            pattern = method.split(":", 1)[1]
+            weights = [1 if re.search(pattern, s.name, re.IGNORECASE) else 0
+                       for s in self.specs]
+            if sum(weights) == 0:
+                raise ValueError(f"no layer names match partition regex {pattern!r}")
+            return partition_balanced([w + 1e-3 for w in weights], self.num_stages)
+        raise NotImplementedError(f"partition_method {self.partition_method!r}")
+
+    def stage_layers(self, stage_id: int) -> List[LayerSpec]:
+        return self.specs[self.parts[stage_id]:self.parts[stage_id + 1]]
+
+    def stage_of_layer(self, layer_idx: int) -> int:
+        for s in range(self.num_stages):
+            if self.parts[s] <= layer_idx < self.parts[s + 1]:
+                return s
+        raise IndexError(layer_idx)
+
+    @property
+    def tied_keys(self) -> List[str]:
+        keys = []
+        for s in self.specs:
+            if isinstance(s, TiedLayerSpec) and s.key not in keys:
+                keys.append(s.key)
+        return keys
+
+    # ------------------------------------------------------------ functional build
+    def init(self, rng) -> Dict[str, Any]:
+        """Build the full param tree: ``{"layers": [per-layer], "tied": {key: ...}}``.
+        Tied keys are built once (first spec wins)."""
+        params: Dict[str, Any] = {"layers": [], "tied": {}}
+        rngs = jax.random.split(rng, len(self.specs) + 1)
+        for i, spec in enumerate(self.specs):
+            if isinstance(spec, TiedLayerSpec):
+                if spec.key not in params["tied"]:
+                    params["tied"][spec.key] = spec.build(rngs[i])
+                params["layers"].append({})  # weights live under tied/
+            else:
+                params["layers"].append(spec.build(rngs[i]))
+        return params
+
+    def apply(self, params, x, **kw):
+        """Sequential execution through all layers (single-program path; also the
+        reference semantics for ``pp=1``). With
+        ``activation_checkpoint_interval>0``, each interval chunk is rematerialized
+        (parity: ``runtime/pipe/module.py:309-364`` forward with checkpointing)."""
+        interval = self.activation_checkpoint_interval
+
+        def run_range(x, lo, hi):
+            for i in range(lo, hi):
+                spec = self.specs[i]
+                w = (params["tied"][spec.key]
+                     if isinstance(spec, TiedLayerSpec) else params["layers"][i])
+                x = spec.apply(w, x, **kw)
+            return x
+
+        if interval <= 0:
+            return run_range(x, 0, len(self.specs))
+        i = 0
+        while i < len(self.specs):
+            hi = min(i + interval, len(self.specs))
+            x = jax.checkpoint(lambda x, lo=i, hi=hi: run_range(x, lo, hi))(x)
+            i = hi
+        return x
+
+    def to_module(self, partition_specs: Optional[Callable] = None) -> Module:
+        """Wrap as an engine-consumable :class:`Module`; ``apply`` feeds the last
+        layer's output to ``loss_fn(output, batch)`` when provided."""
+
+        def apply(params, batch, rngs=None, train=True):
+            x = batch["input_ids"] if isinstance(batch, dict) else batch
+            out = self.apply(params, x)
+            if self.loss_fn is not None:
+                loss = self.loss_fn(out, batch)
+            else:
+                loss = out
+            return loss, {}
+
+        return Module(init=self.init, apply=apply, partition_specs=partition_specs)

@@ -1,0 +1,269 @@
+"""Pipeline instruction schedules.
+
+Capability parity with the reference's ``runtime/pipe/schedule.py`` (``PipeSchedule``
+base at ``:51``, ``InferenceSchedule:129``, ``TrainSchedule:182`` with 1F1B step
+generation at ``:189-241`` and buffer count at ``:243``, ``DataParallelSchedule:273``,
+instruction classes ``:300-380``).
+
+These schedules are pure rank/step math. On GPU the reference *executes* them with
+an instruction-map interpreter (``runtime/pipe/engine.py:1360``) doing explicit p2p
+sends/recvs. On TPU the SPMD executor (:mod:`.spmd`) compiles the whole pipeline
+into one XLA program, so these classes serve three roles:
+
+1. documentation + tests of the schedule semantics (bubble math, buffer counts);
+2. the planning layer for a future MPMD multi-host executor;
+3. API parity for user code that introspects schedules.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+
+# ----------------------------------------------------------------- instructions
+class PipeInstruction:
+    """Base class for one step-command in a pipeline schedule. Parity:
+    ``runtime/pipe/schedule.py:300``."""
+
+    def __init__(self, **kwargs):
+        self.name = self.__class__.__name__
+        self.kwargs = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        if self.kwargs:
+            args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+            return f"{self.name}({args})"
+        return self.name
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.kwargs == other.kwargs
+
+
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+class BufferOpInstruction(PipeInstruction):
+    def __init__(self, buffer_id: int, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class LoadMicroBatch(BufferOpInstruction):
+    pass
+
+
+class ForwardPass(BufferOpInstruction):
+    pass
+
+
+class BackwardPass(BufferOpInstruction):
+    pass
+
+
+class SendActivation(BufferOpInstruction):
+    pass
+
+
+class RecvActivation(BufferOpInstruction):
+    pass
+
+
+class SendGrad(BufferOpInstruction):
+    pass
+
+
+class RecvGrad(BufferOpInstruction):
+    pass
+
+
+# ----------------------------------------------------------------- schedules
+class PipeSchedule:
+    """Generator of per-step instruction lists for one (stage, #stages, #micros).
+
+    Parity: ``runtime/pipe/schedule.py:51``.
+    """
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        assert 0 <= stage_id < stages
+        self.micro_batches = int(micro_batches)
+        self.stages = int(stages)
+        self.stage_id = int(stage_id)
+        self.prev_stage = self.stage_id - 1
+        self.next_stage = self.stage_id + 1
+
+    def steps(self):
+        raise NotImplementedError
+
+    def num_pipe_buffers(self) -> int:
+        return self.micro_batches
+
+    @property
+    def stage(self) -> int:
+        return self.stage_id
+
+    @property
+    def num_stages(self) -> int:
+        return self.stages
+
+    @property
+    def num_micro_batches(self) -> int:
+        return self.micro_batches
+
+    @property
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.stages - 1
+
+    def _buffer_idx(self, micro_batch_id: int) -> int:
+        return micro_batch_id % self.num_pipe_buffers()
+
+    def __iter__(self):
+        return iter(self.steps())
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only pipelining. Parity: ``runtime/pipe/schedule.py:129``."""
+
+    def steps(self):
+        total_steps = self.micro_batches + self.stages - 1
+        for step_id in range(total_steps):
+            cmds: List[PipeInstruction] = []
+            micro_batch_id = step_id - self.stage_id
+            if 0 <= micro_batch_id < self.micro_batches:
+                buf = self._buffer_idx(micro_batch_id)
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buf))
+                else:
+                    cmds.append(RecvActivation(buf))
+                cmds.append(ForwardPass(buf))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buf))
+            yield cmds
+
+    def num_pipe_buffers(self) -> int:
+        return 2  # double buffering, parity :175-180
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B: each stage alternates forward and backward micro-batches once warm.
+
+    Parity: ``runtime/pipe/schedule.py:182``. Step parity convention: even
+    step-slots are forward, odd are backward; stage ``s`` starts its first forward
+    at slot ``s`` and drains backwards symmetrically.
+    """
+
+    def steps(self):
+        prev_micro_batch_id = -1
+        total_steps = 2 * (self.micro_batches + self.stages - 1)
+        for step_id in range(total_steps):
+            micro_batch_id, is_forward = self._step_to_micro_batch(step_id)
+            cmds: List[PipeInstruction] = []
+            valid = self._valid_micro_batch(micro_batch_id)
+
+            # communication with neighbors (recv for this step, send of prev result)
+            if self._valid_micro_batch(prev_micro_batch_id):
+                prev_buf = self._buffer_idx(prev_micro_batch_id)
+                if is_forward:
+                    if not self.is_first_stage:
+                        cmds.append(SendGrad(prev_buf))
+                else:
+                    if not self.is_last_stage:
+                        cmds.append(SendActivation(prev_buf))
+            if valid:
+                buf = self._buffer_idx(micro_batch_id)
+                if is_forward:
+                    if self.is_first_stage:
+                        cmds.append(LoadMicroBatch(buf))
+                    else:
+                        cmds.append(RecvActivation(buf))
+                    cmds.append(ForwardPass(buf))
+                else:
+                    if not self.is_last_stage:
+                        cmds.append(RecvGrad(buf))
+                    cmds.append(BackwardPass(buf))
+
+            # final step: reduce + optimizer (parity :233-241)
+            if step_id == total_steps - 1:
+                cmds.append(ReduceTiedGrads())
+                cmds.append(ReduceGrads())
+                cmds.append(OptimizerStep())
+            prev_micro_batch_id = micro_batch_id if valid else -1
+            yield cmds
+
+    def num_pipe_buffers(self) -> int:
+        """In-flight buffer count shrinks as the stage nears the end. Parity
+        ``:243``: ``min(stages - stage_id, micro_batches)``."""
+        buffers = min(self.stages - self.stage_id, self.micro_batches)
+        return max(2, buffers)
+
+    def _valid_micro_batch(self, micro_batch_id: int) -> bool:
+        return 0 <= micro_batch_id < self.micro_batches
+
+    def _step_to_micro_batch(self, step_id: int):
+        # stage s: forward of micro m at slot 2m+s (parity of s); backward at
+        # slot 2m+2S-s-1 (opposite parity). Last stage alternates F,B immediately;
+        # backward of stage s trails stage s+1 by one slot.
+        if (step_id - self.stage_id) % 2 == 0:
+            return (step_id - self.stage_id) // 2, True
+        return (step_id - (2 * self.stages - self.stage_id - 1)) // 2, False
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Degenerate schedule for stages==1. Parity: ``runtime/pipe/schedule.py:273``."""
+
+    def steps(self):
+        for micro_batch_id in range(self.micro_batches):
+            cmds: List[PipeInstruction] = [
+                LoadMicroBatch(0),
+                ForwardPass(0),
+                BackwardPass(0),
+            ]
+            if micro_batch_id == self.micro_batches - 1:
+                cmds.extend([ReduceGrads(), OptimizerStep()])
+            yield cmds
+
+    def num_pipe_buffers(self) -> int:
+        return 1
+
+
+def _is_even(x: int) -> bool:
+    return x % 2 == 0
+
+
+def _is_odd(x: int) -> bool:
+    return x % 2 == 1
+
+
+def bubble_fraction(micro_batches: int, stages: int) -> float:
+    """Pipeline bubble overhead (S-1)/(M+S-1) — the quantity the schedules and the
+    SPMD executor both pay; exposed for autotuning."""
+    return (stages - 1) / (micro_batches + stages - 1)
+
+
+def verify_schedule(sched: Iterable, micro_batches: int, is_train: bool) -> bool:
+    """Sanity: every micro-batch gets exactly one ForwardPass (and BackwardPass if
+    training) across the schedule's steps."""
+    fwd, bwd = [], []
+    for cmds in sched:
+        for c in cmds:
+            if isinstance(c, ForwardPass):
+                fwd.append(c.buffer_id)
+            elif isinstance(c, BackwardPass):
+                bwd.append(c.buffer_id)
+    ok = len(fwd) == micro_batches
+    if is_train:
+        ok = ok and len(bwd) == micro_batches
+    return ok

@@ -1,0 +1,164 @@
+"""SPMD pipeline-parallel executor.
+
+The TPU-native replacement for the reference's instruction-interpreter pipeline
+engine (``runtime/pipe/engine.py:37,1360``: dispatch of Send/Recv/Forward/Backward
+commands over NCCL p2p with hand-managed buffers and a separate grad pipeline).
+
+Design (collective-permute pipelining inside one XLA program):
+
+- stage weights live stacked on a leading ``[S, ...]`` axis sharded over the ``pp``
+  mesh axis — each device holds only its stage's layers;
+- the activation "buffers" are one ``[S, micro_batch, ...]`` array, also
+  pp-sharded: row ``i`` is what stage ``i`` is currently processing;
+- one *tick* applies every stage to its row in parallel (``vmap`` over the stage
+  axis — pure per-row compute, so XLA keeps each row on its shard) and then shifts
+  rows down by one (``concatenate([new_input, y[:-1]])`` on a pp-sharded axis
+  lowers to a neighbor collective-permute — exactly the reference's
+  ``SendActivation``/``RecvActivation`` pair, scheduled by the compiler);
+- after ``M + S - 1`` ticks every micro-batch has exited the last stage
+  (GPipe-style fill/drain: the (S-1)/(M+S-1) bubble is identical to the
+  reference's 1F1B bubble);
+- **backward**: ``jax.grad`` of this loop. The transpose of a collective-permute
+  is the reverse permute, so autodiff yields the mirrored grad pipeline
+  (``SendGrad``/``RecvGrad``) with no extra code. Per-tick ``jax.checkpoint``
+  bounds activation memory to one stage-activation per in-flight micro-batch —
+  the same residency 1F1B achieves.
+
+Tied weights (embedding read at stage 0, head at stage S-1) are handled by keeping
+them *outside* the pipelined scan (replicated over pp); autodiff sums both use
+sites' contributions, replacing the reference's explicit tied-grad allreduce
+(``runtime/pipe/module.py:421``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ...models.api import maybe_shard
+
+
+def pipeline_spec(batch_spec_tail: Tuple = ()) -> P:
+    """PartitionSpec of the [S, mb, ...] rotating buffer: stage axis over pp."""
+    return P("pp", *batch_spec_tail)
+
+
+def pipelined_apply(
+    stage_fn: Callable[..., jnp.ndarray],
+    stage_params: Any,
+    microbatches: jnp.ndarray,
+    num_stages: int,
+    *,
+    stream_spec: Optional[P] = None,
+    remat: bool = True,
+    extra_args: Tuple = (),
+) -> jnp.ndarray:
+    """Run ``microbatches`` through ``num_stages`` pipeline stages.
+
+    Args:
+      stage_fn: ``(params_slice, x, micro_id, stage_id, *extra) -> y`` — one
+        stage's compute for ONE micro-batch. ``params_slice`` is the per-stage
+        leaf slice (leading stage axis removed by the vmap), ``micro_id`` the
+        micro-batch index and ``stage_id`` the stage index (for rng folding /
+        global layer ids); must be shape-preserving on ``x`` (stages are
+        homogeneous — the transformer case; heterogeneous stacks use
+        PipelineModule.apply).
+      stage_params: pytree with leading ``[S, ...]`` stage axis on every leaf,
+        sharded ``P("pp", ...)``.
+      microbatches: ``[M, mb, ...]`` activation stream entering stage 0.
+      num_stages: S; must equal the ``pp`` mesh-axis size when sharded.
+      stream_spec: PartitionSpec of ONE micro-batch (e.g. ``P(("dp","ep"), "sp",
+        None)``) used to constrain the rotating buffer's tail dims.
+      remat: rematerialize each tick (activation checkpointing over the pipeline).
+      extra_args: broadcast to every stage invocation (e.g. positions).
+
+    Returns ``[M, mb, ...]`` outputs of the last stage (valid for all M).
+    """
+    S = int(num_stages)
+    M = int(microbatches.shape[0])
+    tail = stream_spec if stream_spec is not None else P()
+    buf_spec = P("pp", *tuple(tail))
+
+    def one_stage(w, x, micro_id, stage_id, *extra):
+        return stage_fn(w, x, micro_id, stage_id, *extra)
+
+    vstage = jax.vmap(one_stage, in_axes=(0, 0, 0, 0, *([None] * len(extra_args))))
+    if remat:
+        vstage = jax.checkpoint(vstage)
+
+    # stage i at tick t processes micro-batch (t - i); negative/overflow ids are
+    # bubble ticks whose output never lands in `outputs`.
+    stage_ids = jnp.arange(S)
+
+    def tick(carry, t):
+        state, outputs = carry
+        micro_ids = t - stage_ids  # [S]
+        y = vstage(stage_params, state, micro_ids, stage_ids, *extra_args)
+        y = maybe_shard(y, buf_spec)
+        # last stage's output is micro-batch t-(S-1); clamp → early garbage lands
+        # in slot 0 and is overwritten at t = S-1 when the real one arrives.
+        out_idx = jnp.clip(t - (S - 1), 0, M - 1)
+        outputs = jax.lax.dynamic_update_slice_in_dim(outputs, y[-1:], out_idx, axis=0)
+        # shift: stage 0 ingests the next micro-batch, stage i takes stage i-1's out
+        nxt = jax.lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(t + 1, 0, M - 1), axis=0, keepdims=True)
+        state = jnp.concatenate([nxt, y[:-1]], axis=0)
+        state = maybe_shard(state, buf_spec)
+        return (state, outputs), None
+
+    mb_shape = microbatches.shape[1:]
+    state0 = jnp.concatenate(
+        [microbatches[0][None],
+         jnp.zeros((S - 1,) + mb_shape, microbatches.dtype)], axis=0)
+    state0 = maybe_shard(state0, buf_spec)
+    outputs0 = jnp.zeros((M,) + mb_shape, microbatches.dtype)
+
+    (_, outputs), _ = jax.lax.scan(
+        tick, (state0, outputs0), jnp.arange(M + S - 1))
+    return outputs
+
+
+def stack_stage_params(layer_params: Any, num_stages: int) -> Any:
+    """Reshape stacked-layer leaves ``[L, ...]`` -> ``[S, L/S, ...]`` so the
+    leading axis is the pipeline-stage axis. Parity: the reference's
+    ``PipelineModule._partition_layers`` uniform split (``runtime/pipe/module.py:365``)
+    for homogeneous stacks."""
+
+    def reshape(leaf):
+        L = leaf.shape[0]
+        if L % num_stages != 0:
+            raise ValueError(
+                f"layer count {L} not divisible by pipeline stages {num_stages}")
+        return leaf.reshape((num_stages, L // num_stages) + leaf.shape[1:])
+
+    return jax.tree_util.tree_map(reshape, layer_params)
+
+
+def unstack_stage_params(stage_params: Any) -> Any:
+    """Inverse of :func:`stack_stage_params`."""
+    return jax.tree_util.tree_map(
+        lambda leaf: leaf.reshape((leaf.shape[0] * leaf.shape[1],) + leaf.shape[2:]),
+        stage_params)
+
+
+def split_microbatches(batch: Any, num_micro: int) -> Any:
+    """Reshape each [B, ...] leaf to [M, B/M, ...]."""
+
+    def reshape(leaf):
+        B = leaf.shape[0]
+        if B % num_micro != 0:
+            raise ValueError(f"batch {B} not divisible by micro-batches {num_micro}")
+        return leaf.reshape((num_micro, B // num_micro) + leaf.shape[1:])
+
+    return jax.tree_util.tree_map(reshape, batch)
+
+
+def merge_microbatches(batch: Any) -> Any:
+    """Inverse of :func:`split_microbatches`."""
+    return jax.tree_util.tree_map(
+        lambda leaf: leaf.reshape((leaf.shape[0] * leaf.shape[1],) + leaf.shape[2:]),
+        batch)
