@@ -54,6 +54,10 @@ def forward(cfg: G.GPTConfig, num_stages: int, num_micro: int, params,
             input_ids: jnp.ndarray, rngs=None, train: bool = True) -> jnp.ndarray:
     """Logits [B, T, V] via pipelined blocks. B must divide by num_micro."""
     B, T = input_ids.shape
+    if T > cfg.max_seq_len:
+        raise ValueError(
+            f"sequence length {T} exceeds max_seq_len {cfg.max_seq_len} "
+            f"(out-of-range position lookups would return NaN)")
     x = jnp.take(params["wte"], input_ids, axis=0)
     positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
     if not cfg.rotary:

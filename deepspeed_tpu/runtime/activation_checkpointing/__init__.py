@@ -1,0 +1,11 @@
+from .checkpointing import (  # noqa: F401
+    CheckpointConfig,
+    checkpoint,
+    checkpoint_wrapper,
+    configure,
+    get_rng_tracker,
+    is_configured,
+    model_parallel_reseed,
+    policy_from_config,
+    reset,
+)

@@ -89,6 +89,11 @@ class DeepSpeedEngine:
         if config.comms_logger.enabled:
             comm.configure(enabled=True, verbose=config.comms_logger.verbose)
 
+        # parity: engine._configure_checkpointing → activation-ckpt global config
+        from .activation_checkpointing import configure as _ac_configure
+
+        _ac_configure(deepspeed_config=config)
+
         # ---------------- optimizer + lr schedule
         opt_cfg = config.optimizer
         if client_optimizer is not None:

@@ -213,10 +213,14 @@ class PipelineModule:
 
         if interval <= 0:
             return run_range(x, 0, len(self.specs))
+        # honors the globally-configured activation-checkpointing options
+        # (partition_activations / cpu_checkpointing / policy)
+        from ..activation_checkpointing import checkpoint_wrapper
+
         i = 0
         while i < len(self.specs):
             hi = min(i + interval, len(self.specs))
-            x = jax.checkpoint(lambda x, lo=i, hi=hi: run_range(x, lo, hi))(x)
+            x = checkpoint_wrapper(lambda x, lo=i, hi=hi: run_range(x, lo, hi))(x)
             i = hi
         return x
 

@@ -239,14 +239,6 @@ class DataParallelSchedule(PipeSchedule):
         return 1
 
 
-def _is_even(x: int) -> bool:
-    return x % 2 == 0
-
-
-def _is_odd(x: int) -> bool:
-    return x % 2 == 1
-
-
 def bubble_fraction(micro_batches: int, stages: int) -> float:
     """Pipeline bubble overhead (S-1)/(M+S-1) — the quantity the schedules and the
     SPMD executor both pay; exposed for autotuning."""

@@ -32,7 +32,6 @@ sites' contributions, replacing the reference's explicit tied-grad allreduce
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Callable, Optional, Tuple
 
 import jax
@@ -40,11 +39,6 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ...models.api import maybe_shard
-
-
-def pipeline_spec(batch_spec_tail: Tuple = ()) -> P:
-    """PartitionSpec of the [S, mb, ...] rotating buffer: stage axis over pp."""
-    return P("pp", *batch_spec_tail)
 
 
 def pipelined_apply(
