@@ -1,0 +1,188 @@
+// Async file I/O thread pool for ZeRO-Infinity-style swapping.
+//
+// Capability parity with the reference's AIO stack (csrc/aio/common/
+// deepspeed_aio_common.cpp, csrc/aio/py_lib/deepspeed_aio_thread.cpp:84,
+// deepspeed_py_aio_handle.cpp:282): a pool of worker threads servicing
+// read/write requests against files, with completion tracking, powering
+// optimizer-state/param swap to local SSD and async checkpoint writes.
+//
+// TPU-native framing: on TPU VMs the swap target is the local SSD / ramdisk;
+// the host side of ZeRO-Infinity is identical to the GPU case. Plain
+// pread/pwrite on the pool (portable; io_uring/libaio are kernel-config
+// dependent) — the concurrency model (queue + N workers + wait handles)
+// mirrors deepspeed_aio_thread.cpp.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <fcntl.h>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Request {
+  int id;
+  bool write;
+  std::string path;
+  void* buf;
+  int64_t nbytes;
+  int64_t offset;
+  bool fsync;
+};
+
+struct Pool {
+  std::vector<std::thread> workers;
+  std::deque<Request> queue;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::condition_variable done_cv;
+  std::atomic<bool> stop{false};
+  int next_id = 1;
+  // completed request ids with status (0 ok, negative errno)
+  std::mutex done_mu;
+  std::vector<std::pair<int, int>> done;
+  std::atomic<int> inflight{0};
+
+  void push_done(int id, int status) {
+    std::lock_guard<std::mutex> g(done_mu);
+    done.emplace_back(id, status);
+  }
+
+  int take_status(int id) {
+    std::lock_guard<std::mutex> g(done_mu);
+    for (auto it = done.begin(); it != done.end(); ++it) {
+      if (it->first == id) {
+        int s = it->second;
+        done.erase(it);
+        return s;
+      }
+    }
+    return 1;  // not finished
+  }
+};
+
+int do_io(const Request& r) {
+  int flags = r.write ? (O_WRONLY | O_CREAT) : O_RDONLY;
+  int fd = ::open(r.path.c_str(), flags, 0644);
+  if (fd < 0) return -errno;
+  char* p = (char*)r.buf;
+  int64_t left = r.nbytes;
+  int64_t off = r.offset;
+  while (left > 0) {
+    ssize_t n = r.write ? ::pwrite(fd, p, left, off) : ::pread(fd, p, left, off);
+    if (n < 0) {
+      int e = errno;
+      ::close(fd);
+      return -e;
+    }
+    if (n == 0) break;  // EOF on read
+    p += n;
+    left -= n;
+    off += n;
+  }
+  int rc = 0;
+  if (left != 0) rc = -EIO;
+  if (r.write && r.fsync && rc == 0 && ::fsync(fd) != 0) rc = -errno;
+  ::close(fd);
+  return rc;
+}
+
+void worker(Pool* pool) {
+  for (;;) {
+    Request r;
+    {
+      std::unique_lock<std::mutex> lk(pool->mu);
+      pool->cv.wait(lk, [&] { return pool->stop || !pool->queue.empty(); });
+      if (pool->stop && pool->queue.empty()) return;
+      r = pool->queue.front();
+      pool->queue.pop_front();
+    }
+    int rc = do_io(r);
+    pool->push_done(r.id, rc);
+    pool->inflight.fetch_sub(1);
+    pool->done_cv.notify_all();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ds_aio_create(int num_threads) {
+  auto* pool = new Pool();
+  if (num_threads < 1) num_threads = 1;
+  for (int i = 0; i < num_threads; ++i) pool->workers.emplace_back(worker, pool);
+  return pool;
+}
+
+void ds_aio_destroy(void* h) {
+  auto* pool = (Pool*)h;
+  {
+    std::lock_guard<std::mutex> g(pool->mu);
+    pool->stop = true;
+  }
+  pool->cv.notify_all();
+  for (auto& t : pool->workers) t.join();
+  delete pool;
+}
+
+static int submit(Pool* pool, bool write, const char* path, void* buf,
+                  int64_t nbytes, int64_t offset, int fsync) {
+  int id;
+  {
+    std::lock_guard<std::mutex> g(pool->mu);
+    id = pool->next_id++;
+    pool->queue.push_back(
+        Request{id, write, path, buf, nbytes, offset, fsync != 0});
+  }
+  pool->inflight.fetch_add(1);
+  pool->cv.notify_one();
+  return id;
+}
+
+// Submit async ops; returns a request id. The buffer must stay alive until wait.
+int ds_aio_pread(void* h, const char* path, void* buf, int64_t nbytes,
+                 int64_t offset) {
+  return submit((Pool*)h, false, path, buf, nbytes, offset, 0);
+}
+
+int ds_aio_pwrite(void* h, const char* path, const void* buf, int64_t nbytes,
+                  int64_t offset, int fsync) {
+  return submit((Pool*)h, true, path, (void*)buf, nbytes, offset, fsync);
+}
+
+// Block until request `id` completes; returns 0 on success, -errno on failure.
+int ds_aio_wait(void* h, int id) {
+  auto* pool = (Pool*)h;
+  for (;;) {
+    int s = pool->take_status(id);
+    if (s <= 0) return s;
+    std::unique_lock<std::mutex> lk(pool->done_mu);
+    pool->done_cv.wait_for(lk, std::chrono::milliseconds(50));
+  }
+}
+
+// Block until every submitted request completes; returns count still inflight (0).
+// Also discards completion records nobody waited on (fire-and-forget writes) so
+// the done list cannot grow without bound across training steps.
+int ds_aio_drain(void* h) {
+  auto* pool = (Pool*)h;
+  while (pool->inflight.load() > 0) {
+    std::unique_lock<std::mutex> lk(pool->done_mu);
+    pool->done_cv.wait_for(lk, std::chrono::milliseconds(50));
+  }
+  {
+    std::lock_guard<std::mutex> g(pool->done_mu);
+    pool->done.clear();
+  }
+  return 0;
+}
+
+int ds_aio_version() { return 1; }
+}

@@ -57,9 +57,20 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         }
         with open(os.path.join(ckpt_dir, "meta.json"), "w") as f:
             json.dump(meta, f, indent=2, default=str)
-        if save_latest:
-            with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-                f.write(tag)
+    # ZeRO-Offload: the fp32 master + moments live in host RAM/SSD on the runner.
+    # Written BEFORE the 'latest' pointer so a crash in between can never leave a
+    # resolvable tag with missing optimizer state.
+    offload = getattr(engine, "_offload", None)
+    if offload is not None and is_writer:
+        import numpy as np
+
+        if offload.master is None:  # checkpoint before the first step
+            offload.init_host_state()
+        np.savez(os.path.join(ckpt_dir, "host_optimizer.npz"),
+                 **offload.host_state_dict())
+    if is_writer and save_latest:
+        with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+            f.write(tag)
     comm.barrier("save_checkpoint")
     log_dist(f"saved checkpoint {ckpt_dir}")
     return ckpt_dir
@@ -93,6 +104,20 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     engine.global_steps = int(meta.get("global_steps", 0))
     engine.micro_steps = int(meta.get("micro_steps", 0))
     engine.skipped_steps = int(meta.get("skipped_steps", 0))
+    offload = getattr(engine, "_offload", None)
+    if offload is not None and load_optimizer_states:
+        host_path = os.path.join(ckpt_dir, "host_optimizer.npz")
+        if not os.path.exists(host_path):
+            raise FileNotFoundError(
+                f"checkpoint {ckpt_dir} has no host_optimizer.npz but the engine "
+                "runs ZeRO-Offload; pass load_optimizer_states=False to restart "
+                "the optimizer deliberately")
+        import numpy as np
+
+        if offload.master is None:
+            offload.init_host_state()
+        with np.load(host_path) as d:
+            offload.load_host_state_dict(dict(d))
     log_dist(f"loaded checkpoint {ckpt_dir}")
     return ckpt_dir, meta.get("client_state", {})
 

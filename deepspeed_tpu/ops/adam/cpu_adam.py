@@ -1,0 +1,146 @@
+"""Host-side SIMD Adam/Adagrad (ZeRO-Offload's CPU optimizer).
+
+Capability parity with the reference's ``DeepSpeedCPUAdam``
+(``ops/adam/cpu_adam.py:12`` wrapping ``csrc/adam/cpu_adam.cpp``) and
+``DeepSpeedCPUAdagrad`` (``ops/adagrad/cpu_adagrad.py``): the optimizer step runs
+on the host CPU over fp32 master state with hand-written SIMD (AVX2+FMA via
+:mod:`deepspeed_tpu.ops.op_builder`), producing a bf16 copy-back buffer for the
+device in the same pass (the reference's async fp16 copy-back,
+``cpu_adam.cpp:216-239``).
+
+Operates on numpy arrays in place; the engine-side driver is
+:class:`deepspeed_tpu.runtime.zero.offload.HostOffloadRunner`. Falls back to a
+pure-numpy step when no C++ toolchain is available (is_compatible probing,
+parity: ``op_builder/builder.py:236``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ...utils.logging import logger, warning_once
+from ..op_builder import get_builder
+
+
+def _as_f32(x: np.ndarray) -> np.ndarray:
+    assert x.dtype == np.float32 and x.flags["C_CONTIGUOUS"]
+    return x
+
+
+def _ptr(x: Optional[np.ndarray], typ):
+    if x is None:
+        return ctypes.cast(None, ctypes.POINTER(typ))
+    return x.ctypes.data_as(ctypes.POINTER(typ))
+
+
+class _NativeLib:
+    _lib = None
+    _tried = False
+
+    @classmethod
+    def get(cls):
+        if not cls._tried:
+            cls._tried = True
+            builder = get_builder("ds_cpu_ops")
+            if builder.is_compatible():
+                try:
+                    cls._lib = builder.load()
+                except Exception as e:  # toolchain present but build failed
+                    warning_once(f"cpu_adam: native build failed ({e}); numpy fallback")
+            else:
+                warning_once("cpu_adam: no C++ toolchain; numpy fallback")
+        return cls._lib
+
+
+class DeepSpeedCPUAdam:
+    """Fused host Adam/AdamW over flat fp32 arrays (in-place).
+
+    Unlike the torch reference there is no param-group machinery here — the
+    offload runner drives one flat buffer per pytree leaf.
+    """
+
+    def __init__(self, lr: float = 1e-3, betas: Tuple[float, float] = (0.9, 0.999),
+                 eps: float = 1e-8, weight_decay: float = 0.0,
+                 adamw_mode: bool = True, bias_correction: bool = True):
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self.bias_correction = bias_correction
+        self._lib = _NativeLib.get()
+
+    @property
+    def is_native(self) -> bool:
+        return self._lib is not None
+
+    def step(self, p: np.ndarray, m: np.ndarray, v: np.ndarray, g: np.ndarray,
+             step_count: int, lr: Optional[float] = None,
+             bf16_out: Optional[np.ndarray] = None) -> None:
+        """One Adam step over flat arrays; ``step_count`` is 1-based."""
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.betas
+        if self.bias_correction:
+            bc1 = 1.0 - b1 ** step_count
+            bc2 = 1.0 - b2 ** step_count
+        else:
+            bc1 = bc2 = 1.0
+        n = p.size
+        if self._lib is not None:
+            self._lib.ds_adam_step(
+                _ptr(_as_f32(p), ctypes.c_float), _ptr(_as_f32(m), ctypes.c_float),
+                _ptr(_as_f32(v), ctypes.c_float), _ptr(_as_f32(g), ctypes.c_float),
+                ctypes.c_int64(n), ctypes.c_float(lr), ctypes.c_float(b1),
+                ctypes.c_float(b2), ctypes.c_float(self.eps),
+                ctypes.c_float(self.weight_decay), ctypes.c_float(bc1),
+                ctypes.c_float(bc2), ctypes.c_int(1 if self.adamw_mode else 0),
+                _ptr(bf16_out, ctypes.c_uint16))
+            return
+        # numpy fallback (same math)
+        gi = g if (self.adamw_mode or not self.weight_decay) else g + self.weight_decay * p
+        np.multiply(m, b1, out=m)
+        m += (1.0 - b1) * gi
+        np.multiply(v, b2, out=v)
+        v += (1.0 - b2) * gi * gi
+        upd = (m / bc1) / (np.sqrt(v / bc2) + self.eps)
+        if self.weight_decay and self.adamw_mode:
+            upd += self.weight_decay * p
+        p -= lr * upd
+        if bf16_out is not None:
+            x = p.view(np.uint32)
+            bf16_out[:] = ((x + 0x7FFF + ((x >> 16) & 1)) >> 16).astype(np.uint16)
+
+
+class DeepSpeedCPUAdagrad:
+    """Host Adagrad (parity: ``ops/adagrad/cpu_adagrad.py:138``)."""
+
+    def __init__(self, lr: float = 1e-2, eps: float = 1e-10, weight_decay: float = 0.0):
+        self.lr = lr
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self._lib = _NativeLib.get()
+
+    @property
+    def is_native(self) -> bool:
+        return self._lib is not None
+
+    def step(self, p: np.ndarray, a: np.ndarray, g: np.ndarray,
+             lr: Optional[float] = None,
+             bf16_out: Optional[np.ndarray] = None) -> None:
+        lr = self.lr if lr is None else lr
+        if self._lib is not None:
+            self._lib.ds_adagrad_step(
+                _ptr(_as_f32(p), ctypes.c_float), _ptr(_as_f32(a), ctypes.c_float),
+                _ptr(_as_f32(g), ctypes.c_float), ctypes.c_int64(p.size),
+                ctypes.c_float(lr), ctypes.c_float(self.eps),
+                ctypes.c_float(self.weight_decay), _ptr(bf16_out, ctypes.c_uint16))
+            return
+        gi = g + self.weight_decay * p
+        a += gi * gi
+        p -= lr * gi / (np.sqrt(a) + self.eps)
+        if bf16_out is not None:
+            x = p.view(np.uint32)
+            bf16_out[:] = ((x + 0x7FFF + ((x >> 16) & 1)) >> 16).astype(np.uint16)

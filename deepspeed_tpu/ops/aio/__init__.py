@@ -1,0 +1,1 @@
+from .handle import AsyncIOHandle  # noqa: F401

@@ -1,0 +1,1 @@
+from .builder import CpuOpBuilder, OpBuilder, get_builder  # noqa: F401

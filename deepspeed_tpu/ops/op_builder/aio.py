@@ -1,0 +1,33 @@
+"""Builder for the async file-I/O library (parity: ``op_builder/async_io.py``)."""
+
+from __future__ import annotations
+
+import ctypes
+
+from .builder import OpBuilder
+
+
+class AsyncIOBuilder(OpBuilder):
+    NAME = "ds_aio"
+    SOURCES = ["aio.cpp"]
+    EXTRA_LDFLAGS = ["-lpthread"]
+
+    def load(self) -> ctypes.CDLL:
+        lib = super().load()
+        assert lib.ds_aio_version() == 1
+        lib.ds_aio_create.restype = ctypes.c_void_p
+        lib.ds_aio_create.argtypes = [ctypes.c_int]
+        lib.ds_aio_destroy.argtypes = [ctypes.c_void_p]
+        lib.ds_aio_pread.restype = ctypes.c_int
+        lib.ds_aio_pread.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64]
+        lib.ds_aio_pwrite.restype = ctypes.c_int
+        lib.ds_aio_pwrite.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int]
+        lib.ds_aio_wait.restype = ctypes.c_int
+        lib.ds_aio_wait.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ds_aio_drain.restype = ctypes.c_int
+        lib.ds_aio_drain.argtypes = [ctypes.c_void_p]
+        return lib

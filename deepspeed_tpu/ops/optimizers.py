@@ -233,11 +233,10 @@ def get_optimizer(name: str, params: Dict[str, Any]) -> Optimizer:
     """Build an optimizer from a DeepSpeed ``"optimizer"`` config block.
 
     Parity: ``runtime/engine.py:1315`` (_configure_basic_optimizer) name dispatch.
-    1-bit variants currently fall back to their dense counterparts (the
-    error-feedback compressed collective is a later milestone); the fallback warns.
+    1-bit variants return their dense counterparts — that IS the warmup-phase math;
+    the engine routes the compressed stage through
+    :class:`deepspeed_tpu.runtime.fp16.onebit.OnebitRunner`.
     """
-    from ..utils.logging import warning_once
-
     name_l = name.lower()
     lr_ignored = {k: v for k, v in params.items() if k != "lr"}
     betas = tuple(lr_ignored.get("betas", (0.9, 0.999)))
@@ -248,11 +247,8 @@ def get_optimizer(name: str, params: Dict[str, Any]) -> Optimizer:
                           adam_w_mode=(name_l != "adam") or lr_ignored.get("adam_w_mode", True),
                           bias_correction=lr_ignored.get("bias_correction", True))
     if name_l in ("onebitadam", "zerooneadam"):
-        warning_once(f"{name}: compressed collectives not yet enabled; using dense FusedAdam")
         return fused_adam(betas=betas, eps=eps, weight_decay=wd)
     if name_l in ("lamb", "fusedlamb", "onebitlamb"):
-        if name_l == "onebitlamb":
-            warning_once("OneBitLamb: compressed collectives not yet enabled; using dense LAMB")
         return fused_lamb(betas=betas, eps=eps, weight_decay=wd,
                           max_coeff=lr_ignored.get("max_coeff", 10.0),
                           min_coeff=lr_ignored.get("min_coeff", 0.01))

@@ -154,7 +154,12 @@ def _partition_saved(x, mp_axes: Sequence[str]):
     extent = 1
     for a in live:
         extent *= sizes[a]
-    for d in range(x.ndim):
+    # prefer trailing (feature/sequence) dims and never dim 0 of a batched
+    # activation: dim 0 is the batch, already sharded over dp — constraining it
+    # to the mp axes would force reshard collectives at every boundary instead
+    # of reducing per-rank saved memory
+    candidates = range(x.ndim - 1, 0, -1) if x.ndim >= 2 else range(x.ndim)
+    for d in candidates:
         if x.shape[d] % extent == 0 and x.shape[d] >= extent:
             spec = [None] * x.ndim
             spec[d] = tuple(live) if len(live) > 1 else live[0]

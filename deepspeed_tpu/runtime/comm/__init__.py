@@ -1,0 +1,8 @@
+"""Hand-written compressed collectives (parity: reference ``runtime/comm/``)."""
+
+from .compressed import (  # noqa: F401
+    compressed_allreduce,
+    compression_error_shapes,
+    pack_signs,
+    unpack_signs,
+)

@@ -1,0 +1,111 @@
+"""Error-compensated 1-bit compressed allreduce.
+
+Capability parity with the reference's hand-written compressed collectives
+(``runtime/comm/nccl.py:52`` ``NcclBackend.compressed_allreduce``,
+``runtime/comm/mpi.py:170``): the two-stage sign-compression allreduce with
+worker- and server-side error feedback that powers 1-bit Adam / 1-bit LAMB /
+0/1 Adam (``runtime/fp16/onebit/``).
+
+Algorithm (identical structure to the reference):
+
+1. worker: ``buf = x + worker_error``; one fp32 scale ``||buf||/sqrt(n)``;
+   signs packed to REAL 1-bit wire format (``jnp.packbits`` → uint8, 8 signs/byte);
+   ``worker_error = buf - scale * sign(buf)`` stays local.
+2. exchange: ``all_to_all`` of packed sign chunks over the compression axis — each
+   rank is the "server" for its 1/world chunk (the reference's allgather+local-chunk
+   reduction, ``nccl.py:84-118``); scales travel via a tiny ``all_gather``.
+3. server: decompress+average its chunk, compress the average again with
+   server-side error feedback, ``all_gather`` the result to everyone.
+
+Wire volume per rank ≈ ``2 * n/8`` bytes vs ``2 * n * 4`` uncompressed — the same
+~16x (fp32) / ~8x (fp16) reduction the reference reports.
+
+TPU-native notes: runs inside ``shard_map`` over a mesh axis; the packed uint8
+tensors ride ICI like any other array; everything fuses into the surrounding
+compiled step (no separate comm stream management — XLA schedules it).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def pack_signs(x: jnp.ndarray) -> jnp.ndarray:
+    """[n] float -> [n/8] uint8 of sign bits (1 = non-negative). n % 8 == 0."""
+    bits = (x >= 0).astype(jnp.uint8)
+    return jnp.packbits(bits)
+
+
+def unpack_signs(packed: jnp.ndarray, n: int) -> jnp.ndarray:
+    """[n/8] uint8 -> [n] float32 of ±1."""
+    bits = jnp.unpackbits(packed)[:n]
+    return 2.0 * bits.astype(jnp.float32) - 1.0
+
+
+def compression_error_shapes(n: int, world: int) -> Tuple[int, int]:
+    """(worker_error_size, server_error_size) for a flat buffer of ``n`` elements.
+
+    ``n`` must be padded by the caller to a multiple of ``world * 8`` (bit packing
+    by chunks). Parity: the reference pads the fused buffer the same way
+    (``nccl.py:60-76``).
+    """
+    if n % (world * 8) != 0:
+        raise ValueError(f"buffer size {n} must be a multiple of world*8={world * 8}")
+    return n, n // world
+
+
+def compressed_allreduce(
+    x: jnp.ndarray,
+    worker_error: jnp.ndarray,
+    server_error: jnp.ndarray,
+    axis_name: str,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One error-compensated compressed allreduce step (call inside shard_map).
+
+    Args:
+      x: [n] fp32 — this rank's local vector (e.g. local momentum).
+      worker_error: [n] fp32 — persistent worker error feedback.
+      server_error: [n/world] fp32 — persistent server error feedback (this rank's
+        chunk).
+      axis_name: mesh axis to compress over.
+
+    Returns ``(result, new_worker_error, new_server_error)`` where ``result`` is the
+    approximate mean of ``x`` across the axis, identical on all ranks.
+    """
+    n = x.shape[0]
+    world = jax.lax.psum(1, axis_name)
+
+    # ---- worker compression (ref nccl.py:77-83)
+    buf = x.astype(jnp.float32) + worker_error
+    scale_w = jnp.linalg.norm(buf) / np.sqrt(n)
+    signs = buf >= 0
+    new_worker_error = buf - scale_w * jnp.where(signs, 1.0, -1.0)
+
+    # ---- exchange: chunk c of every rank's signs goes to rank c (ref :84-101)
+    packed = jnp.packbits(signs.astype(jnp.uint8)).reshape(world, -1)  # [W, n/8W]
+    recv = jax.lax.all_to_all(packed, axis_name, split_axis=0, concat_axis=0,
+                              tiled=False)  # [W, n/8W]: rank j's view of my chunk
+    scales = jax.lax.all_gather(scale_w, axis_name)  # [W]
+
+    chunk = n // world
+    signs_per_rank = jax.vmap(lambda p: unpack_signs(p, chunk))(recv)  # [W, chunk]
+    chunk_avg = jnp.mean(scales[:, None] * signs_per_rank, axis=0)  # [chunk]
+
+    # ---- server compression of the averaged chunk (ref :102-118)
+    sbuf = chunk_avg + server_error
+    scale_s = jnp.linalg.norm(sbuf) / np.sqrt(chunk)
+    s_signs = sbuf >= 0
+    new_server_error = sbuf - scale_s * jnp.where(s_signs, 1.0, -1.0)
+    s_packed = jnp.packbits(s_signs.astype(jnp.uint8))  # [chunk/8]
+
+    # ---- broadcast all server chunks to everyone
+    all_packed = jax.lax.all_gather(s_packed, axis_name)  # [W, chunk/8]
+    all_scales = jax.lax.all_gather(scale_s, axis_name)  # [W]
+    all_signs = jax.vmap(lambda p: unpack_signs(p, chunk))(all_packed)  # [W, chunk]
+    result = (all_scales[:, None] * all_signs).reshape(n)
+
+    return result, new_worker_error, new_server_error

@@ -89,10 +89,34 @@ class DeepSpeedEngine:
         if config.comms_logger.enabled:
             comm.configure(enabled=True, verbose=config.comms_logger.verbose)
 
-        # parity: engine._configure_checkpointing → activation-ckpt global config
+        # parity: engine._configure_checkpointing → activation-ckpt global config.
+        # An explicit user configure() wins unless the JSON actually carries a
+        # non-default activation_checkpointing block (the reference honors the
+        # Megatron-style pre-initialize configure call the same way).
         from .activation_checkpointing import configure as _ac_configure
+        from .activation_checkpointing import is_configured as _ac_is_configured
+        from .config import ActivationCheckpointingConfig as _ACConfig
 
-        _ac_configure(deepspeed_config=config)
+        if (not _ac_is_configured()
+                or config.activation_checkpointing != _ACConfig()):
+            _ac_configure(deepspeed_config=config)
+
+        # 1-bit optimizers: warmup runs the normal dense program; the compressed
+        # stage is a dedicated shard_map program (runtime/fp16/onebit.py)
+        self._onebit = None
+        _opt_type = (config.optimizer.type.lower() if config.optimizer else "")
+        if _opt_type in ("onebitadam", "onebitlamb", "zerooneadam"):
+            from .fp16.onebit import OnebitRunner
+
+            self._onebit = OnebitRunner(self, _opt_type, config.optimizer.params)
+
+        # ZeRO-Offload: optimizer state in host RAM, stepped by the native C++
+        # SIMD optimizer (runtime/zero/offload.py); device keeps bf16 params only
+        self._offload = None
+        self._offload_requested = (
+            config.zero_optimization.offload_optimizer_device in ("cpu", "nvme"))
+        if self._offload_requested and self._onebit is not None:
+            raise ValueError("offload_optimizer and 1-bit optimizers are exclusive")
 
         # ---------------- optimizer + lr schedule
         opt_cfg = config.optimizer
@@ -150,6 +174,10 @@ class DeepSpeedEngine:
         # ---------------- build state + compiled steps
         self.state = self._init_state()
         self.state_shardings = jax.tree_util.tree_map(lambda x: x.sharding, self.state)
+        if self._offload_requested:
+            from .zero.offload import HostOffloadRunner
+
+            self._offload = HostOffloadRunner(self)
         self._compile_steps()
         n_params = count_parameters(self.state["params"])
         log_dist(
@@ -166,6 +194,17 @@ class DeepSpeedEngine:
             params_f32 = _constrain(params_f32, jax.tree_util.tree_map(
                 lambda s: NamedSharding(self.mesh, s), pspecs))
             params = cast_to_compute(params_f32, self.pc)
+            if self._offload_requested:
+                # master + moments live in host RAM (HostOffloadRunner); device
+                # state holds only the compute-dtype params
+                return {
+                    "params": params,
+                    "master": {},
+                    "opt": {},
+                    "step": jnp.zeros((), jnp.int32),
+                    "micro": jnp.zeros((), jnp.int32),
+                    "scaler": init_scaler_state(self.pc),
+                }
             master = make_master(params_f32, self.pc)
             if master is not None:
                 master = _constrain(master, self.opt_leaf_shardings)
@@ -189,6 +228,8 @@ class DeepSpeedEngine:
 
         with mesh_context(self.mesh):
             state = jax.jit(init_fn)(self._rng)
+        if self._onebit is not None:
+            state["onebit"] = self._onebit.init_state()
         return state
 
     # ------------------------------------------------------------------ compiled fns
@@ -256,14 +297,15 @@ class DeepSpeedEngine:
             new_params = _constrain(new_target, self.param_shardings)
 
         new_scaler = update_scaler(self.pc, state["scaler"], finite)
-        new_state = {
+        new_state = dict(state)  # passthrough for extra keys (e.g. onebit errors)
+        new_state.update({
             "params": new_params,
             "master": new_master,
             "opt": new_opt,
             "step": state["step"] + 1,
             "micro": jnp.zeros((), jnp.int32),
             "scaler": new_scaler,
-        }
+        })
         metrics = {
             "grad_norm": gnorm,
             "lr": lr,
@@ -343,6 +385,15 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------ public API
     def forward(self, batch) -> jnp.ndarray:
         """Run fwd (+bwd, see module docstring) on one micro-batch; returns the loss."""
+        if self._onebit is not None:
+            raise RuntimeError(
+                "1-bit optimizers use the fused train_batch() API (the compressed "
+                "stage is a single program; the split forward/backward/step surface "
+                "cannot express per-rank gradient exchange)")
+        if self._offload is not None:
+            raise RuntimeError(
+                "ZeRO-Offload uses the fused train_batch() API (the host optimizer "
+                "step is driven once per global batch)")
         if self.wall_clock_breakdown():
             self.timers("forward").start()
         batch = self._place_batch(batch)
@@ -408,8 +459,13 @@ class DeepSpeedEngine:
         Parity: ``PipelineEngine.train_batch``-style one-call API."""
         self.tput_timer.start()
         batch = self._place_batch(batch, leading_gas=True)
-        with mesh_context(self.mesh):
-            self.state, metrics = self._train_batch_jit(self.state, batch, self._next_rng())
+        runner = self._onebit or self._offload
+        if runner is not None:
+            self.state, metrics = runner.train_batch(batch, self._next_rng())
+        else:
+            with mesh_context(self.mesh):
+                self.state, metrics = self._train_batch_jit(
+                    self.state, batch, self._next_rng())
         self.micro_steps += self.gas
         self._last_loss = metrics["loss"]
         self._finish_step(metrics)

@@ -1,0 +1,306 @@
+"""1-bit optimizers: OnebitAdam, OnebitLamb, ZeroOneAdam.
+
+Capability parity with the reference's error-compensated compressed optimizers
+(``runtime/fp16/onebit/adam.py:11``, ``lamb.py:12``, ``zoadam.py:11``): a two-phase
+state machine — dense warmup, then a compressed stage where the heavy collective
+is replaced by the 1-bit error-feedback allreduce
+(:mod:`deepspeed_tpu.runtime.comm.compressed`).
+
+Phase semantics (matching the reference):
+
+- **warmup** (``step < freeze_step``): plain dense Adam/LAMB — the engine's normal
+  fused train step (the reference likewise runs vanilla Adam, ``adam.py:240-253``).
+- **compressed** (``step >= freeze_step``):
+  - *OnebitAdam*: variance ``v`` frozen; each worker folds its LOCAL gradient into
+    momentum, and the momentum (not the gradient) is compressed-allreduced
+    (``adam.py:180-232``).
+  - *OnebitLamb*: same compressed-momentum exchange plus per-tensor trust ratio on
+    the reconstructed update (``lamb.py``).
+  - *ZeroOneAdam*: the gradient itself is compressed-allreduced; variance keeps
+    updating until ``var_freeze_step`` (``zoadam.py``).
+
+TPU-native structure: the compressed stage is ONE jitted program whose core runs in
+``shard_map`` over the ``dp`` axis — the only place in the framework where gradients
+must exist per-rank *before* averaging (everywhere else XLA's implicit psum is the
+right thing). The phase switch is a host-level decision exactly like the
+reference's python step counter.
+
+Restrictions (mirroring the reference's documented ones — 1-bit optimizers don't
+compose with ZeRO ≥ 2 or model parallelism there either): requires pure data
+parallelism (tp=pp=sp=ep=1) and ZeRO stage 0, bf16 or fp32 (no dynamic loss
+scaling), and the fused ``train_batch`` API.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...utils.logging import log_dist, logger
+from ..comm.compressed import compressed_allreduce
+
+ONEBIT_TYPES = ("onebitadam", "onebitlamb", "zerooneadam")
+
+
+@dataclasses.dataclass(frozen=True)
+class OnebitParams:
+    variant: str  # "onebitadam" | "onebitlamb" | "zerooneadam"
+    betas: tuple = (0.9, 0.999)
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    freeze_step: int = 100
+    var_freeze_step: int = 100  # zerooneadam only
+    max_coeff: float = 10.0  # lamb trust clip
+    min_coeff: float = 0.01
+    bias_correction: bool = True
+
+    @classmethod
+    def from_config(cls, variant: str, params: Dict[str, Any]) -> "OnebitParams":
+        return cls(
+            variant=variant,
+            betas=tuple(params.get("betas", (0.9, 0.999))),
+            eps=params.get("eps", 1e-8),
+            weight_decay=params.get("weight_decay", 0.0),
+            freeze_step=int(params.get("freeze_step", 100)),
+            var_freeze_step=int(params.get("var_freeze_step",
+                                           params.get("freeze_step", 100))),
+            max_coeff=params.get("max_coeff", 10.0),
+            min_coeff=params.get("min_coeff", 0.01),
+            bias_correction=params.get("bias_correction", True),
+        )
+
+
+def _flatten(tree) -> jnp.ndarray:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.concatenate([l.astype(jnp.float32).ravel() for l in leaves])
+
+
+def _unflatten(flat: jnp.ndarray, tree):
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out, off = [], 0
+    for l in leaves:
+        n = int(np.prod(l.shape)) if l.shape else 1
+        out.append(flat[off:off + n].reshape(l.shape))
+        off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+class OnebitRunner:
+    """Owns the compressed-stage program + error-feedback state for an engine."""
+
+    def __init__(self, engine, variant: str, params: Dict[str, Any]):
+        self.engine = engine
+        self.p = OnebitParams.from_config(variant, params)
+        topo = engine.topo
+        if (topo.model_parallel_size > 1 or topo.pipe_parallel_size > 1
+                or topo.sequence_parallel_size > 1 or topo.expert_parallel_size > 1):
+            raise ValueError(
+                f"{variant}: 1-bit optimizers require pure data parallelism "
+                "(tp=pp=sp=ep=1), matching the reference's restrictions")
+        if engine.policy.stage >= 2:
+            raise ValueError(
+                f"{variant}: incompatible with ZeRO stage >= 2 (reference parity); "
+                "use stage 0/1")
+        if engine.pc.loss_scaling:
+            raise ValueError(f"{variant}: dynamic loss scaling unsupported; use bf16")
+        self.world = topo.axes["dp"]
+        self._compressed_jit = None
+        n = int(sum(int(np.prod(l.shape) or 1) for l in
+                    jax.tree_util.tree_leaves(
+                        jax.eval_shape(engine.model.init, jax.random.PRNGKey(0)))))
+        pad_to = max(self.world * 8, 1)
+        self.n_elems = n
+        self.n_pad = ((n + pad_to - 1) // pad_to) * pad_to
+        log_dist(f"{variant}: freeze_step={self.p.freeze_step}, "
+                 f"{n} params (padded {self.n_pad}) over dp={self.world}")
+
+    # ------------------------------------------------------------------ state
+    def init_state(self) -> Dict[str, jnp.ndarray]:
+        """Error-feedback buffers, part of engine.state (checkpointed)."""
+        mesh = self.engine.mesh
+        W, Np = self.world, self.n_pad
+        werr = jnp.zeros((W, Np), jnp.float32)
+        serr = jnp.zeros((W, Np // W), jnp.float32)
+        werr = jax.device_put(werr, NamedSharding(mesh, P("dp", None)))
+        serr = jax.device_put(serr, NamedSharding(mesh, P("dp", None)))
+        return {"worker_error": werr, "server_error": serr}
+
+    # ------------------------------------------------------------------ stage 2 program
+    def _build_compressed(self):
+        engine = self.engine
+        p = self.p
+        b1, b2 = p.betas
+        W, Np = self.world, self.n_pad
+        mesh = engine.mesh
+
+        param_specs_repl = jax.tree_util.tree_map(lambda _: P(), engine.param_specs)
+
+        def local_grads(params, batch, rng):
+            def loss_fn(q):
+                out = engine.model.apply(q, batch, rngs={"dropout": rng}, train=True)
+                loss, aux = out if isinstance(out, tuple) else (out, {})
+                return loss.astype(jnp.float32), loss
+
+            g, loss = jax.grad(loss_fn, has_aux=True)(params)
+            return g, loss
+
+        has_master = bool(engine.state["master"])
+
+        def body(params, master, mu, nu, count, werr, serr, batch, rng, lr):  # noqa: C901
+            # params/master/mu/nu replicated; batch is the LOCAL dp shard;
+            # werr [1, Np] / serr [1, Np/W] are this rank's rows
+            rng = jax.random.fold_in(rng, jax.lax.axis_index("dp"))
+            gas = engine.gas
+
+            if gas == 1:
+                g_tree, loss = local_grads(params, batch, rng)
+            else:
+                rngs = jax.random.split(rng, gas)
+
+                def scan_body(acc, xs):
+                    mb, r = xs
+                    g, l = local_grads(params, mb, r)
+                    acc = jax.tree_util.tree_map(
+                        lambda a, b: a + b / gas, acc, g)
+                    return acc, l
+
+                zero = jax.tree_util.tree_map(
+                    lambda x: jnp.zeros(x.shape, jnp.float32), params)
+                g_tree, losses = jax.lax.scan(scan_body, zero, (batch, rngs))
+                loss = jnp.mean(losses)
+
+            g_flat = _flatten(g_tree)
+            g_flat = jnp.pad(g_flat, (0, Np - self.n_elems))
+            mu_flat = _flatten(mu)
+            mu_flat = jnp.pad(mu_flat, (0, Np - self.n_elems))
+
+            new_count = count + 1
+            cf = new_count.astype(jnp.float32)
+            bc1 = 1.0 - b1 ** cf if p.bias_correction else jnp.float32(1.0)
+            # the variance is frozen past its freeze boundary, so its bias
+            # correction must freeze with it — otherwise the denominator
+            # sqrt(v/bc2) keeps shrinking as bc2 -> 1 and the step size silently
+            # inflates (the reference sidesteps this by dropping bias correction
+            # in the compressed stage, adam.py:216; freezing the factor is the
+            # numerically-continuous version of the same choice)
+            v_freeze = float(p.var_freeze_step if p.variant == "zerooneadam"
+                             else p.freeze_step)
+            cf2 = jnp.minimum(cf, v_freeze)
+            bc2 = 1.0 - b2 ** cf2 if p.bias_correction else jnp.float32(1.0)
+
+            if p.variant == "zerooneadam":
+                # compress the gradient itself; momentum/variance follow locally
+                g_avg, w_new, s_new = compressed_allreduce(
+                    g_flat, werr[0], serr[0], "dp")
+                m_new_flat = b1 * mu_flat + (1.0 - b1) * g_avg
+                nu_flat = jnp.pad(_flatten(nu), (0, Np - self.n_elems))
+                # variance keeps updating until var_freeze_step, then freezes
+                v_upd = b2 * nu_flat + (1.0 - b2) * g_avg * g_avg
+                v_new_flat = jnp.where(count < p.var_freeze_step, v_upd, nu_flat)
+            else:
+                # onebit adam/lamb: fold LOCAL grad into momentum, compress momentum
+                m_local = b1 * mu_flat + (1.0 - b1) * g_flat
+                m_new_flat, w_new, s_new = compressed_allreduce(
+                    m_local, werr[0], serr[0], "dp")
+                nu_flat = jnp.pad(_flatten(nu), (0, Np - self.n_elems))
+                v_new_flat = nu_flat  # frozen
+
+            upd_flat = (m_new_flat / bc1) / (jnp.sqrt(v_new_flat / bc2) + p.eps)
+            upd_tree = _unflatten(upd_flat[:self.n_elems], params)
+            m_tree = _unflatten(m_new_flat[:self.n_elems], mu)
+            v_tree = _unflatten(v_new_flat[:self.n_elems], nu)
+
+            def apply_leaf(tgt, u):
+                t32 = tgt.astype(jnp.float32)
+                u = u + p.weight_decay * t32 if p.weight_decay else u
+                if p.variant == "onebitlamb":
+                    w_norm = jnp.linalg.norm(t32)
+                    u_norm = jnp.linalg.norm(u)
+                    trust = jnp.where(
+                        (w_norm > 0) & (u_norm > 0),
+                        jnp.clip(w_norm / u_norm, p.min_coeff, p.max_coeff), 1.0)
+                    u = trust * u
+                return t32 - lr * u  # fp32; cast below
+
+            # step the fp32 master when one exists (bf16 mode) — updating bf16
+            # params directly would round away small updates and leave the saved
+            # master stale
+            target = master if has_master else params
+            new_target = jax.tree_util.tree_map(apply_leaf, target, upd_tree)
+            new_params = jax.tree_util.tree_map(
+                lambda t, pr: t.astype(pr.dtype), new_target, params)
+            new_master = new_target if has_master else master
+            loss_mean = jax.lax.pmean(loss, "dp")
+            gnorm = jnp.linalg.norm(upd_flat)
+            return (new_params, new_master, m_tree, v_tree, new_count,
+                    w_new[None, :], s_new[None, :], loss_mean, gnorm)
+
+        bspec = P(("dp",))
+
+        def step(state, batch, rng):
+            opt = state["opt"]
+            ob = state["onebit"]
+            lr = jnp.asarray(engine.lr_fn(state["step"]), jnp.float32)
+            batch_specs = jax.tree_util.tree_map(
+                lambda _: P(None, "dp") if engine.gas > 1 else bspec, batch)
+            master_specs = jax.tree_util.tree_map(lambda _: P(), state["master"])
+            sm = shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(param_specs_repl, master_specs,
+                          jax.tree_util.tree_map(lambda _: P(), opt.mu),
+                          jax.tree_util.tree_map(lambda _: P(), opt.nu),
+                          P(), P("dp", None), P("dp", None),
+                          batch_specs, P(), P()),
+                out_specs=(param_specs_repl, master_specs,
+                           jax.tree_util.tree_map(lambda _: P(), opt.mu),
+                           jax.tree_util.tree_map(lambda _: P(), opt.nu),
+                           P(), P("dp", None), P("dp", None), P(), P()),
+                check_vma=False,
+            )
+            (new_params, new_master, m, v, count, werr, serr, loss, gnorm) = sm(
+                state["params"], state["master"], opt.mu, opt.nu, opt.count,
+                ob["worker_error"], ob["server_error"], batch, rng, lr)
+            new_state = dict(state)
+            new_state["params"] = new_params
+            new_state["master"] = new_master
+            new_state["opt"] = type(opt)(count=count, mu=m, nu=v)
+            new_state["onebit"] = {"worker_error": werr, "server_error": serr}
+            new_state["step"] = state["step"] + 1
+            metrics = {
+                "loss": loss,
+                "grad_norm": gnorm,
+                "lr": lr,
+                "loss_scale": jnp.float32(1.0),
+                "overflow": jnp.bool_(False),
+            }
+            return new_state, metrics
+
+        ss = self.engine.state_shardings
+        return jax.jit(step, in_shardings=(ss, None, None),
+                       out_shardings=(ss, None), donate_argnums=(0,))
+
+    # ------------------------------------------------------------------ dispatch
+    def train_batch(self, batch, rng):
+        engine = self.engine
+        if engine.global_steps < self.p.freeze_step:
+            # dense warmup phase — the engine's normal fused program
+            from ..topology import mesh_context
+
+            with mesh_context(engine.mesh):
+                return engine._train_batch_jit(engine.state, batch, rng)
+        if self._compressed_jit is None:
+            log_dist(f"{self.p.variant}: entering compressed stage at step "
+                     f"{engine.global_steps} (freeze_step={self.p.freeze_step})")
+            self._compressed_jit = self._build_compressed()
+        from ..topology import mesh_context
+
+        with mesh_context(engine.mesh):
+            return self._compressed_jit(engine.state, batch, rng)

@@ -1,0 +1,92 @@
+"""Native AIO library + NVMe optimizer swapping (ZeRO-Infinity path)."""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.ops.aio import AsyncIOHandle
+from deepspeed_tpu.runtime.swap_tensor import NVMeLeafStore
+
+
+def test_aio_roundtrip(tmp_path, rng):
+    h = AsyncIOHandle(num_threads=2)
+    data = rng.normal(size=4096).astype(np.float32)
+    path = str(tmp_path / "blob.bin")
+    rid = h.pwrite(path, data, fsync=True)
+    assert h.wait(rid) == 0
+    out = np.empty_like(data)
+    rid = h.pread(path, out)
+    assert h.wait(rid) == 0
+    np.testing.assert_array_equal(out, data)
+    h.close()
+
+
+def test_aio_many_concurrent(tmp_path, rng):
+    h = AsyncIOHandle(num_threads=4)
+    blobs = [rng.normal(size=1024).astype(np.float32) for _ in range(16)]
+    rids = [h.pwrite(str(tmp_path / f"b{i}.bin"), b) for i, b in enumerate(blobs)]
+    h.drain()
+    outs = [np.empty_like(b) for b in blobs]
+    rids = [h.pread(str(tmp_path / f"b{i}.bin"), o) for i, o in enumerate(outs)]
+    for rid in rids:
+        assert h.wait(rid) == 0
+    for o, b in zip(outs, blobs):
+        np.testing.assert_array_equal(o, b)
+    h.close()
+
+
+def test_aio_read_missing_file_fails(tmp_path):
+    h = AsyncIOHandle(num_threads=1)
+    buf = np.empty(16, np.float32)
+    rid = h.pread(str(tmp_path / "nope.bin"), buf)
+    assert h.wait(rid) < 0
+    h.close()
+
+
+def test_leaf_store_roundtrip(tmp_path, rng):
+    store = NVMeLeafStore(str(tmp_path / "opt"), aio_threads=2)
+    leaves = [rng.normal(size=(8, 4)).astype(np.float32),
+              rng.normal(size=(16,)).astype(np.float32)]
+    store.write_init(leaves)
+    m0, mm0, vv0 = store.get(0)
+    np.testing.assert_array_equal(m0, leaves[0])
+    np.testing.assert_array_equal(mm0, np.zeros_like(leaves[0]))
+    m0 += 1.0
+    store.writeback(0, m0, mm0, vv0)
+    store.drain()
+    m1, _, _ = store.get(1)
+    np.testing.assert_array_equal(m1, leaves[1])
+    m0b, _, _ = store.get(0)
+    np.testing.assert_array_equal(m0b, leaves[0] + 1.0)
+
+
+def test_nvme_offload_training_matches_cpu_offload(tmp_path):
+    from deepspeed_tpu.models import build_gpt
+    from deepspeed_tpu.models.gpt import GPTConfig
+
+    def make(dev_cfg):
+        model, cfg = build_gpt(GPTConfig(
+            vocab_size=128, d_model=32, n_layer=2, n_head=2, max_seq_len=32))
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2, "offload_optimizer": dev_cfg},
+            "steps_per_print": 0,
+        })
+        return engine, cfg
+
+    e_nvme, cfg = make({"device": "nvme", "nvme_path": str(tmp_path)})
+    e_cpu, _ = make({"device": "cpu"})
+    assert e_nvme._offload.store is not None
+    r = np.random.default_rng(0)
+    for i in range(3):
+        b = {"input_ids": r.integers(0, 128, size=(16, 16), dtype=np.int32)}
+        m1 = e_nvme.train_batch(b)
+        m2 = e_cpu.train_batch(b)
+        # identical math, different storage medium
+        np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-6)
+    # state actually lives on disk
+    import os
+
+    files = os.listdir(str(tmp_path / "optimizer"))
+    assert any(f.startswith("leaf_0_") for f in files)
