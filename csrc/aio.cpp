@@ -22,6 +22,7 @@
 #include <string>
 #include <thread>
 #include <unistd.h>
+#include <unordered_set>
 #include <vector>
 
 namespace {
@@ -44,16 +45,22 @@ struct Pool {
   std::condition_variable done_cv;
   std::atomic<bool> stop{false};
   int next_id = 1;
-  // completed request ids with status (0 ok, negative errno)
+  // completed request ids with status (0 ok, negative errno); `pending` tracks
+  // submitted-but-unfinished ids so wait() can distinguish "still running"
+  // from "already completed and its record consumed/discarded"
   std::mutex done_mu;
   std::vector<std::pair<int, int>> done;
+  std::unordered_set<int> pending;
   std::atomic<int> inflight{0};
 
   void push_done(int id, int status) {
     std::lock_guard<std::mutex> g(done_mu);
+    pending.erase(id);
     done.emplace_back(id, status);
   }
 
+  // Returns the status (<= 0) if finished, 1 if still pending, 0 if unknown
+  // (already waited on, or discarded by drain — treated as completed OK).
   int take_status(int id) {
     std::lock_guard<std::mutex> g(done_mu);
     for (auto it = done.begin(); it != done.end(); ++it) {
@@ -63,7 +70,7 @@ struct Pool {
         return s;
       }
     }
-    return 1;  // not finished
+    return pending.count(id) ? 1 : 0;
   }
 };
 
@@ -138,10 +145,19 @@ static int submit(Pool* pool, bool write, const char* path, void* buf,
   {
     std::lock_guard<std::mutex> g(pool->mu);
     id = pool->next_id++;
+  }
+  // bookkeeping BEFORE the request becomes runnable, or a fast worker could
+  // complete it and erase a pending entry that was never inserted
+  {
+    std::lock_guard<std::mutex> g(pool->done_mu);
+    pool->pending.insert(id);
+  }
+  pool->inflight.fetch_add(1);
+  {
+    std::lock_guard<std::mutex> g(pool->mu);
     pool->queue.push_back(
         Request{id, write, path, buf, nbytes, offset, fsync != 0});
   }
-  pool->inflight.fetch_add(1);
   pool->cv.notify_one();
   return id;
 }

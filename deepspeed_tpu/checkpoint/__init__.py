@@ -115,7 +115,7 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         import numpy as np
 
         if offload.master is None:
-            offload.init_host_state()
+            offload.init_host_state(for_load=True)
         with np.load(host_path) as d:
             offload.load_host_state_dict(dict(d))
     log_dist(f"loaded checkpoint {ckpt_dir}")

@@ -30,6 +30,13 @@ def _as_f32(x: np.ndarray) -> np.ndarray:
     return x
 
 
+def _write_bf16(p: np.ndarray, bf16_out: np.ndarray) -> None:
+    """Round-to-nearest-even fp32 -> bf16 (ml_dtypes does the bit math)."""
+    import ml_dtypes
+
+    bf16_out[:] = p.astype(ml_dtypes.bfloat16).view(np.uint16)
+
+
 def _ptr(x: Optional[np.ndarray], typ):
     if x is None:
         return ctypes.cast(None, ctypes.POINTER(typ))
@@ -110,8 +117,7 @@ class DeepSpeedCPUAdam:
             upd += self.weight_decay * p
         p -= lr * upd
         if bf16_out is not None:
-            x = p.view(np.uint32)
-            bf16_out[:] = ((x + 0x7FFF + ((x >> 16) & 1)) >> 16).astype(np.uint16)
+            _write_bf16(p, bf16_out)
 
 
 class DeepSpeedCPUAdagrad:
@@ -142,5 +148,4 @@ class DeepSpeedCPUAdagrad:
         a += gi * gi
         p -= lr * gi / (np.sqrt(a) + self.eps)
         if bf16_out is not None:
-            x = p.view(np.uint32)
-            bf16_out[:] = ((x + 0x7FFF + ((x >> 16) & 1)) >> 16).astype(np.uint16)
+            _write_bf16(p, bf16_out)

@@ -37,6 +37,17 @@ def _build_dir() -> str:
     return d
 
 
+def _cpu_supports(feature: str) -> bool:
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    return feature in line.split()
+    except OSError:
+        pass
+    return False
+
+
 def _try_compile(cxx: str, flags: List[str]) -> bool:
     src = "int main(){return 0;}"
     with tempfile.TemporaryDirectory() as td:
@@ -78,7 +89,10 @@ class OpBuilder:
         flags = []
         if os.environ.get("DS_TPU_DISABLE_SIMD"):
             return flags
-        if _try_compile(cxx, ["-mavx2", "-mfma"]):
+        # the compiler accepting -mavx2 says nothing about the host CPU; gate on
+        # the actual cpuinfo flags or the binary dies with SIGILL at first use
+        if _cpu_supports("avx2") and _cpu_supports("fma") and \
+                _try_compile(cxx, ["-mavx2", "-mfma"]):
             flags += ["-mavx2", "-mfma"]
         if _try_compile(cxx, ["-fopenmp"]):
             flags += ["-fopenmp"]
@@ -104,7 +118,8 @@ class OpBuilder:
         out = os.path.join(_build_dir(), f"{self.NAME}-{sig}.so")
         if os.path.exists(out):
             return out
-        tmp = out + ".tmp"
+        tmp = f"{out}.{os.getpid()}.tmp"  # unique per process: concurrent cold
+        # builds each publish atomically via os.replace instead of interleaving
         r = subprocess.run([*cmd, "-o", tmp, *self.EXTRA_LDFLAGS],
                            capture_output=True, text=True, timeout=600)
         if r.returncode != 0:

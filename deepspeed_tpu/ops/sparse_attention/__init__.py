@@ -1,0 +1,10 @@
+from .sparsity_config import (  # noqa: F401
+    BigBirdSparsityConfig,
+    BSLongformerSparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    LocalSlidingWindowSparsityConfig,
+    SparsityConfig,
+    VariableSparsityConfig,
+)
+from .sparse_self_attention import SparseSelfAttention, sparse_attention  # noqa: F401

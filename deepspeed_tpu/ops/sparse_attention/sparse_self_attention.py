@@ -1,0 +1,70 @@
+"""Sparse self-attention module.
+
+Capability parity with the reference's ``SparseSelfAttention``
+(``ops/sparse_attention/sparse_self_attention.py:11``): computes
+softmax(QK^T * scale + mask) V restricted to a :class:`SparsityConfig` block
+layout. The reference composes three Triton kernels (SDD matmul, blocksparse
+softmax, DSD matmul); here it is one fused Pallas kernel
+(:func:`deepspeed_tpu.ops.pallas.blocksparse_attention.blocksparse_attention`).
+
+Layouts are cached per sequence length (parity: the reference's
+``master_layout`` buffer + ``get_layout``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .sparsity_config import FixedSparsityConfig, SparsityConfig
+
+
+def sparse_attention(
+    q: jnp.ndarray,  # [B, T, H, D]
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    config: SparsityConfig,
+    causal: Optional[bool] = None,
+    softmax_scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Functional one-shot API (builds/caches the layout via the config)."""
+    return SparseSelfAttention(config, causal=causal)(
+        q, k, v, softmax_scale=softmax_scale)
+
+
+class SparseSelfAttention:
+    """Holds a sparsity config; callable on [B, T, H, D] q/k/v."""
+
+    def __init__(self, sparsity_config: Optional[SparsityConfig] = None,
+                 causal: Optional[bool] = None,
+                 max_seq_length: int = 2048):
+        self.sparsity_config = sparsity_config or FixedSparsityConfig(num_heads=4)
+        attn = getattr(self.sparsity_config, "attention", "bidirectional")
+        self.causal = causal if causal is not None else (attn == "unidirectional")
+        self.max_seq_length = max_seq_length
+        self._layouts: Dict[int, np.ndarray] = {}
+
+    def get_layout(self, seq_len: int) -> np.ndarray:
+        if seq_len not in self._layouts:
+            self._layouts[seq_len] = self.sparsity_config.make_layout(seq_len)
+        return self._layouts[seq_len]
+
+    def density(self, seq_len: int) -> float:
+        layout = self.get_layout(seq_len)
+        return float(layout.mean())
+
+    def __call__(self, q, k, v, softmax_scale: Optional[float] = None):
+        from ..pallas.blocksparse_attention import blocksparse_attention
+
+        B, T, H, D = q.shape
+        if H != self.sparsity_config.num_heads:
+            raise ValueError(
+                f"q has {H} heads but the sparsity config declares "
+                f"{self.sparsity_config.num_heads}")
+        layout = self.get_layout(T)
+        return blocksparse_attention(
+            q, k, v, layout, self.sparsity_config.block,
+            causal=self.causal, softmax_scale=softmax_scale)

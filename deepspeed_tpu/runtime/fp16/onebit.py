@@ -238,7 +238,9 @@ class OnebitRunner:
                 lambda t, pr: t.astype(pr.dtype), new_target, params)
             new_master = new_target if has_master else master
             loss_mean = jax.lax.pmean(loss, "dp")
-            gnorm = jnp.linalg.norm(upd_flat)
+            # norm over real elements only: padding has v=0 but nonzero
+            # compressed momentum, which would blow the norm up to ~scale/eps
+            gnorm = jnp.linalg.norm(upd_flat[:self.n_elems])
             return (new_params, new_master, m_tree, v_tree, new_count,
                     w_new[None, :], s_new[None, :], loss_mean, gnorm)
 

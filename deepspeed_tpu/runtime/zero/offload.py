@@ -90,12 +90,24 @@ class HostOffloadRunner:
                  f"{', NVMe swap' if self.store is not None else ''})")
 
     # ------------------------------------------------------------------ state
-    def init_host_state(self) -> None:
+    def init_host_state(self, for_load: bool = False) -> None:
+        """``for_load``: a checkpoint load follows immediately — only shapes are
+        needed, skip writing fresh state that would be overwritten at once."""
         flat, self._treedef = _leaves(self.engine.state["params"])
-        masters = [np.array(jax.device_get(l), np.float32, copy=True) for l in flat]
         if self.store is not None:
-            self.store.write_init(masters)
+            if for_load:
+                self.store.shapes = [tuple(l.shape) for l in flat]
+            else:
+                self.store.write_init([
+                    np.array(jax.device_get(l), np.float32, copy=True) for l in flat])
             self.master = "nvme"  # sentinel: state lives on disk
+            return
+        masters = [np.array(jax.device_get(l), np.float32, copy=True) for l in flat]
+        if for_load:
+            # placeholders with the right shapes; load_host_state_dict replaces them
+            self.master = masters
+            self.m = [np.zeros_like(x) for x in masters]
+            self.v = [np.zeros_like(x) for x in masters]
             return
         self.master = masters
         self.m = [np.zeros_like(x) for x in self.master]
@@ -164,9 +176,8 @@ class HostOffloadRunner:
     def _to_device_leaf(mst: np.ndarray, old, sharding):
         """Compute-dtype copy-back of one master leaf (bf16 round-to-nearest)."""
         if old.dtype == jnp.bfloat16:
-            host = np.ascontiguousarray(mst, np.float32).view(np.uint32)
-            bf16 = ((host + 0x7FFF + ((host >> 16) & 1)) >> 16).astype(np.uint16)
-            arr = bf16.view(ml_dtypes.bfloat16).reshape(old.shape)
+            arr = np.ascontiguousarray(mst, np.float32).astype(
+                ml_dtypes.bfloat16).reshape(old.shape)
         else:
             arr = mst.astype(old.dtype).reshape(old.shape)
         return jax.device_put(arr, sharding)

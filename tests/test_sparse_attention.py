@@ -1,0 +1,168 @@
+"""Blocksparse attention: layout builders + Pallas kernel vs dense reference.
+
+Mirrors the reference's tests/unit/ops/sparse_attention intent: kernel output
+must equal dense attention masked to the layout, forward and backward.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.pallas.blocksparse_attention import (
+    blocksparse_attention,
+    layout_tables,
+)
+from deepspeed_tpu.ops.sparse_attention import (
+    BigBirdSparsityConfig,
+    BSLongformerSparsityConfig,
+    DenseSparsityConfig,
+    FixedSparsityConfig,
+    LocalSlidingWindowSparsityConfig,
+    SparseSelfAttention,
+    VariableSparsityConfig,
+)
+
+BLOCK = 8  # tiny blocks for CPU interpret mode
+NEG = -1e30
+
+
+def _dense_masked(q, k, v, layout, block, causal):
+    """Reference: dense attention with the blocksparse + causal mask applied."""
+    B, T, H, D = q.shape
+    n = T // block
+    mask = np.kron(np.asarray(layout), np.ones((block, block)))  # [H, T, T]
+    if causal:
+        mask = mask * np.tril(np.ones((T, T)))
+    s = jnp.einsum("bthd,bshd->bhts", q, k) / np.sqrt(D)
+    s = jnp.where(jnp.asarray(mask[None]) > 0, s, NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhts,bshd->bthd", p, v)
+
+
+def _qkv(rng, B=1, T=64, H=2, D=16):
+    s = (B, T, H, D)
+    return (jnp.asarray(rng.normal(size=s), jnp.float32),
+            jnp.asarray(rng.normal(size=s), jnp.float32),
+            jnp.asarray(rng.normal(size=s), jnp.float32))
+
+
+# ------------------------------------------------------------------- layouts
+def test_layout_shapes_and_diagonal():
+    for cfg in [
+        DenseSparsityConfig(num_heads=2, block=BLOCK),
+        FixedSparsityConfig(num_heads=2, block=BLOCK, num_local_blocks=4),
+        VariableSparsityConfig(num_heads=2, block=BLOCK),
+        BigBirdSparsityConfig(num_heads=2, block=BLOCK),
+        BSLongformerSparsityConfig(num_heads=2, block=BLOCK),
+        LocalSlidingWindowSparsityConfig(num_heads=2, block=BLOCK),
+    ]:
+        layout = cfg.make_layout(64)
+        assert layout.shape == (2, 8, 8)
+        idx = np.arange(8)
+        assert (layout[:, idx, idx] == 1).all()  # diagonal always active
+
+
+def test_unidirectional_layouts_are_lower_triangular():
+    for cfg in [
+        FixedSparsityConfig(num_heads=2, block=BLOCK, num_local_blocks=4,
+                            attention="unidirectional"),
+        BigBirdSparsityConfig(num_heads=2, block=BLOCK, attention="unidirectional"),
+        LocalSlidingWindowSparsityConfig(num_heads=2, block=BLOCK),
+    ]:
+        layout = cfg.make_layout(64)
+        assert (np.triu(layout, k=1) == 0).all()
+
+
+def test_sliding_window_is_banded():
+    cfg = LocalSlidingWindowSparsityConfig(
+        num_heads=1, block=BLOCK, num_sliding_window_blocks=2)
+    layout = cfg.make_layout(64)
+    # causal band of width 2 blocks
+    for i in range(8):
+        active = np.nonzero(layout[0, i])[0]
+        assert active.min() >= max(0, i - 1) and active.max() == i
+
+
+def test_layout_seq_not_divisible_raises():
+    with pytest.raises(ValueError, match="multiple of block"):
+        DenseSparsityConfig(num_heads=1, block=BLOCK).make_layout(60)
+
+
+def test_layout_tables_roundtrip():
+    cfg = BigBirdSparsityConfig(num_heads=2, block=BLOCK)
+    layout = cfg.make_layout(64)
+    kidx, kcnt, qidx, qcnt = layout_tables(layout)
+    # reconstruct the layout from the tables
+    recon = np.zeros_like(layout)
+    for h in range(2):
+        for i in range(8):
+            recon[h, i, kidx[h, i, : kcnt[h, i]]] = 1
+    np.testing.assert_array_equal(recon, layout)
+    recon_t = np.zeros_like(layout)
+    for h in range(2):
+        for j in range(8):
+            recon_t[h, qidx[h, j, : qcnt[h, j]], j] = 1
+    np.testing.assert_array_equal(recon_t, layout)
+
+
+# ------------------------------------------------------------------- kernel
+@pytest.mark.parametrize("causal", [True, False])
+def test_dense_layout_matches_dense_attention(rng, causal):
+    q, k, v = _qkv(rng)
+    layout = DenseSparsityConfig(num_heads=2, block=BLOCK).make_layout(64)
+    out = blocksparse_attention(q, k, v, layout, BLOCK, causal=causal)
+    ref = _dense_masked(q, k, v, layout, BLOCK, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("make_cfg", [
+    lambda: FixedSparsityConfig(num_heads=2, block=BLOCK, num_local_blocks=2,
+                                attention="unidirectional"),
+    lambda: BigBirdSparsityConfig(num_heads=2, block=BLOCK,
+                                  num_sliding_window_blocks=3,
+                                  attention="unidirectional"),
+    lambda: BSLongformerSparsityConfig(num_heads=2, block=BLOCK,
+                                       num_sliding_window_blocks=3),
+    lambda: LocalSlidingWindowSparsityConfig(num_heads=2, block=BLOCK),
+])
+def test_sparse_matches_masked_dense(rng, make_cfg):
+    cfg = make_cfg()
+    causal = getattr(cfg, "attention", "bidirectional") == "unidirectional"
+    q, k, v = _qkv(rng)
+    layout = cfg.make_layout(64)
+    out = blocksparse_attention(q, k, v, layout, BLOCK, causal=causal)
+    ref = _dense_masked(q, k, v, layout, BLOCK, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4)
+
+
+def test_sparse_grads_match_masked_dense(rng):
+    cfg = FixedSparsityConfig(num_heads=2, block=BLOCK, num_local_blocks=2,
+                              attention="unidirectional")
+    q, k, v = _qkv(rng, T=32)
+    layout = cfg.make_layout(32)
+
+    def loss_sparse(q, k, v):
+        return (blocksparse_attention(q, k, v, layout, BLOCK, causal=True) ** 2).sum()
+
+    def loss_dense(q, k, v):
+        return (_dense_masked(q, k, v, layout, BLOCK, True) ** 2).sum()
+
+    gs = jax.grad(loss_sparse, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gs, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4, rtol=1e-3)
+
+
+def test_sparse_self_attention_module(rng):
+    module = SparseSelfAttention(
+        BigBirdSparsityConfig(num_heads=2, block=BLOCK, attention="unidirectional"))
+    q, k, v = _qkv(rng)
+    out = module(q, k, v)
+    assert out.shape == q.shape
+    assert module.causal is True
+    assert 0.0 < module.density(64) < 1.0
+    # head-count mismatch guard
+    with pytest.raises(ValueError, match="heads"):
+        module(q[:, :, :1], k[:, :, :1], v[:, :, :1])
