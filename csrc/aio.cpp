@@ -59,8 +59,9 @@ struct Pool {
     done.emplace_back(id, status);
   }
 
-  // Returns the status (<= 0) if finished, 1 if still pending, 0 if unknown
-  // (already waited on, or discarded by drain — treated as completed OK).
+  // Returns the status (<= 0) if finished, 1 if still pending, -EINVAL if
+  // unknown (already waited on, discarded by drain, or never submitted) —
+  // callers must hold each id's result exactly once or use drain().
   int take_status(int id) {
     std::lock_guard<std::mutex> g(done_mu);
     for (auto it = done.begin(); it != done.end(); ++it) {
@@ -70,7 +71,7 @@ struct Pool {
         return s;
       }
     }
-    return pending.count(id) ? 1 : 0;
+    return pending.count(id) ? 1 : -EINVAL;
   }
 };
 
@@ -184,20 +185,24 @@ int ds_aio_wait(void* h, int id) {
   }
 }
 
-// Block until every submitted request completes; returns count still inflight (0).
-// Also discards completion records nobody waited on (fire-and-forget writes) so
-// the done list cannot grow without bound across training steps.
+// Block until every submitted request completes. Discards completion records
+// nobody waited on (fire-and-forget writes) so the done list cannot grow
+// without bound — but COUNTS discarded failures: returns 0 if everything
+// succeeded, -N if N discarded requests had failed since the last drain.
 int ds_aio_drain(void* h) {
   auto* pool = (Pool*)h;
   while (pool->inflight.load() > 0) {
     std::unique_lock<std::mutex> lk(pool->done_mu);
     pool->done_cv.wait_for(lk, std::chrono::milliseconds(50));
   }
+  int failures = 0;
   {
     std::lock_guard<std::mutex> g(pool->done_mu);
+    for (auto& rec : pool->done)
+      if (rec.second < 0) ++failures;
     pool->done.clear();
   }
-  return 0;
+  return -failures;
 }
 
 int ds_aio_version() { return 1; }

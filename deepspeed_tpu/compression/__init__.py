@@ -1,0 +1,6 @@
+from .compress import (  # noqa: F401
+    CompressionScheduler,
+    init_compression,
+    quantize_params_for_inference,
+)
+from .config import get_compression_config  # noqa: F401

@@ -1,0 +1,188 @@
+"""Compression-in-training: QAT weight quantization (MoQ), pruning masks,
+layer reduction, and post-training int8 weight quantization for inference.
+
+Capability parity with the reference's compression stack
+(``compression/compress.py`` ``init_compression``/``redundancy_clean``,
+``compression/basic_layer.py`` LinearLayer_Compress et al.,
+``compression/scheduler.py`` compression scheduler): the reference swaps
+nn.Modules for compression-aware clones; in a functional JAX framework the same
+capability is a **parameter-tree transform** applied inside the jitted loss —
+fake-quant (straight-through) and pruning masks gate on the traced global step
+against each group's ``schedule_offset``, so one compiled program covers the
+whole schedule.
+
+Param selection: the reference keys groups on module-name patterns; here
+patterns match the parameter tree's key paths (substring or fnmatch). Default
+targets are matmul weights (ndim >= 2), excluding embeddings and norms.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.quantizer import fake_quant, quantize
+from ..utils.logging import log_dist
+from .config import get_compression_config
+
+# params whose key path contains one of these are never quantized/pruned
+_EXCLUDE_DEFAULT = ("ln", "layernorm", "norm", "bias", "wpe", "wte", "embed")
+
+
+def _path_str(path) -> str:
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p))))
+                    for p in path)
+
+
+def _key_paths(tree) -> List[Tuple[str, Any]]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(_path_str(path), leaf) for path, leaf in flat]
+
+
+def _matches(key: str, patterns: List[str]) -> bool:
+    k = key.lower()
+    return any(p.lower() in k or fnmatch.fnmatch(k, p.lower()) for p in patterns)
+
+
+class CompressionScheduler:
+    """Per-leaf compression plan applied inside the training step."""
+
+    def __init__(self, config: Dict[str, Any], param_tree):
+        self.cfg = get_compression_config(config)
+        # resolve each leaf to its (bits, groups, offset) plan at build time
+        self.plan: Dict[str, Dict[str, Any]] = {}
+        wq = self.cfg["weight_quantization"]
+        sp = self.cfg["sparse_pruning"]
+        for key, leaf in _key_paths(param_tree):
+            if not hasattr(leaf, "ndim") or leaf.ndim < 2:
+                continue
+            if _matches(key, list(_EXCLUDE_DEFAULT)):
+                continue
+            entry: Dict[str, Any] = {}
+            if wq["shared"]["enabled"]:
+                bits, groups = self._group_lookup(
+                    key, wq["groups"],
+                    ("start_bits", 8), ("quantize_groups",
+                                        wq["shared"]["quantize_groups"]))
+                entry["quant_bits"] = int(bits)
+                entry["quant_groups"] = int(groups)
+                entry["quant_offset"] = int(wq["shared"]["schedule_offset"])
+            if sp["shared"]["enabled"]:
+                ratio, _ = self._group_lookup(
+                    key, sp["groups"], ("dense_ratio", 0.5), ("unused", 0))
+                entry["prune_ratio"] = float(ratio)
+                entry["prune_offset"] = int(sp["shared"]["schedule_offset"])
+                entry["prune_method"] = sp["shared"]["method"]
+            if entry:
+                self.plan[key] = entry
+        if self.plan:
+            log_dist(f"compression: {len(self.plan)} tensors under "
+                     f"{'QAT ' if wq['shared']['enabled'] else ''}"
+                     f"{'pruning' if sp['shared']['enabled'] else ''}".strip())
+
+    @staticmethod
+    def _group_lookup(key: str, groups: Dict[str, Any], first: Tuple[str, Any],
+                      second: Tuple[str, Any]):
+        """different_groups entries: {name: {params: {...}, modules: [patterns]}}."""
+        for _, g in (groups or {}).items():
+            mods = g.get("modules", ["*"])
+            if _matches(key, mods):
+                p = g.get("params", {})
+                return p.get(first[0], first[1]), p.get(second[0], second[1])
+        return first[1], second[1]
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.plan)
+
+    # ------------------------------------------------------------------ in-step
+    def transform(self, params, step: jnp.ndarray):
+        """Apply scheduled fake-quant / pruning to planned leaves. ``step`` is
+        traced; gating is a select so one program covers the schedule."""
+        if not self.plan:
+            return params
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        out = []
+        for path, leaf in flat:
+            entry = self.plan.get(_path_str(path))
+            x = leaf
+            if entry is not None:
+                if "quant_bits" in entry:
+                    xq = fake_quant(x, entry["quant_bits"], entry["quant_groups"])
+                    x = jnp.where(step >= entry["quant_offset"], xq, x)
+                if "prune_ratio" in entry:
+                    # lax.cond, not where: the pruning branch sorts |W| (O(n log n))
+                    # and must not execute during the pre-offset steps
+                    x = jax.lax.cond(
+                        step >= entry["prune_offset"],
+                        lambda t: _prune_l1(t, entry["prune_ratio"]),
+                        lambda t: t, x)
+            out.append(x)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _prune_l1(x: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    """Magnitude (L1) pruning to ``dense_ratio`` density: smallest-|x| entries
+    zeroed. Parity: compression/basic_layer sparse_pruning l1 method."""
+    k = max(1, int(round(x.size * dense_ratio)))
+    flat = jnp.abs(x.ravel())
+    threshold = jnp.sort(flat)[x.size - k]
+    return jnp.where(jnp.abs(x) >= threshold, x, 0.0).astype(x.dtype)
+
+
+def init_compression(param_tree, ds_config) -> CompressionScheduler:
+    """Build a scheduler from a DeepSpeedConfig (or raw dict). Parity:
+    ``compression/compress.py`` init_compression."""
+    block = (ds_config.compression_training
+             if hasattr(ds_config, "compression_training") else ds_config)
+    return CompressionScheduler(block, param_tree)
+
+
+def layer_reduction_map(n_teacher_layers: int, keep: int,
+                        teacher_layer: Optional[List[int]] = None) -> List[int]:
+    """Which teacher layers a reduced student keeps. Parity:
+    ``compression/helper.py`` student initialization mapping."""
+    if teacher_layer:
+        assert len(teacher_layer) == keep
+        return list(teacher_layer)
+    if keep <= 1:
+        return [n_teacher_layers - 1]
+    stride = (n_teacher_layers - 1) / (keep - 1)
+    return [int(round(i * stride)) for i in range(keep)]
+
+
+def quantize_params_for_inference(params, bits: int = 8, num_groups: int = 1,
+                                  exclude=_EXCLUDE_DEFAULT):
+    """Post-training weight quantization: returns (int8 tree, scales tree,
+    metadata) for storage, and a dequantize closure for load. Parity: the
+    inference GroupQuantizer (``module_inject/replace_module.py:144``)."""
+    from ..ops.quantizer import dequantize
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    q_leaves, s_leaves = [], []
+    quantized_keys = []
+    for path, leaf in flat:
+        key = _path_str(path)
+        if hasattr(leaf, "ndim") and leaf.ndim >= 2 and not _matches(key, list(exclude)):
+            q, s = quantize(leaf, bits=bits, num_groups=num_groups)
+            q_leaves.append(q)
+            s_leaves.append(s)
+            quantized_keys.append(key)
+        else:
+            q_leaves.append(leaf)
+            s_leaves.append(None)
+    qtree = jax.tree_util.tree_unflatten(treedef, q_leaves)
+
+    def dequantize_tree(dtype=jnp.bfloat16):
+        out = []
+        for (path, _), q, s in zip(flat, q_leaves, s_leaves):
+            out.append(q if s is None else dequantize(q, s, dtype=dtype))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    return qtree, s_leaves, {"quantized": quantized_keys,
+                             "dequantize": dequantize_tree}

@@ -95,16 +95,24 @@ class AsyncIOHandle:
         return rid
 
     def wait(self, request_id: int) -> int:
-        """Block until the request completes; 0 = success, -errno = failure."""
+        """Block until the request completes; 0 = success, -errno = failure,
+        -22 (EINVAL) for an unknown/already-consumed id."""
         if self._pool is not None:
             return self._lib.ds_aio_wait(self._pool, request_id)
-        return self._fallback_results.pop(request_id, 0)
+        return self._fallback_results.pop(request_id, -22)
 
     def drain(self) -> None:
-        """Block until every submitted request completes."""
+        """Block until every submitted request completes. Raises if any
+        fire-and-forget request failed since the last drain."""
         if self._pool is not None:
-            self._lib.ds_aio_drain(self._pool)
+            rc = self._lib.ds_aio_drain(self._pool)
+            if rc < 0:
+                raise IOError(f"aio: {-rc} async request(s) failed before drain")
+            return
+        failed = [r for r in self._fallback_results.values() if r < 0]
         self._fallback_results.clear()
+        if failed:
+            raise IOError(f"aio: {len(failed)} async request(s) failed before drain")
 
     def close(self) -> None:
         if self._pool is not None:

@@ -38,13 +38,15 @@ def _build_dir() -> str:
 
 
 def _cpu_supports(feature: str) -> bool:
+    """True if /proc/cpuinfo lists the feature; optimistic (True) where cpuinfo
+    is unavailable (non-Linux) so the try-compile gate still decides there."""
     try:
         with open("/proc/cpuinfo") as f:
             for line in f:
                 if line.startswith("flags"):
                     return feature in line.split()
     except OSError:
-        pass
+        return True
     return False
 
 

@@ -265,6 +265,7 @@ def blocksparse_attention(
     block: int,
     causal: bool = True,
     softmax_scale: Optional[float] = None,
+    tables: Optional[Tuple] = None,  # precomputed layout_tables (caching)
 ) -> jnp.ndarray:
     """Attention restricted to the active blocks of ``layout``; differentiable."""
     B, T, H, D = q.shape
@@ -272,10 +273,11 @@ def blocksparse_attention(
         raise ValueError(
             f"layout {layout.shape} != (H={H}, {T // block}, {T // block})")
     scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(D)
-    kidx, kcnt, qidx, qcnt = layout_tables(layout)
+    if tables is None:
+        tables = tuple(jnp.asarray(t) for t in layout_tables(layout))
+    kidx, kcnt, qidx, qcnt = tables
     qt = q.transpose(0, 2, 1, 3).reshape(B * H, T, D)
     kt = k.transpose(0, 2, 1, 3).reshape(B * H, T, D)
     vt = v.transpose(0, 2, 1, 3).reshape(B * H, T, D)
-    o = _bs_attn(qt, kt, vt, jnp.asarray(kidx), jnp.asarray(kcnt),
-                 jnp.asarray(qidx), jnp.asarray(qcnt), H, scale, causal, block)
+    o = _bs_attn(qt, kt, vt, kidx, kcnt, qidx, qcnt, H, scale, causal, block)
     return o.reshape(B, H, T, D).transpose(0, 2, 1, 3)

@@ -1,0 +1,5 @@
+from .quantize import (  # noqa: F401
+    dequantize,
+    fake_quant,
+    quantize,
+)

@@ -1,0 +1,71 @@
+"""Group-wise quantization ops.
+
+Capability parity with the reference's quantization kernels
+(``csrc/quantization/fake_quantizer.cu`` QAT fake-quant used by MoQ,
+``quantize.cu``/``pt_binding.cpp`` groupwise int8 quant/dequant used by int8
+inference, wrapped by ``ops/quantizer/``): symmetric group-wise quantization to
+``bits`` with fp32 scales, plus a straight-through-estimator fake-quant for
+quantization-aware training.
+
+TPU-native: these are pure XLA element-wise ops (reduce-max per group, scale,
+round, clamp) — they fuse into the surrounding program; no custom kernel is
+needed for the quality path. Storage quantization (int8 weights at rest for
+inference) uses the same math with the int8 array actually materialized.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _group(x: jnp.ndarray, num_groups: int) -> jnp.ndarray:
+    n = x.size
+    if n % num_groups != 0:
+        raise ValueError(f"size {n} not divisible into {num_groups} groups")
+    return x.reshape(num_groups, n // num_groups)
+
+
+def quantize(x: jnp.ndarray, bits: int = 8, num_groups: int = 1
+             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric group-wise quantization.
+
+    Returns ``(q, scales)`` where ``q`` is int8 (any bits <= 8 stored as int8)
+    of ``x.shape`` and ``scales`` is ``[num_groups]`` fp32.
+    """
+    g = _group(x.astype(jnp.float32), num_groups)
+    qmax = 2.0 ** (bits - 1) - 1.0
+    absmax = jnp.max(jnp.abs(g), axis=1, keepdims=True)
+    scales = jnp.where(absmax > 0, absmax / qmax, 1.0)
+    q = jnp.clip(jnp.round(g / scales), -qmax - 1, qmax).astype(jnp.int8)
+    return q.reshape(x.shape), scales[:, 0]
+
+
+def dequantize(q: jnp.ndarray, scales: jnp.ndarray, dtype=jnp.float32) -> jnp.ndarray:
+    num_groups = scales.shape[0]
+    g = _group(q.astype(jnp.float32), num_groups)
+    return (g * scales[:, None]).reshape(q.shape).astype(dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def fake_quant(x: jnp.ndarray, bits: int = 8, num_groups: int = 1) -> jnp.ndarray:
+    """Quantize-dequantize with a straight-through gradient (QAT).
+
+    Parity: ``fake_quantizer.cu`` (MoQ's in-training quantizer).
+    """
+    q, scales = quantize(x, bits=bits, num_groups=num_groups)
+    return dequantize(q, scales, dtype=x.dtype)
+
+
+def _fq_fwd(x, bits, num_groups):
+    return fake_quant(x, bits, num_groups), None
+
+
+def _fq_bwd(bits, num_groups, _, g):
+    return (g,)  # straight-through
+
+
+fake_quant.defvjp(_fq_fwd, _fq_bwd)

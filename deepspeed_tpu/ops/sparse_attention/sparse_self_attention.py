@@ -30,27 +30,37 @@ def sparse_attention(
     causal: Optional[bool] = None,
     softmax_scale: Optional[float] = None,
 ) -> jnp.ndarray:
-    """Functional one-shot API (builds/caches the layout via the config)."""
+    """Functional one-shot convenience (no cross-call caching — construct a
+    :class:`SparseSelfAttention` once for repeated eager use)."""
     return SparseSelfAttention(config, causal=causal)(
         q, k, v, softmax_scale=softmax_scale)
 
 
 class SparseSelfAttention:
-    """Holds a sparsity config; callable on [B, T, H, D] q/k/v."""
+    """Holds a sparsity config; callable on [B, T, H, D] q/k/v. Layouts AND the
+    kernel's index tables are cached per sequence length, so eager per-step use
+    pays the O(H·n²) table construction once."""
 
     def __init__(self, sparsity_config: Optional[SparsityConfig] = None,
-                 causal: Optional[bool] = None,
-                 max_seq_length: int = 2048):
+                 causal: Optional[bool] = None):
         self.sparsity_config = sparsity_config or FixedSparsityConfig(num_heads=4)
         attn = getattr(self.sparsity_config, "attention", "bidirectional")
         self.causal = causal if causal is not None else (attn == "unidirectional")
-        self.max_seq_length = max_seq_length
         self._layouts: Dict[int, np.ndarray] = {}
+        self._tables: Dict[int, Tuple] = {}
 
     def get_layout(self, seq_len: int) -> np.ndarray:
         if seq_len not in self._layouts:
             self._layouts[seq_len] = self.sparsity_config.make_layout(seq_len)
         return self._layouts[seq_len]
+
+    def _get_tables(self, seq_len: int) -> Tuple:
+        if seq_len not in self._tables:
+            from ..pallas.blocksparse_attention import layout_tables
+
+            self._tables[seq_len] = tuple(
+                jnp.asarray(t) for t in layout_tables(self.get_layout(seq_len)))
+        return self._tables[seq_len]
 
     def density(self, seq_len: int) -> float:
         layout = self.get_layout(seq_len)
@@ -67,4 +77,5 @@ class SparseSelfAttention:
         layout = self.get_layout(T)
         return blocksparse_attention(
             q, k, v, layout, self.sparsity_config.block,
-            causal=self.causal, softmax_scale=softmax_scale)
+            causal=self.causal, softmax_scale=softmax_scale,
+            tables=self._get_tables(T))

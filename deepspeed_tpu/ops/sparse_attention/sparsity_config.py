@@ -43,6 +43,26 @@ class SparsityConfig:
     def make_layout(self, seq_len: int) -> np.ndarray:
         raise NotImplementedError
 
+    @staticmethod
+    def _check_attention(attention: str) -> str:
+        if attention not in ("unidirectional", "bidirectional"):
+            raise ValueError(f"invalid attention type {attention!r}")
+        return attention
+
+    def _global_cols_mask(self, n: int, global_block_indices,
+                          global_block_end_indices) -> np.ndarray:
+        """Boolean column mask from explicit global block indices (optionally
+        start/end ranges)."""
+        cols = np.zeros(n, dtype=bool)
+        if global_block_end_indices is None:
+            for i in global_block_indices:
+                if 0 <= i < n:
+                    cols[i] = True
+        else:
+            for s, e in zip(global_block_indices, global_block_end_indices):
+                cols[max(0, s):min(e, n)] = True
+        return cols
+
     def _finalize(self, layout: np.ndarray, causal: bool) -> np.ndarray:
         if causal:
             n = layout.shape[1]
@@ -87,8 +107,7 @@ class FixedSparsityConfig(SparsityConfig):
         super().__init__(num_heads, block, different_layout_per_head)
         if num_local_blocks % num_global_blocks != 0:
             raise ValueError("num_local_blocks must divide by num_global_blocks")
-        if attention not in ("unidirectional", "bidirectional"):
-            raise ValueError(f"invalid attention type {attention!r}")
+        attention = self._check_attention(attention)
         if horizontal_global_attention and attention != "bidirectional":
             raise ValueError("horizontal global attention requires bidirectional")
         if num_different_global_patterns > 1 and not different_layout_per_head:
@@ -144,8 +163,7 @@ class VariableSparsityConfig(SparsityConfig):
         self.local_window_blocks = local_window_blocks or [4]
         self.global_block_indices = global_block_indices or [0]
         self.global_block_end_indices = global_block_end_indices
-        if attention not in ("unidirectional", "bidirectional"):
-            raise ValueError(f"invalid attention type {attention!r}")
+        attention = self._check_attention(attention)
         if horizontal_global_attention and attention != "bidirectional":
             raise ValueError("horizontal global attention requires bidirectional")
         self.attention = attention
@@ -156,15 +174,8 @@ class VariableSparsityConfig(SparsityConfig):
             raise ValueError("global start/end index lists must have equal length")
 
     def _global_cols(self, n: int) -> np.ndarray:
-        cols = np.zeros(n, dtype=bool)
-        if self.global_block_end_indices is None:
-            for i in self.global_block_indices:
-                if 0 <= i < n:
-                    cols[i] = True
-        else:
-            for s, e in zip(self.global_block_indices, self.global_block_end_indices):
-                cols[max(0, s):min(e, n)] = True
-        return cols
+        return self._global_cols_mask(
+            n, self.global_block_indices, self.global_block_end_indices)
 
     def make_layout(self, seq_len: int) -> np.ndarray:
         layout = self.setup_layout(seq_len)
@@ -210,9 +221,7 @@ class BigBirdSparsityConfig(SparsityConfig):
         self.num_random_blocks = num_random_blocks
         self.num_sliding_window_blocks = num_sliding_window_blocks
         self.num_global_blocks = num_global_blocks
-        if attention not in ("unidirectional", "bidirectional"):
-            raise ValueError(f"invalid attention type {attention!r}")
-        self.attention = attention
+        self.attention = self._check_attention(attention)
         self.seed = seed
 
     def make_layout(self, seq_len: int) -> np.ndarray:
@@ -256,7 +265,7 @@ class BSLongformerSparsityConfig(SparsityConfig):
         self.num_sliding_window_blocks = num_sliding_window_blocks
         self.global_block_indices = global_block_indices or [0]
         self.global_block_end_indices = global_block_end_indices
-        self.attention = attention
+        self.attention = self._check_attention(attention)
         if self.global_block_end_indices is not None and \
                 len(self.global_block_end_indices) != len(self.global_block_indices):
             raise ValueError("global start/end index lists must have equal length")
@@ -266,14 +275,8 @@ class BSLongformerSparsityConfig(SparsityConfig):
         n = layout.shape[1]
         causal = self.attention == "unidirectional"
         w = self.num_sliding_window_blocks // 2
-        gcols = np.zeros(n, dtype=bool)
-        if self.global_block_end_indices is None:
-            for i in self.global_block_indices:
-                if 0 <= i < n:
-                    gcols[i] = True
-        else:
-            for s, e in zip(self.global_block_indices, self.global_block_end_indices):
-                gcols[max(0, s):min(e, n)] = True
+        gcols = self._global_cols_mask(
+            n, self.global_block_indices, self.global_block_end_indices)
         for h in range(self.num_heads):
             for i in range(n):
                 layout[h, i, max(0, i - w):min(n, i + w + 1)] = 1
@@ -290,7 +293,7 @@ class LocalSlidingWindowSparsityConfig(SparsityConfig):
                  attention: str = "unidirectional"):
         super().__init__(num_heads, block, different_layout_per_head=False)
         self.num_sliding_window_blocks = num_sliding_window_blocks
-        self.attention = attention
+        self.attention = self._check_attention(attention)
 
     def make_layout(self, seq_len: int) -> np.ndarray:
         layout = self.setup_layout(seq_len)

@@ -1,0 +1,7 @@
+from .curriculum_scheduler import CurriculumScheduler  # noqa: F401
+from .data_sampler import DeepSpeedDataSampler  # noqa: F401
+from .data_routing.random_ltd import (  # noqa: F401
+    RandomLTDScheduler,
+    random_ltd_gather,
+    random_ltd_scatter,
+)

@@ -1,0 +1,5 @@
+from .random_ltd import (  # noqa: F401
+    RandomLTDScheduler,
+    random_ltd_gather,
+    random_ltd_scatter,
+)

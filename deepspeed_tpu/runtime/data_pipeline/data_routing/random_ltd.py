@@ -1,0 +1,100 @@
+"""Random layer-wise token dropping (Random-LTD).
+
+Capability parity with the reference's Random-LTD stack
+(``runtime/data_pipeline/data_routing/basic_layer.py:13`` RandomLayerTokenDrop,
+``scheduler.py`` RandomLTDScheduler, and the CUDA token sort/gather/scatter
+kernels ``csrc/random_ltd/``): during training, sandwiched transformer layers
+see only a random subset of tokens; outputs scatter back into the full hidden
+stream so dropped tokens pass through unchanged. The retained-token count grows
+on a schedule until the layer sees every token.
+
+TPU-native: the reference needs three CUDA kernels (token_sort.cu, gather_scatter
+.cu, slice_attn_masks.cu) because eager torch gathers are slow; under XLA this is
+``jnp.take_along_axis`` / scatter, fused into the surrounding program (SURVEY
+§2.4 marks these kernels "trivial in XLA"). The retained count is a static shape:
+it changes only at schedule boundaries, so each bucket compiles once (the
+``difficulty_step``-style quantization below keeps bucket count small).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def random_ltd_gather(x: jnp.ndarray, keep: int, rng: jax.Array
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Sample ``keep`` token positions per batch row (sorted, so relative order
+    is preserved — parity with token_sort.cu) and gather them.
+
+    x: [B, T, D] -> (x_kept [B, keep, D], indices [B, keep])
+    """
+    B, T, _ = x.shape
+    scores = jax.random.uniform(rng, (B, T))
+    idx = jnp.argsort(scores, axis=1)[:, :keep]  # random subset
+    idx = jnp.sort(idx, axis=1)  # keep temporal order
+    kept = jnp.take_along_axis(x, idx[:, :, None], axis=1)
+    return kept, idx
+
+
+def random_ltd_scatter(x_kept: jnp.ndarray, idx: jnp.ndarray,
+                       x_full: jnp.ndarray) -> jnp.ndarray:
+    """Scatter processed tokens back; untouched positions keep ``x_full``'s
+    values (dropped tokens bypass the layer). Parity: gather_scatter.cu."""
+    B, keep, D = x_kept.shape
+    batch_idx = jnp.arange(B)[:, None]
+    return x_full.at[batch_idx, idx].set(x_kept)
+
+
+class RandomLTDScheduler:
+    """Retained-token schedule. Parity:
+    ``data_routing/scheduler.py`` (BaseScheduler/RandomLTDScheduler).
+
+    Config schema follows the reference's ``"random_ltd"`` block:
+    {"total_layer_num": 24, "random_ltd_layer_num": 22,
+     "random_ltd_layer_id": [...], "model_mask_name": ...,
+     "random_ltd_schedule": {"min_value": 128, "max_value": 2048,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"seq_per_step": 16, "require_steps": 10000}}}
+    """
+
+    def __init__(self, config: Dict[str, Any]):
+        self.config = config
+        sched = config.get("random_ltd_schedule", {})
+        self.min_value = int(sched.get("min_value", 128))
+        self.max_value = int(sched.get("max_value", 1024))
+        cfg = sched.get("schedule_config", {})
+        self.seq_per_step = int(cfg.get("seq_per_step", 16))
+        self.require_steps = int(cfg.get("require_steps", 1000))
+        self.layer_ids = list(config.get("random_ltd_layer_id", []))
+        self.current_value = self.min_value
+
+    def get_value(self, global_steps: int) -> int:
+        frac = min(1.0, global_steps / max(1, self.require_steps))
+        v = self.min_value + (self.max_value - self.min_value) * frac
+        v = int(v / self.seq_per_step) * self.seq_per_step  # compile buckets
+        return max(self.min_value, min(self.max_value, v))
+
+    def update(self, global_steps: int) -> int:
+        self.current_value = self.get_value(global_steps)
+        return self.current_value
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"current_value": self.current_value}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.current_value = int(sd["current_value"])
+
+
+def random_ltd_layer(layer_fn, x: jnp.ndarray, keep: int, rng: jax.Array,
+                     *args, **kwargs) -> jnp.ndarray:
+    """Run ``layer_fn`` on a random ``keep``-token subset of ``x``; dropped
+    tokens pass through. Parity: ``basic_layer.py:13`` forward."""
+    T = x.shape[1]
+    if keep >= T:
+        return layer_fn(x, *args, **kwargs)
+    kept, idx = random_ltd_gather(x, keep, rng)
+    out = layer_fn(kept, *args, **kwargs)
+    return random_ltd_scatter(out, idx, x)

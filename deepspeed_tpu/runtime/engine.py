@@ -110,6 +110,26 @@ class DeepSpeedEngine:
 
             self._onebit = OnebitRunner(self, _opt_type, config.optimizer.params)
 
+        # compression-in-training (MoQ QAT / pruning): a param-tree transform
+        # applied inside the loss (parity: compression/compress.py init_compression)
+        self._compression = None
+        if config.compression_training:
+            from ..compression import init_compression
+
+            sched = init_compression(
+                jax.eval_shape(model.init, jax.random.PRNGKey(0)), config)
+            if sched.enabled:
+                self._compression = sched
+
+        # curriculum learning: step-scheduled sequence truncation (parity:
+        # engine.py:1810-1816; legacy "curriculum_learning" block)
+        self.curriculum_scheduler = None
+        cl = config.curriculum_learning
+        if cl and cl.get("enabled"):
+            from .data_pipeline import CurriculumScheduler
+
+            self.curriculum_scheduler = CurriculumScheduler(cl)
+
         # ZeRO-Offload: optimizer state in host RAM, stepped by the native C++
         # SIMD optimizer (runtime/zero/offload.py); device keeps bf16 params only
         self._offload = None
@@ -117,6 +137,13 @@ class DeepSpeedEngine:
             config.zero_optimization.offload_optimizer_device in ("cpu", "nvme"))
         if self._offload_requested and self._onebit is not None:
             raise ValueError("offload_optimizer and 1-bit optimizers are exclusive")
+        if self._compression is not None and (
+                self._offload_requested or self._onebit is not None):
+            # their gradient programs bypass the QAT transform; failing loudly
+            # beats silently training full-precision under an MoQ config
+            raise ValueError(
+                "compression_training is not supported together with "
+                "ZeRO-Offload or 1-bit optimizers")
 
         # ---------------- optimizer + lr schedule
         opt_cfg = config.optimizer
@@ -233,8 +260,12 @@ class DeepSpeedEngine:
         return state
 
     # ------------------------------------------------------------------ compiled fns
-    def _loss_and_grads(self, params, batch, scale, rngs):
+    def _loss_and_grads(self, params, batch, scale, rngs, step=None):
         def loss_fn(p):
+            if self._compression is not None and step is not None:
+                # inside the loss so the straight-through fake-quant gradient
+                # reaches the unquantized master weights
+                p = self._compression.transform(p, step)
             out = self.model.apply(p, batch, rngs=rngs, train=True)
             loss, aux = out if isinstance(out, tuple) else (out, {})
             return loss.astype(jnp.float32) * scale, (loss, aux)
@@ -254,7 +285,8 @@ class DeepSpeedEngine:
         it resident)."""
         scale = state["scaler"].scale if self.pc.loss_scaling else jnp.float32(1.0)
         rngs = {"dropout": rng}
-        loss, aux, grads = self._loss_and_grads(state["params"], batch, scale, rngs)
+        loss, aux, grads = self._loss_and_grads(
+            state["params"], batch, scale, rngs, step=state["step"])
         # accumulate with 1/gas scaling (the reference scales loss by 1/gas at
         # engine.py:1945; scaling the grads is numerically identical)
         inv_gas = 1.0 / float(self.gas)
@@ -382,6 +414,29 @@ class DeepSpeedEngine:
         self._rng, sub = jax.random.split(self._rng)
         return sub
 
+    # sequence-bearing batch keys truncated by curriculum seqlen scheduling
+    _SEQ_KEYS = ("input_ids", "labels", "attention_mask", "position_ids",
+                 "token_type_ids")
+
+    def _apply_curriculum(self, batch):
+        """Truncate the sequence dimension to the scheduled difficulty (parity:
+        the reference's curriculum seqlen hook, engine.py:1810-1816). Each
+        distinct difficulty value is one XLA compile bucket — the scheduler's
+        difficulty_step quantization keeps the bucket count small."""
+        if self.curriculum_scheduler is None:
+            return batch
+        seqlen = self.curriculum_scheduler.update_difficulty(self.global_steps + 1)
+
+        def trunc(x):
+            if hasattr(x, "ndim") and x.ndim >= 2 and x.shape[-1] > seqlen:
+                return x[..., :seqlen]
+            return x
+
+        if isinstance(batch, dict):
+            return {k: (trunc(v) if k in self._SEQ_KEYS else v)
+                    for k, v in batch.items()}
+        return jax.tree_util.tree_map(trunc, batch)
+
     # ------------------------------------------------------------------ public API
     def forward(self, batch) -> jnp.ndarray:
         """Run fwd (+bwd, see module docstring) on one micro-batch; returns the loss."""
@@ -396,6 +451,7 @@ class DeepSpeedEngine:
                 "step is driven once per global batch)")
         if self.wall_clock_breakdown():
             self.timers("forward").start()
+        batch = self._apply_curriculum(batch)
         batch = self._place_batch(batch)
         if self._micro_jit is None:
             ss = self.state_shardings
@@ -458,6 +514,7 @@ class DeepSpeedEngine:
         program. ``batch`` arrays are [gas, batch, ...] when gas>1, else [batch, ...].
         Parity: ``PipelineEngine.train_batch``-style one-call API."""
         self.tput_timer.start()
+        batch = self._apply_curriculum(batch)
         batch = self._place_batch(batch, leading_gas=True)
         runner = self._onebit or self._offload
         if runner is not None:

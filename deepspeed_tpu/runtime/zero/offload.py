@@ -102,14 +102,14 @@ class HostOffloadRunner:
                     np.array(jax.device_get(l), np.float32, copy=True) for l in flat])
             self.master = "nvme"  # sentinel: state lives on disk
             return
-        masters = [np.array(jax.device_get(l), np.float32, copy=True) for l in flat]
         if for_load:
-            # placeholders with the right shapes; load_host_state_dict replaces them
-            self.master = masters
-            self.m = [np.zeros_like(x) for x in masters]
-            self.v = [np.zeros_like(x) for x in masters]
+            # load_host_state_dict only needs the leaf count — skip the full
+            # device->host transfer that it would immediately discard
+            self.master = [None] * len(flat)
+            self.m = self.v = [None] * len(flat)
             return
-        self.master = masters
+        self.master = [np.array(jax.device_get(l), np.float32, copy=True)
+                       for l in flat]
         self.m = [np.zeros_like(x) for x in self.master]
         self.v = [np.zeros_like(x) for x in self.master]
 

@@ -1,0 +1,152 @@
+"""Quantization ops + compression-in-training (MoQ/pruning) + inference quant."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.compression import (
+    CompressionScheduler,
+    init_compression,
+    quantize_params_for_inference,
+)
+from deepspeed_tpu.compression.compress import _prune_l1, layer_reduction_map
+from deepspeed_tpu.ops.quantizer import dequantize, fake_quant, quantize
+
+
+# ------------------------------------------------------------------- quant ops
+def test_quantize_dequantize_roundtrip_error_bounded(rng):
+    x = jnp.asarray(rng.normal(size=(64, 32)), jnp.float32)
+    q, s = quantize(x, bits=8, num_groups=8)
+    assert q.dtype == jnp.int8 and s.shape == (8,)
+    xr = dequantize(q, s)
+    # int8 symmetric: error bounded by scale/2 per group
+    err = np.abs(np.asarray(xr - x))
+    bound = np.repeat(np.asarray(s) / 2, x.size // 8).reshape(x.shape)
+    assert (err <= bound + 1e-6).all()
+
+
+def test_quantize_preserves_zero_and_extremes():
+    x = jnp.asarray([[0.0, 1.0, -1.0, 0.5]], jnp.float32)
+    q, s = quantize(x, bits=8, num_groups=1)
+    xr = np.asarray(dequantize(q, s))
+    assert xr[0, 0] == 0.0
+    np.testing.assert_allclose(xr[0, 1], 1.0, rtol=1e-2)
+    np.testing.assert_allclose(xr[0, 2], -1.0, rtol=1e-2)
+
+
+def test_fake_quant_straight_through_gradient(rng):
+    x = jnp.asarray(rng.normal(size=(32,)), jnp.float32)
+
+    def loss(x):
+        return (fake_quant(x, 8, 1) ** 2).sum()
+
+    g = jax.grad(loss)(x)
+    # STE: grad flows as if identity around the quantizer; d/dx (q(x))^2 = 2*q(x)
+    np.testing.assert_allclose(np.asarray(g), 2 * np.asarray(fake_quant(x, 8, 1)),
+                               rtol=1e-5)
+
+
+def test_lower_bits_higher_error(rng):
+    x = jnp.asarray(rng.normal(size=(256,)), jnp.float32)
+    e8 = float(jnp.abs(fake_quant(x, 8, 1) - x).mean())
+    e4 = float(jnp.abs(fake_quant(x, 4, 1) - x).mean())
+    e2 = float(jnp.abs(fake_quant(x, 2, 1) - x).mean())
+    assert e8 < e4 < e2
+
+
+# ------------------------------------------------------------------- pruning
+def test_prune_l1_density(rng):
+    x = jnp.asarray(rng.normal(size=(100,)), jnp.float32)
+    xp = _prune_l1(x, 0.3)
+    nnz = int((np.asarray(xp) != 0).sum())
+    assert nnz == 30
+    # survivors are the largest-magnitude entries
+    kept = np.abs(np.asarray(x))[np.asarray(xp) != 0]
+    dropped = np.abs(np.asarray(x))[np.asarray(xp) == 0]
+    assert kept.min() >= dropped.max() - 1e-6
+
+
+def test_layer_reduction_map():
+    assert layer_reduction_map(12, 4) == [0, 4, 7, 11]
+    assert layer_reduction_map(12, 1) == [11]
+    assert layer_reduction_map(6, 3, teacher_layer=[1, 3, 5]) == [1, 3, 5]
+
+
+# ------------------------------------------------------------------- scheduler
+def _param_tree(rng):
+    return {
+        "blocks": {"qkv_w": jnp.asarray(rng.normal(size=(4, 8, 8)), jnp.float32)},
+        "wte": jnp.asarray(rng.normal(size=(16, 8)), jnp.float32),
+        "lnf_scale": jnp.ones((8,), jnp.float32),
+    }
+
+
+def test_scheduler_plans_matmul_weights_only(rng):
+    tree = _param_tree(rng)
+    sched = CompressionScheduler({
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 5},
+            "different_groups": {"g0": {"params": {"start_bits": 8,
+                                                   "quantize_groups": 4}}},
+        }}, tree)
+    assert sched.enabled
+    assert "blocks/qkv_w" in sched.plan
+    assert "wte" not in sched.plan  # embedding excluded
+    assert "lnf_scale" not in sched.plan  # 1-D excluded
+
+
+def test_scheduler_gates_on_step(rng):
+    tree = _param_tree(rng)
+    sched = CompressionScheduler({
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 5},
+            "different_groups": {}}}, tree)
+    before = sched.transform(tree, jnp.int32(0))
+    np.testing.assert_array_equal(np.asarray(before["blocks"]["qkv_w"]),
+                                  np.asarray(tree["blocks"]["qkv_w"]))
+    after = sched.transform(tree, jnp.int32(10))
+    assert not np.array_equal(np.asarray(after["blocks"]["qkv_w"]),
+                              np.asarray(tree["blocks"]["qkv_w"]))
+
+
+# ------------------------------------------------------------------- engine
+def test_engine_qat_trains():
+    from deepspeed_tpu.models import build_gpt
+    from deepspeed_tpu.models.gpt import GPTConfig
+
+    model, cfg = build_gpt(GPTConfig(
+        vocab_size=64, d_model=32, n_layer=1, n_head=2, max_seq_len=16))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "compression_training": {
+                "weight_quantization": {
+                    "shared_parameters": {"enabled": True, "schedule_offset": 2},
+                    "different_groups": {
+                        "g0": {"params": {"start_bits": 8, "quantize_groups": 1}}},
+                }},
+            "steps_per_print": 0,
+        })
+    assert engine._compression is not None
+    r = np.random.default_rng(0)
+    b = {"input_ids": r.integers(0, 64, size=(8, 16), dtype=np.int32)}
+    losses = [float(engine.train_batch(b)["loss"]) for _ in range(6)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]  # still learns through quantization
+
+
+# ------------------------------------------------------------------- inference quant
+def test_quantize_params_for_inference(rng):
+    tree = _param_tree(rng)
+    qtree, scales, meta = quantize_params_for_inference(tree, bits=8, num_groups=4)
+    assert qtree["blocks"]["qkv_w"].dtype == jnp.int8
+    assert qtree["wte"].dtype == jnp.float32  # excluded stays
+    assert meta["quantized"] == ["blocks/qkv_w"]
+    deq = meta["dequantize"](dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(deq["blocks"]["qkv_w"]),
+                               np.asarray(tree["blocks"]["qkv_w"]), atol=0.05)

@@ -1,0 +1,217 @@
+"""Data efficiency: curriculum scheduler, data sampler, random-LTD."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.data_pipeline import (
+    CurriculumScheduler,
+    DeepSpeedDataSampler,
+    RandomLTDScheduler,
+    random_ltd_gather,
+    random_ltd_scatter,
+)
+from deepspeed_tpu.runtime.data_pipeline.data_routing.random_ltd import random_ltd_layer
+
+
+# ------------------------------------------------------------------- curriculum
+def test_fixed_linear_schedule():
+    s = CurriculumScheduler({
+        "curriculum_type": "seqlen", "min_difficulty": 8, "max_difficulty": 64,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8}})
+    assert s.get_difficulty(0) == 8
+    assert s.get_difficulty(50) == 8 + (64 - 8) // 2 // 8 * 8  # quantized midpoint
+    assert s.get_difficulty(100) == 64
+    assert s.get_difficulty(10_000) == 64
+    # monotone non-decreasing
+    vals = [s.get_difficulty(t) for t in range(0, 120, 5)]
+    assert all(a <= b for a, b in zip(vals, vals[1:]))
+
+
+def test_fixed_root_schedule_grows_faster_early():
+    lin = CurriculumScheduler({
+        "min_difficulty": 8, "max_difficulty": 512,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 1000, "difficulty_step": 8}})
+    root = CurriculumScheduler({
+        "min_difficulty": 8, "max_difficulty": 512,
+        "schedule_type": "fixed_root",
+        "schedule_config": {"total_curriculum_step": 1000, "difficulty_step": 8,
+                            "root_degree": 2}})
+    assert root.get_difficulty(100) > lin.get_difficulty(100)
+    assert root.get_difficulty(1000) == lin.get_difficulty(1000) == 512
+
+
+def test_fixed_discrete_schedule():
+    s = CurriculumScheduler({
+        "min_difficulty": 8, "max_difficulty": 64,
+        "schedule_type": "fixed_discrete",
+        "schedule_config": {"difficulty": [8, 32, 64], "max_step": [10, 20]}})
+    assert s.get_difficulty(5) == 8
+    assert s.get_difficulty(15) == 32
+    assert s.get_difficulty(25) == 64
+
+
+def test_scheduler_state_roundtrip():
+    s = CurriculumScheduler({
+        "min_difficulty": 8, "max_difficulty": 64,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8}})
+    s.update_difficulty(57)
+    sd = s.state_dict()
+    s2 = CurriculumScheduler({
+        "min_difficulty": 8, "max_difficulty": 64,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8}})
+    s2.load_state_dict(sd)
+    assert s2.get_current_difficulty() == s.get_current_difficulty()
+
+
+# ------------------------------------------------------------------- sampler
+def test_sampler_partitions_ranks_disjointly():
+    batches = {}
+    for rank in range(2):
+        s = DeepSpeedDataSampler(
+            total_samples=64, micro_batch_size=4,
+            data_parallel_rank=rank, data_parallel_size=2, seed=7)
+        batches[rank] = list(s)
+    assert len(batches[0]) == len(batches[1]) == 8
+    for b0, b1 in zip(batches[0], batches[1]):
+        assert set(b0).isdisjoint(b1)
+    seen = set().union(*[set(b) for b in batches[0] + batches[1]])
+    assert seen == set(range(64))  # full epoch coverage
+
+
+def test_sampler_deterministic_and_resumable():
+    s1 = DeepSpeedDataSampler(total_samples=32, micro_batch_size=4, seed=3)
+    all1 = list(s1)
+    s2 = DeepSpeedDataSampler(total_samples=32, micro_batch_size=4, seed=3)
+    # consume 3 batches, checkpoint, resume
+    it = iter(s2)
+    first3 = [next(it) for _ in range(3)]
+    sd = s2.state_dict()
+    s3 = DeepSpeedDataSampler(total_samples=32, micro_batch_size=4, seed=3)
+    s3.load_state_dict(sd)
+    rest = list(s3)
+    assert first3 + rest == all1
+
+
+def test_sampler_curriculum_gates_difficulty():
+    sched = CurriculumScheduler({
+        "min_difficulty": 10, "max_difficulty": 100,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 10, "difficulty_step": 10}})
+    step = {"n": 0}
+    s = DeepSpeedDataSampler(
+        total_samples=50, micro_batch_size=4, seed=1,
+        curriculum_scheduler=sched, difficulty_fn=lambda i: i,
+        global_steps_fn=lambda: step["n"])
+    it = iter(s)
+    b = next(it)
+    assert all(i <= 10 for i in b)  # early: only easy samples
+    step["n"] = 10
+    hard_seen = any(any(i > 10 for i in next(it)) for _ in range(5))
+    assert hard_seen  # after the ramp, hard samples flow
+
+
+def test_sampler_curriculum_resume_no_duplicates():
+    """Gated consumption is out of permutation order; resume must not repeat
+    consumed samples nor drop deferred ones."""
+    def make(step_box):
+        sched = CurriculumScheduler({
+            "min_difficulty": 20, "max_difficulty": 100,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 4, "difficulty_step": 20}})
+        return DeepSpeedDataSampler(
+            total_samples=40, micro_batch_size=4, seed=5,
+            curriculum_scheduler=sched, difficulty_fn=lambda i: i,
+            global_steps_fn=lambda: step_box["n"])
+
+    step = {"n": 0}
+    s = make(step)
+    it = iter(s)
+    consumed = []
+    for _ in range(3):
+        consumed += next(it)
+        step["n"] += 1
+    sd = s.state_dict()
+
+    step2 = {"n": step["n"]}
+    s2 = make(step2)
+    s2.load_state_dict(sd)
+    rest = []
+    for b in s2:
+        rest += b
+        step2["n"] += 1
+    # no duplicates across the resume point, full epoch coverage
+    assert set(consumed).isdisjoint(rest)
+    assert len(consumed + rest) == len(set(consumed + rest))
+    assert set(consumed + rest) == set(range(40))
+
+
+# ------------------------------------------------------------------- random-ltd
+def test_random_ltd_gather_scatter_roundtrip(rng):
+    x = jnp.asarray(rng.normal(size=(2, 16, 8)), jnp.float32)
+    kept, idx = random_ltd_gather(x, 6, jax.random.PRNGKey(0))
+    assert kept.shape == (2, 6, 8)
+    assert (np.diff(np.asarray(idx), axis=1) > 0).all()  # sorted order kept
+    out = random_ltd_scatter(kept, idx, x)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))  # identity layer
+
+
+def test_random_ltd_layer_passthrough(rng):
+    x = jnp.asarray(rng.normal(size=(2, 16, 8)), jnp.float32)
+    double = lambda t: t * 2.0
+    out = random_ltd_layer(double, x, 6, jax.random.PRNGKey(1))
+    doubled = np.isclose(np.asarray(out), 2 * np.asarray(x)).all(axis=-1)
+    untouched = np.isclose(np.asarray(out), np.asarray(x)).all(axis=-1)
+    assert doubled.sum() == 2 * 6  # exactly keep tokens per row doubled
+    assert (doubled | untouched).all()
+    # keep >= T: whole layer applies
+    out_full = random_ltd_layer(double, x, 16, jax.random.PRNGKey(1))
+    np.testing.assert_allclose(np.asarray(out_full), 2 * np.asarray(x))
+
+
+def test_random_ltd_scheduler_ramps():
+    s = RandomLTDScheduler({
+        "random_ltd_schedule": {
+            "min_value": 64, "max_value": 256,
+            "schedule_config": {"seq_per_step": 32, "require_steps": 100}}})
+    assert s.get_value(0) == 64
+    assert s.get_value(100) == 256
+    vals = [s.get_value(t) for t in range(0, 120, 10)]
+    assert all(a <= b for a, b in zip(vals, vals[1:]))
+    assert all(v % 32 == 0 for v in vals)
+
+
+# ------------------------------------------------------------------- engine hook
+def test_engine_curriculum_truncates_seqlen():
+    from deepspeed_tpu.models import build_gpt
+    from deepspeed_tpu.models.gpt import GPTConfig
+
+    model, cfg = build_gpt(GPTConfig(
+        vocab_size=64, d_model=32, n_layer=1, n_head=2, max_seq_len=32))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "curriculum_learning": {
+                "enabled": True, "curriculum_type": "seqlen",
+                "min_difficulty": 8, "max_difficulty": 32,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 4, "difficulty_step": 8},
+            },
+            "steps_per_print": 0,
+        })
+    r = np.random.default_rng(0)
+    batch = {"input_ids": r.integers(0, 64, size=(8, 32), dtype=np.int32)}
+    m = engine.train_batch(batch)
+    assert np.isfinite(float(m["loss"]))
+    assert engine.curriculum_scheduler.get_current_difficulty() == 8
+    for _ in range(4):
+        m = engine.train_batch(batch)
+    assert engine.curriculum_scheduler.get_current_difficulty() == 32
