@@ -89,7 +89,7 @@ def initialize(
 def init_inference(model: Any = None, config: Any = None, **kwargs):
     """Create an inference engine. Parity: ``deepspeed.init_inference``
     (``deepspeed/__init__.py:233``)."""
-    from .inference.engine import InferenceEngine
+    from .inference.engine import InferenceEngine, for_gpt
     from .inference.config import DeepSpeedInferenceConfig
 
     if config is None:
@@ -98,6 +98,14 @@ def init_inference(model: Any = None, config: Any = None, **kwargs):
         config = {**(config if isinstance(config, dict) else {}), **kwargs}
     inf_cfg = (config if isinstance(config, DeepSpeedInferenceConfig)
                else DeepSpeedInferenceConfig(**config))
+    # HF transformers model: route through the import policies (the reference's
+    # replace_transformer_layer path, module_inject/replace_module.py:302)
+    if model is not None and hasattr(model, "state_dict") and hasattr(model, "config") \
+            and not hasattr(model, "prefill"):
+        from .module_inject import import_hf_model
+
+        gpt_cfg, params = import_hf_model(model)
+        model = for_gpt(gpt_cfg, params)
     return InferenceEngine(model, inf_cfg)
 
 

@@ -34,6 +34,15 @@ from .config import get_compression_config
 _EXCLUDE_DEFAULT = ("ln", "layernorm", "norm", "bias", "wpe", "wte", "embed")
 
 
+def _is_weight(key: str, leaf) -> bool:
+    """Quantize/prune matmul weights only — stacked per-layer biases are 2-D
+    ([L, F]) but are still biases (the reference GroupQuantizer is weights-only)."""
+    if not hasattr(leaf, "ndim") or leaf.ndim < 2:
+        return False
+    last = key.rsplit("/", 1)[-1].lower()
+    return not (last.endswith("_b") or "bias" in last)
+
+
 def _path_str(path) -> str:
     return "/".join(str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p))))
                     for p in path)
@@ -59,9 +68,7 @@ class CompressionScheduler:
         wq = self.cfg["weight_quantization"]
         sp = self.cfg["sparse_pruning"]
         for key, leaf in _key_paths(param_tree):
-            if not hasattr(leaf, "ndim") or leaf.ndim < 2:
-                continue
-            if _matches(key, list(_EXCLUDE_DEFAULT)):
+            if not _is_weight(key, leaf) or _matches(key, list(_EXCLUDE_DEFAULT)):
                 continue
             entry: Dict[str, Any] = {}
             if wq["shared"]["enabled"]:
@@ -157,6 +164,7 @@ def layer_reduction_map(n_teacher_layers: int, keep: int,
 
 
 def quantize_params_for_inference(params, bits: int = 8, num_groups: int = 1,
+                                  group_size: Optional[int] = None,
                                   exclude=_EXCLUDE_DEFAULT):
     """Post-training weight quantization: returns (int8 tree, scales tree,
     metadata) for storage, and a dequantize closure for load. Parity: the
@@ -168,8 +176,11 @@ def quantize_params_for_inference(params, bits: int = 8, num_groups: int = 1,
     quantized_keys = []
     for path, leaf in flat:
         key = _path_str(path)
-        if hasattr(leaf, "ndim") and leaf.ndim >= 2 and not _matches(key, list(exclude)):
-            q, s = quantize(leaf, bits=bits, num_groups=num_groups)
+        if _is_weight(key, leaf) and not _matches(key, list(exclude)):
+            ng = num_groups
+            if group_size and leaf.size % group_size == 0:
+                ng = leaf.size // group_size
+            q, s = quantize(leaf, bits=bits, num_groups=ng)
             q_leaves.append(q)
             s_leaves.append(s)
             quantized_keys.append(key)

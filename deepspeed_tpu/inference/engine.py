@@ -66,6 +66,31 @@ class InferenceEngine:
             model.params)
         shapes = jax.eval_shape(lambda: params)
         specs = model.partition_specs(shapes) if hasattr(model, "partition_specs") else None
+        if specs is None and tp > 1:
+            # AutoTP: infer Megatron-style specs for unknown trees
+            # (parity: module_inject/auto_tp.py:7)
+            from ..module_inject import auto_tp_specs
+
+            specs = auto_tp_specs(params, tp_size=tp)
+            log_dist("inference engine: AutoTP-inferred tensor-parallel sharding")
+
+        # int8 weight-only storage quantization (parity: GroupQuantizer,
+        # module_inject/replace_module.py:144). NOTE current memory semantics:
+        # int8 + scales are what live at rest / in checkpoints and transfers;
+        # inside a compiled generate the dequantized compute-dtype tree is
+        # loop-invariant across the scan, so peak HBM during generation is NOT
+        # reduced (per-layer in-scan dequant is a later optimization)
+        self._quant_scales = None
+        if self.config.quant.enabled:
+            from ..compression import quantize_params_for_inference
+
+            params, scales, meta = quantize_params_for_inference(
+                params, bits=self.config.quant.bits,
+                group_size=self.config.quant.group_size)
+            self._quant_scales = scales
+            log_dist(f"inference engine: int{self.config.quant.bits} weights for "
+                     f"{len(meta['quantized'])} tensors")
+
         if specs is not None:
             self.params = jax.tree_util.tree_map(
                 lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)), params, specs)
@@ -73,6 +98,17 @@ class InferenceEngine:
             self.params = jax.device_put(params, NamedSharding(self.mesh, P()))
         log_dist(f"inference engine: dtype {self.dtype}, tp={tp}, "
                  f"max_out_tokens={self.config.max_out_tokens}")
+
+    def _materialize(self, params):
+        """Inside-jit dequantization of int8 leaves back to compute dtype."""
+        if self._quant_scales is None:
+            return params
+        from ..ops.quantizer import dequantize
+
+        flat, treedef = jax.tree_util.tree_flatten(params)
+        out = [leaf if s is None else dequantize(leaf, s, dtype=self.dtype)
+               for leaf, s in zip(flat, self._quant_scales)]
+        return jax.tree_util.tree_unflatten(treedef, out)
 
     def profile_model_time(self, use_cuda_events: bool = False) -> None:
         """Parity: ``inference/engine.py:151``."""
@@ -99,6 +135,7 @@ class InferenceEngine:
         key = ("prefill", shape)
         if key not in self._decode_fns:
             def fn(params, ids):
+                params = self._materialize(params)
                 cache = self.model.init_cache(shape[0], shape[1], self.dtype)
                 logits, _ = self.model.prefill(params, ids, cache)
                 return logits
@@ -116,7 +153,6 @@ class InferenceEngine:
         input_ids = jnp.asarray(input_ids)
         B, T = input_ids.shape
         max_new = max_new_tokens or self.config.max_out_tokens
-        total = T + max_new
         key = jax.random.PRNGKey(seed)
         gen_key = (B, T, max_new, temperature, top_k,
                    -1 if eos_token_id is None else eos_token_id)
@@ -135,7 +171,9 @@ class InferenceEngine:
                            top_k: int, eos: int):
         model = self.model
         dtype = self.dtype
-        total = T + max_new
+        # cache padded to a 128-multiple: full-lane blocks for the Pallas decode
+        # kernel; the validity mask makes the padding inert
+        total = -(-(T + max_new) // 128) * 128
 
         def sample(logits, key):
             if temperature == 0.0:
@@ -147,6 +185,7 @@ class InferenceEngine:
             return jax.random.categorical(key, logits, axis=-1)
 
         def fn(params, input_ids, key):
+            params = self._materialize(params)
             cache = model.init_cache(B, total, dtype)
             logits, cache = model.prefill(params, input_ids, cache)
             next_tok = sample(logits[:, -1, :], key)

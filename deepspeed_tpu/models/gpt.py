@@ -50,6 +50,9 @@ class GPTConfig:
     tie_embeddings: bool = True
     dropout: float = 0.0
     layer_norm_eps: float = 1e-5
+    activation: str = "gelu"  # "gelu" (tanh approx), "gelu_exact", "relu" (OPT)
+    parallel_residual: bool = False  # NeoX-style x + attn(ln1 x) + mlp(ln2 x)
+    pos_offset: int = 0  # learned-position index offset (OPT uses 2)
     remat: bool = False  # activation checkpointing per block
     remat_policy: str = "nothing_saveable"  # jax.checkpoint_policies name
     use_flash: Optional[bool] = None  # None = auto dispatch
@@ -112,7 +115,7 @@ def init_params(cfg: GPTConfig, rng: jax.Array,
         "lnf_bias": jnp.zeros((d,)),
     }
     if not cfg.rotary:
-        params["wpe"] = normal(k[5], (cfg.max_seq_len, d), std)
+        params["wpe"] = normal(k[5], (cfg.max_seq_len + cfg.pos_offset, d), std)
     if not cfg.tie_embeddings:
         params["lm_head"] = normal(k[6], (v, d), std)
     return params
@@ -166,9 +169,17 @@ def _rope(x: jnp.ndarray, positions: jnp.ndarray, rotary_dims: int) -> jnp.ndarr
     return jnp.concatenate([rotated, x_pass], axis=-1)
 
 
-def attention_sublayer(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray],
-                       positions: jnp.ndarray, dropout_rng, train: bool) -> jnp.ndarray:
-    """Pre-LN self-attention + residual (shared by dense and MoE blocks)."""
+def _act(cfg: GPTConfig, h: jnp.ndarray) -> jnp.ndarray:
+    if cfg.activation == "relu":
+        return jax.nn.relu(h)
+    if cfg.activation == "gelu_exact":
+        return jax.nn.gelu(h, approximate=False)
+    return jax.nn.gelu(h, approximate=True)
+
+
+def _attention_delta(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray],
+                     positions: jnp.ndarray) -> jnp.ndarray:
+    """Attention output (pre-residual): attn_out(MHA(ln1(x)))."""
     B, T, D = x.shape
     H, Dh = cfg.n_head, cfg.head_dim
     h = layer_norm(x, w["ln1_scale"], w["ln1_bias"], cfg.layer_norm_eps)
@@ -184,17 +195,34 @@ def attention_sublayer(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray]
         k_ = _rope(k_, positions, rd)
     attn = multihead_attention(q, k_, v, causal=True, use_flash=cfg.use_flash)
     attn = attn.reshape(B, T, D)
-    attn = attn @ w["attn_out_w"] + w["attn_out_b"]
+    return attn @ w["attn_out_w"] + w["attn_out_b"]
+
+
+def _mlp_delta(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+    """MLP output (pre-residual): mlp(ln2(x))."""
+    h = layer_norm(x, w["ln2_scale"], w["ln2_bias"], cfg.layer_norm_eps)
+    h = h @ w["mlp_up_w"] + w["mlp_up_b"]
+    h = _act(cfg, h)
+    return h @ w["mlp_down_w"] + w["mlp_down_b"]
+
+
+def attention_sublayer(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray],
+                       positions: jnp.ndarray, dropout_rng, train: bool) -> jnp.ndarray:
+    """Pre-LN self-attention + residual (shared by dense and MoE blocks)."""
+    attn = _attention_delta(cfg, x, w, positions)
     return x + _dropout(attn, cfg.dropout, dropout_rng, train, salt=0)
 
 
 def _block(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray],
            positions: jnp.ndarray, dropout_rng, train: bool) -> jnp.ndarray:
+    if cfg.parallel_residual:
+        # NeoX/GPT-J style: both sublayers read the same input
+        attn = _dropout(_attention_delta(cfg, x, w, positions),
+                        cfg.dropout, dropout_rng, train, salt=0)
+        mlp = _dropout(_mlp_delta(cfg, x, w), cfg.dropout, dropout_rng, train, salt=1)
+        return x + attn + mlp
     x = attention_sublayer(cfg, x, w, positions, dropout_rng, train)
-    h = layer_norm(x, w["ln2_scale"], w["ln2_bias"], cfg.layer_norm_eps)
-    h = h @ w["mlp_up_w"] + w["mlp_up_b"]
-    h = jax.nn.gelu(h, approximate=True)
-    h = h @ w["mlp_down_w"] + w["mlp_down_b"]
+    h = _mlp_delta(cfg, x, w)
     x = x + _dropout(h, cfg.dropout, dropout_rng, train, salt=1)
     return x
 
@@ -219,7 +247,7 @@ def forward(cfg: GPTConfig, params: Dict[str, Any], input_ids: jnp.ndarray,
     x = jnp.take(params["wte"], input_ids, axis=0)
     positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
     if not cfg.rotary:
-        x = x + jnp.take(params["wpe"], positions, axis=0)
+        x = x + jnp.take(params["wpe"], positions + cfg.pos_offset, axis=0)
     x = x.astype(params["blocks"]["qkv_w"].dtype)
     # residual stream sharded over batch and (if sp>1) sequence
     x = maybe_shard(x, P(BATCH, "sp", None))
@@ -321,24 +349,31 @@ def _block_with_cache(cfg: GPTConfig, x, w, k_cache, v_cache, pos):
         k_ = _rope(k_, positions, rd)
     k_cache = jax.lax.dynamic_update_slice(k_cache, k_.astype(k_cache.dtype), (0, pos, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
-    # attend over the whole cache with a validity+causal mask
     scale = 1.0 / np.sqrt(Dh)
-    logits = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
-                        k_cache.astype(jnp.float32)) * scale
-    s_idx = jnp.arange(S)[None, :]
-    t_idx = positions[:, :, None]  # absolute position of each query token
-    mask = s_idx <= t_idx  # [B, T, S]
-    logits = jnp.where(mask[:, None, :, :], logits, jnp.float32(-1e30))
-    probs = jax.nn.softmax(logits, axis=-1)
-    attn = jnp.einsum("bhts,bshd->bthd", probs.astype(v_cache.dtype), v_cache)
-    attn = attn.reshape(B, T, D).astype(x.dtype)
+    if T == 1 and cfg.use_flash is not False:
+        # per-token decode: fused Pallas cache-attention kernel (parity:
+        # softmax_context, csrc/transformer/inference)
+        from ..ops.pallas.decode_attention import decode_attention
+
+        attn = decode_attention(q.astype(k_cache.dtype), k_cache, v_cache, pos + 1,
+                                softmax_scale=scale)
+        attn = attn.reshape(B, T, D).astype(x.dtype)
+    else:
+        # prefill: attend over the whole cache with a validity+causal mask
+        logits = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                            k_cache.astype(jnp.float32)) * scale
+        s_idx = jnp.arange(S)[None, :]
+        t_idx = positions[:, :, None]  # absolute position of each query token
+        mask = s_idx <= t_idx  # [B, T, S]
+        logits = jnp.where(mask[:, None, :, :], logits, jnp.float32(-1e30))
+        probs = jax.nn.softmax(logits, axis=-1)
+        attn = jnp.einsum("bhts,bshd->bthd", probs.astype(v_cache.dtype), v_cache)
+        attn = attn.reshape(B, T, D).astype(x.dtype)
     attn = attn @ w["attn_out_w"] + w["attn_out_b"]
+    if cfg.parallel_residual:
+        return x + attn + _mlp_delta(cfg, x, w), k_cache, v_cache
     x = x + attn
-    h = layer_norm(x, w["ln2_scale"], w["ln2_bias"], cfg.layer_norm_eps)
-    h = h @ w["mlp_up_w"] + w["mlp_up_b"]
-    h = jax.nn.gelu(h, approximate=True)
-    h = h @ w["mlp_down_w"] + w["mlp_down_b"]
-    return x + h, k_cache, v_cache
+    return x + _mlp_delta(cfg, x, w), k_cache, v_cache
 
 
 def forward_with_cache(cfg: GPTConfig, params, input_ids: jnp.ndarray, cache):
@@ -349,7 +384,7 @@ def forward_with_cache(cfg: GPTConfig, params, input_ids: jnp.ndarray, cache):
     x = jnp.take(params["wte"], input_ids, axis=0)
     positions = pos + jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
     if not cfg.rotary:
-        x = x + jnp.take(params["wpe"], positions, axis=0)
+        x = x + jnp.take(params["wpe"], positions + cfg.pos_offset, axis=0)
     x = x.astype(params["blocks"]["qkv_w"].dtype)
     x = maybe_shard(x, P(BATCH, None, None))
 

@@ -171,7 +171,7 @@ def forward(cfg: GPTMoEConfig, params, input_ids: jnp.ndarray,
     x = jnp.take(params["wte"], input_ids, axis=0)
     positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
     if not b.rotary:
-        x = x + jnp.take(params["wpe"], positions, axis=0)
+        x = x + jnp.take(params["wpe"], positions + b.pos_offset, axis=0)
     x = x.astype(params["moe_blocks"]["qkv_w"].dtype)
     x = maybe_shard(x, P(BATCH, "sp", None))
     drng = (rngs or {}).get("dropout")

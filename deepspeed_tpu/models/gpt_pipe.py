@@ -61,7 +61,7 @@ def forward(cfg: G.GPTConfig, num_stages: int, num_micro: int, params,
     x = jnp.take(params["wte"], input_ids, axis=0)
     positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
     if not cfg.rotary:
-        x = x + jnp.take(params["wpe"], positions, axis=0)
+        x = x + jnp.take(params["wpe"], positions + cfg.pos_offset, axis=0)
     x = x.astype(params["blocks"]["qkv_w"].dtype)
 
     drng = (rngs or {}).get("dropout")

@@ -1,0 +1,2 @@
+from .auto_tp import auto_tp_specs  # noqa: F401
+from .replace_module import import_hf_model, HF_POLICIES  # noqa: F401

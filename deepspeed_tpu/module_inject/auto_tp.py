@@ -1,0 +1,78 @@
+"""AutoTP: automatic tensor-parallel sharding for unknown parameter trees.
+
+Capability parity with the reference's ``AutoTP`` (``module_inject/auto_tp.py:7``):
+the reference parses an unrecognized HF model, finds its Linear layers, column- or
+row-slices them and inserts the all-reduce after each row-parallel matmul. Here
+the same policy is expressed as inferred ``PartitionSpec``s: XLA places the
+all-reduces wherever a row-sharded contraction meets a replicated consumer.
+
+Heuristics (mirroring AutoTP's rules):
+- fused qkv / up-projections (name contains qkv/query/key/value/fc/up/h_to_4h,
+  or out_features > in_features): column-parallel — shard the LAST dim;
+- output/down projections (out/proj/down/4h_to_h, or in > out): row-parallel —
+  shard the second-to-last dim;
+- embeddings: vocab-parallel on dim 0; 1-D tensors (bias/norm) replicated,
+  except biases of column-parallel weights which follow their column sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+_COL_HINTS = ("qkv", "query", "key", "value", "q_proj", "k_proj", "v_proj",
+              "fc1", "up", "h_to_4h", "c_attn", "c_fc", "gate", "in_proj")
+_ROW_HINTS = ("out", "proj_out", "down", "4h_to_h", "c_proj", "o_proj", "fc2",
+              "dense")
+_EMBED_HINTS = ("wte", "embed", "lm_head", "word_embeddings")
+
+
+def _path_str(path) -> str:
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p))))
+                    for p in path).lower()
+
+
+def _spec_for(key: str, leaf, tp_axis: str) -> P:
+    ndim = getattr(leaf, "ndim", 0)
+    shape = getattr(leaf, "shape", ())
+    if ndim == 0:
+        return P()
+    if any(h in key for h in _EMBED_HINTS) and ndim >= 2:
+        return P(*([tp_axis] + [None] * (ndim - 1)))
+    if ndim == 1:
+        return P(None)
+    col = any(h in key for h in _COL_HINTS)
+    row = any(h in key for h in _ROW_HINTS)
+    if not col and not row:
+        # fall back on shape: expanding matmuls are column-parallel
+        col = shape[-1] >= shape[-2]
+        row = not col
+    spec = [None] * ndim
+    if col:
+        spec[-1] = tp_axis
+    else:
+        spec[-2] = tp_axis
+    return P(*spec)
+
+
+def auto_tp_specs(params, tp_axis: str = "tp", tp_size: Optional[int] = None):
+    """Infer a TP PartitionSpec tree for an arbitrary param tree.
+
+    ``tp_size``: when given, dims not divisible by it fall back to replication
+    (the reference's AutoTP likewise skips unshardable Linears).
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for path, leaf in flat:
+        spec = _spec_for(_path_str(path), leaf, tp_axis)
+        if tp_size is not None:
+            entries = list(spec)
+            for d, e in enumerate(entries):
+                if e is not None and leaf.shape[d] % tp_size != 0:
+                    entries = [None] * leaf.ndim
+                    break
+            spec = P(*entries)
+        out.append(spec)
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -1,0 +1,94 @@
+"""Single-token decode attention over a KV cache, in Pallas.
+
+Capability parity with the reference's fused decode kernels — the
+``softmax_context`` KV-cache attention (``csrc/transformer/inference/csrc/
+softmax.cu`` + ``pt_binding.cpp`` attention bindings, workspace layout
+``inference_context.h``): one new query token attends over the cache with a
+validity mask, in one kernel, without materializing [B, H, S] probabilities in
+HBM.
+
+Grid = (B, H): each program streams its head's cache [S, Dh] through VMEM in
+blocks with an online softmax. The current cache length arrives as a scalar
+array input (the analog of the reference's ``current_tokens`` workspace field) —
+the compiled kernel serves every decode step of a generation, whatever the
+length.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .flash_attention import NEG_INF, _interpret
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
+                   block_k: int):
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # [1, Dh] row-block
+    cur = len_ref[0, 0]
+
+    Dh = q.shape[-1]
+    acc = jnp.zeros((1, Dh), jnp.float32)
+    m_i = jnp.full((1, 1), NEG_INF, jnp.float32)
+    l_i = jnp.zeros((1, 1), jnp.float32)
+    num_blocks = (cur + block_k - 1) // block_k
+
+    def body(ki, carry):
+        acc, m_i, l_i = carry
+        k = k_ref[0, pl.ds(ki * block_k, block_k), 0, :].astype(jnp.float32)  # [Bk, Dh]
+        v = v_ref[0, pl.ds(ki * block_k, block_k), 0, :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [1, Bk]
+        s_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+        s = jnp.where(s_pos < cur, s, NEG_INF)
+        m_new = jnp.maximum(m_i, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_i - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_i + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot(p, v)
+        return acc, m_new, l_new
+
+    acc, m_i, l_i = jax.lax.fori_loop(0, num_blocks, body, (acc, m_i, l_i))
+    l_safe = jnp.where(l_i == 0.0, 1.0, l_i)
+    o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
+
+
+def decode_attention(
+    q: jnp.ndarray,  # [B, 1, H, Dh] — the new token's query
+    k_cache: jnp.ndarray,  # [B, S, H, Dh]
+    v_cache: jnp.ndarray,
+    cur_len: jnp.ndarray,  # scalar int32: valid cache entries INCLUDING the new token
+    softmax_scale: Optional[float] = None,
+    block_k: int = 256,
+) -> jnp.ndarray:
+    """Returns [B, 1, H, Dh]. The new token's k/v must already be in the cache."""
+    B, one, H, Dh = q.shape
+    assert one == 1
+    S = k_cache.shape[1]
+    # largest power-of-two block that divides S (any S works; engines should pad
+    # the cache to a 128-multiple so the loop runs on full-lane blocks)
+    block_k = min(block_k, S)
+    while block_k > 1 and S % block_k:
+        block_k //= 2
+    scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(Dh)
+    lens = jnp.broadcast_to(jnp.asarray(cur_len, jnp.int32), (1, 1))
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, sm_scale=scale, block_k=block_k),
+        grid=(B, H),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda b, h: (0, 0)),
+            pl.BlockSpec((1, 1, 1, Dh), lambda b, h: (b, 0, h, 0)),
+            pl.BlockSpec((1, S, 1, Dh), lambda b, h: (b, 0, h, 0)),
+            pl.BlockSpec((1, S, 1, Dh), lambda b, h: (b, 0, h, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, 1, Dh), lambda b, h: (b, 0, h, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, 1, H, Dh), q.dtype),
+        interpret=_interpret(),
+    )(lens, q, k_cache, v_cache)
+    return out
