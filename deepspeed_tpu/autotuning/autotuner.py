@@ -1,0 +1,158 @@
+"""Autotuner: config-space search for throughput.
+
+Capability parity with the reference's autotuning subsystem
+(``autotuning/autotuner.py`` + ``scheduler.py``): enumerate a tuning space over
+ZeRO stage and micro-batch size (plus user-supplied dimensions), run short
+measured trials, and emit the best DeepSpeed config. The reference launches each
+experiment as a separate multi-node job through its scheduler; here a trial is a
+callable (by default: build an engine, run a few ``train_batch`` steps, report
+tokens/sec) in-process — one controller owns all chips on a TPU host, so no
+cross-job resource manager is needed.
+
+The config schema follows the reference's ``"autotuning"`` block: enabled,
+metric ("throughput" | "latency"), start_profile_step/end_profile_step,
+tuner_early_stopping, and the tuning space under "tuner" / zero stages /
+micro-batch candidates.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import itertools
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.logging import log_dist, logger
+
+
+@dataclasses.dataclass
+class TuningExperiment:
+    """One point in the tuning space."""
+
+    config: Dict[str, Any]
+    metric_value: Optional[float] = None
+    error: Optional[str] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None and self.metric_value is not None
+
+
+def default_trial_runner(model_factory: Callable, batch_factory: Callable,
+                         steps: int = 5) -> Callable[[Dict[str, Any]], float]:
+    """Returns a trial function: config -> tokens/sec (OOM/shape errors -> raise)."""
+
+    def run(config: Dict[str, Any]) -> float:
+        import numpy as np
+
+        import deepspeed_tpu
+
+        model = model_factory()
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, config={**config, "steps_per_print": 0})
+        batch = batch_factory(engine.train_batch_size)
+        m = engine.train_batch(batch)  # compile
+        float(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            m = engine.train_batch(batch)
+        float(m["loss"])
+        dt = time.perf_counter() - t0
+        tokens = steps * int(np.prod(next(iter(batch.values())).shape))
+        return tokens / dt
+
+    return run
+
+
+class Autotuner:
+    """Grid/early-stopped search over micro-batch x ZeRO stage (x extras)."""
+
+    def __init__(self, base_config: Dict[str, Any],
+                 tuning_space: Optional[Dict[str, List[Any]]] = None,
+                 metric: str = "throughput",
+                 early_stopping: int = 0,
+                 results_dir: Optional[str] = None):
+        at = dict(base_config.get("autotuning", {}))
+        self.base_config = {k: v for k, v in base_config.items() if k != "autotuning"}
+        self.metric = at.get("metric", metric)
+        self.early_stopping = int(at.get("tuner_early_stopping", early_stopping))
+        self.results_dir = results_dir or at.get("results_dir", "autotuning_results")
+        space = tuning_space or {}
+        self.space: Dict[str, List[Any]] = {
+            "train_micro_batch_size_per_gpu": space.get(
+                "train_micro_batch_size_per_gpu",
+                at.get("micro_batch_sizes", [1, 2, 4, 8])),
+            "zero_optimization.stage": space.get(
+                "zero_optimization.stage", at.get("zero_stages", [0, 1, 2, 3])),
+        }
+        for k, v in space.items():
+            self.space.setdefault(k, v)
+        self.experiments: List[TuningExperiment] = []
+
+    # ------------------------------------------------------------------ space
+    def _set(self, config: Dict[str, Any], dotted: str, value: Any) -> None:
+        parts = dotted.split(".")
+        node = config
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = value
+
+    def generate_experiments(self) -> List[TuningExperiment]:
+        keys = sorted(self.space)
+        exps = []
+        for combo in itertools.product(*[self.space[k] for k in keys]):
+            cfg = copy.deepcopy(self.base_config)
+            for k, v in zip(keys, combo):
+                self._set(cfg, k, v)
+            exps.append(TuningExperiment(config=cfg))
+        return exps
+
+    # ------------------------------------------------------------------ tuning
+    def tune(self, trial_fn: Callable[[Dict[str, Any]], float]
+             ) -> Optional[TuningExperiment]:
+        """Run the space; returns the best experiment (None if all failed).
+
+        ``trial_fn(config) -> metric`` (higher better for throughput, lower
+        better for latency). Failures are recorded, not fatal — the reference
+        likewise treats OOM configs as pruned points.
+        """
+        self.experiments = self.generate_experiments()
+        best: Optional[TuningExperiment] = None
+        stale = 0
+        for i, exp in enumerate(self.experiments):
+            try:
+                v = float(trial_fn(exp.config))
+                exp.metric_value = v
+            except Exception as e:  # pruned point
+                exp.error = f"{type(e).__name__}: {e}"
+                logger.info(f"autotuner: experiment {i} pruned ({exp.error})")
+                continue
+            better = (best is None
+                      or (self.metric != "latency" and v > best.metric_value)
+                      or (self.metric == "latency" and v < best.metric_value))
+            if better:
+                best, stale = exp, 0
+            else:
+                stale += 1
+                if self.early_stopping and stale >= self.early_stopping:
+                    log_dist(f"autotuner: early stop after {stale} stale trials")
+                    break
+        self._write_results(best)
+        return best
+
+    def _write_results(self, best: Optional[TuningExperiment]) -> None:
+        try:
+            os.makedirs(self.results_dir, exist_ok=True)
+            with open(os.path.join(self.results_dir, "results.json"), "w") as f:
+                json.dump({
+                    "metric": self.metric,
+                    "experiments": [
+                        {"config": e.config, "metric_value": e.metric_value,
+                         "error": e.error} for e in self.experiments],
+                    "best": best.config if best else None,
+                }, f, indent=2, default=str)
+        except OSError as e:
+            logger.warning(f"autotuner: could not write results ({e})")

@@ -1,0 +1,6 @@
+from .elasticity import (  # noqa: F401
+    ElasticityError,
+    compute_elastic_config,
+    get_candidate_batch_sizes,
+    get_valid_gpus,
+)

@@ -1,0 +1,109 @@
+"""Elastic training batch math.
+
+Capability parity with the reference's elasticity v0.1/0.2
+(``elasticity/elasticity.py:125,173,287``): given an acceptable-batch-size
+ceiling and a set of micro-batch sizes, find a global batch size that remains
+valid (batch = micro x gas x world) across a whole RANGE of world sizes, so a
+preempted/resized job resumes without changing the effective batch.
+
+The algorithm is the reference's: candidate global batch sizes are each
+micro-batch scaled by powers of two up to the ceiling; a world size is valid for
+a candidate if the candidate divides by (micro x world) for some micro; the
+chosen candidate maximizes the number of valid world sizes, tie-broken by the
+preference for larger batch.
+
+Pure host math, portable as-is to TPU slices (world = chips or hosts).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+LATEST_ELASTICITY_VERSION = 0.2
+MINIMUM_DEEPSPEED_VERSION = "0.3.8"
+
+
+class ElasticityError(Exception):
+    """Parity: ``elasticity/elasticity.py`` error types (collapsed)."""
+
+
+def get_candidate_batch_sizes(base_list: List[int],
+                              max_acceptable_batch_size: int) -> List[int]:
+    """Each micro-batch size scaled by powers of 2 up to the ceiling."""
+    candidates = set()
+    for base in base_list:
+        if base <= 0:
+            raise ElasticityError(f"micro batch size must be positive, got {base}")
+        b = base
+        while b <= max_acceptable_batch_size:
+            candidates.add(b)
+            b *= 2
+    return sorted(candidates)
+
+
+def get_valid_gpus(batch_size: int, micro_batches: List[int],
+                   min_valid_gpus: int, max_valid_gpus: int) -> List[int]:
+    """World sizes at which ``batch_size`` decomposes as micro x gas x world."""
+    valid = []
+    for w in range(min_valid_gpus, max_valid_gpus + 1):
+        for mb in micro_batches:
+            if batch_size % (mb * w) == 0:
+                valid.append(w)
+                break
+    return valid
+
+
+def _best_candidate(candidates: List[int], micro_batches: List[int],
+                    min_gpus: int, max_gpus: int, prefer_larger: bool
+                    ) -> Tuple[Optional[int], List[int]]:
+    best_bs, best_gpus = None, []
+    order = reversed(candidates) if prefer_larger else iter(candidates)
+    for bs in order:
+        gpus = get_valid_gpus(bs, micro_batches, min_gpus, max_gpus)
+        if len(gpus) > len(best_gpus):
+            best_bs, best_gpus = bs, gpus
+    return best_bs, best_gpus
+
+
+def compute_elastic_config(ds_config: Dict[str, Any], world_size: int = 0
+                           ) -> Tuple[int, List[int], int]:
+    """Resolve the elasticity block. Parity: ``elasticity.py:287``.
+
+    Returns ``(final_batch_size, valid_world_sizes, micro_batch)`` where
+    ``micro_batch`` is resolved only when ``world_size`` > 0 (0 = just planning).
+    """
+    e = dict(ds_config.get("elasticity", {}) if isinstance(ds_config, dict)
+             else ds_config.elasticity or {})
+    if not e.get("enabled", False):
+        raise ElasticityError("elasticity block missing or disabled")
+    max_batch = int(e.get("max_train_batch_size", 2000))
+    micro_batches = [int(m) for m in e.get("micro_batch_sizes", [2, 4, 6])]
+    min_gpus = int(e.get("min_gpus", 1))
+    max_gpus = int(e.get("max_gpus", 10000))
+    prefer_larger = bool(e.get("prefer_larger_batch", True))
+    version = float(e.get("version", LATEST_ELASTICITY_VERSION))
+    if version > LATEST_ELASTICITY_VERSION:
+        raise ElasticityError(f"unsupported elasticity version {version}")
+    if min_gpus < 1 or max_gpus < min_gpus:
+        raise ElasticityError(f"invalid gpu range [{min_gpus}, {max_gpus}]")
+
+    candidates = get_candidate_batch_sizes(micro_batches, max_batch)
+    final_bs, valid_gpus = _best_candidate(
+        candidates, micro_batches, min_gpus, max_gpus, prefer_larger)
+    if final_bs is None:
+        raise ElasticityError(
+            f"no batch size <= {max_batch} works for micro batches {micro_batches} "
+            f"over [{min_gpus}, {max_gpus}] workers")
+
+    micro = -1
+    if world_size > 0:
+        if world_size not in valid_gpus:
+            raise ElasticityError(
+                f"world size {world_size} is not among the valid sizes {valid_gpus} "
+                f"for elastic batch {final_bs}")
+        # largest micro batch that divides (reference prefers larger micro)
+        for mb in sorted(micro_batches, reverse=prefer_larger):
+            if final_bs % (mb * world_size) == 0:
+                micro = mb
+                break
+    return final_bs, valid_gpus, micro

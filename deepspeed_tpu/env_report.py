@@ -1,0 +1,61 @@
+"""Environment/compatibility report (the ``ds_report`` CLI).
+
+Parity: reference ``deepspeed/env_report.py`` (``ds_report`` entry in ``bin/``):
+versions, device inventory, and per-native-op compatibility probing.
+"""
+
+from __future__ import annotations
+
+import importlib
+import platform
+import sys
+from typing import List, Tuple
+
+GREEN_OK = "[OKAY]"
+RED_FAIL = "[FAIL]"
+YELLOW_NO = "[NO]"
+
+
+def op_report() -> List[Tuple[str, bool]]:
+    from .ops.op_builder import get_builder
+
+    out = []
+    for name in ("ds_cpu_ops", "ds_aio"):
+        try:
+            out.append((name, get_builder(name).is_compatible()))
+        except Exception:
+            out.append((name, False))
+    return out
+
+
+def main(argv=None) -> int:
+    lines = ["-" * 70, "DeepSpeed-TPU C++/native op report", "-" * 70]
+    for name, ok in op_report():
+        lines.append(f"{name:<24} {GREEN_OK if ok else YELLOW_NO}")
+    lines += ["-" * 70, "General environment:", "-" * 70]
+    lines.append(f"python                   {sys.version.split()[0]} ({platform.platform()})")
+    for mod in ("jax", "jaxlib", "flax", "optax", "numpy", "transformers", "torch"):
+        try:
+            m = importlib.import_module(mod)
+            lines.append(f"{mod:<24} {getattr(m, '__version__', '?')}")
+        except ImportError:
+            lines.append(f"{mod:<24} {YELLOW_NO}")
+    from . import __version__
+
+    lines.append(f"deepspeed_tpu            {__version__}")
+    try:
+        import jax
+
+        devs = jax.devices()
+        lines.append(f"devices                  {len(devs)} x {devs[0].device_kind}"
+                     if devs else "devices                  none")
+        lines.append(f"default backend          {jax.default_backend()}")
+        lines.append(f"process count            {jax.process_count()}")
+    except Exception as e:
+        lines.append(f"devices                  {RED_FAIL} ({e})")
+    print("\n".join(lines))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1 @@
+from .runner import filter_hosts, main, parse_hostfile  # noqa: F401

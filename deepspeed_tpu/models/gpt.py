@@ -350,9 +350,12 @@ def _block_with_cache(cfg: GPTConfig, x, w, k_cache, v_cache, pos):
     k_cache = jax.lax.dynamic_update_slice(k_cache, k_.astype(k_cache.dtype), (0, pos, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
     scale = 1.0 / np.sqrt(Dh)
-    if T == 1 and cfg.use_flash is not False:
+    use_kernel = (cfg.use_flash is True
+                  or (cfg.use_flash is None and jax.default_backend() == "tpu"))
+    if T == 1 and use_kernel:
         # per-token decode: fused Pallas cache-attention kernel (parity:
-        # softmax_context, csrc/transformer/inference)
+        # softmax_context, csrc/transformer/inference); auto mode gates on the
+        # TPU backend like the prefill flash dispatch (ops/attention.py)
         from ..ops.pallas.decode_attention import decode_attention
 
         attn = decode_attention(q.astype(k_cache.dtype), k_cache, v_cache, pos + 1,

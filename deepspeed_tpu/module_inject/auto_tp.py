@@ -43,6 +43,13 @@ def _spec_for(key: str, leaf, tp_axis: str) -> P:
         return P(*([tp_axis] + [None] * (ndim - 1)))
     if ndim == 1:
         return P(None)
+    # biases (possibly stacked per-layer, [L, F]): follow column-parallel
+    # weights on the feature dim, otherwise replicate — never shard the layer dim
+    last = key.rsplit("/", 1)[-1]
+    if last.endswith("_b") or "bias" in last:
+        if any(h in key for h in _COL_HINTS):
+            return P(*([None] * (ndim - 1) + [tp_axis]))
+        return P(*([None] * ndim))
     col = any(h in key for h in _COL_HINTS)
     row = any(h in key for h in _ROW_HINTS)
     if not col and not row:

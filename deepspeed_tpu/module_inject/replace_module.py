@@ -32,6 +32,26 @@ def _np(t) -> np.ndarray:
                       np.float32)
 
 
+# HF activation names -> this framework's GPTConfig.activation
+_ACT_MAP = {
+    "relu": "relu",
+    "gelu": "gelu_exact",  # torch.nn.GELU default (erf)
+    "gelu_new": "gelu",  # tanh approximation
+    "gelu_fast": "gelu",
+    "gelu_pytorch_tanh": "gelu",
+    "gelu_python": "gelu_exact",
+}
+
+
+def _map_activation(hf_name: str, arch: str) -> str:
+    act = _ACT_MAP.get(str(hf_name).lower())
+    if act is None:
+        raise ValueError(
+            f"{arch}: unsupported activation {hf_name!r}; supported: "
+            f"{sorted(_ACT_MAP)}")
+    return act
+
+
 def _stack(sd: Dict[str, np.ndarray], fmt: str, n_layer: int, transpose=False):
     mats = []
     for i in range(n_layer):
@@ -52,7 +72,7 @@ def _gpt2_policy(hf_model) -> Tuple[GPTConfig, Dict[str, Any]]:
         vocab_size=c.vocab_size, n_layer=c.n_layer, n_head=c.n_head,
         d_model=c.n_embd, max_seq_len=c.n_positions, rotary=False,
         tie_embeddings=True, layer_norm_eps=c.layer_norm_epsilon,
-        activation="gelu")
+        activation=_map_activation(c.activation_function, "GPT2"))
     sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
     L = c.n_layer
     params = {
@@ -96,7 +116,8 @@ def _gptneox_policy(hf_model) -> Tuple[GPTConfig, Dict[str, Any]]:
         n_head=c.num_attention_heads, d_model=c.hidden_size,
         d_ff=c.intermediate_size, max_seq_len=c.max_position_embeddings,
         rotary=True, rotary_pct=c.rotary_pct, tie_embeddings=False,
-        layer_norm_eps=c.layer_norm_eps, activation="gelu_exact",
+        layer_norm_eps=c.layer_norm_eps,
+        activation=_map_activation(c.hidden_act, "GPTNeoX"),
         parallel_residual=bool(getattr(c, "use_parallel_residual", True)))
     sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
     L = c.num_hidden_layers
@@ -150,7 +171,8 @@ def _opt_policy(hf_model) -> Tuple[GPTConfig, Dict[str, Any]]:
         n_head=c.num_attention_heads, d_model=c.hidden_size,
         d_ff=c.ffn_dim, max_seq_len=c.max_position_embeddings,
         rotary=False, pos_offset=2, tie_embeddings=True,
-        activation="relu", layer_norm_eps=1e-5)
+        activation=_map_activation(c.activation_function, "OPT"),
+        layer_norm_eps=1e-5)
     sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
     L = c.num_hidden_layers
     pre = "model.decoder.layers.{}"

@@ -23,7 +23,10 @@ Event = Tuple[str, float, int]
 
 class TensorBoardMonitor:
     def __init__(self, output_path: str, job_name: str = "DeepSpeedJobName"):
-        from tensorboardX import SummaryWriter
+        try:
+            from tensorboardX import SummaryWriter
+        except ImportError:  # torch ships its own writer in this image
+            from torch.utils.tensorboard import SummaryWriter
 
         path = os.path.join(output_path or "runs", job_name)
         os.makedirs(path, exist_ok=True)

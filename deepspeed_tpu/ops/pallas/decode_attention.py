@@ -7,11 +7,14 @@ softmax.cu`` + ``pt_binding.cpp`` attention bindings, workspace layout
 validity mask, in one kernel, without materializing [B, H, S] probabilities in
 HBM.
 
-Grid = (B, H): each program streams its head's cache [S, Dh] through VMEM in
-blocks with an online softmax. The current cache length arrives as a scalar
-array input (the analog of the reference's ``current_tokens`` workspace field) —
-the compiled kernel serves every decode step of a generation, whatever the
-length.
+Grid = (B, H, S/block_k): the cache's sequence dimension is a GRID axis, so each
+program instance holds only one [block_k, Dh] K/V tile in VMEM — long contexts
+stream tile by tile (TPU iterates the innermost grid dimension sequentially on
+one core, so the online-softmax state lives in VMEM scratch across tiles). The
+current cache length arrives as a scalar array input (the analog of the
+reference's ``current_tokens`` workspace field) — one compiled kernel serves
+every decode step of a generation; tiles entirely past the valid length
+contribute nothing (their rows mask to -inf).
 """
 
 from __future__ import annotations
@@ -24,38 +27,42 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from .flash_attention import NEG_INF, _interpret
 
 
-def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, sm_scale: float,
-                   block_k: int):
-    q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # [1, Dh] row-block
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+                   *, sm_scale: float, block_k: int, num_blocks: int):
+    ki = pl.program_id(2)
     cur = len_ref[0, 0]
 
-    Dh = q.shape[-1]
-    acc = jnp.zeros((1, Dh), jnp.float32)
-    m_i = jnp.full((1, 1), NEG_INF, jnp.float32)
-    l_i = jnp.zeros((1, 1), jnp.float32)
-    num_blocks = (cur + block_k - 1) // block_k
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
 
-    def body(ki, carry):
-        acc, m_i, l_i = carry
-        k = k_ref[0, pl.ds(ki * block_k, block_k), 0, :].astype(jnp.float32)  # [Bk, Dh]
-        v = v_ref[0, pl.ds(ki * block_k, block_k), 0, :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [1, Bk]
-        s_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
-        s = jnp.where(s_pos < cur, s, NEG_INF)
-        m_new = jnp.maximum(m_i, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m_i - m_new)
-        p = jnp.exp(s - m_new)
-        l_new = alpha * l_i + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jax.lax.dot(p, v)
-        return acc, m_new, l_new
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # [1, Dh]
+    k = k_ref[0, :, 0, :].astype(jnp.float32)  # [Bk, Dh]
+    v = v_ref[0, :, 0, :].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [1, Bk]
+    s_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+    s = jnp.where(s_pos < cur, s, NEG_INF)
 
-    acc, m_i, l_i = jax.lax.fori_loop(0, num_blocks, body, (acc, m_i, l_i))
-    l_safe = jnp.where(l_i == 0.0, 1.0, l_i)
-    o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
+    m_prev = m_ref[...]
+    l_prev = l_ref[...]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    m_ref[...] = m_new
+    l_ref[...] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot(p, v)
+
+    @pl.when(ki == num_blocks - 1)
+    def _finalize():
+        l_safe = jnp.where(l_ref[...] == 0.0, 1.0, l_ref[...])
+        o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
 
 
 def decode_attention(
@@ -64,31 +71,38 @@ def decode_attention(
     v_cache: jnp.ndarray,
     cur_len: jnp.ndarray,  # scalar int32: valid cache entries INCLUDING the new token
     softmax_scale: Optional[float] = None,
-    block_k: int = 256,
+    block_k: int = 512,
 ) -> jnp.ndarray:
     """Returns [B, 1, H, Dh]. The new token's k/v must already be in the cache."""
     B, one, H, Dh = q.shape
     assert one == 1
     S = k_cache.shape[1]
-    # largest power-of-two block that divides S (any S works; engines should pad
-    # the cache to a 128-multiple so the loop runs on full-lane blocks)
+    # largest power-of-two tile that divides S (engines should pad the cache to
+    # a 128-multiple so tiles stay full-lane)
     block_k = min(block_k, S)
     while block_k > 1 and S % block_k:
         block_k //= 2
+    num_blocks = S // block_k
     scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(Dh)
     lens = jnp.broadcast_to(jnp.asarray(cur_len, jnp.int32), (1, 1))
 
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, sm_scale=scale, block_k=block_k),
-        grid=(B, H),
+        functools.partial(_decode_kernel, sm_scale=scale, block_k=block_k,
+                          num_blocks=num_blocks),
+        grid=(B, H, num_blocks),
         in_specs=[
-            pl.BlockSpec((1, 1), lambda b, h: (0, 0)),
-            pl.BlockSpec((1, 1, 1, Dh), lambda b, h: (b, 0, h, 0)),
-            pl.BlockSpec((1, S, 1, Dh), lambda b, h: (b, 0, h, 0)),
-            pl.BlockSpec((1, S, 1, Dh), lambda b, h: (b, 0, h, 0)),
+            pl.BlockSpec((1, 1), lambda b, h, ki: (0, 0)),
+            pl.BlockSpec((1, 1, 1, Dh), lambda b, h, ki: (b, 0, h, 0)),
+            pl.BlockSpec((1, block_k, 1, Dh), lambda b, h, ki: (b, ki, h, 0)),
+            pl.BlockSpec((1, block_k, 1, Dh), lambda b, h, ki: (b, ki, h, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, 1, Dh), lambda b, h: (b, 0, h, 0)),
+        out_specs=pl.BlockSpec((1, 1, 1, Dh), lambda b, h, ki: (b, 0, h, 0)),
         out_shape=jax.ShapeDtypeStruct((B, 1, H, Dh), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((1, Dh), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+        ],
         interpret=_interpret(),
     )(lens, q, k_cache, v_cache)
     return out

@@ -1,0 +1,5 @@
+from .flops_profiler import (  # noqa: F401
+    FlopsProfiler,
+    get_model_profile,
+    profile_compiled_fn,
+)

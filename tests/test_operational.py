@@ -1,0 +1,187 @@
+"""Operational layer: elasticity math, launcher parsing, autotuner, flops
+profiler, ds_report."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.autotuning import Autotuner
+from deepspeed_tpu.elasticity import (
+    ElasticityError,
+    compute_elastic_config,
+    get_candidate_batch_sizes,
+    get_valid_gpus,
+)
+from deepspeed_tpu.launcher import filter_hosts, parse_hostfile
+from deepspeed_tpu.profiling import get_model_profile, profile_compiled_fn
+
+
+# ------------------------------------------------------------------- elasticity
+def test_candidate_batch_sizes():
+    assert get_candidate_batch_sizes([2, 3], 24) == [2, 3, 4, 6, 8, 12, 16, 24]
+
+
+def test_valid_gpus():
+    # batch 24, micro {2,3}: w valid iff 24 % (2w)==0 or 24 % (3w)==0
+    assert get_valid_gpus(24, [2, 3], 1, 12) == [1, 2, 3, 4, 6, 8, 12]
+
+
+def test_compute_elastic_config_maximizes_valid_worlds():
+    cfg = {"elasticity": {
+        "enabled": True, "max_train_batch_size": 100,
+        "micro_batch_sizes": [2, 4, 6], "min_gpus": 1, "max_gpus": 16,
+        "version": 0.2}}
+    bs, gpus, _ = compute_elastic_config(cfg)
+    # all candidates: every valid world count must be maximal for the chosen bs
+    from deepspeed_tpu.elasticity import get_candidate_batch_sizes
+
+    best_count = max(
+        len(get_valid_gpus(c, [2, 4, 6], 1, 16))
+        for c in get_candidate_batch_sizes([2, 4, 6], 100))
+    assert len(gpus) == best_count
+    assert bs % 2 == 0
+
+    # resolving at a concrete world size yields a dividing micro batch
+    bs2, gpus2, micro = compute_elastic_config(cfg, world_size=gpus[0])
+    assert bs2 == bs and micro > 0 and bs % (micro * gpus[0]) == 0
+
+
+def test_elasticity_world_size_mismatch_raises():
+    cfg = {"elasticity": {
+        "enabled": True, "max_train_batch_size": 16,
+        "micro_batch_sizes": [4], "min_gpus": 1, "max_gpus": 4}}
+    with pytest.raises(ElasticityError, match="not among"):
+        compute_elastic_config(cfg, world_size=3)
+    with pytest.raises(ElasticityError, match="disabled"):
+        compute_elastic_config({"elasticity": {"enabled": False}})
+
+
+# ------------------------------------------------------------------- launcher
+def test_parse_hostfile_and_filters():
+    hosts = parse_hostfile([
+        "worker-0 slots=4  # comment",
+        "",
+        "worker-1 slots=4",
+        "worker-2 slots=2",
+    ])
+    assert hosts == {"worker-0": 4, "worker-1": 4, "worker-2": 2}
+
+    pool = filter_hosts(hosts, include="worker-0:1,3@worker-2")
+    assert pool == {"worker-0": [1, 3], "worker-2": [0, 1]}
+
+    pool = filter_hosts(hosts, exclude="worker-1")
+    assert sorted(pool) == ["worker-0", "worker-2"]
+
+    pool = filter_hosts(hosts, exclude="worker-0:0,1,2,3")
+    assert "worker-0" not in pool
+
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        filter_hosts(hosts, include="worker-0", exclude="worker-1")
+    with pytest.raises(ValueError, match="unknown hosts"):
+        filter_hosts(hosts, include="nope")
+    with pytest.raises(ValueError, match="duplicate host"):
+        parse_hostfile(["a slots=1", "a slots=2"])
+
+
+def test_ssh_runner_command_construction():
+    from deepspeed_tpu.launcher.runner import SSHRunner, parse_args
+
+    args = parse_args(["--launcher", "ssh", "train.py", "--lr", "1e-4"])
+    args.launch_cmd = "python train.py --lr 1e-4"
+    pool = {"h0": [0], "h1": [0]}
+    cmds = SSHRunner(args, pool).get_cmd({"DS_COORD_PORT": "1234"}, pool)
+    assert len(cmds) == 2
+    assert cmds[0][0] == "ssh" and cmds[0][-2] == "h0"
+    assert "JAX_PROCESS_ID=0" in cmds[0][-1]
+    assert "JAX_PROCESS_ID=1" in cmds[1][-1]
+    assert "JAX_COORDINATOR_ADDRESS=h0:1234" in cmds[1][-1]
+    assert "JAX_NUM_PROCESSES=2" in cmds[1][-1]
+
+
+# ------------------------------------------------------------------- autotuner
+def test_autotuner_picks_best_and_prunes(tmp_path):
+    base = {"train_micro_batch_size_per_gpu": 1,
+            "autotuning": {"enabled": True, "metric": "throughput"}}
+    tuner = Autotuner(base, tuning_space={
+        "train_micro_batch_size_per_gpu": [1, 2, 4],
+        "zero_optimization.stage": [0, 2]},
+        results_dir=str(tmp_path))
+
+    def fake_trial(cfg):
+        mb = cfg["train_micro_batch_size_per_gpu"]
+        stage = cfg["zero_optimization"]["stage"]
+        if mb == 4:
+            raise MemoryError("OOM")  # pruned point
+        return mb * 10 + (5 if stage == 2 else 0)
+
+    best = tuner.tune(fake_trial)
+    assert best is not None
+    assert best.config["train_micro_batch_size_per_gpu"] == 2
+    assert best.config["zero_optimization"]["stage"] == 2
+    results = json.loads((tmp_path / "results.json").read_text())
+    assert results["best"] == best.config
+    errors = [e for e in results["experiments"] if e["error"]]
+    assert len(errors) == 2  # both mb=4 points pruned
+
+
+def test_autotuner_latency_metric(tmp_path):
+    tuner = Autotuner({}, tuning_space={
+        "train_micro_batch_size_per_gpu": [1, 2],
+        "zero_optimization.stage": [0]}, metric="latency",
+        results_dir=str(tmp_path))
+    best = tuner.tune(lambda cfg: cfg["train_micro_batch_size_per_gpu"])
+    assert best.config["train_micro_batch_size_per_gpu"] == 1
+
+
+# ------------------------------------------------------------------- profiler
+def test_profile_compiled_fn_reports_flops():
+    import jax.numpy as jnp
+
+    a = jnp.ones((128, 128), jnp.float32)
+    prof = profile_compiled_fn(lambda x: x @ x, a)
+    # 2*N^3 flops for a square matmul
+    assert prof["flops"] >= 2 * 128 ** 3 * 0.9
+    assert prof["latency_s"] > 0
+
+
+def test_get_model_profile():
+    from deepspeed_tpu.models import build_gpt
+    from deepspeed_tpu.models.gpt import GPTConfig
+
+    model, cfg = build_gpt(GPTConfig(
+        vocab_size=64, d_model=32, n_layer=1, n_head=2, max_seq_len=16))
+    batch = {"input_ids": np.zeros((2, 16), np.int32)}
+    prof = get_model_profile(model, batch)
+    assert prof["params"] > 0 and prof["flops"] > 0
+
+
+def test_flops_profiler_on_engine():
+    from deepspeed_tpu.models import build_gpt
+    from deepspeed_tpu.models.gpt import GPTConfig
+    from deepspeed_tpu.profiling import FlopsProfiler
+
+    model, cfg = build_gpt(GPTConfig(
+        vocab_size=64, d_model=32, n_layer=1, n_head=2, max_seq_len=16))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config={"train_micro_batch_size_per_gpu": 1,
+                             "steps_per_print": 0})
+    prof = FlopsProfiler(engine)
+    r = np.random.default_rng(0)
+    prof.profile_train_batch({"input_ids": r.integers(0, 64, (8, 16), dtype=np.int32)})
+    text = prof.print_model_profile()
+    assert "Flops Profiler" in text and "params" in text
+    assert prof.get_total_params() > 0
+
+
+# ------------------------------------------------------------------- ds_report
+def test_ds_report_runs():
+    from deepspeed_tpu.env_report import main, op_report
+
+    ops = dict(op_report())
+    assert ops.get("ds_cpu_ops") is True
+    assert main() == 0
