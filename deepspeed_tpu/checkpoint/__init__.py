@@ -32,19 +32,51 @@ def _tag_for(step: int) -> str:
     return f"global_step{step}"
 
 
+def _validate_tag(tag: str) -> None:
+    """All processes must agree on the tag (parity: ``engine.py:3055``)."""
+    if jax.process_count() == 1:
+        return
+    import hashlib
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    # fixed-size digest: assert_equal needs an array leaf, not a unicode str
+    digest = np.frombuffer(
+        hashlib.sha256(tag.encode()).digest(), dtype=np.uint8).copy()
+    multihost_utils.assert_equal(
+        digest, f"checkpoint tag differs across processes (local: {tag!r})")
+
+
+def _get_ckpt_engine(engine):
+    ce = getattr(engine, "_ckpt_engine", None)
+    if ce is None:
+        from ..runtime.checkpoint_engine import get_checkpoint_engine
+
+        ce = get_checkpoint_engine(getattr(engine, "config", None))
+        engine._ckpt_engine = ce
+    return ce
+
+
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     client_state: Optional[dict] = None, save_latest: bool = True) -> str:
     tag = tag or _tag_for(int(engine.state["step"]))
+    _validate_tag(tag)
+    ckpt_engine = _get_ckpt_engine(engine)
+    ckpt_engine.create(tag)
     ckpt_dir = os.path.join(save_dir, tag)
     is_writer = jax.process_index() == 0
     if is_writer:
         os.makedirs(ckpt_dir, exist_ok=True)
+    writer = getattr(ckpt_engine, "save_array", None)
     # collective: every process participates in gathering sharded leaves
-    save_pytree(engine.state, os.path.join(ckpt_dir, "state"), write=is_writer)
+    save_pytree(engine.state, os.path.join(ckpt_dir, "state"), write=is_writer,
+                file_writer=writer)
     # mid-accumulation save: the imperative API's gradient buffer is live state
     mid_accum = getattr(engine, "_grad_acc", None) is not None and int(engine.state["micro"]) > 0
     if mid_accum:
-        save_pytree(engine._grad_acc, os.path.join(ckpt_dir, "grad_acc"), write=is_writer)
+        save_pytree(engine._grad_acc, os.path.join(ckpt_dir, "grad_acc"),
+                    write=is_writer, file_writer=writer)
     if is_writer:
         meta = {
             "tag": tag,
@@ -62,12 +94,13 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     # resolvable tag with missing optimizer state.
     offload = getattr(engine, "_offload", None)
     if offload is not None and is_writer:
-        import numpy as np
-
         if offload.master is None:  # checkpoint before the first step
             offload.init_host_state()
-        np.savez(os.path.join(ckpt_dir, "host_optimizer.npz"),
-                 **offload.host_state_dict())
+        ckpt_engine.save(offload.host_state_dict(),
+                         os.path.join(ckpt_dir, "host_optimizer.npz"))
+    # durability point: async engines flush all queued writes here, BEFORE the
+    # 'latest' pointer makes the tag resolvable
+    ckpt_engine.commit(tag)
     if is_writer and save_latest:
         with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
             f.write(tag)

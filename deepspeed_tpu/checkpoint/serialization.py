@@ -47,11 +47,17 @@ def _fetch_full(leaf) -> np.ndarray:
     return np.asarray(multihost_utils.process_allgather(leaf, tiled=True))
 
 
-def save_pytree(tree, directory: str, write: bool = True) -> None:
+def save_pytree(tree, directory: str, write: bool = True,
+                file_writer=None) -> None:
     """Serialize ``tree``. In multi-process runs EVERY process must call this (leaf
-    gathering is collective); only processes with ``write=True`` touch the disk."""
+    gathering is collective); only processes with ``write=True`` touch the disk.
+
+    ``file_writer(path, np_array)``: pluggable array writer — the checkpoint
+    engines route this (sync np.save by default; the async engine enqueues to
+    its background writers, parity: nebula-style overlap)."""
     if write:
         os.makedirs(os.path.join(directory, "arrays"), exist_ok=True)
+    writer = file_writer or (lambda path, arr: np.save(path, arr))
     flat, _ = _flatten_with_paths(tree)
     meta = []
     for i, (key, leaf) in enumerate(flat):
@@ -64,7 +70,7 @@ def save_pytree(tree, directory: str, write: bool = True) -> None:
         raw_view = arr.dtype.kind not in "biufc"
         if raw_view:
             arr = arr.view(_UINT_FOR_SIZE[arr.dtype.itemsize])
-        np.save(os.path.join(directory, "arrays", f"{i}.npy"), arr)
+        writer(os.path.join(directory, "arrays", f"{i}.npy"), arr)
         meta.append({"key": key, "index": i, "shape": list(arr.shape),
                      "dtype": dtype_name, "raw_view": raw_view})
     if write:

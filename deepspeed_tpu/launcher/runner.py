@@ -210,7 +210,7 @@ def main(argv=None) -> int:
     if list(pool) == ["localhost"]:
         return subprocess.call([sys.executable, args.user_script, *args.user_args])
     runner = RUNNERS[args.launcher](args, pool)
-    if not runner.backend_exists():
+    if not args.no_ssh_check and not runner.backend_exists():
         raise RuntimeError(f"launcher backend {args.launcher!r} unavailable")
     env = build_environment(args, pool)
     procs = [subprocess.Popen(cmd) for cmd in runner.get_cmd(env, pool)]

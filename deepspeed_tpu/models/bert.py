@@ -1,0 +1,209 @@
+"""BERT-family encoder models, TPU-first.
+
+Capability parity with the reference's BERT workloads: the fused training
+transformer kernel targets BERT (``csrc/transformer/ds_transformer_cuda.cpp``,
+``DeepSpeedTransformerLayer`` ``ops/transformer/transformer.py:459``), the
+flagship benchmark is BERT SQuAD fine-tuning (``docs/_posts/2020-05-28-fastest-
+bert-training.md``), and the inference policies cover bert/distilbert
+(``module_inject/containers/bert.py``).
+
+Same TPU-first structure as :mod:`.gpt`: stacked per-layer params under a
+``lax.scan``, Megatron-style TP specs, flash/XLA attention dispatch. Post-LN
+residuals (original BERT), learned positions + token-type embeddings, MLM head
+with tied decoder; the ``Module`` loss is masked-LM cross-entropy over
+``labels`` (-100 = unmasked, the HF convention).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import multihead_attention
+from .api import Module, maybe_shard
+from .gpt import layer_norm
+
+BATCH = ("dp", "ep")
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    d_ff: Optional[int] = None  # default 4*d_model
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    dropout: float = 0.0
+    layer_norm_eps: float = 1e-12
+    use_flash: Optional[bool] = None
+
+    @property
+    def ffn_dim(self) -> int:
+        return self.d_ff if self.d_ff is not None else 4 * self.d_model
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_head == 0
+        return self.d_model // self.n_head
+
+
+PRESETS: Dict[str, BertConfig] = {
+    "bert-base": BertConfig(),
+    "bert-large": BertConfig(n_layer=24, n_head=16, d_model=1024),
+    "tiny-bert": BertConfig(vocab_size=256, n_layer=2, n_head=4, d_model=64,
+                            max_seq_len=128),
+}
+
+
+# --------------------------------------------------------------------------- init
+def init_params(cfg: BertConfig, rng: jax.Array) -> Dict[str, Any]:
+    d, f, v, l = cfg.d_model, cfg.ffn_dim, cfg.vocab_size, cfg.n_layer
+    k = jax.random.split(rng, 8)
+    std = 0.02
+
+    def normal(key, shape, s=std):
+        return jax.random.normal(key, shape, jnp.float32) * s
+
+    return {
+        "wte": normal(k[0], (v, d)),
+        "wpe": normal(k[1], (cfg.max_seq_len, d)),
+        "wtt": normal(k[2], (cfg.type_vocab_size, d)),
+        "emb_ln_scale": jnp.ones((d,)), "emb_ln_bias": jnp.zeros((d,)),
+        "blocks": {
+            "qkv_w": normal(k[3], (l, d, 3 * d)), "qkv_b": jnp.zeros((l, 3 * d)),
+            "attn_out_w": normal(k[4], (l, d, d)), "attn_out_b": jnp.zeros((l, d)),
+            "ln1_scale": jnp.ones((l, d)), "ln1_bias": jnp.zeros((l, d)),
+            "mlp_up_w": normal(k[5], (l, d, f)), "mlp_up_b": jnp.zeros((l, f)),
+            "mlp_down_w": normal(k[6], (l, f, d)), "mlp_down_b": jnp.zeros((l, d)),
+            "ln2_scale": jnp.ones((l, d)), "ln2_bias": jnp.zeros((l, d)),
+        },
+        # MLM head: dense transform + LN; decoder tied to wte with its own bias
+        "mlm_dense_w": normal(k[7], (d, d)), "mlm_dense_b": jnp.zeros((d,)),
+        "mlm_ln_scale": jnp.ones((d,)), "mlm_ln_bias": jnp.zeros((d,)),
+        "mlm_bias": jnp.zeros((v,)),
+        # pooler (for sentence-level tasks)
+        "pooler_w": normal(k[0], (d, d)), "pooler_b": jnp.zeros((d,)),
+    }
+
+
+def partition_specs(cfg: BertConfig, param_shapes) -> Dict[str, Any]:
+    return {
+        "wte": P("tp", None), "wpe": P(None, None), "wtt": P(None, None),
+        "emb_ln_scale": P(None), "emb_ln_bias": P(None),
+        "blocks": {
+            "qkv_w": P(None, None, "tp"), "qkv_b": P(None, "tp"),
+            "attn_out_w": P(None, "tp", None), "attn_out_b": P(None, None),
+            "ln1_scale": P(None, None), "ln1_bias": P(None, None),
+            "mlp_up_w": P(None, None, "tp"), "mlp_up_b": P(None, "tp"),
+            "mlp_down_w": P(None, "tp", None), "mlp_down_b": P(None, None),
+            "ln2_scale": P(None, None), "ln2_bias": P(None, None),
+        },
+        "mlm_dense_w": P(None, None), "mlm_dense_b": P(None),
+        "mlm_ln_scale": P(None), "mlm_ln_bias": P(None),
+        "mlm_bias": P("tp"),
+        "pooler_w": P(None, None), "pooler_b": P(None),
+    }
+
+
+# --------------------------------------------------------------------------- fwd
+def _block(cfg: BertConfig, x, w, pad_bias):
+    """Post-LN encoder block: LN(x + attn(x)), LN(x + mlp(x))."""
+    B, T, D = x.shape
+    H, Dh = cfg.n_head, cfg.head_dim
+    qkv = x @ w["qkv_w"] + w["qkv_b"]
+    q, k_, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, T, H, Dh)
+    k_ = k_.reshape(B, T, H, Dh)
+    v = v.reshape(B, T, H, Dh)
+    attn = multihead_attention(q, k_, v, causal=False, bias=pad_bias,
+                               use_flash=False if pad_bias is not None
+                               else cfg.use_flash)
+    attn = attn.reshape(B, T, D) @ w["attn_out_w"] + w["attn_out_b"]
+    x = layer_norm(x + attn, w["ln1_scale"], w["ln1_bias"], cfg.layer_norm_eps)
+    h = jax.nn.gelu(x @ w["mlp_up_w"] + w["mlp_up_b"], approximate=False)
+    h = h @ w["mlp_down_w"] + w["mlp_down_b"]
+    return layer_norm(x + h, w["ln2_scale"], w["ln2_bias"], cfg.layer_norm_eps)
+
+
+def encode(cfg: BertConfig, params, input_ids: jnp.ndarray,
+           attention_mask: Optional[jnp.ndarray] = None,
+           token_type_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Hidden states [B, T, D]."""
+    B, T = input_ids.shape
+    if T > cfg.max_seq_len:
+        raise ValueError(f"sequence length {T} exceeds max_seq_len {cfg.max_seq_len}")
+    x = jnp.take(params["wte"], input_ids, axis=0)
+    x = x + params["wpe"][None, :T, :]
+    if token_type_ids is not None:
+        x = x + jnp.take(params["wtt"], token_type_ids, axis=0)
+    else:
+        x = x + params["wtt"][0][None, None, :]
+    x = layer_norm(x, params["emb_ln_scale"], params["emb_ln_bias"],
+                   cfg.layer_norm_eps)
+    x = x.astype(params["blocks"]["qkv_w"].dtype)
+    x = maybe_shard(x, P(BATCH, None, None))
+
+    pad_bias = None
+    if attention_mask is not None:
+        pad_bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0,
+                             -1e30).astype(jnp.float32)
+
+    def body(x, layer_w):
+        return _block(cfg, x, layer_w, pad_bias), None
+
+    x, _ = jax.lax.scan(body, x, params["blocks"])
+    return x
+
+
+def mlm_logits(cfg: BertConfig, params, hidden: jnp.ndarray) -> jnp.ndarray:
+    h = hidden @ params["mlm_dense_w"].astype(hidden.dtype) + \
+        params["mlm_dense_b"].astype(hidden.dtype)
+    h = jax.nn.gelu(h, approximate=False)
+    h = layer_norm(h, params["mlm_ln_scale"], params["mlm_ln_bias"],
+                   cfg.layer_norm_eps)
+    return jnp.einsum("btd,vd->btv", h, params["wte"].astype(h.dtype)) + \
+        params["mlm_bias"].astype(h.dtype)
+
+
+def pooled_output(params, hidden: jnp.ndarray) -> jnp.ndarray:
+    cls = hidden[:, 0, :]
+    return jnp.tanh(cls @ params["pooler_w"].astype(cls.dtype)
+                    + params["pooler_b"].astype(cls.dtype))
+
+
+def mlm_loss(cfg: BertConfig, params, batch: Dict[str, jnp.ndarray],
+             rngs=None, train: bool = True):
+    """Masked-LM cross-entropy; labels==-100 positions are ignored (HF
+    convention). Without "labels", every position contributes (sanity mode)."""
+    hidden = encode(cfg, params, batch["input_ids"],
+                    attention_mask=batch.get("attention_mask"),
+                    token_type_ids=batch.get("token_type_ids"))
+    logits = mlm_logits(cfg, params, hidden).astype(jnp.float32)
+    labels = batch.get("labels", batch["input_ids"])
+    mask = (labels != -100)
+    safe_labels = jnp.where(mask, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(mask.sum(), 1)
+    loss = jnp.where(mask, nll, 0.0).sum() / denom
+    correct = (jnp.argmax(logits, -1) == safe_labels) & mask
+    return loss, {"mlm_acc": correct.sum() / denom}
+
+
+def build(cfg_or_name) -> Tuple[Module, BertConfig]:
+    cfg = PRESETS[cfg_or_name] if isinstance(cfg_or_name, str) else cfg_or_name
+    return Module(
+        init=functools.partial(init_params, cfg),
+        apply=lambda params, batch, rngs=None, train=True: mlm_loss(
+            cfg, params, batch, rngs=rngs, train=train),
+        partition_specs=functools.partial(partition_specs, cfg),
+    ), cfg

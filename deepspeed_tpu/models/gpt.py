@@ -53,6 +53,10 @@ class GPTConfig:
     activation: str = "gelu"  # "gelu" (tanh approx), "gelu_exact", "relu" (OPT)
     parallel_residual: bool = False  # NeoX-style x + attn(ln1 x) + mlp(ln2 x)
     pos_offset: int = 0  # learned-position index offset (OPT uses 2)
+    alibi: bool = False  # Bloom: linear attention bias instead of positions
+    rotary_interleaved: bool = False  # GPT-J rotate_every_two vs NeoX rotate_half
+    embed_layernorm: bool = False  # Bloom: LN right after the token embedding
+    lm_head_bias: bool = False  # GPT-J: bias on the (untied) LM head
     remat: bool = False  # activation checkpointing per block
     remat_policy: str = "nothing_saveable"  # jax.checkpoint_policies name
     use_flash: Optional[bool] = None  # None = auto dispatch
@@ -114,10 +118,15 @@ def init_params(cfg: GPTConfig, rng: jax.Array,
         "lnf_scale": jnp.ones((d,)),
         "lnf_bias": jnp.zeros((d,)),
     }
-    if not cfg.rotary:
+    if not cfg.rotary and not cfg.alibi:
         params["wpe"] = normal(k[5], (cfg.max_seq_len + cfg.pos_offset, d), std)
+    if cfg.embed_layernorm:
+        params["emb_ln_scale"] = jnp.ones((d,))
+        params["emb_ln_bias"] = jnp.zeros((d,))
     if not cfg.tie_embeddings:
         params["lm_head"] = normal(k[6], (v, d), std)
+        if cfg.lm_head_bias:
+            params["lm_head_b"] = jnp.zeros((v,))
     return params
 
 
@@ -136,10 +145,15 @@ def partition_specs(cfg: GPTConfig, param_shapes) -> Dict[str, Any]:
         "lnf_scale": P(None),
         "lnf_bias": P(None),
     }
-    if not cfg.rotary:
+    if not cfg.rotary and not cfg.alibi:
         specs["wpe"] = P(None, None)
+    if cfg.embed_layernorm:
+        specs["emb_ln_scale"] = P(None)
+        specs["emb_ln_bias"] = P(None)
     if not cfg.tie_embeddings:
         specs["lm_head"] = P("tp", None)
+        if cfg.lm_head_bias:
+            specs["lm_head_b"] = P("tp")
     return specs
 
 
@@ -154,8 +168,12 @@ def layer_norm(x: jnp.ndarray, scale, bias, eps: float) -> jnp.ndarray:
     return (y * scale + bias).astype(x.dtype)
 
 
-def _rope(x: jnp.ndarray, positions: jnp.ndarray, rotary_dims: int) -> jnp.ndarray:
-    """Rotary embedding on the first ``rotary_dims`` of the head dim. x: [B,T,H,Dh]."""
+def _rope(x: jnp.ndarray, positions: jnp.ndarray, rotary_dims: int,
+          interleaved: bool = False) -> jnp.ndarray:
+    """Rotary embedding on the first ``rotary_dims`` of the head dim. x: [B,T,H,Dh].
+
+    ``interleaved=False``: NeoX rotate_half (pair (i, i+half)).
+    ``interleaved=True``: GPT-J rotate_every_two (pair (2i, 2i+1))."""
     if rotary_dims == 0:
         return x
     x_rot, x_pass = x[..., :rotary_dims], x[..., rotary_dims:]
@@ -164,9 +182,39 @@ def _rope(x: jnp.ndarray, positions: jnp.ndarray, rotary_dims: int) -> jnp.ndarr
     angles = positions[:, :, None].astype(jnp.float32) * freqs[None, None, :]  # [B,T,half]
     cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
     sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
-    x1, x2 = x_rot[..., :half], x_rot[..., half:]
-    rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    if interleaved:
+        x1, x2 = x_rot[..., 0::2], x_rot[..., 1::2]
+        r1 = x1 * cos - x2 * sin
+        r2 = x2 * cos + x1 * sin
+        rotated = jnp.stack([r1, r2], axis=-1).reshape(x_rot.shape)
+    else:
+        x1, x2 = x_rot[..., :half], x_rot[..., half:]
+        rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return jnp.concatenate([rotated, x_pass], axis=-1)
+
+
+def alibi_slopes(n_heads: int) -> np.ndarray:
+    """Bloom's per-head ALiBi slopes (handles non-power-of-two head counts).
+    Parity: the reference's alibi softmax path (``softmax.cu`` alibi mode,
+    ``model_implementations/transformers/ds_bloom.py``)."""
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(np.log2(n) - 3)))
+        return start * (start ** np.arange(n))
+
+    n = 2 ** int(np.floor(np.log2(n_heads)))
+    slopes = pow2_slopes(n)
+    if n < n_heads:
+        extra = pow2_slopes(2 * n)[0::2][: n_heads - n]
+        slopes = np.concatenate([slopes, extra])
+    return slopes.astype(np.float32)
+
+
+def _alibi_bias(cfg: GPTConfig, q_positions: jnp.ndarray, kv_len: int) -> jnp.ndarray:
+    """[B, H, T, S] additive bias: slopes[h] * (s - t_abs)."""
+    slopes = jnp.asarray(alibi_slopes(cfg.n_head))
+    s_idx = jnp.arange(kv_len)[None, None, None, :]
+    t_abs = q_positions[:, None, :, None]
+    return slopes[None, :, None, None] * (s_idx - t_abs).astype(jnp.float32)
 
 
 def _act(cfg: GPTConfig, h: jnp.ndarray) -> jnp.ndarray:
@@ -191,9 +239,11 @@ def _attention_delta(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray],
     if cfg.rotary:
         rd = int(cfg.rotary_pct * Dh)
         rd -= rd % 2
-        q = _rope(q, positions, rd)
-        k_ = _rope(k_, positions, rd)
-    attn = multihead_attention(q, k_, v, causal=True, use_flash=cfg.use_flash)
+        q = _rope(q, positions, rd, cfg.rotary_interleaved)
+        k_ = _rope(k_, positions, rd, cfg.rotary_interleaved)
+    bias = _alibi_bias(cfg, positions, T) if cfg.alibi else None
+    attn = multihead_attention(q, k_, v, causal=True, bias=bias,
+                               use_flash=cfg.use_flash)
     attn = attn.reshape(B, T, D)
     return attn @ w["attn_out_w"] + w["attn_out_b"]
 
@@ -246,8 +296,11 @@ def forward(cfg: GPTConfig, params: Dict[str, Any], input_ids: jnp.ndarray,
             f"(out-of-range position lookups would return NaN)")
     x = jnp.take(params["wte"], input_ids, axis=0)
     positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
-    if not cfg.rotary:
+    if not cfg.rotary and not cfg.alibi:
         x = x + jnp.take(params["wpe"], positions + cfg.pos_offset, axis=0)
+    if cfg.embed_layernorm:
+        x = layer_norm(x, params["emb_ln_scale"], params["emb_ln_bias"],
+                       cfg.layer_norm_eps)
     x = x.astype(params["blocks"]["qkv_w"].dtype)
     # residual stream sharded over batch and (if sp>1) sequence
     x = maybe_shard(x, P(BATCH, "sp", None))
@@ -271,6 +324,8 @@ def forward(cfg: GPTConfig, params: Dict[str, Any], input_ids: jnp.ndarray,
     x = layer_norm(x, params["lnf_scale"], params["lnf_bias"], cfg.layer_norm_eps)
     head = params["wte"] if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
+    if cfg.lm_head_bias and not cfg.tie_embeddings:
+        logits = logits + params["lm_head_b"].astype(logits.dtype)
     return logits
 
 
@@ -345,13 +400,15 @@ def _block_with_cache(cfg: GPTConfig, x, w, k_cache, v_cache, pos):
     if cfg.rotary:
         rd = int(cfg.rotary_pct * Dh)
         rd -= rd % 2
-        q = _rope(q, positions, rd)
-        k_ = _rope(k_, positions, rd)
+        q = _rope(q, positions, rd, cfg.rotary_interleaved)
+        k_ = _rope(k_, positions, rd, cfg.rotary_interleaved)
     k_cache = jax.lax.dynamic_update_slice(k_cache, k_.astype(k_cache.dtype), (0, pos, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
     scale = 1.0 / np.sqrt(Dh)
     use_kernel = (cfg.use_flash is True
                   or (cfg.use_flash is None and jax.default_backend() == "tpu"))
+    if cfg.alibi:
+        use_kernel = False  # decode kernel has no bias input yet
     if T == 1 and use_kernel:
         # per-token decode: fused Pallas cache-attention kernel (parity:
         # softmax_context, csrc/transformer/inference); auto mode gates on the
@@ -368,6 +425,8 @@ def _block_with_cache(cfg: GPTConfig, x, w, k_cache, v_cache, pos):
         s_idx = jnp.arange(S)[None, :]
         t_idx = positions[:, :, None]  # absolute position of each query token
         mask = s_idx <= t_idx  # [B, T, S]
+        if cfg.alibi:
+            logits = logits + _alibi_bias(cfg, positions, S)
         logits = jnp.where(mask[:, None, :, :], logits, jnp.float32(-1e30))
         probs = jax.nn.softmax(logits, axis=-1)
         attn = jnp.einsum("bhts,bshd->bthd", probs.astype(v_cache.dtype), v_cache)
@@ -386,8 +445,11 @@ def forward_with_cache(cfg: GPTConfig, params, input_ids: jnp.ndarray, cache):
     pos = cache["pos"]
     x = jnp.take(params["wte"], input_ids, axis=0)
     positions = pos + jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
-    if not cfg.rotary:
+    if not cfg.rotary and not cfg.alibi:
         x = x + jnp.take(params["wpe"], positions + cfg.pos_offset, axis=0)
+    if cfg.embed_layernorm:
+        x = layer_norm(x, params["emb_ln_scale"], params["emb_ln_bias"],
+                       cfg.layer_norm_eps)
     x = x.astype(params["blocks"]["qkv_w"].dtype)
     x = maybe_shard(x, P(BATCH, None, None))
 
@@ -401,6 +463,8 @@ def forward_with_cache(cfg: GPTConfig, params, input_ids: jnp.ndarray, cache):
     x = layer_norm(x, params["lnf_scale"], params["lnf_bias"], cfg.layer_norm_eps)
     head = params["wte"] if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
+    if cfg.lm_head_bias and not cfg.tie_embeddings:
+        logits = logits + params["lm_head_b"].astype(logits.dtype)
     return logits, {"k": new_k, "v": new_v, "pos": pos + T}
 
 

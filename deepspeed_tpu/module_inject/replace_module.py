@@ -208,10 +208,174 @@ def _opt_policy(hf_model) -> Tuple[GPTConfig, Dict[str, Any]]:
     return cfg, params
 
 
+def _bloom_policy(hf_model) -> Tuple[GPTConfig, Dict[str, Any]]:
+    """HF BloomForCausalLM -> params. Parity: ``containers/bloom.py``
+    (BLOOMLayerPolicy): ALiBi positions, embedding layernorm, per-head
+    interleaved fused qkv (same [H, 3, Dh] packing as NeoX)."""
+    c = hf_model.config
+    cfg = GPTConfig(
+        vocab_size=c.vocab_size, n_layer=c.n_layer, n_head=c.n_head,
+        d_model=c.hidden_size, max_seq_len=getattr(c, "seq_length", 2048),
+        rotary=False, alibi=True, embed_layernorm=True, tie_embeddings=True,
+        layer_norm_eps=c.layer_norm_epsilon, activation="gelu")
+    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+    L = c.n_layer
+    H, Dh = cfg.n_head, cfg.head_dim
+    pre = "transformer.h.{}"
+    qkv_ws, qkv_bs = [], []
+    for i in range(L):
+        w, b = _neox_qkv_permute(
+            sd[f"transformer.h.{i}.self_attention.query_key_value.weight"],
+            sd[f"transformer.h.{i}.self_attention.query_key_value.bias"], H, Dh)
+        qkv_ws.append(w.T)
+        qkv_bs.append(b)
+    params = {
+        "wte": jnp.asarray(sd["transformer.word_embeddings.weight"]),
+        "emb_ln_scale": jnp.asarray(
+            sd["transformer.word_embeddings_layernorm.weight"]),
+        "emb_ln_bias": jnp.asarray(
+            sd["transformer.word_embeddings_layernorm.bias"]),
+        "blocks": {
+            "ln1_scale": _stack(sd, pre + ".input_layernorm.weight", L),
+            "ln1_bias": _stack(sd, pre + ".input_layernorm.bias", L),
+            "qkv_w": jnp.asarray(np.stack(qkv_ws)),
+            "qkv_b": jnp.asarray(np.stack(qkv_bs)),
+            "attn_out_w": _stack(sd, pre + ".self_attention.dense.weight", L,
+                                 transpose=True),
+            "attn_out_b": _stack(sd, pre + ".self_attention.dense.bias", L),
+            "ln2_scale": _stack(sd, pre + ".post_attention_layernorm.weight", L),
+            "ln2_bias": _stack(sd, pre + ".post_attention_layernorm.bias", L),
+            "mlp_up_w": _stack(sd, pre + ".mlp.dense_h_to_4h.weight", L,
+                               transpose=True),
+            "mlp_up_b": _stack(sd, pre + ".mlp.dense_h_to_4h.bias", L),
+            "mlp_down_w": _stack(sd, pre + ".mlp.dense_4h_to_h.weight", L,
+                                 transpose=True),
+            "mlp_down_b": _stack(sd, pre + ".mlp.dense_4h_to_h.bias", L),
+        },
+        "lnf_scale": jnp.asarray(sd["transformer.ln_f.weight"]),
+        "lnf_bias": jnp.asarray(sd["transformer.ln_f.bias"]),
+    }
+    return cfg, params
+
+
+def _gptj_policy(hf_model) -> Tuple[GPTConfig, Dict[str, Any]]:
+    """HF GPTJForCausalLM -> params. Parity: ``containers/gptj.py``
+    (HFGPTJLayerPolicy): partial interleaved rotary, parallel residual sharing
+    ONE layernorm (imported by duplicating ln_1 into the ln2 slots), biasless
+    separate q/k/v, biased untied LM head."""
+    c = hf_model.config
+    head_dim = c.n_embd // c.n_head
+    cfg = GPTConfig(
+        vocab_size=c.vocab_size, n_layer=c.n_layer, n_head=c.n_head,
+        d_model=c.n_embd, d_ff=getattr(c, "n_inner", None) or 4 * c.n_embd,
+        max_seq_len=c.n_positions, rotary=True,
+        rotary_pct=c.rotary_dim / head_dim, rotary_interleaved=True,
+        parallel_residual=True, tie_embeddings=False, lm_head_bias=True,
+        layer_norm_eps=c.layer_norm_epsilon,
+        activation=_map_activation(c.activation_function, "GPTJ"))
+    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+    L = c.n_layer
+    D = c.n_embd
+    qkv_ws = []
+    for i in range(L):
+        ws = [sd[f"transformer.h.{i}.attn.{p}_proj.weight"].T
+              for p in ("q", "k", "v")]
+        qkv_ws.append(np.concatenate(ws, axis=1))  # [D, 3D]
+    params = {
+        "wte": jnp.asarray(sd["transformer.wte.weight"]),
+        "lm_head": jnp.asarray(sd["lm_head.weight"]),
+        "lm_head_b": jnp.asarray(sd["lm_head.bias"]),
+        "blocks": {
+            # GPT-J applies ONE ln to both branches; duplicate into both slots
+            "ln1_scale": _stack(sd, "transformer.h.{}.ln_1.weight", L),
+            "ln1_bias": _stack(sd, "transformer.h.{}.ln_1.bias", L),
+            "ln2_scale": _stack(sd, "transformer.h.{}.ln_1.weight", L),
+            "ln2_bias": _stack(sd, "transformer.h.{}.ln_1.bias", L),
+            "qkv_w": jnp.asarray(np.stack(qkv_ws)),
+            "qkv_b": jnp.asarray(np.zeros((L, 3 * D), np.float32)),
+            "attn_out_w": _stack(sd, "transformer.h.{}.attn.out_proj.weight", L,
+                                 transpose=True),
+            "attn_out_b": jnp.asarray(np.zeros((L, D), np.float32)),
+            "mlp_up_w": _stack(sd, "transformer.h.{}.mlp.fc_in.weight", L,
+                               transpose=True),
+            "mlp_up_b": _stack(sd, "transformer.h.{}.mlp.fc_in.bias", L),
+            "mlp_down_w": _stack(sd, "transformer.h.{}.mlp.fc_out.weight", L,
+                                 transpose=True),
+            "mlp_down_b": _stack(sd, "transformer.h.{}.mlp.fc_out.bias", L),
+        },
+        "lnf_scale": jnp.asarray(sd["transformer.ln_f.weight"]),
+        "lnf_bias": jnp.asarray(sd["transformer.ln_f.bias"]),
+    }
+    return cfg, params
+
+
+def _bert_policy(hf_model):
+    """HF BertForMaskedLM -> (BertConfig, params). Parity:
+    ``containers/bert.py`` (HFBertLayerPolicy)."""
+    from ..models.bert import BertConfig
+
+    c = hf_model.config
+    cfg = BertConfig(
+        vocab_size=c.vocab_size, n_layer=c.num_hidden_layers,
+        n_head=c.num_attention_heads, d_model=c.hidden_size,
+        d_ff=c.intermediate_size, max_seq_len=c.max_position_embeddings,
+        type_vocab_size=c.type_vocab_size, layer_norm_eps=c.layer_norm_eps)
+    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+    L = c.num_hidden_layers
+    pre = "bert.encoder.layer.{}"
+    qkv_ws, qkv_bs = [], []
+    for i in range(L):
+        ws = [sd[f"bert.encoder.layer.{i}.attention.self.{p}.weight"].T
+              for p in ("query", "key", "value")]
+        bs = [sd[f"bert.encoder.layer.{i}.attention.self.{p}.bias"]
+              for p in ("query", "key", "value")]
+        qkv_ws.append(np.concatenate(ws, axis=1))
+        qkv_bs.append(np.concatenate(bs))
+    params = {
+        "wte": jnp.asarray(sd["bert.embeddings.word_embeddings.weight"]),
+        "wpe": jnp.asarray(sd["bert.embeddings.position_embeddings.weight"]),
+        "wtt": jnp.asarray(sd["bert.embeddings.token_type_embeddings.weight"]),
+        "emb_ln_scale": jnp.asarray(sd["bert.embeddings.LayerNorm.weight"]),
+        "emb_ln_bias": jnp.asarray(sd["bert.embeddings.LayerNorm.bias"]),
+        "blocks": {
+            "qkv_w": jnp.asarray(np.stack(qkv_ws)),
+            "qkv_b": jnp.asarray(np.stack(qkv_bs)),
+            "attn_out_w": _stack(sd, pre + ".attention.output.dense.weight", L,
+                                 transpose=True),
+            "attn_out_b": _stack(sd, pre + ".attention.output.dense.bias", L),
+            "ln1_scale": _stack(sd, pre + ".attention.output.LayerNorm.weight", L),
+            "ln1_bias": _stack(sd, pre + ".attention.output.LayerNorm.bias", L),
+            "mlp_up_w": _stack(sd, pre + ".intermediate.dense.weight", L,
+                               transpose=True),
+            "mlp_up_b": _stack(sd, pre + ".intermediate.dense.bias", L),
+            "mlp_down_w": _stack(sd, pre + ".output.dense.weight", L,
+                                 transpose=True),
+            "mlp_down_b": _stack(sd, pre + ".output.dense.bias", L),
+            "ln2_scale": _stack(sd, pre + ".output.LayerNorm.weight", L),
+            "ln2_bias": _stack(sd, pre + ".output.LayerNorm.bias", L),
+        },
+        "mlm_dense_w": jnp.asarray(
+            sd["cls.predictions.transform.dense.weight"].T),
+        "mlm_dense_b": jnp.asarray(sd["cls.predictions.transform.dense.bias"]),
+        "mlm_ln_scale": jnp.asarray(
+            sd["cls.predictions.transform.LayerNorm.weight"]),
+        "mlm_ln_bias": jnp.asarray(sd["cls.predictions.transform.LayerNorm.bias"]),
+        "mlm_bias": jnp.asarray(sd["cls.predictions.bias"]),
+        # BertForMaskedLM has no pooler; zero-init placeholders keep the tree
+        # shape of models/bert.init_params
+        "pooler_w": jnp.zeros((c.hidden_size, c.hidden_size), jnp.float32),
+        "pooler_b": jnp.zeros((c.hidden_size,), jnp.float32),
+    }
+    return cfg, params
+
+
 HF_POLICIES = {
     "GPT2LMHeadModel": _gpt2_policy,
     "GPTNeoXForCausalLM": _gptneox_policy,
     "OPTForCausalLM": _opt_policy,
+    "BloomForCausalLM": _bloom_policy,
+    "GPTJForCausalLM": _gptj_policy,
+    "BertForMaskedLM": _bert_policy,
 }
 
 

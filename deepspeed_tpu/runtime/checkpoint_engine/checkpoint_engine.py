@@ -1,0 +1,147 @@
+"""Pluggable checkpoint engines.
+
+Capability parity with the reference's checkpoint-engine abstraction
+(``runtime/checkpoint_engine/checkpoint_engine.py:1`` ``CheckpointEngine`` ABC,
+``torch_checkpoint_engine.py:7`` synchronous impl, ``nebula_checkpoint_engine.py
+:15`` async service impl): ``create(tag) -> save(...) -> commit(tag)`` with a
+synchronous native engine and an async engine that overlaps serialization with
+training (the Nebula capability slot — here a background writer thread over the
+host-gathered arrays; durability point is ``commit``).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...utils.logging import log_dist, logger
+
+
+class CheckpointEngine:
+    """Parity: ``checkpoint_engine.py:1``."""
+
+    def __init__(self, config_params=None):
+        self.config = config_params
+
+    def create(self, tag: str) -> None:
+        """Start a checkpoint under ``tag``."""
+
+    def save(self, state_dict: Dict[str, np.ndarray], path: str) -> None:
+        raise NotImplementedError
+
+    def load(self, path: str, map_location=None) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def makedirs(self, path: str, exist_ok: bool = True) -> None:
+        os.makedirs(path, exist_ok=exist_ok)
+
+    def commit(self, tag: str) -> bool:
+        """Durability point: after this returns, the tag is fully persisted."""
+        return True
+
+
+class NativeCheckpointEngine(CheckpointEngine):
+    """Synchronous writer (parity: ``TorchCheckpointEngine``)."""
+
+    def save(self, state_dict: Dict[str, np.ndarray], path: str) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as f:
+            np.savez(f, **state_dict)
+        os.replace(tmp, path)
+
+    def save_array(self, path: str, arr: np.ndarray) -> None:
+        """Single-array write (the serialization layer's file granularity)."""
+        np.save(path, arr)
+
+    def load(self, path: str, map_location=None) -> Dict[str, np.ndarray]:
+        with np.load(path, allow_pickle=False) as d:
+            return dict(d)
+
+
+class AsyncCheckpointEngine(CheckpointEngine):
+    """Background-thread writer: ``save`` enqueues and returns immediately;
+    ``commit`` blocks until everything under the tag is durable.
+
+    Parity: the Nebula async-service capability (``nebula_checkpoint_engine.py``)
+    without the external service — same API contract (training overlaps I/O,
+    ``commit`` is the barrier).
+    """
+
+    def __init__(self, config_params=None, writers: int = 2):
+        super().__init__(config_params)
+        self._q: "queue.Queue[Optional[Tuple[Dict, str]]]" = queue.Queue()
+        self._errors: List[str] = []
+        self._inner = NativeCheckpointEngine()
+        self._threads = [threading.Thread(target=self._worker, daemon=True)
+                         for _ in range(writers)]
+        for t in self._threads:
+            t.start()
+
+    def _worker(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            sd, path = item
+            try:
+                if set(sd) == {"__single__"}:
+                    self._inner.save_array(path, sd["__single__"])
+                else:
+                    self._inner.save(sd, path)
+            except Exception as e:
+                self._errors.append(f"{path}: {e}")
+            finally:
+                self._q.task_done()
+
+    def save(self, state_dict: Dict[str, np.ndarray], path: str) -> None:
+        # snapshot: caller may mutate arrays after return (training continues)
+        snap = {k: np.array(v, copy=True) for k, v in state_dict.items()}
+        self._q.put((snap, path))
+
+    def save_array(self, path: str, arr: np.ndarray) -> None:
+        # host-gathered jax buffers are immutable; no copy needed
+        self._q.put(({"__single__": arr}, path))
+
+    def _raise_errors(self) -> None:
+        if self._errors:
+            errs, self._errors = self._errors, []
+            raise IOError(f"async checkpoint writes failed: {errs}")
+
+    def load(self, path: str, map_location=None) -> Dict[str, np.ndarray]:
+        self._q.join()
+        self._raise_errors()
+        return self._inner.load(path)
+
+    def commit(self, tag: str) -> bool:
+        self._q.join()
+        self._raise_errors()
+        log_dist(f"checkpoint tag {tag} committed (async)")
+        return True
+
+    def shutdown(self) -> None:
+        for _ in self._threads:
+            self._q.put(None)
+        for t in self._threads:
+            t.join(timeout=10)
+
+
+def get_checkpoint_engine(ds_config) -> CheckpointEngine:
+    """Select from the ``"checkpoint"`` config block. Parity: the engine's
+    nebula-vs-torch selection (``runtime/engine.py`` _configure_checkpointing)."""
+    block = {}
+    if ds_config is not None:
+        block = (ds_config.get("checkpoint", {}) if isinstance(ds_config, dict)
+                 else getattr(ds_config, "checkpoint", {}) or {})
+    kind = str(block.get("checkpoint_engine", "native")).lower()
+    if kind in ("async", "nebula"):
+        return AsyncCheckpointEngine(block, writers=int(block.get("writers", 2)))
+    if kind in ("native", "torch", ""):
+        return NativeCheckpointEngine(block)
+    logger.warning(f"unknown checkpoint_engine {kind!r}; using native")
+    return NativeCheckpointEngine(block)

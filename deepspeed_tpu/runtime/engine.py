@@ -24,6 +24,7 @@ update timing, accumulation boundaries) matches the reference.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
 import numpy as np
@@ -197,6 +198,12 @@ class DeepSpeedEngine:
             from ..monitor.monitor import MonitorMaster
 
             self._monitor = MonitorMaster(config.monitor)
+        # flops profiler: prints at profile_step (parity: profiler.py:236 hook)
+        self._flops_profiler = None
+        if config.flops_profiler.enabled:
+            from ..profiling import FlopsProfiler
+
+            self._flops_profiler = FlopsProfiler(self, config.flops_profiler)
 
         # ---------------- build state + compiled steps
         self.state = self._init_state()
@@ -514,6 +521,12 @@ class DeepSpeedEngine:
         program. ``batch`` arrays are [gas, batch, ...] when gas>1, else [batch, ...].
         Parity: ``PipelineEngine.train_batch``-style one-call API."""
         self.tput_timer.start()
+        if (self._flops_profiler is not None
+                and self.global_steps + 1 == self.config.flops_profiler.profile_step):
+            self._flops_profiler.profile_train_batch(batch)
+            self._flops_profiler.print_model_profile(
+                profile_step=self.config.flops_profiler.profile_step,
+                output_file=self.config.flops_profiler.output_file)
         batch = self._apply_curriculum(batch)
         batch = self._place_batch(batch, leading_gas=True)
         runner = self._onebit or self._offload
@@ -587,3 +600,29 @@ class DeepSpeedEngine:
         from ..checkpoint import load_checkpoint as _load
 
         return _load(self, load_dir, tag=tag, load_optimizer_states=load_optimizer_states)
+
+    def save_16bit_model(self, save_dir: str,
+                         save_filename: str = "pytorch_model.npz") -> str:
+        """Gather the full 16-bit weights to host and write one consolidated
+        file. Parity: ``engine.save_16bit_model`` / the stage-3 consolidated
+        save (``runtime/engine.py:3410,3480``) — here every ZeRO stage gathers
+        the same way (leaves are logical arrays; device_get resolves shards)."""
+        from ..checkpoint.serialization import (
+            _UINT_FOR_SIZE,
+            _fetch_full,
+            _flatten_with_paths,
+        )
+
+        os.makedirs(save_dir, exist_ok=True)
+        flat, _ = _flatten_with_paths(self.state["params"])
+        out = {}
+        for key, leaf in flat:
+            arr = _fetch_full(leaf)
+            if arr.dtype.kind not in "biufc":  # ml_dtypes -> sized uint view
+                key = f"{key}::{arr.dtype}"
+                arr = arr.view(_UINT_FOR_SIZE[arr.dtype.itemsize])
+            out[key] = arr
+        path = os.path.join(save_dir, save_filename)
+        if jax.process_index() == 0:
+            np.savez(path, **out)
+        return path

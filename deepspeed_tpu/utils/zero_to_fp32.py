@@ -1,0 +1,101 @@
+"""Consolidate a checkpoint into a single fp32 state dict.
+
+Capability parity with the reference's ``utils/zero_to_fp32.py`` (auto-copied
+next to every checkpoint, ``runtime/engine.py:3388``): recover full fp32 weights
+from a training checkpoint without constructing the model or the training
+topology. The reference must merge per-rank ZeRO shards; this framework's
+checkpoint format already stores every leaf as its full logical array
+(SURVEY §5 "universal checkpoint" is the native format), so consolidation is
+extraction: prefer the fp32 master copy when present, else cast params.
+
+CLI:  python -m deepspeed_tpu.utils.zero_to_fp32 <checkpoint_dir> <output.npz>
+where <checkpoint_dir> is either the run directory (uses ``latest``) or a tag
+directory.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, Optional
+
+import msgpack
+import numpy as np
+
+
+def _load_leaves(state_dir: str) -> Dict[str, np.ndarray]:
+    with open(os.path.join(state_dir, "state.msgpack"), "rb") as f:
+        meta = msgpack.unpackb(f.read())
+    out = {}
+    for m in meta["leaves"]:
+        arr = np.load(os.path.join(state_dir, "arrays", f"{m['index']}.npy"))
+        if m.get("raw_view"):
+            import ml_dtypes
+
+            arr = arr.view(np.dtype(getattr(ml_dtypes, m["dtype"], m["dtype"])))
+        out[m["key"]] = arr
+    return out
+
+
+def _resolve_tag_dir(path: str) -> str:
+    if os.path.exists(os.path.join(path, "state")):
+        return path
+    latest = os.path.join(path, "latest")
+    if os.path.exists(latest):
+        with open(latest) as f:
+            return os.path.join(path, f.read().strip())
+    raise FileNotFoundError(f"{path} is neither a tag dir nor has a 'latest' file")
+
+
+def get_fp32_state_dict_from_zero_checkpoint(
+        checkpoint_dir: str, tag: Optional[str] = None) -> Dict[str, np.ndarray]:
+    """Parity: the reference function of the same name (``zero_to_fp32.py``)."""
+    if tag is not None:
+        ckpt = os.path.join(checkpoint_dir, tag)
+    else:
+        ckpt = _resolve_tag_dir(checkpoint_dir)
+    leaves = _load_leaves(os.path.join(ckpt, "state"))
+    masters = {k[len("master/"):]: v for k, v in leaves.items()
+               if k.startswith("master/")}
+    params = {k[len("params/"):]: v for k, v in leaves.items()
+              if k.startswith("params/")}
+    # ZeRO-Offload: fp32 masters live in host_optimizer.npz, positionally keyed
+    # master_{i} in the params tree's flatten order (_load_leaves preserves it)
+    host_path = os.path.join(ckpt, "host_optimizer.npz")
+    if not masters and os.path.exists(host_path):
+        with np.load(host_path) as d:
+            for i, key in enumerate(params):
+                mkey = f"master_{i}"
+                if mkey in d:
+                    masters[key] = d[mkey].reshape(params[key].shape)
+    out = {}
+    for key, arr in params.items():
+        src = masters.get(key, arr)
+        out[key] = np.asarray(src, np.float32) if src.dtype != np.float32 else src
+    if not out:
+        raise ValueError(f"no params found in {ckpt}")
+    return out
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(
+        checkpoint_dir: str, output_file: str, tag: Optional[str] = None) -> None:
+    """Parity: the reference CLI behavior — writes a consolidated fp32 file."""
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    np.savez(output_file, **sd)
+    total = sum(int(v.size) for v in sd.values())
+    print(f"saved {len(sd)} tensors ({total / 1e6:.1f}M params, fp32) "
+          f"to {output_file}")
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 2:
+        print(__doc__)
+        return 1
+    convert_zero_checkpoint_to_fp32_state_dict(argv[0], argv[1])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,148 @@
+"""Checkpoint engines, zero_to_fp32 consolidation, save_16bit_model."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.checkpoint_engine import (
+    AsyncCheckpointEngine,
+    NativeCheckpointEngine,
+    get_checkpoint_engine,
+)
+from deepspeed_tpu.utils.zero_to_fp32 import (
+    convert_zero_checkpoint_to_fp32_state_dict,
+    get_fp32_state_dict_from_zero_checkpoint,
+)
+
+
+def _engine(config_extra=None):
+    from deepspeed_tpu.models import build_gpt
+    from deepspeed_tpu.models.gpt import GPTConfig
+
+    model, cfg = build_gpt(GPTConfig(
+        vocab_size=64, d_model=32, n_layer=2, n_head=2, max_seq_len=16))
+    config = {"train_micro_batch_size_per_gpu": 1,
+              "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+              "steps_per_print": 0}
+    config.update(config_extra or {})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    return engine, cfg
+
+
+def _batch(cfg, seed=0):
+    r = np.random.default_rng(seed)
+    return {"input_ids": r.integers(0, cfg.vocab_size, size=(8, 16), dtype=np.int32)}
+
+
+# --------------------------------------------------------------------- engines
+def test_native_engine_roundtrip(tmp_path, rng):
+    e = NativeCheckpointEngine()
+    sd = {"a": rng.normal(size=(4, 4)).astype(np.float32),
+          "b": np.arange(10, dtype=np.int64)}
+    path = str(tmp_path / "x.npz")
+    e.save(sd, path)
+    out = e.load(path)
+    np.testing.assert_array_equal(out["a"], sd["a"])
+    np.testing.assert_array_equal(out["b"], sd["b"])
+    assert e.commit("t") is True
+
+
+def test_async_engine_overlaps_and_commits(tmp_path, rng):
+    e = AsyncCheckpointEngine(writers=2)
+    paths = []
+    for i in range(8):
+        sd = {"a": rng.normal(size=(64, 64)).astype(np.float32)}
+        p = str(tmp_path / f"c{i}.npz")
+        e.save(sd, p)
+        paths.append((p, sd["a"].copy()))
+    e.commit("tag")  # durability barrier
+    for p, a in paths:
+        np.testing.assert_array_equal(NativeCheckpointEngine().load(p)["a"], a)
+    e.shutdown()
+
+
+def test_async_engine_snapshot_isolation(tmp_path):
+    e = AsyncCheckpointEngine(writers=1)
+    arr = np.ones((32,), np.float32)
+    e.save({"a": arr}, str(tmp_path / "snap.npz"))
+    arr[:] = -1  # mutate after enqueue: snapshot must have the old value
+    e.commit("t")
+    out = NativeCheckpointEngine().load(str(tmp_path / "snap.npz"))
+    np.testing.assert_array_equal(out["a"], np.ones((32,), np.float32))
+    e.shutdown()
+
+
+def test_get_checkpoint_engine_selection():
+    assert isinstance(get_checkpoint_engine(None), NativeCheckpointEngine)
+    assert isinstance(get_checkpoint_engine(
+        {"checkpoint": {"checkpoint_engine": "async"}}), AsyncCheckpointEngine)
+    assert isinstance(get_checkpoint_engine(
+        {"checkpoint": {"checkpoint_engine": "nebula"}}), AsyncCheckpointEngine)
+
+
+def test_engine_save_with_async_checkpoint_engine(tmp_path):
+    engine, cfg = _engine({"checkpoint": {"checkpoint_engine": "async"}})
+    b = _batch(cfg)
+    engine.train_batch(b)
+    ckpt = engine.save_checkpoint(str(tmp_path))
+    assert os.path.exists(os.path.join(ckpt, "state", "state.msgpack"))
+    # reload into a fresh engine and continue identically
+    e2, _ = _engine({"checkpoint": {"checkpoint_engine": "async"}})
+    e2.load_checkpoint(str(tmp_path))
+    m1 = engine.train_batch(b)
+    m2 = e2.train_batch(b)
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-6)
+
+
+# --------------------------------------------------------------------- zero_to_fp32
+def test_zero_to_fp32_consolidation(tmp_path):
+    engine, cfg = _engine({"bf16": {"enabled": True},
+                           "zero_optimization": {"stage": 2}})
+    engine.train_batch(_batch(cfg))
+    engine.save_checkpoint(str(tmp_path))
+
+    sd = get_fp32_state_dict_from_zero_checkpoint(str(tmp_path))
+    assert all(v.dtype == np.float32 for v in sd.values())
+    # master copy preferred: values match the training master, full precision
+    master_wte = np.asarray(engine.state["master"]["wte"], np.float32)
+    np.testing.assert_array_equal(sd["wte"], master_wte)
+
+    out = str(tmp_path / "consolidated.npz")
+    convert_zero_checkpoint_to_fp32_state_dict(str(tmp_path), out)
+    with np.load(out) as d:
+        np.testing.assert_array_equal(d["wte"], master_wte)
+
+
+def test_zero_to_fp32_cli(tmp_path):
+    from deepspeed_tpu.utils.zero_to_fp32 import main
+
+    engine, cfg = _engine()
+    engine.train_batch(_batch(cfg))
+    engine.save_checkpoint(str(tmp_path))
+    out = str(tmp_path / "out.npz")
+    assert main([str(tmp_path), out]) == 0
+    assert os.path.exists(out)
+    assert main([]) == 1  # usage
+
+
+# --------------------------------------------------------------------- 16bit save
+def test_save_16bit_model(tmp_path):
+    engine, cfg = _engine({"bf16": {"enabled": True}})
+    engine.train_batch(_batch(cfg))
+    path = engine.save_16bit_model(str(tmp_path))
+    assert os.path.exists(path)
+    with np.load(path) as d:
+        keys = list(d.keys())
+        assert any(k.endswith("::bfloat16") for k in keys)
+        wte_key = [k for k in keys if k.startswith("wte")][0]
+        import ml_dtypes
+
+        arr = d[wte_key].view(ml_dtypes.bfloat16)
+        np.testing.assert_array_equal(
+            arr, np.asarray(engine.state["params"]["wte"]))

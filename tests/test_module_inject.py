@@ -66,6 +66,28 @@ def test_opt_import_matches_hf(rng):
     assert cfg.activation == "relu" and cfg.pos_offset == 2
 
 
+def test_bloom_import_matches_hf(rng):
+    hf_cfg = transformers.BloomConfig(
+        vocab_size=93, hidden_size=32, n_layer=2, n_head=4,
+        layer_norm_epsilon=1e-5)
+    torch.manual_seed(0)
+    model = transformers.BloomForCausalLM(hf_cfg).eval()
+    ids = rng.integers(0, 93, size=(2, 10)).astype(np.int64)
+    cfg, _ = _compare_logits(model, ids)
+    assert cfg.alibi and cfg.embed_layernorm and cfg.tie_embeddings
+
+
+def test_gptj_import_matches_hf(rng):
+    hf_cfg = transformers.GPTJConfig(
+        vocab_size=95, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        rotary_dim=4)
+    torch.manual_seed(0)
+    model = transformers.GPTJForCausalLM(hf_cfg).eval()
+    ids = rng.integers(0, 95, size=(2, 10)).astype(np.int64)
+    cfg, _ = _compare_logits(model, ids)
+    assert cfg.rotary_interleaved and cfg.parallel_residual and cfg.lm_head_bias
+
+
 def test_unknown_architecture_raises():
     class Fake:
         pass

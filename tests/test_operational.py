@@ -160,6 +160,29 @@ def test_get_model_profile():
     assert prof["params"] > 0 and prof["flops"] > 0
 
 
+def test_engine_flops_profiler_config_hook(tmp_path):
+    """flops_profiler config block must actually fire at profile_step."""
+    from deepspeed_tpu.models import build_gpt
+    from deepspeed_tpu.models.gpt import GPTConfig
+
+    out = str(tmp_path / "prof.txt")
+    model, cfg = build_gpt(GPTConfig(
+        vocab_size=64, d_model=32, n_layer=1, n_head=2, max_seq_len=16))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config={
+            "train_micro_batch_size_per_gpu": 1,
+            "flops_profiler": {"enabled": True, "profile_step": 2,
+                               "output_file": out},
+            "steps_per_print": 0})
+    r = np.random.default_rng(0)
+    b = {"input_ids": r.integers(0, 64, (8, 16), dtype=np.int32)}
+    engine.train_batch(b)
+    assert not os.path.exists(out)
+    engine.train_batch(b)  # step 2: profile fires
+    assert os.path.exists(out)
+    assert "Flops Profiler" in open(out).read()
+
+
 def test_flops_profiler_on_engine():
     from deepspeed_tpu.models import build_gpt
     from deepspeed_tpu.models.gpt import GPTConfig
