@@ -1,26 +1,44 @@
-"""Benchmark: GPT-2 350M training throughput on the available TPU chip(s).
+#!/usr/bin/env python
+"""Benchmark harness: prints ONE JSON line {"metric","value","unit","vs_baseline",...}.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+Round-1 post-mortem (VERDICT.md weak #1): a single axon/TPU backend-init hiccup
+must not cost the round's perf evidence. This file is therefore an ORCHESTRATOR
+that never imports jax itself:
 
-Baseline anchor (BASELINE.md): the reference's published BERT-class single-V100
-kernel numbers don't map 1:1 to a v5e chip, so the baseline here is the
-BASELINE.json north-star framing — model FLOPs utilization (MFU). vs_baseline is
-measured MFU / 0.45 (the 45% MFU target the reference stack achieves at scale);
-1.0 means on-target.
+1. probe the TPU backend in a small subprocess with a hard timeout, retrying
+   with backoff (axon init can hang rather than raise);
+2. run each benchmark config in its own worker subprocess (``--worker``) with a
+   timeout, retrying once — a crash/timeout in one config degrades the sweep,
+   not the artifact;
+3. if the TPU never comes up, fall back to a forced-CPU mesh so a real measured
+   number (clearly marked ``"platform": "cpu"``) is still emitted alongside the
+   TPU error record.
+
+Sweep (VERDICT "next" #2, BASELINE.json matrix): ZeRO-1/2/3 training MFU on the
+flagship GPT, plus an inference decode p50/p90 latency config (parity:
+``/root/reference/benchmarks/inference/gpt-bench.py``). The headline metric is
+the best training config's tokens/sec/chip; ``vs_baseline`` is its MFU / 0.45
+(the reference stack's at-scale MFU bar — BASELINE.md north star).
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
 
-import numpy as np  # noqa: E402
+PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
+PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
+WORKER_TIMEOUT = int(os.environ.get("BENCH_WORKER_TIMEOUT", "1200"))
 
 
-def peak_flops_per_chip() -> float:
-    """bf16 peak for the local chip generation."""
+def peak_flops_per_chip(platform: str) -> float:
+    """bf16 peak for the local chip generation (meaningless on cpu fallback)."""
+    if platform == "cpu":
+        return 1e12  # nominal; MFU not reported for cpu
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
     table = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
     for k, v in table.items():
@@ -29,27 +47,94 @@ def peak_flops_per_chip() -> float:
     return 197e12
 
 
-def main():
+def _cpu_env(env: dict, n_devices: int = 1) -> dict:
+    """Force a virtual n-device CPU mesh — single source of truth lives in
+    __graft_entry__ (the round-1 axon-hang post-mortem recipe)."""
+    from __graft_entry__ import _force_cpu_env
+
+    return _force_cpu_env(n_devices, env)
+
+
+def probe_backend() -> tuple:
+    """Return ("tpu", n_chips) if a real accelerator initializes, else ("cpu", 1).
+
+    Never blocks the parent: the probe runs in a subprocess under a timeout and
+    does one real matmul so 'initialized' means 'usable', not just 'registered'.
+    A backend whose devices are CPU counts as the fallback, not the target.
+    """
+    code = ("import jax, jax.numpy as jnp; d = jax.devices(); "
+            "x = jnp.ones((256,256), jnp.bfloat16); (x@x).block_until_ready(); "
+            "print('PLATFORM=%s NCHIPS=%d' % (d[0].platform, len(d)))")
+    errors = []
+    for attempt in range(PROBE_ATTEMPTS):
+        try:
+            p = subprocess.run([sys.executable, "-c", code], timeout=PROBE_TIMEOUT,
+                               capture_output=True, text=True, cwd=REPO)
+            if p.returncode == 0 and "NCHIPS=" in p.stdout:
+                platform = p.stdout.split("PLATFORM=")[1].split()[0]
+                n = int(p.stdout.split("NCHIPS=")[1].split()[0])
+                if platform == "cpu":
+                    errors.append("probe found only CPU devices")
+                    return "cpu", 1, errors
+                return "tpu", n, errors
+            errors.append(f"probe rc={p.returncode}: {p.stderr.strip()[-400:]}")
+        except subprocess.TimeoutExpired:
+            errors.append(f"probe attempt {attempt + 1} hung >{PROBE_TIMEOUT}s (killed)")
+        if attempt < PROBE_ATTEMPTS - 1:
+            time.sleep(10 * (attempt + 1))
+    return "cpu", 1, errors
+
+
+def run_worker(cfg: dict, platform: str, retries: int = 1):
+    """Run one benchmark config in a subprocess; returns parsed JSON or error dict."""
+    env = dict(os.environ) if platform == "tpu" else _cpu_env(os.environ)
+    last_err = None
+    for attempt in range(retries + 1):
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--worker", json.dumps(cfg)],
+                timeout=WORKER_TIMEOUT, capture_output=True, text=True, env=env, cwd=REPO)
+            for line in reversed(p.stdout.strip().splitlines()):
+                line = line.strip()
+                if line.startswith("{"):
+                    return json.loads(line)
+            last_err = f"rc={p.returncode}: {p.stderr.strip()[-500:]}"
+        except subprocess.TimeoutExpired:
+            last_err = f"worker hung >{WORKER_TIMEOUT}s (killed)"
+        if attempt < retries:
+            time.sleep(5)
+    return {"config": cfg.get("name"), "error": last_err}
+
+
+# ---------------------------------------------------------------- worker side
+
+def _worker(cfg: dict) -> None:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    out = (_worker_train(cfg) if cfg["kind"] == "train" else _worker_infer(cfg))
+    print(json.dumps(out))
+
+
+def _worker_train(cfg: dict) -> dict:
+    import dataclasses
+
+    import numpy as np
+
     import jax
 
     import deepspeed_tpu
     from deepspeed_tpu.models import build_gpt
-
-    model_name = os.environ.get("BENCH_MODEL", "gpt2-350m")
-    micro_bs = int(os.environ.get("BENCH_BS", "16"))
-    seq = int(os.environ.get("BENCH_SEQ", "1024"))
-    stage = int(os.environ.get("BENCH_ZERO_STAGE", "2"))
-    steps = int(os.environ.get("BENCH_STEPS", "20"))
-
-    import dataclasses
-
     from deepspeed_tpu.models import gpt as gpt_mod
 
-    cfg = gpt_mod.PRESETS[model_name]
-    if os.environ.get("BENCH_REMAT", "1") == "1":
-        cfg = dataclasses.replace(cfg, remat=True)
-    model, cfg = build_gpt(cfg)
+    platform = jax.devices()[0].platform
+    mcfg = gpt_mod.PRESETS[cfg["model"]]
+    if cfg.get("remat", True):
+        mcfg = dataclasses.replace(mcfg, remat=True)
+    model, mcfg = build_gpt(mcfg)
     n_chips = len(jax.devices())
+    micro_bs, seq, steps = cfg["micro_bs"], cfg["seq"], cfg["steps"]
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model,
         config={
@@ -57,51 +142,150 @@ def main():
             "optimizer": {"type": "AdamW",
                           "params": {"lr": 3e-4, "weight_decay": 0.1}},
             "bf16": {"enabled": True},
-            "zero_optimization": {"stage": stage},
+            "zero_optimization": {"stage": cfg["stage"]},
             "gradient_clipping": 1.0,
             "steps_per_print": 0,
         })
 
     rng = np.random.default_rng(0)
 
-    def make_batch(i):
+    def make_batch():
         return {"input_ids": rng.integers(
-            0, cfg.vocab_size, size=(micro_bs * n_chips, seq), dtype=np.int32)}
+            0, mcfg.vocab_size, size=(micro_bs * n_chips, seq), dtype=np.int32)}
 
-    # warmup (compile)
-    m = engine.train_batch(make_batch(0))
+    m = engine.train_batch(make_batch())  # warmup/compile
     float(m["loss"])
 
     t0 = time.perf_counter()
-    for i in range(steps):
-        m = engine.train_batch(make_batch(i + 1))
-    # force a host transfer of an end-of-step output: device_get cannot return
-    # until every step in the dependency chain has executed (block_until_ready is
-    # not trustworthy through remote-dispatch tunnels)
+    for _ in range(steps):
+        m = engine.train_batch(make_batch())
+    # host transfer: device_get can't return until the whole chain executed
+    # (block_until_ready is not trustworthy through remote-dispatch tunnels)
     float(m["loss"])
     _ = np.asarray(jax.device_get(m["grad_norm"]))
     dt = time.perf_counter() - t0
 
     tokens = steps * micro_bs * n_chips * (seq - 1)
     tok_per_sec_chip = tokens / dt / n_chips
+    n_params = mcfg.num_params()
     # 6*N FLOPs/token (fwd+bwd) + attention term 12*L*d*T per token
-    n_params = cfg.num_params()
-    flops_per_token = 6 * n_params + 12 * cfg.n_layer * cfg.d_model * seq
-    mfu = tok_per_sec_chip * flops_per_token / peak_flops_per_chip()
-    result = {
-        "metric": f"{model_name} ZeRO-{stage} bf16 training tokens/sec/chip",
-        "value": round(tok_per_sec_chip, 1),
-        "unit": "tokens/sec/chip",
-        "vs_baseline": round(mfu / 0.45, 3),
-        "mfu": round(mfu, 4),
-        "chips": n_chips,
-        "micro_bs": micro_bs,
-        "seq": seq,
+    flops_per_token = 6 * n_params + 12 * mcfg.n_layer * mcfg.d_model * seq
+    mfu = tok_per_sec_chip * flops_per_token / peak_flops_per_chip(platform)
+    return {
+        "config": cfg["name"], "kind": "train", "platform": platform,
+        "tokens_per_sec_chip": round(tok_per_sec_chip, 1),
+        "mfu": round(mfu, 4), "chips": n_chips, "micro_bs": micro_bs,
+        "seq": seq, "stage": cfg["stage"],
         "loss": round(float(m["loss"]), 4),
         "step_ms": round(dt / steps * 1e3, 1),
     }
+
+
+def _worker_infer(cfg: dict) -> dict:
+    import numpy as np
+
+    import jax
+
+    from deepspeed_tpu.inference import DeepSpeedInferenceConfig, InferenceEngine
+    from deepspeed_tpu.inference.engine import for_gpt
+    from deepspeed_tpu.models import gpt as gpt_mod
+
+    platform = jax.devices()[0].platform
+    mcfg = gpt_mod.PRESETS[cfg["model"]]
+    params = gpt_mod.init_params(mcfg, jax.random.PRNGKey(0))
+    engine = InferenceEngine(
+        for_gpt(mcfg, params),
+        DeepSpeedInferenceConfig(dtype="bfloat16",
+                                 max_out_tokens=cfg["prompt"] + cfg["gen"] + 8))
+    ids = np.asarray(np.random.default_rng(0).integers(
+        0, mcfg.vocab_size, (cfg["batch"], cfg["prompt"])), np.int32)
+
+    short, long_ = max(cfg["gen"] // 4, 1), cfg["gen"]
+    # warmup/compile both shapes
+    np.asarray(engine.generate(ids, max_new_tokens=short))
+    np.asarray(engine.generate(ids, max_new_tokens=long_))
+    lat = []
+    for _ in range(cfg.get("reps", 5)):
+        t0 = time.perf_counter()
+        np.asarray(engine.generate(ids, max_new_tokens=short))
+        t1 = time.perf_counter()
+        np.asarray(engine.generate(ids, max_new_tokens=long_))
+        t2 = time.perf_counter()
+        # subtract prefill+dispatch overhead: marginal per-token decode latency
+        lat.append(((t2 - t1) - (t1 - t0)) / (long_ - short) * 1e3)
+    lat.sort()
+    p50 = lat[len(lat) // 2]
+    p90 = lat[min(len(lat) - 1, int(len(lat) * 0.9))]
+    return {
+        "config": cfg["name"], "kind": "inference", "platform": platform,
+        "decode_p50_ms": round(p50, 3), "decode_p90_ms": round(p90, 3),
+        "tokens_per_sec": round(1e3 / max(p50, 1e-9) * cfg["batch"], 1),
+        "batch": cfg["batch"], "prompt": cfg["prompt"],
+    }
+
+
+# ---------------------------------------------------------------- parent side
+
+def main() -> None:
+    platform, n_chips, probe_errors = probe_backend()
+    for e in probe_errors:
+        print(f"[bench] {e}", file=sys.stderr)
+
+    if platform == "tpu":
+        model = os.environ.get("BENCH_MODEL", "gpt2-350m")
+        bs = int(os.environ.get("BENCH_BS", "16"))
+        seq = int(os.environ.get("BENCH_SEQ", "1024"))
+        steps = int(os.environ.get("BENCH_STEPS", "20"))
+        configs = [
+            {"kind": "train", "name": f"{model}-zero{s}", "model": model,
+             "micro_bs": bs, "seq": seq, "stage": s, "steps": steps}
+            for s in (1, 2, 3)
+        ] + [{"kind": "inference", "name": f"{model}-decode", "model": model,
+              "batch": 1, "prompt": 128, "gen": 64}]
+    else:
+        # forced-CPU fallback: tiny shapes, still real measurements
+        configs = [
+            {"kind": "train", "name": f"cpu-fallback-zero{s}", "model": "gpt2-125m",
+             "micro_bs": 2, "seq": 128, "stage": s, "steps": 3}
+            for s in (1, 2)
+        ] + [{"kind": "inference", "name": "cpu-fallback-decode", "model": "gpt2-125m",
+              "batch": 1, "prompt": 32, "gen": 16, "reps": 3}]
+
+    sweep, errors = [], list(probe_errors)
+    for cfg in configs:
+        r = run_worker(cfg, platform)
+        sweep.append(r)
+        if "error" in r:
+            errors.append(f"{cfg['name']}: {r['error']}")
+        print(f"[bench] {json.dumps(r)}", file=sys.stderr)
+
+    train_ok = [r for r in sweep if r.get("kind") == "train" and "error" not in r]
+    infer_ok = [r for r in sweep if r.get("kind") == "inference" and "error" not in r]
+    result = {"platform": platform, "sweep": sweep}
+    if errors:
+        result["errors"] = errors[-4:]
+    if train_ok:
+        best = max(train_ok, key=lambda r: r.get("mfu", 0.0))
+        result.update({
+            "metric": f"{best['config']} bf16 training tokens/sec/chip",
+            "value": best["tokens_per_sec_chip"],
+            "unit": "tokens/sec/chip",
+            "vs_baseline": (round(best["mfu"] / 0.45, 3)
+                            if platform == "tpu" else 0.0),
+            "mfu": best["mfu"],
+        })
+    else:
+        result.update({
+            "metric": "training throughput (all configs failed)",
+            "value": 0.0, "unit": "tokens/sec/chip", "vs_baseline": 0.0,
+        })
+    if infer_ok:
+        result["decode_p50_ms"] = infer_ok[0]["decode_p50_ms"]
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
+        _worker(json.loads(sys.argv[2]))
+    else:
+        main()

@@ -98,13 +98,34 @@ def init_inference(model: Any = None, config: Any = None, **kwargs):
         config = {**(config if isinstance(config, dict) else {}), **kwargs}
     inf_cfg = (config if isinstance(config, DeepSpeedInferenceConfig)
                else DeepSpeedInferenceConfig(**config))
+    # on-disk checkpoint: stream multi-file safetensors/bin shards leaf-by-leaf
+    # — no torch model in memory (parity: the reference's sharded-checkpoint
+    # loading, module_inject/load_checkpoint.py:370 + inference/engine.py:280-441)
+    if model is None and isinstance(inf_cfg.checkpoint, str):
+        from .models.gpt import GPTConfig
+        from .module_inject.load_checkpoint import load_hf_checkpoint
+
+        gpt_cfg, params = load_hf_checkpoint(inf_cfg.checkpoint)
+        if not isinstance(gpt_cfg, GPTConfig):
+            raise ValueError(
+                f"checkpoint at {inf_cfg.checkpoint} is a "
+                f"{type(gpt_cfg).__name__} architecture — only decoder-LM "
+                f"(GPT-family) checkpoints have a generate path; wrap encoder "
+                f"models with a custom adapter instead")
+        model = for_gpt(gpt_cfg, params)
     # HF transformers model: route through the import policies (the reference's
     # replace_transformer_layer path, module_inject/replace_module.py:302)
     if model is not None and hasattr(model, "state_dict") and hasattr(model, "config") \
             and not hasattr(model, "prefill"):
+        from .models.gpt import GPTConfig
         from .module_inject import import_hf_model
 
         gpt_cfg, params = import_hf_model(model)
+        if not isinstance(gpt_cfg, GPTConfig):
+            raise ValueError(
+                f"{type(model).__name__} is not a decoder LM; init_inference's "
+                f"generate path needs a GPT-family model — use the imported "
+                f"(config, params) with your own adapter for encoder models")
         model = for_gpt(gpt_cfg, params)
     return InferenceEngine(model, inf_cfg)
 

@@ -73,6 +73,8 @@ class InferenceEngine:
 
             specs = auto_tp_specs(params, tp_size=tp)
             log_dist("inference engine: AutoTP-inferred tensor-parallel sharding")
+        if specs is not None:
+            specs = self._sanitize_specs(params, specs)
 
         # int8 weight-only storage quantization (parity: GroupQuantizer,
         # module_inject/replace_module.py:144). NOTE current memory semantics:
@@ -98,6 +100,29 @@ class InferenceEngine:
             self.params = jax.device_put(params, NamedSharding(self.mesh, P()))
         log_dist(f"inference engine: dtype {self.dtype}, tp={tp}, "
                  f"max_out_tokens={self.config.max_out_tokens}")
+
+    def _sanitize_specs(self, params, specs):
+        """Drop mesh axes from dims they don't divide (e.g. an odd vocab over
+        tp=2) — the same indivisibility guard AutoTP applies to inferred specs,
+        extended to model-provided ones so imported checkpoints with unfriendly
+        shapes still place (replicating just the offending dims)."""
+
+        def fix(x, spec):
+            out = []
+            for dim, names in enumerate(tuple(spec)):
+                if names is None:
+                    out.append(None)
+                    continue
+                tup = names if isinstance(names, tuple) else (names,)
+                extent = int(np.prod([self.mesh.shape[n] for n in tup]))
+                if dim < x.ndim and extent and x.shape[dim] % extent == 0:
+                    out.append(names)
+                else:
+                    out.append(None)
+            return P(*out)
+
+        return jax.tree_util.tree_map(
+            fix, params, specs, is_leaf=lambda s: isinstance(s, P))
 
     def _materialize(self, params):
         """Inside-jit dequantization of int8 leaves back to compute dtype."""

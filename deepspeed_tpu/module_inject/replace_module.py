@@ -61,19 +61,17 @@ def _stack(sd: Dict[str, np.ndarray], fmt: str, n_layer: int, transpose=False):
 
 
 # --------------------------------------------------------------------- policies
-def _gpt2_policy(hf_model) -> Tuple[GPTConfig, Dict[str, Any]]:
+def _gpt2_policy(c, sd) -> Tuple[GPTConfig, Dict[str, Any]]:
     """HF GPT2LMHeadModel -> params. Parity: ``containers/gpt2.py`` (HFGPT2LayerPolicy).
 
     HF GPT-2 uses Conv1D (weight [in, out] — already our orientation) and fused
     c_attn [D, 3D] in q|k|v block order, matching our concatenated split.
     """
-    c = hf_model.config
     cfg = GPTConfig(
         vocab_size=c.vocab_size, n_layer=c.n_layer, n_head=c.n_head,
         d_model=c.n_embd, max_seq_len=c.n_positions, rotary=False,
         tie_embeddings=True, layer_norm_eps=c.layer_norm_epsilon,
         activation=_map_activation(c.activation_function, "GPT2"))
-    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
     L = c.n_layer
     params = {
         "wte": jnp.asarray(sd["transformer.wte.weight"]),
@@ -108,9 +106,8 @@ def _neox_qkv_permute(w: np.ndarray, b: np.ndarray, H: int, Dh: int):
     return w.reshape(3 * D, D), b.reshape(3 * D)
 
 
-def _gptneox_policy(hf_model) -> Tuple[GPTConfig, Dict[str, Any]]:
+def _gptneox_policy(c, sd) -> Tuple[GPTConfig, Dict[str, Any]]:
     """HF GPTNeoXForCausalLM -> params. Parity: ``containers/gptneox.py``."""
-    c = hf_model.config
     cfg = GPTConfig(
         vocab_size=c.vocab_size, n_layer=c.num_hidden_layers,
         n_head=c.num_attention_heads, d_model=c.hidden_size,
@@ -119,7 +116,6 @@ def _gptneox_policy(hf_model) -> Tuple[GPTConfig, Dict[str, Any]]:
         layer_norm_eps=c.layer_norm_eps,
         activation=_map_activation(c.hidden_act, "GPTNeoX"),
         parallel_residual=bool(getattr(c, "use_parallel_residual", True)))
-    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
     L = c.num_hidden_layers
     H, Dh = cfg.n_head, cfg.head_dim
     qkv_ws, qkv_bs = [], []
@@ -157,13 +153,12 @@ def _gptneox_policy(hf_model) -> Tuple[GPTConfig, Dict[str, Any]]:
     return cfg, params
 
 
-def _opt_policy(hf_model) -> Tuple[GPTConfig, Dict[str, Any]]:
+def _opt_policy(c, sd) -> Tuple[GPTConfig, Dict[str, Any]]:
     """HF OPTForCausalLM -> params. Parity: ``containers/opt.py`` (HFOPTLayerPolicy).
 
     OPT: separate q/k/v Linears (fused here), ReLU, learned positions with the
     characteristic +2 offset, final LN, tied embeddings.
     """
-    c = hf_model.config
     assert getattr(c, "do_layer_norm_before", True), \
         "only pre-LN OPT variants are supported"
     cfg = GPTConfig(
@@ -173,7 +168,6 @@ def _opt_policy(hf_model) -> Tuple[GPTConfig, Dict[str, Any]]:
         rotary=False, pos_offset=2, tie_embeddings=True,
         activation=_map_activation(c.activation_function, "OPT"),
         layer_norm_eps=1e-5)
-    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
     L = c.num_hidden_layers
     pre = "model.decoder.layers.{}"
     qkv_ws, qkv_bs = [], []
@@ -208,17 +202,15 @@ def _opt_policy(hf_model) -> Tuple[GPTConfig, Dict[str, Any]]:
     return cfg, params
 
 
-def _bloom_policy(hf_model) -> Tuple[GPTConfig, Dict[str, Any]]:
+def _bloom_policy(c, sd) -> Tuple[GPTConfig, Dict[str, Any]]:
     """HF BloomForCausalLM -> params. Parity: ``containers/bloom.py``
     (BLOOMLayerPolicy): ALiBi positions, embedding layernorm, per-head
     interleaved fused qkv (same [H, 3, Dh] packing as NeoX)."""
-    c = hf_model.config
     cfg = GPTConfig(
         vocab_size=c.vocab_size, n_layer=c.n_layer, n_head=c.n_head,
         d_model=c.hidden_size, max_seq_len=getattr(c, "seq_length", 2048),
         rotary=False, alibi=True, embed_layernorm=True, tie_embeddings=True,
         layer_norm_eps=c.layer_norm_epsilon, activation="gelu")
-    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
     L = c.n_layer
     H, Dh = cfg.n_head, cfg.head_dim
     pre = "transformer.h.{}"
@@ -258,12 +250,11 @@ def _bloom_policy(hf_model) -> Tuple[GPTConfig, Dict[str, Any]]:
     return cfg, params
 
 
-def _gptj_policy(hf_model) -> Tuple[GPTConfig, Dict[str, Any]]:
+def _gptj_policy(c, sd) -> Tuple[GPTConfig, Dict[str, Any]]:
     """HF GPTJForCausalLM -> params. Parity: ``containers/gptj.py``
     (HFGPTJLayerPolicy): partial interleaved rotary, parallel residual sharing
     ONE layernorm (imported by duplicating ln_1 into the ln2 slots), biasless
     separate q/k/v, biased untied LM head."""
-    c = hf_model.config
     head_dim = c.n_embd // c.n_head
     cfg = GPTConfig(
         vocab_size=c.vocab_size, n_layer=c.n_layer, n_head=c.n_head,
@@ -273,7 +264,6 @@ def _gptj_policy(hf_model) -> Tuple[GPTConfig, Dict[str, Any]]:
         parallel_residual=True, tie_embeddings=False, lm_head_bias=True,
         layer_norm_eps=c.layer_norm_epsilon,
         activation=_map_activation(c.activation_function, "GPTJ"))
-    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
     L = c.n_layer
     D = c.n_embd
     qkv_ws = []
@@ -309,18 +299,16 @@ def _gptj_policy(hf_model) -> Tuple[GPTConfig, Dict[str, Any]]:
     return cfg, params
 
 
-def _bert_policy(hf_model):
+def _bert_policy(c, sd):
     """HF BertForMaskedLM -> (BertConfig, params). Parity:
     ``containers/bert.py`` (HFBertLayerPolicy)."""
     from ..models.bert import BertConfig
 
-    c = hf_model.config
     cfg = BertConfig(
         vocab_size=c.vocab_size, n_layer=c.num_hidden_layers,
         n_head=c.num_attention_heads, d_model=c.hidden_size,
         d_ff=c.intermediate_size, max_seq_len=c.max_position_embeddings,
         type_vocab_size=c.type_vocab_size, layer_norm_eps=c.layer_norm_eps)
-    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
     L = c.num_hidden_layers
     pre = "bert.encoder.layer.{}"
     qkv_ws, qkv_bs = [], []
@@ -390,7 +378,8 @@ def import_hf_model(hf_model) -> Tuple[GPTConfig, Dict[str, Any]]:
     if policy is None:
         raise ValueError(
             f"no import policy for {name}; supported: {sorted(HF_POLICIES)}")
-    cfg, params = policy(hf_model)
+    sd = {k: _np(v) for k, v in hf_model.state_dict().items()}
+    cfg, params = policy(hf_model.config, sd)
     n = sum(int(np.prod(l.shape)) for l in
             __import__("jax").tree_util.tree_leaves(params))
     log_dist(f"imported {name}: {n / 1e6:.1f}M params -> GPTConfig({cfg.n_layer}L, "

@@ -6,3 +6,4 @@ from .schedule import (DataParallelSchedule, InferenceSchedule,  # noqa: F401
                        PipeSchedule, TrainSchedule, bubble_fraction)
 from .spmd import (merge_microbatches, pipelined_apply,  # noqa: F401
                    split_microbatches, stack_stage_params, unstack_stage_params)
+from .mpmd import MPMDPipelineEngine  # noqa: F401

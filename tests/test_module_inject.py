@@ -197,3 +197,128 @@ def test_int8_inference_close_to_fp(rng):
     # same argmax on most positions (weight-only int8 keeps predictions)
     agree = (l_fp.argmax(-1) == l_q.argmax(-1)).mean()
     assert agree >= 0.8
+
+
+# --------------------------------------------------- sharded checkpoint loading
+def _write_sharded_checkpoint(tmpdir, hf_model, n_shards=2, fmt="safetensors"):
+    """Write an HF-style multi-file sharded checkpoint dir (index + shards)."""
+    import json
+    import os
+
+    sd = {k: v.detach().clone() for k, v in hf_model.state_dict().items()
+          if not k.endswith((".attn.masked_bias", ".attn.bias"))}
+    names = sorted(sd)
+    chunk = (len(names) + n_shards - 1) // n_shards
+    weight_map = {}
+    for i in range(n_shards):
+        part = names[i * chunk:(i + 1) * chunk]
+        if fmt == "safetensors":
+            fname = f"model-{i + 1:05d}-of-{n_shards:05d}.safetensors"
+            from safetensors.torch import save_file
+
+            save_file({k: sd[k].contiguous() for k in part},
+                      os.path.join(tmpdir, fname))
+        else:
+            fname = f"pytorch_model-{i + 1:05d}-of-{n_shards:05d}.bin"
+            torch.save({k: sd[k] for k in part}, os.path.join(tmpdir, fname))
+        weight_map.update({k: fname for k in part})
+    idx_name = ("model.safetensors.index.json" if fmt == "safetensors"
+                else "pytorch_model.bin.index.json")
+    with open(os.path.join(tmpdir, idx_name), "w") as f:
+        json.dump({"weight_map": weight_map}, f)
+    cfg_dict = hf_model.config.to_dict()
+    cfg_dict["architectures"] = [type(hf_model).__name__]
+    with open(os.path.join(tmpdir, "config.json"), "w") as f:
+        json.dump(cfg_dict, f)
+
+
+@pytest.mark.parametrize("fmt", ["safetensors", "bin"])
+def test_sharded_checkpoint_streams_from_disk(tmp_path, rng, fmt):
+    """VERDICT r1 #4: multi-file checkpoint dir loads leaf-by-leaf with no torch
+    model in memory, matching the in-memory import exactly."""
+    from deepspeed_tpu.module_inject.load_checkpoint import load_hf_checkpoint
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=61, n_positions=32, n_embd=32, n_layer=3, n_head=4)
+    torch.manual_seed(1)
+    model = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    _write_sharded_checkpoint(str(tmp_path), model, n_shards=2, fmt=fmt)
+
+    cfg_mem, params_mem = import_hf_model(model)
+    cfg_disk, params_disk = load_hf_checkpoint(str(tmp_path))
+    assert cfg_disk == cfg_mem
+    for a, b in zip(jax.tree_util.tree_leaves(params_disk),
+                    jax.tree_util.tree_leaves(params_mem)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_init_inference_from_checkpoint_dir_tp2(tmp_path, rng):
+    """init_inference(checkpoint=<dir>) under tp=2 generates identically to the
+    in-memory import path (parity: ref inference/engine.py:380 checkpoint flow)."""
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=61, n_positions=64, n_embd=32, n_layer=2, n_head=4)
+    torch.manual_seed(2)
+    model = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    _write_sharded_checkpoint(str(tmp_path), model, n_shards=2)
+
+    ids = rng.integers(0, 61, size=(1, 8)).astype(np.int32)
+    eng_disk = deepspeed_tpu.init_inference(
+        checkpoint=str(tmp_path), dtype="float32",
+        tensor_parallel={"tp_size": 2}, max_out_tokens=32)
+    eng_mem = deepspeed_tpu.init_inference(
+        model, dtype="float32", tensor_parallel={"tp_size": 2},
+        max_out_tokens=32)
+    out_disk = np.asarray(eng_disk.generate(ids, max_new_tokens=8,
+                                            temperature=0.0))
+    out_mem = np.asarray(eng_mem.generate(ids, max_new_tokens=8,
+                                          temperature=0.0))
+    np.testing.assert_array_equal(out_disk, out_mem)
+
+
+def test_mp_checkpoint_roundtrip_and_mesh_placement(tmp_path, rng):
+    """save_mp_checkpoint/load_mp_checkpoint: tp-presharded export reloads both
+    to host (concat) and directly onto a tp=2 mesh with correct shard placement
+    (parity: ref save_mp_checkpoint_path resharding)."""
+    from deepspeed_tpu.models import gpt as G
+    from deepspeed_tpu.module_inject.load_checkpoint import (
+        load_mp_checkpoint, save_mp_checkpoint)
+    from deepspeed_tpu.runtime.topology import MeshTopology
+
+    cfg = G.GPTConfig(vocab_size=32, n_layer=2, n_head=4, d_model=16,
+                      max_seq_len=16)
+    params = G.init_params(cfg, jax.random.PRNGKey(0))
+    shapes = jax.tree_util.tree_map(lambda x: x, params)
+    specs = G.partition_specs(cfg, shapes)
+    save_mp_checkpoint(str(tmp_path / "mp"), params, specs, tp_size=2,
+                       model_config=cfg)
+
+    # host reload
+    host = load_mp_checkpoint(str(tmp_path / "mp"), params, specs, mesh=None)
+    for a, b in zip(jax.tree_util.tree_leaves(host),
+                    jax.tree_util.tree_leaves(params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0)
+
+    # direct-to-mesh reload
+    topo = MeshTopology.create(tp=2, devices=jax.devices()[:2])
+    on_mesh = load_mp_checkpoint(str(tmp_path / "mp"), params, specs,
+                                 mesh=topo.mesh)
+    qkv = on_mesh["blocks"]["qkv_w"]
+    assert not qkv.sharding.is_fully_replicated
+    for a, b in zip(jax.tree_util.tree_leaves(on_mesh),
+                    jax.tree_util.tree_leaves(params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0)
+
+
+def test_streamed_checkpoint_preserves_bf16(tmp_path):
+    """A bf16 checkpoint must stream as bf16 (host memory ~= checkpoint size,
+    not 2x via an fp32 upcast)."""
+    from deepspeed_tpu.module_inject.load_checkpoint import load_hf_checkpoint
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=32, n_positions=16, n_embd=16, n_layer=1, n_head=2)
+    torch.manual_seed(3)
+    model = transformers.GPT2LMHeadModel(hf_cfg).to(torch.bfloat16).eval()
+    _write_sharded_checkpoint(str(tmp_path), model, n_shards=2)
+    _, params = load_hf_checkpoint(str(tmp_path))
+    assert params["wte"].dtype == jnp.bfloat16, params["wte"].dtype
+    assert params["blocks"]["qkv_w"].dtype == jnp.bfloat16

@@ -225,3 +225,133 @@ def test_gpt_pipe_trains_with_engine():
         m = engine.train_batch({"input_ids": ids})
         losses.append(float(m["loss"]))
     assert losses[-1] < losses[0], losses
+
+
+# ----------------------------------------------------------------- MPMD 1F1B
+def _tiny_lm_module(vocab=31, d=16, n_mlp=6, num_stages=4):
+    """Heterogeneous pipeline: tied embedding -> residual MLPs -> tied head."""
+    from deepspeed_tpu.runtime.pipe.mpmd import MPMDPipelineEngine  # noqa: F401
+
+    def emb_init(rng):
+        return jax.random.normal(rng, (vocab, d), jnp.float32) * 0.05
+
+    def emb_apply(w, ids):
+        return w[ids]
+
+    def head_apply(w, x):
+        return x @ w.T
+
+    def mlp_init(rng):
+        k1, k2 = jax.random.split(rng)
+        return {"w1": jax.random.normal(k1, (d, 2 * d), jnp.float32) * 0.05,
+                "w2": jax.random.normal(k2, (2 * d, d), jnp.float32) * 0.05}
+
+    def mlp_apply(w, x):
+        return x + jnp.tanh(x @ w["w1"]) @ w["w2"]
+
+    def loss_fn(logits, mb):
+        ids = mb["input_ids"]
+        logp = jax.nn.log_softmax(logits[:, :-1], -1)
+        tgt = ids[:, 1:]
+        nll = -jnp.take_along_axis(logp, tgt[..., None], -1)
+        return jnp.mean(nll)
+
+    specs = [TiedLayerSpec("emb", emb_init, emb_apply, name="embed",
+                           param_count=vocab * d)]
+    specs += [LayerSpec(mlp_init, mlp_apply, name=f"mlp{i}",
+                        param_count=4 * d * d) for i in range(n_mlp)]
+    specs += [TiedLayerSpec("emb", emb_init, head_apply, name="head",
+                            param_count=vocab * d)]
+    return PipelineModule(specs, num_stages=num_stages,
+                          partition_method="uniform", loss_fn=loss_fn), loss_fn
+
+
+def test_mpmd_1f1b_matches_dense_and_residency():
+    """VERDICT r1 #3: the executed 1F1B schedule must (a) reproduce the dense
+    loss/grads and (b) hold at most min(stages - stage_id, M) live activation
+    buffers per stage — the TrainSchedule.num_pipe_buffers bound (parity:
+    reference runtime/pipe/schedule.py:243), NOT GPipe's M."""
+    from deepspeed_tpu.runtime.pipe.mpmd import MPMDPipelineEngine
+
+    S, M, mb, T = 4, 8, 2, 12
+    module, loss_fn = _tiny_lm_module(num_stages=S)
+    eng = MPMDPipelineEngine(module, num_micro=M, devices=jax.devices()[:S])
+    params = eng.init(jax.random.PRNGKey(0))
+
+    r = np.random.default_rng(0)
+    batch = {"input_ids": r.integers(0, 31, size=(M, mb, T), dtype=np.int32)}
+
+    opt_state = eng.init_optimizer(params)
+    new_params, opt_state, metrics = eng.train_batch(
+        params, opt_state, batch, apply_update=True)
+
+    # (b) 1F1B residency bound, per stage
+    assert eng.peak_live_buffers == [min(S - s, M) for s in range(S)], \
+        eng.peak_live_buffers
+
+    # (a) dense reference: same params flattened, mean loss over micros
+    full = module.init(jax.random.PRNGKey(0))
+
+    def dense_loss(full_params):
+        losses = []
+        for m in range(M):
+            out = module.apply(full_params, batch["input_ids"][m])
+            losses.append(loss_fn(out, {"input_ids": batch["input_ids"][m]}))
+        return jnp.mean(jnp.stack(losses))
+
+    ref_loss, ref_grads = jax.value_and_grad(dense_loss)(full)
+    np.testing.assert_allclose(float(metrics["loss"]), float(ref_loss),
+                               rtol=2e-5)
+    # grads match per stage and for tied weights
+    for s in range(S):
+        lo, hi = module.parts[s], module.parts[s + 1]
+        got = jax.tree_util.tree_leaves(metrics["grads"]["stages"][s])
+        want = jax.tree_util.tree_leaves([ref_grads["layers"][i]
+                                          for i in range(lo, hi)])
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(metrics["grads"]["tied"]["emb"]),
+                               np.asarray(ref_grads["tied"]["emb"]),
+                               rtol=1e-4, atol=1e-6)
+    # the step actually moved the params
+    moved = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(lambda a, b: jnp.max(jnp.abs(a - b)),
+                               new_params["tied"], params["tied"]))
+    assert any(float(x) > 0 for x in moved)
+
+
+def test_mpmd_heterogeneous_stage_loss_decreases():
+    """Heterogeneous stages (embed | mlps | mlps | head) train end to end."""
+    from deepspeed_tpu.runtime.pipe.mpmd import MPMDPipelineEngine
+
+    S, M, mb, T = 3, 4, 2, 10
+    module, _ = _tiny_lm_module(vocab=23, d=12, n_mlp=4, num_stages=S)
+    eng = MPMDPipelineEngine(module, num_micro=M, devices=jax.devices()[:S],
+                             lr=0.1)
+    params = eng.init(jax.random.PRNGKey(1))
+    opt_state = eng.init_optimizer(params)
+    r = np.random.default_rng(1)
+    batch = {"input_ids": r.integers(0, 23, size=(M, mb, T), dtype=np.int32)}
+    losses = []
+    for _ in range(6):
+        params, opt_state, metrics = eng.train_batch(params, opt_state, batch)
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], losses
+
+
+def test_mpmd_inference_schedule_forward():
+    from deepspeed_tpu.runtime.pipe.mpmd import MPMDPipelineEngine
+
+    S, M, mb, T = 4, 4, 2, 8
+    module, _ = _tiny_lm_module(num_stages=S)
+    eng = MPMDPipelineEngine(module, num_micro=M, devices=jax.devices()[:S])
+    params = eng.init(jax.random.PRNGKey(0))
+    r = np.random.default_rng(2)
+    batch = {"input_ids": r.integers(0, 31, size=(M, mb, T), dtype=np.int32)}
+    out = eng.forward_batch(params, batch)
+    full = module.init(jax.random.PRNGKey(0))
+    for m in range(M):
+        ref = module.apply(full, batch["input_ids"][m])
+        np.testing.assert_allclose(np.asarray(out[m]), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
