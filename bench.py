@@ -113,8 +113,92 @@ def _worker(cfg: dict) -> None:
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
-    out = (_worker_train(cfg) if cfg["kind"] == "train" else _worker_infer(cfg))
-    print(json.dumps(out))
+    fn = {"train": _worker_train, "inference": _worker_infer,
+          "kernels": _worker_kernels}[cfg["kind"]]
+    print(json.dumps(fn(cfg)))
+
+
+def _worker_kernels(cfg: dict) -> dict:
+    """Mosaic-compile every Pallas kernel on the chip at bench-realistic shapes
+    BEFORE the sweep, so a BlockSpec regression costs one config, not the
+    round's inference evidence (VERDICT r2 'next' #1)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(0)
+    results, failed = {}, []
+
+    def check(name, fn):
+        try:
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn())
+            results[name] = {"ok": True,
+                             "compile_s": round(time.perf_counter() - t0, 1)}
+        except Exception as e:  # record, keep probing the others
+            results[name] = {"ok": False, "error": str(e)[-300:]}
+            failed.append(name)
+
+    B, H, S, Dh = 4, 16, 1024, 64
+    q4 = jnp.asarray(rng.standard_normal((B, S, H, Dh)), jnp.bfloat16)
+
+    def flash():
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+        f = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+        return f(q4, q4, q4)
+
+    def flash_bwd():
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+        f = jax.jit(jax.grad(
+            lambda q, k, v: flash_attention(q, k, v, causal=True)
+            .astype(jnp.float32).sum()))
+        return f(q4, q4, q4)
+
+    def decode():
+        from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
+
+        qd = jnp.asarray(rng.standard_normal((B, 1, H, Dh)), jnp.bfloat16)
+        kc = jnp.asarray(rng.standard_normal((B, H, S, Dh)), jnp.bfloat16)
+        f = jax.jit(lambda q, k, v, n: decode_attention(q, k, v, n))
+        return f(qd, kc, kc, jnp.int32(S // 2))
+
+    def blocksparse():
+        from deepspeed_tpu.ops.pallas.blocksparse_attention import (
+            blocksparse_attention)
+        from deepspeed_tpu.ops.sparse_attention import FixedSparsityConfig
+
+        sc = FixedSparsityConfig(num_heads=H, block=128)
+        layout = np.asarray(sc.make_layout(S))
+        f = jax.jit(lambda q, k, v: blocksparse_attention(
+            q, k, v, layout=layout, block=128))
+        return f(q4, q4, q4)
+
+    def blocksparse_bwd():
+        from deepspeed_tpu.ops.pallas.blocksparse_attention import (
+            blocksparse_attention)
+        from deepspeed_tpu.ops.sparse_attention import FixedSparsityConfig
+
+        sc = FixedSparsityConfig(num_heads=H, block=128)
+        layout = np.asarray(sc.make_layout(S))
+        f = jax.jit(jax.grad(lambda q, k, v: blocksparse_attention(
+            q, k, v, layout=layout, block=128).astype(jnp.float32).sum()))
+        return f(q4, q4, q4)
+
+    check("flash_attention", flash)
+    check("flash_attention_bwd", flash_bwd)
+    check("decode_attention", decode)
+    check("blocksparse_attention", blocksparse)
+    check("blocksparse_attention_bwd", blocksparse_bwd)
+    out = {"config": cfg["name"], "kind": "kernels", "platform": platform,
+           "kernels": results}
+    if failed:
+        out["error"] = "Mosaic compile failed: " + ", ".join(
+            f"{k} ({results[k]['error'][-120:]})" for k in failed)
+    return out
 
 
 def _worker_train(cfg: dict) -> dict:
@@ -237,6 +321,8 @@ def main() -> None:
         seq = int(os.environ.get("BENCH_SEQ", "1024"))
         steps = int(os.environ.get("BENCH_STEPS", "20"))
         configs = [
+            {"kind": "kernels", "name": "pallas-kernel-smoke"},
+        ] + [
             {"kind": "train", "name": f"{model}-zero{s}", "model": model,
              "micro_bs": bs, "seq": seq, "stage": s, "steps": steps}
             for s in (1, 2, 3)

@@ -15,7 +15,7 @@ and CUDA-graph-style replay. TPU-native mapping:
 - **CUDA graphs** (``inference/engine.py:467-495``): the decode step is compiled
   once for a fixed [batch, 1] shape and replayed — XLA's compiled executable *is*
   the captured graph.
-- **KV cache** (``inference_context.h``): a pytree of [L, B, S, H, Dh] arrays in
+- **KV cache** (``inference_context.h``): a pytree of [L, B, H, S, Dh] arrays in
   HBM (see ``models/gpt.py::init_cache``), sharded over ``tp`` on the head axis.
 """
 
@@ -196,8 +196,9 @@ class InferenceEngine:
                            top_k: int, eos: int):
         model = self.model
         dtype = self.dtype
-        # cache padded to a 128-multiple: full-lane blocks for the Pallas decode
-        # kernel; the validity mask makes the padding inert
+        # cache sequence axis padded to a 128-multiple so the Pallas decode
+        # kernel's (block_k, Dh) tiles stay sublane-aligned; the validity mask
+        # makes the padding inert
         total = -(-(T + max_new) // 128) * 128
 
         def sample(logits, key):

@@ -375,8 +375,9 @@ def loss_fn(cfg: GPTConfig, params, batch: Dict[str, jnp.ndarray],
 def init_cache(cfg: GPTConfig, batch_size: int, max_len: int, dtype=jnp.bfloat16):
     """Per-layer stacked KV cache. Parity: the reference's inference workspace
     (``csrc/transformer/inference/includes/inference_context.h``) — here a pytree
-    of [L, B, S, H, Dh] arrays living in HBM."""
-    shape = (cfg.n_layer, batch_size, max_len, cfg.n_head, cfg.head_dim)
+    of [L, B, H, S, Dh] arrays living in HBM. Heads lead the sequence axis so the
+    Pallas decode kernel streams Mosaic-tileable (block_k, Dh) slices."""
+    shape = (cfg.n_layer, batch_size, cfg.n_head, max_len, cfg.head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype),
             "pos": jnp.zeros((), jnp.int32)}
 
@@ -385,11 +386,11 @@ def _block_with_cache(cfg: GPTConfig, x, w, k_cache, v_cache, pos):
     """One transformer block consuming/updating a KV cache slice.
 
     x: [B, T, D] new tokens (T=prompt len at prefill, 1 at decode);
-    k_cache/v_cache: [B, S, H, Dh]; pos: scalar — tokens already in the cache.
+    k_cache/v_cache: [B, H, S, Dh]; pos: scalar — tokens already in the cache.
     """
     B, T, D = x.shape
     H, Dh = cfg.n_head, cfg.head_dim
-    S = k_cache.shape[1]
+    S = k_cache.shape[2]
     h = layer_norm(x, w["ln1_scale"], w["ln1_bias"], cfg.layer_norm_eps)
     qkv = h @ w["qkv_w"] + w["qkv_b"]
     q, k_, v = jnp.split(qkv, 3, axis=-1)
@@ -402,8 +403,10 @@ def _block_with_cache(cfg: GPTConfig, x, w, k_cache, v_cache, pos):
         rd -= rd % 2
         q = _rope(q, positions, rd, cfg.rotary_interleaved)
         k_ = _rope(k_, positions, rd, cfg.rotary_interleaved)
-    k_cache = jax.lax.dynamic_update_slice(k_cache, k_.astype(k_cache.dtype), (0, pos, 0, 0))
-    v_cache = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k_.transpose(0, 2, 1, 3).astype(k_cache.dtype), (0, 0, pos, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v.transpose(0, 2, 1, 3).astype(v_cache.dtype), (0, 0, pos, 0))
     scale = 1.0 / np.sqrt(Dh)
     use_kernel = (cfg.use_flash is True
                   or (cfg.use_flash is None and jax.default_backend() == "tpu"))
@@ -420,7 +423,7 @@ def _block_with_cache(cfg: GPTConfig, x, w, k_cache, v_cache, pos):
         attn = attn.reshape(B, T, D).astype(x.dtype)
     else:
         # prefill: attend over the whole cache with a validity+causal mask
-        logits = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+        logits = jnp.einsum("bthd,bhsd->bhts", q.astype(jnp.float32),
                             k_cache.astype(jnp.float32)) * scale
         s_idx = jnp.arange(S)[None, :]
         t_idx = positions[:, :, None]  # absolute position of each query token
@@ -429,7 +432,7 @@ def _block_with_cache(cfg: GPTConfig, x, w, k_cache, v_cache, pos):
             logits = logits + _alibi_bias(cfg, positions, S)
         logits = jnp.where(mask[:, None, :, :], logits, jnp.float32(-1e30))
         probs = jax.nn.softmax(logits, axis=-1)
-        attn = jnp.einsum("bhts,bshd->bthd", probs.astype(v_cache.dtype), v_cache)
+        attn = jnp.einsum("bhts,bhsd->bthd", probs.astype(v_cache.dtype), v_cache)
         attn = attn.reshape(B, T, D).astype(x.dtype)
     attn = attn @ w["attn_out_w"] + w["attn_out_b"]
     if cfg.parallel_residual:

@@ -14,6 +14,10 @@ Structure (extends :mod:`.flash_attention`):
   with counts ``kcnt [H, nQ]``; the kernel's inner ``fori_loop`` runs only
   ``kcnt`` iterations and dynamically slices the k/v blocks it needs — compute
   and HBM traffic scale with layout density, not T².
+- the index/count tables ride **scalar prefetch** (SMEM via
+  ``pltpu.PrefetchScalarGridSpec``) — int32 control data is not tileable as a
+  VMEM block, and Mosaic rejects (1, 1, A) blocks; SMEM residency is the TPU
+  idiom for blocksparse index tables.
 - backward mirrors it with the transposed table (active q-blocks per k-block)
   for dk/dv.
 - causal masking is elementwise inside diagonal blocks; block-level causality is
@@ -30,6 +34,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from .flash_attention import LANES, NEG_INF, _interpret
 
@@ -61,7 +66,8 @@ def layout_tables(layout: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarra
 
 # --------------------------------------------------------------------------- fwd
 def _fwd_kernel(kidx_ref, kcnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                sm_scale: float, causal: bool, block: int):
+                H: int, sm_scale: float, causal: bool, block: int):
+    h = jax.lax.rem(pl.program_id(0), H)
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * sm_scale  # [B, D]
     bq = q.shape[0]
@@ -72,7 +78,7 @@ def _fwd_kernel(kidx_ref, kcnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 
     def body(a, carry):
         acc, m_i, l_i = carry
-        ki = kidx_ref[0, 0, a]
+        ki = kidx_ref[h, qi, a]
         k = k_ref[0, pl.ds(ki * block, block), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(ki * block, block), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [B, B]
@@ -86,7 +92,7 @@ def _fwd_kernel(kidx_ref, kcnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         acc = acc * alpha + jax.lax.dot(p, v)
         return acc, m_new, l_new
 
-    acc, m_i, l_i = jax.lax.fori_loop(0, kcnt_ref[0, 0], body, (acc, m_i, l_i))
+    acc, m_i, l_i = jax.lax.fori_loop(0, kcnt_ref[h, qi], body, (acc, m_i, l_i))
     l_safe = jnp.where(l_i == 0.0, 1.0, l_i)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
     lse_ref[0] = jnp.broadcast_to(m_i + jnp.log(l_safe), (bq, LANES))
@@ -94,7 +100,9 @@ def _fwd_kernel(kidx_ref, kcnt_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 
 # --------------------------------------------------------------------------- bwd
 def _bwd_dq_kernel(kidx_ref, kcnt_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
-                   lse_ref, dq_ref, *, sm_scale: float, causal: bool, block: int):
+                   lse_ref, dq_ref, *, H: int, sm_scale: float, causal: bool,
+                   block: int):
+    h = jax.lax.rem(pl.program_id(0), H)
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
@@ -105,7 +113,7 @@ def _bwd_dq_kernel(kidx_ref, kcnt_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
     q_pos = qi * block + jax.lax.broadcasted_iota(jnp.int32, (bq, block), 0)
 
     def body(a, dq):
-        ki = kidx_ref[0, 0, a]
+        ki = kidx_ref[h, qi, a]
         k = k_ref[0, pl.ds(ki * block, block), :].astype(jnp.float32)
         v = v_ref[0, pl.ds(ki * block, block), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * sm_scale
@@ -117,14 +125,15 @@ def _bwd_dq_kernel(kidx_ref, kcnt_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
         ds = p * (dp - delta) * sm_scale
         return dq + jax.lax.dot(ds, k)
 
-    dq = jax.lax.fori_loop(0, kcnt_ref[0, 0], body,
+    dq = jax.lax.fori_loop(0, kcnt_ref[h, qi], body,
                            jnp.zeros((bq, q.shape[-1]), jnp.float32))
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(qidx_ref, qcnt_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
-                    lse_ref, dk_ref, dv_ref, *, sm_scale: float, causal: bool,
-                    block: int):
+                    lse_ref, dk_ref, dv_ref, *, H: int, sm_scale: float,
+                    causal: bool, block: int):
+    h = jax.lax.rem(pl.program_id(0), H)
     ki = pl.program_id(1)
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
@@ -133,7 +142,7 @@ def _bwd_dkv_kernel(qidx_ref, qcnt_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
 
     def body(a, carry):
         dk, dv = carry
-        qi = qidx_ref[0, 0, a]
+        qi = qidx_ref[h, ki, a]
         q = q_ref[0, pl.ds(qi * block, block), :].astype(jnp.float32)
         do = do_ref[0, pl.ds(qi * block, block), :].astype(jnp.float32)
         o = o_ref[0, pl.ds(qi * block, block), :].astype(jnp.float32)
@@ -151,7 +160,7 @@ def _bwd_dkv_kernel(qidx_ref, qcnt_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
         return dk, dv
 
     dk, dv = jax.lax.fori_loop(
-        0, qcnt_ref[0, 0], body,
+        0, qcnt_ref[h, ki], body,
         (jnp.zeros((bk, k.shape[-1]), jnp.float32),
          jnp.zeros((bk, v.shape[-1]), jnp.float32)))
     dk_ref[0] = dk.astype(dk_ref.dtype)
@@ -159,30 +168,25 @@ def _bwd_dkv_kernel(qidx_ref, qcnt_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
 
 
 # --------------------------------------------------------------------------- glue
-def _tbl_specs(A: int, H: int):
-    """BlockSpecs for the per-(head, block) index/count tables; the grid's dim 0
-    is batch*heads, so the head coordinate is bh % H."""
-    return [
-        pl.BlockSpec((1, 1, A), lambda bh, i: (bh % H, i, 0)),
-        pl.BlockSpec((1, 1), lambda bh, i: (bh % H, i)),
-    ]
-
-
 def _fwd(q, k, v, kidx, kcnt, H, sm_scale, causal, block):
     BH, T, D = q.shape
-    A = kidx.shape[-1]
-    o, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal, block=block),
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # kidx, kcnt in SMEM
         grid=(BH, T // block),
-        in_specs=_tbl_specs(A, H) + [
-            pl.BlockSpec((1, block, D), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, T, D), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, T, D), lambda bh, i: (bh, 0, 0)),
+        in_specs=[
+            pl.BlockSpec((1, block, D), lambda bh, i, *_: (bh, i, 0)),
+            pl.BlockSpec((1, T, D), lambda bh, i, *_: (bh, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda bh, i, *_: (bh, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block, D), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, block, LANES), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, block, D), lambda bh, i, *_: (bh, i, 0)),
+            pl.BlockSpec((1, block, LANES), lambda bh, i, *_: (bh, i, 0)),
         ],
+    )
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, H=H, sm_scale=sm_scale, causal=causal,
+                          block=block),
+        grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((BH, T, D), q.dtype),
             jax.ShapeDtypeStruct((BH, T, LANES), jnp.float32),
@@ -195,38 +199,47 @@ def _fwd(q, k, v, kidx, kcnt, H, sm_scale, causal, block):
 def _bwd(kidx, kcnt, qidx, qcnt, H, sm_scale, causal, block, res, do):
     q, k, v, o, lse = res
     BH, T, D = q.shape
-    A, Aq = kidx.shape[-1], qidx.shape[-1]
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block=block),
+    dq_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
         grid=(BH, T // block),
-        in_specs=_tbl_specs(A, H) + [
-            pl.BlockSpec((1, block, D), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, T, D), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, T, D), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, block, D), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, block, D), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, block, LANES), lambda bh, i: (bh, i, 0)),
+        in_specs=[
+            pl.BlockSpec((1, block, D), lambda bh, i, *_: (bh, i, 0)),
+            pl.BlockSpec((1, T, D), lambda bh, i, *_: (bh, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda bh, i, *_: (bh, 0, 0)),
+            pl.BlockSpec((1, block, D), lambda bh, i, *_: (bh, i, 0)),
+            pl.BlockSpec((1, block, D), lambda bh, i, *_: (bh, i, 0)),
+            pl.BlockSpec((1, block, LANES), lambda bh, i, *_: (bh, i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block, D), lambda bh, i: (bh, i, 0)),
+        out_specs=pl.BlockSpec((1, block, D), lambda bh, i, *_: (bh, i, 0)),
+    )
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, H=H, sm_scale=sm_scale, causal=causal,
+                          block=block),
+        grid_spec=dq_spec,
         out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
         interpret=_interpret(),
     )(kidx, kcnt, q, k, v, o, do, lse)
 
-    dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, block=block),
+    dkv_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
         grid=(BH, T // block),
-        in_specs=_tbl_specs(Aq, H) + [
-            pl.BlockSpec((1, T, D), lambda bh, j: (bh, 0, 0)),
-            pl.BlockSpec((1, block, D), lambda bh, j: (bh, j, 0)),
-            pl.BlockSpec((1, block, D), lambda bh, j: (bh, j, 0)),
-            pl.BlockSpec((1, T, D), lambda bh, j: (bh, 0, 0)),
-            pl.BlockSpec((1, T, D), lambda bh, j: (bh, 0, 0)),
-            pl.BlockSpec((1, T, LANES), lambda bh, j: (bh, 0, 0)),
+        in_specs=[
+            pl.BlockSpec((1, T, D), lambda bh, j, *_: (bh, 0, 0)),
+            pl.BlockSpec((1, block, D), lambda bh, j, *_: (bh, j, 0)),
+            pl.BlockSpec((1, block, D), lambda bh, j, *_: (bh, j, 0)),
+            pl.BlockSpec((1, T, D), lambda bh, j, *_: (bh, 0, 0)),
+            pl.BlockSpec((1, T, D), lambda bh, j, *_: (bh, 0, 0)),
+            pl.BlockSpec((1, T, LANES), lambda bh, j, *_: (bh, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block, D), lambda bh, j: (bh, j, 0)),
-            pl.BlockSpec((1, block, D), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, block, D), lambda bh, j, *_: (bh, j, 0)),
+            pl.BlockSpec((1, block, D), lambda bh, j, *_: (bh, j, 0)),
         ],
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, H=H, sm_scale=sm_scale, causal=causal,
+                          block=block),
+        grid_spec=dkv_spec,
         out_shape=[
             jax.ShapeDtypeStruct((BH, T, D), k.dtype),
             jax.ShapeDtypeStruct((BH, T, D), v.dtype),

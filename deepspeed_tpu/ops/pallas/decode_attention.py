@@ -7,6 +7,12 @@ softmax.cu`` + ``pt_binding.cpp`` attention bindings, workspace layout
 validity mask, in one kernel, without materializing [B, H, S] probabilities in
 HBM.
 
+Layout is [B, H, S, Dh] — sequence in the sublane dimension, head_dim in the
+lane dimension — so every block the kernel touches is Mosaic-tileable: K/V
+stream as (block_k, Dh) tiles (block_k a multiple of the sublane tile, Dh the
+full lane extent) and the q/out blocks are full-dim (1, Dh) slices. The head
+and batch axes are size-1 leading block dims selected by the grid index map.
+
 Grid = (B, H, S/block_k): the cache's sequence dimension is a GRID axis, so each
 program instance holds only one [block_k, Dh] K/V tile in VMEM — long contexts
 stream tile by tile (TPU iterates the innermost grid dimension sequentially on
@@ -46,8 +52,8 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
     @pl.when(ki * block_k < cur)  # tiles wholly past the valid length: no work
     def _tile():
         q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # [1, Dh]
-        k = k_ref[0, :, 0, :].astype(jnp.float32)  # [Bk, Dh]
-        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)  # [Bk, Dh]
+        v = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [1, Bk]
         s_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
         s = jnp.where(s_pos < cur, s, NEG_INF)
@@ -69,7 +75,7 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
 
 def decode_attention(
     q: jnp.ndarray,  # [B, 1, H, Dh] — the new token's query
-    k_cache: jnp.ndarray,  # [B, S, H, Dh]
+    k_cache: jnp.ndarray,  # [B, H, S, Dh]
     v_cache: jnp.ndarray,
     cur_len: jnp.ndarray,  # scalar int32: valid cache entries INCLUDING the new token
     softmax_scale: Optional[float] = None,
@@ -78,15 +84,16 @@ def decode_attention(
     """Returns [B, 1, H, Dh]. The new token's k/v must already be in the cache."""
     B, one, H, Dh = q.shape
     assert one == 1
-    S = k_cache.shape[1]
+    S = k_cache.shape[2]
     # largest power-of-two tile that divides S (engines should pad the cache to
-    # a 128-multiple so tiles stay full-lane)
+    # a 128-multiple so tiles stay sublane-aligned)
     block_k = min(block_k, S)
     while block_k > 1 and S % block_k:
         block_k //= 2
     num_blocks = S // block_k
     scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(Dh)
     lens = jnp.broadcast_to(jnp.asarray(cur_len, jnp.int32), (1, 1))
+    qh = q.transpose(0, 2, 1, 3)  # [B, H, 1, Dh] — heads lead, like the cache
 
     out = pl.pallas_call(
         functools.partial(_decode_kernel, sm_scale=scale, block_k=block_k,
@@ -94,17 +101,17 @@ def decode_attention(
         grid=(B, H, num_blocks),
         in_specs=[
             pl.BlockSpec((1, 1), lambda b, h, ki: (0, 0)),
-            pl.BlockSpec((1, 1, 1, Dh), lambda b, h, ki: (b, 0, h, 0)),
-            pl.BlockSpec((1, block_k, 1, Dh), lambda b, h, ki: (b, ki, h, 0)),
-            pl.BlockSpec((1, block_k, 1, Dh), lambda b, h, ki: (b, ki, h, 0)),
+            pl.BlockSpec((1, 1, 1, Dh), lambda b, h, ki: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, Dh), lambda b, h, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, Dh), lambda b, h, ki: (b, h, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, 1, Dh), lambda b, h, ki: (b, 0, h, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, 1, H, Dh), q.dtype),
+        out_specs=pl.BlockSpec((1, 1, 1, Dh), lambda b, h, ki: (b, h, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, 1, Dh), q.dtype),
         scratch_shapes=[
             pltpu.VMEM((1, Dh), jnp.float32),
             pltpu.VMEM((1, 1), jnp.float32),
             pltpu.VMEM((1, 1), jnp.float32),
         ],
         interpret=_interpret(),
-    )(lens, q, k_cache, v_cache)
-    return out
+    )(lens, qh, k_cache, v_cache)
+    return out.transpose(0, 2, 1, 3)  # back to [B, 1, H, Dh]

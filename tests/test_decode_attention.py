@@ -10,22 +10,23 @@ from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
 
 
 def _dense_decode(q, k_cache, v_cache, cur_len):
+    """q: [B, 1, H, Dh]; k_cache/v_cache: [B, H, S, Dh]."""
     B, _, H, Dh = q.shape
-    S = k_cache.shape[1]
-    s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+    S = k_cache.shape[2]
+    s = jnp.einsum("bthd,bhsd->bhts", q.astype(jnp.float32),
                    k_cache.astype(jnp.float32)) / np.sqrt(Dh)
     mask = jnp.arange(S)[None, None, None, :] < cur_len
     s = jnp.where(mask, s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhts,bshd->bthd", p, v_cache.astype(jnp.float32))
+    return jnp.einsum("bhts,bhsd->bthd", p, v_cache.astype(jnp.float32))
 
 
 @pytest.mark.parametrize("cur_len", [1, 7, 16, 32])
 def test_decode_matches_dense(rng, cur_len):
     B, S, H, Dh = 2, 32, 4, 16
     q = jnp.asarray(rng.normal(size=(B, 1, H, Dh)), jnp.float32)
-    k = jnp.asarray(rng.normal(size=(B, S, H, Dh)), jnp.float32)
-    v = jnp.asarray(rng.normal(size=(B, S, H, Dh)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, H, S, Dh)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, H, S, Dh)), jnp.float32)
     out = decode_attention(q, k, v, jnp.int32(cur_len), block_k=8)
     ref = _dense_decode(q, k, v, cur_len)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4)
@@ -35,8 +36,8 @@ def test_decode_length_is_traced(rng):
     """One compiled kernel must serve every decode step (length as data)."""
     B, S, H, Dh = 1, 16, 2, 8
     q = jnp.asarray(rng.normal(size=(B, 1, H, Dh)), jnp.float32)
-    k = jnp.asarray(rng.normal(size=(B, S, H, Dh)), jnp.float32)
-    v = jnp.asarray(rng.normal(size=(B, S, H, Dh)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, H, S, Dh)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, H, S, Dh)), jnp.float32)
 
     f = jax.jit(lambda q, k, v, n: decode_attention(q, k, v, n, block_k=8))
     for n in (1, 5, 12):
