@@ -60,6 +60,54 @@ def initialize(
     ds_config = cfg if isinstance(cfg, DeepSpeedConfig) else DeepSpeedConfig.load(
         cfg, world_size=jax.device_count())
     from .ops.optimizers import Optimizer as _Opt
+    from .runtime.pipe.module import PipelineModule
+
+    if optimizer is not None and not isinstance(optimizer, _Opt):
+        raise TypeError(
+            "client optimizer must be a deepspeed_tpu.ops.optimizers.Optimizer "
+            f"(got {type(optimizer)})")
+
+    # A PipelineModule (heterogeneous layer-spec list) trains on the MPMD
+    # interpreter with the engine's real optimizer/precision/checkpoint stack
+    # (parity: deepspeed.initialize returning a PipelineEngine,
+    # deepspeed/__init__.py:124-148).
+    if isinstance(model, PipelineModule):
+        from .runtime.pipe.engine import PipelineEngine as _PipeEngineT
+
+        if topology is not None:
+            raise ValueError(
+                "topology is not supported with a PipelineModule — the MPMD "
+                "PipelineEngine builds its own stage-per-device grid from "
+                "config.mesh.dp; use mesh.pp>1 with a functional model for "
+                "mesh-based pipelining")
+        engine = _PipeEngineT(
+            module=model,
+            config=ds_config,
+            lr_scheduler_fn=lr_scheduler if callable(lr_scheduler) else None,
+            client_optimizer=optimizer,
+            seed=seed,
+        )
+        dataloader = None
+        if training_data is not None:
+            from .runtime.dataloader import DeepSpeedDataLoader
+
+            dataloader = DeepSpeedDataLoader(
+                training_data,
+                batch_size=engine.micro_batch_size * engine.M * engine.dp)
+        return engine, engine.optimizer, dataloader, engine.lr_fn
+
+    # pp > 1 with a pipeline-capable functional model: rebuild it as the SPMD
+    # collective-permute pipeline (layer stack sharded over the pp mesh axis)
+    # and train it through the standard engine — ZeRO over dp, precision,
+    # checkpointing all apply unchanged.
+    if ds_config.mesh.pp > 1 and not model.pipelined:
+        if model.to_pipeline is None:
+            raise ValueError(
+                f"mesh.pp={ds_config.mesh.pp} requires a pipeline-capable model: "
+                "pass a Module with to_pipeline (models.build_gpt provides one) "
+                "or a PipelineModule")
+        num_micro = ds_config.pipeline.micro_batches or 2 * ds_config.mesh.pp
+        model = model.to_pipeline(ds_config.mesh.pp, num_micro)
 
     engine = DeepSpeedEngine(
         model=model,
@@ -67,12 +115,8 @@ def initialize(
         topology=topology,
         seed=seed,
         lr_scheduler_fn=lr_scheduler if callable(lr_scheduler) else None,
-        client_optimizer=optimizer if isinstance(optimizer, _Opt) else None,
+        client_optimizer=optimizer,
     )
-    if optimizer is not None and not isinstance(optimizer, _Opt):
-        raise TypeError(
-            "client optimizer must be a deepspeed_tpu.ops.optimizers.Optimizer "
-            f"(got {type(optimizer)})")
     training_dataloader = None
     if training_data is not None:
         from .runtime.dataloader import DeepSpeedDataLoader

@@ -43,11 +43,20 @@ def replicated_specs(param_shapes) -> Any:
 
 @dataclasses.dataclass(frozen=True)
 class Module:
-    """A trainable model: functional (init, apply, partition_specs)."""
+    """A trainable model: functional (init, apply, partition_specs).
+
+    ``to_pipeline(num_stages, num_micro) -> Module`` (optional) rebuilds this
+    model as its pipeline-parallel variant — layer stack sharded over the ``pp``
+    mesh axis, micro-batches streamed by collective-permute pipelining. The
+    engine calls it from ``initialize()`` when the mesh requests ``pp > 1``
+    (parity: ``deepspeed.initialize`` returning a ``PipelineEngine`` for a
+    ``PipelineModule``, ``deepspeed/__init__.py:124-148``)."""
 
     init: Callable[[jax.Array], Params]
     apply: Callable[..., Tuple[jax.Array, Dict[str, Any]]]
     partition_specs: Optional[Callable[[Any], Any]] = None
+    to_pipeline: Optional[Callable[[int, int], "Module"]] = None
+    pipelined: bool = False  # True: apply() already pipelines over the pp axis
 
     def specs(self, param_shapes) -> Any:
         if self.partition_specs is None:

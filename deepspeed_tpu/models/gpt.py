@@ -475,9 +475,16 @@ def build(cfg_or_name) -> Tuple[Module, GPTConfig]:
     """Build a GPT :class:`Module` from a config or preset name."""
     cfg = PRESETS[cfg_or_name] if isinstance(cfg_or_name, str) else cfg_or_name
 
+    def to_pipeline(num_stages: int, num_micro: int) -> Module:
+        from . import gpt_pipe
+
+        module, _ = gpt_pipe.build(cfg, num_stages, num_micro)
+        return module
+
     return Module(
         init=functools.partial(init_params, cfg),
         apply=lambda params, batch, rngs=None, train=True: loss_fn(
             cfg, params, batch, rngs=rngs, train=train),
         partition_specs=functools.partial(partition_specs, cfg),
+        to_pipeline=to_pipeline,
     ), cfg

@@ -117,4 +117,5 @@ def build(cfg_or_name, num_stages: int, num_micro: int) -> Tuple[Module, G.GPTCo
         apply=lambda params, batch, rngs=None, train=True: loss_fn(
             cfg, num_stages, num_micro, params, batch, rngs=rngs, train=train),
         partition_specs=functools.partial(partition_specs, cfg, num_stages),
+        pipelined=True,
     ), cfg

@@ -143,11 +143,19 @@ class MeshTopologyConfig(DeepSpeedConfigModel):
 
 
 class PipelineConfig(DeepSpeedConfigModel):
-    """Parity: engine pipeline knobs (``runtime/pipe/module.py:86`` args)."""
+    """Parity: engine pipeline knobs (``runtime/pipe/module.py:86`` args).
+
+    ``micro_batches``: pipeline micro-batches per ``train_batch``. 0 picks a
+    path-specific default: the SPMD mesh path (functional model, ``mesh.pp>1``)
+    uses ``2 * pp`` — gradient accumulation composes on top as an outer loop —
+    while the MPMD ``PipelineModule`` path uses ``gradient_accumulation_steps``
+    when it is >1 (the reference's ``engine.micro_batches = gas`` contract,
+    ``runtime/pipe/engine.py:37``), else ``2 * pp``."""
 
     stages: int = 1
     partition_method: str = "parameters"
     activation_checkpoint_interval: int = 0
+    micro_batches: int = 0
 
 
 class EigenvalueConfig(DeepSpeedConfigModel):

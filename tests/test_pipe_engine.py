@@ -1,0 +1,170 @@
+"""Pipeline training through the PUBLIC initialize() API (VERDICT r2 'next' #3).
+
+Parity target: ``deepspeed.initialize`` returning a ``PipelineEngine`` for a
+``PipelineModule`` (``/root/reference/deepspeed/__init__.py:124-148``) with the
+full engine contract — real optimizer, precision, DP grad handling, pipeline
+checkpointing (``/root/reference/deepspeed/runtime/pipe/engine.py:37``,
+``module.py:533-590``).
+
+Two public paths:
+- SPMD: mesh.pp > 1 + a pipeline-capable Module (``Module.to_pipeline``) →
+  the dense engine trains the collective-permute pipeline; ZeRO/precision/
+  checkpointing unchanged. Exercised at pp=2 x dp=2 x tp=2.
+- MPMD: a PipelineModule (heterogeneous layer specs) → PipelineEngine with the
+  configured optimizer, bf16 master/compute split, DP replicas, checkpointing.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import build_gpt, gpt
+from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+
+from test_pipe import _tiny_lm_module
+
+
+def _tiny_cfg():
+    return gpt.GPTConfig(vocab_size=64, n_layer=2, n_head=2, d_model=32,
+                         max_seq_len=32, dropout=0.0)
+
+
+# ------------------------------------------------------------------- SPMD path
+def test_initialize_auto_pipelines_plain_model():
+    """A PLAIN build_gpt model + mesh.pp>1 must train pipelined end to end:
+    initialize() converts it via Module.to_pipeline (pp=2 x dp=2 x tp=2, ZeRO-1,
+    bf16 off for exact ckpt comparison)."""
+    model, _ = build_gpt(_tiny_cfg())
+    assert model.to_pipeline is not None
+    config = {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 1},
+        "mesh": {"pp": 2, "dp": 2, "tp": 2},
+        "pipeline": {"micro_batches": 2},
+        "bf16": {"enabled": False},
+        "steps_per_print": 0,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config)
+    r = np.random.default_rng(0)
+    ids = r.integers(0, 64, size=(4, 16), dtype=np.int32)
+    losses = [float(engine.train_batch({"input_ids": ids})["loss"])
+              for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_initialize_pp_without_pipeline_model_raises():
+    from deepspeed_tpu.models.api import Module
+
+    bare = Module(init=lambda rng: {}, apply=lambda p, b, **k: (jnp.float32(0), {}))
+    with pytest.raises(ValueError, match="pipeline-capable"):
+        ds.initialize(model=bare, config={
+            "train_micro_batch_size_per_gpu": 1, "mesh": {"pp": 2, "dp": 4}})
+
+
+def test_pp_dp_tp_zero3_checkpoint_roundtrip(tmp_path):
+    """pp=2 x dp=2 x tp=2 with ZeRO-3 param sharding: train, checkpoint, reload
+    into a FRESH engine, and the restored state must continue identically."""
+    model, _ = build_gpt(_tiny_cfg())
+    config = {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "AdamW", "params": {"lr": 5e-3}},
+        "zero_optimization": {"stage": 3, "stage3_param_persistence_threshold": 0},
+        "mesh": {"pp": 2, "dp": 2, "tp": 2},
+        "pipeline": {"micro_batches": 2},
+        "bf16": {"enabled": False},
+        "steps_per_print": 0,
+    }
+    r = np.random.default_rng(1)
+    ids = r.integers(0, 64, size=(4, 16), dtype=np.int32)
+
+    engine, _, _, _ = ds.initialize(model=model, config=config)
+    for _ in range(3):
+        m = engine.train_batch({"input_ids": ids})
+    engine.save_checkpoint(str(tmp_path))
+    ref = float(engine.train_batch({"input_ids": ids})["loss"])
+
+    model2, _ = build_gpt(_tiny_cfg())
+    engine2, _, _, _ = ds.initialize(model=model2, config=config)
+    path, _ = engine2.load_checkpoint(str(tmp_path))
+    assert path is not None
+    got = float(engine2.train_batch({"input_ids": ids})["loss"])
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+# ------------------------------------------------------------------- MPMD path
+def _mpmd_config(dp=1, micro=4, lr=1e-2, opt="Adam"):
+    return {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": opt, "params": {"lr": lr}},
+        "mesh": {"dp": dp},
+        "pipeline": {"micro_batches": micro},
+        "gradient_clipping": 1.0,
+        "bf16": {"enabled": False},
+        "steps_per_print": 0,
+    }
+
+
+def test_initialize_returns_pipeline_engine_for_pipeline_module():
+    module, _ = _tiny_lm_module(num_stages=4)
+    engine, opt, _, _ = ds.initialize(model=module, config=_mpmd_config())
+    assert isinstance(engine, PipelineEngine)
+    assert opt is engine.optimizer
+
+    r = np.random.default_rng(0)
+    batch = {"input_ids": r.integers(0, 31, size=(8, 12), dtype=np.int32)}
+    losses = [float(engine.train_batch(batch)["loss"]) for _ in range(6)]
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(engine.get_global_grad_norm())
+    # 1F1B residency bound still holds through the public engine
+    S = module.num_stages
+    assert engine.peak_live_buffers == [min(S - s, 4) for s in range(S)]
+
+
+def test_pipeline_engine_dp_replicas_match_single():
+    """dp=2 replica-averaged grads == one replica over the concatenated batch
+    (same loss trajectory, the pipeline-boundary DP allreduce semantics)."""
+    r = np.random.default_rng(0)
+    batch = {"input_ids": r.integers(0, 31, size=(8, 12), dtype=np.int32)}
+
+    module1, _ = _tiny_lm_module(num_stages=2)
+    e1, _, _, _ = ds.initialize(model=module1, config=_mpmd_config(dp=1, micro=4))
+    module2, _ = _tiny_lm_module(num_stages=2)
+    e2, _, _, _ = ds.initialize(model=module2, config=_mpmd_config(dp=2, micro=2))
+
+    for _ in range(3):
+        m1 = e1.train_batch(batch)
+        m2 = e2.train_batch(batch)
+        np.testing.assert_allclose(m1["loss"], m2["loss"], rtol=1e-5)
+        np.testing.assert_allclose(m1["grad_norm"], m2["grad_norm"], rtol=1e-4)
+
+
+def test_pipeline_engine_checkpoint_roundtrip(tmp_path):
+    module, _ = _tiny_lm_module(num_stages=2)
+    engine, _, _, _ = ds.initialize(model=module, config=_mpmd_config())
+    r = np.random.default_rng(0)
+    batch = {"input_ids": r.integers(0, 31, size=(8, 12), dtype=np.int32)}
+    for _ in range(3):
+        engine.train_batch(batch)
+    engine.save_checkpoint(str(tmp_path))
+    ref = float(engine.train_batch(batch)["loss"])
+
+    module2, _ = _tiny_lm_module(num_stages=2)
+    engine2, _, _, _ = ds.initialize(model=module2, config=_mpmd_config())
+    path, _ = engine2.load_checkpoint(str(tmp_path))
+    assert path is not None
+    got = float(engine2.train_batch(batch)["loss"])
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_pipeline_engine_eval_batch():
+    module, _ = _tiny_lm_module(num_stages=2)
+    engine, _, _, _ = ds.initialize(model=module, config=_mpmd_config())
+    r = np.random.default_rng(0)
+    batch = {"input_ids": r.integers(0, 31, size=(8, 12), dtype=np.int32)}
+    out = engine.eval_batch(batch)
+    assert out.shape[0] == 4  # M micro-batches stacked
+    assert np.all(np.isfinite(np.asarray(out)))
