@@ -320,7 +320,15 @@ def forward(cfg: GPTConfig, params: Dict[str, Any], input_ids: jnp.ndarray,
         x = block_fn(x, layer_w, positions, lrng)
         return (x, i + 1), None
 
-    (x, _), _ = jax.lax.scan(body, (x, jnp.int32(0)), params["blocks"])
+    # layer loop with explicit ZeRO-3 gather windowing (stage3_max_live_parameters
+    # / stage3_prefetch_bucket_size; plain per-layer scan when unconfigured)
+    from ..runtime.zero.gather import zero3_layer_scan
+
+    layer_specs = jax.tree_util.tree_map(
+        lambda s: P(*tuple(s)[1:]), partition_specs(cfg, None)["blocks"],
+        is_leaf=lambda s: isinstance(s, P))
+    (x, _) = zero3_layer_scan(body, (x, jnp.int32(0)), params["blocks"],
+                              gathered_spec=layer_specs)
     x = layer_norm(x, params["lnf_scale"], params["lnf_bias"], cfg.layer_norm_eps)
     head = params["wte"] if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))

@@ -277,7 +277,12 @@ class DeepSpeedEngine:
             loss, aux = out if isinstance(out, tuple) else (out, {})
             return loss.astype(jnp.float32) * scale, (loss, aux)
 
-        grads, (loss, aux) = jax.grad(loss_fn, has_aux=True)(params)
+        from .zero.gather import gather_window
+
+        # trace-time binding of the stage-3 gather knobs (zero3_layer_scan
+        # windows the layer loop accordingly; no-op below stage 3)
+        with gather_window(self.config.zero_optimization):
+            grads, (loss, aux) = jax.grad(loss_fn, has_aux=True)(params)
         inv = 1.0 / scale
         grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) * inv, grads)
         grads = _constrain(grads, self.grad_shardings)

@@ -50,6 +50,7 @@ from ...utils.logging import log_dist
 from ..config import DeepSpeedConfig
 from ..lr_schedules import schedule_fn_from_config
 from ..precision import PrecisionConfig, init_scaler_state
+from ..utils import clip_by_global_norm, global_norm
 from .module import PipelineModule
 from .mpmd import MPMDPipelineEngine
 from .spmd import split_microbatches
@@ -73,7 +74,15 @@ class PipelineEngine:
         self.pc = PrecisionConfig.from_ds_config(config)
         self.S = module.num_stages
         gas = int(config.gradient_accumulation_steps or 1)
-        self.M = int(config.pipeline.micro_batches or (gas if gas > 1 else 2 * self.S))
+        micro = int(config.pipeline.micro_batches or 0)
+        if micro and gas > 1 and micro != gas:
+            # parity: the reference PipelineEngine enforces micro_batches == gas
+            # (its micro-batching IS the gradient accumulation)
+            raise ValueError(
+                f"pipeline.micro_batches={micro} conflicts with "
+                f"gradient_accumulation_steps={gas}: on the pipeline engine "
+                "micro-batching IS gradient accumulation — set one of them")
+        self.M = micro or (gas if gas > 1 else 2 * self.S)
         self.micro_batch_size = int(config.train_micro_batch_size_per_gpu or 1)
 
         # DP x PP device grid: replica r owns devices [r*S, (r+1)*S) (wrapping
@@ -131,11 +140,11 @@ class PipelineEngine:
         self._grad_acc = None  # checkpoint-surface parity with DeepSpeedEngine
         self._last_metrics: Dict[str, Any] = {}
         self._update_jit = jax.jit(self._stage_update)
-        self._sq_jit = jax.jit(
-            lambda t: sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
-                          for x in jax.tree_util.tree_leaves(t)))
-        self._scale_jit = jax.jit(
-            lambda t, c: jax.tree_util.tree_map(lambda x: x * c, t))
+        self._sq_jit = jax.jit(lambda t: jnp.square(global_norm(t)))
+        # per-stage clip against the precomputed GLOBAL norm (shared coefficient)
+        self._clip_jit = jax.jit(
+            lambda t, norm: clip_by_global_norm(
+                t, float(self.config.gradient_clipping or 0.0), norm=norm)[0])
         log_dist(
             f"pipeline engine ready: {self.S} stages x {self.dp} replicas, "
             f"{self.M} micro-batches, dtype {jnp.dtype(self.pc.compute_dtype).name}, "
@@ -160,6 +169,12 @@ class PipelineEngine:
         [dp * M * micro_bs, ...] (or [M * micro_bs, ...] when dp == 1)."""
         params = self.state["params"]
         compute = _tree_cast(params, self.pc.compute_dtype)
+
+        B = jax.tree_util.tree_leaves(batch)[0].shape[0]
+        if B % (self.dp * self.M):
+            raise ValueError(
+                f"batch size {B} must divide by dp ({self.dp}) x "
+                f"micro_batches ({self.M}) — no rows may be silently dropped")
 
         # split [B, ...] -> per-replica [M, mb, ...]
         def replica_batch(r):
@@ -205,10 +220,10 @@ class PipelineEngine:
         gnorm = self._global_grad_norm(grads)
         clip = float(self.config.gradient_clipping or 0.0)
         if clip > 0.0 and gnorm > clip:
-            coef = jnp.float32(clip / (gnorm + 1e-6))
+            norm = jnp.float32(gnorm)
             grads = {
-                "stages": [self._scale_jit(g, coef) for g in grads["stages"]],
-                "tied": (self._scale_jit(grads["tied"], coef)
+                "stages": [self._clip_jit(g, norm) for g in grads["stages"]],
+                "tied": (self._clip_jit(grads["tied"], norm)
                          if grads["tied"] else grads["tied"]),
             }
 
@@ -246,18 +261,28 @@ class PipelineEngine:
         return metrics
 
     def eval_batch(self, batch) -> jnp.ndarray:
-        """Forward-only pipelined evaluation (InferenceSchedule); returns the
-        last stage's outputs stacked [M, ...] for replica 0."""
+        """Forward-only pipelined evaluation (InferenceSchedule). Every DP
+        replica evaluates its slice; returns the last stage's outputs stacked
+        [dp * M, ...]."""
         compute = _tree_cast(self.state["params"], self.pc.compute_dtype)
-        eng = self._replicas[0]
-        rp = {
-            "stages": [jax.device_put(compute["stages"][s], eng.devices[s])
-                       for s in range(self.S)],
-            "tied": jax.device_put(compute["tied"], eng.devices[0]),
-        }
-        per_replica = jax.tree_util.tree_map(
-            lambda leaf: leaf[: leaf.shape[0] // self.dp], batch)
-        return eng.forward_batch(rp, split_microbatches(per_replica, self.M))
+        B = jax.tree_util.tree_leaves(batch)[0].shape[0]
+        if B % (self.dp * self.M):
+            raise ValueError(
+                f"batch size {B} must divide by dp ({self.dp}) x "
+                f"micro_batches ({self.M})")
+        outs = []
+        for r, eng in enumerate(self._replicas):
+            rp = {
+                "stages": [jax.device_put(compute["stages"][s], eng.devices[s])
+                           for s in range(self.S)],
+                "tied": jax.device_put(compute["tied"], eng.devices[0]),
+            }
+            sl = jax.tree_util.tree_map(
+                lambda leaf: leaf[r * (B // self.dp):(r + 1) * (B // self.dp)],
+                batch)
+            outs.append(eng.forward_batch(rp, split_microbatches(sl, self.M)))
+        return jnp.concatenate([jax.device_put(o, self._replicas[0].devices[-1])
+                                for o in outs], axis=0)
 
     # ------------------------------------------------------------------ info
     @property

@@ -61,7 +61,7 @@ def run_one(spec: dict) -> dict:
     tok = steps * micro_bs * (seq - 1) / dt
     n_params = mcfg.num_params()
     fpt = 6 * n_params + 12 * mcfg.n_layer * mcfg.d_model * seq
-    mfu = tok * fpt / 197e12
+    mfu = tok * fpt / (197e12 * jax.device_count())  # v5e bf16 peak per chip
     return {**spec, "step_ms": round(dt / steps * 1e3, 1),
             "tok_s": round(tok, 1), "mfu": round(mfu, 4),
             "peak_hbm_gb": round(peak_gb, 2)}
@@ -89,13 +89,17 @@ def main():
         return
     results = []
     for spec in grid:
-        p = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--one", json.dumps(spec)],
-            capture_output=True, text=True, timeout=1200, cwd=REPO)
-        line = next((ln for ln in reversed(p.stdout.strip().splitlines())
-                     if ln.startswith("{")), None)
-        r = json.loads(line) if line else {"tag": spec["tag"],
-                                           "error": p.stderr[-300:]}
+        try:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--one",
+                 json.dumps(spec)],
+                capture_output=True, text=True, timeout=1200, cwd=REPO)
+            line = next((ln for ln in reversed(p.stdout.strip().splitlines())
+                         if ln.startswith("{")), None)
+            r = json.loads(line) if line else {"tag": spec["tag"],
+                                               "error": p.stderr[-300:]}
+        except subprocess.TimeoutExpired:
+            r = {"tag": spec["tag"], "error": "timed out after 1200s"}
         results.append(r)
         print(json.dumps(r), flush=True)
     with open(os.path.join(REPO, "mfu_sweep_results.json"), "w") as f:
