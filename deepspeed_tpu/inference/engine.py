@@ -52,7 +52,8 @@ class InferenceEngine:
                  topology: Optional[MeshTopology] = None):
         self.config = config or DeepSpeedInferenceConfig()
         tp = self.config.tensor_parallel.tp_size
-        self.topo = topology or MeshTopology.create(tp=tp)
+        ep = self.config.moe.ep_size  # expert-parallel decode (moe{ep_size})
+        self.topo = topology or MeshTopology.create(tp=tp, ep=ep)
         self.mesh = self.topo.mesh
         self.model = model
         self.dtype = self.config.jax_dtype()
@@ -64,26 +65,23 @@ class InferenceEngine:
         params = jax.tree_util.tree_map(
             lambda x: x.astype(self.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
             model.params)
-        shapes = jax.eval_shape(lambda: params)
-        specs = model.partition_specs(shapes) if hasattr(model, "partition_specs") else None
-        if specs is None and tp > 1:
-            # AutoTP: infer Megatron-style specs for unknown trees
-            # (parity: module_inject/auto_tp.py:7)
-            from ..module_inject import auto_tp_specs
 
-            specs = auto_tp_specs(params, tp_size=tp)
-            log_dist("inference engine: AutoTP-inferred tensor-parallel sharding")
-        if specs is not None:
-            specs = self._sanitize_specs(params, specs)
-
-        # int8 weight-only storage quantization (parity: GroupQuantizer,
-        # module_inject/replace_module.py:144). NOTE current memory semantics:
-        # int8 + scales are what live at rest / in checkpoints and transfers;
-        # inside a compiled generate the dequantized compute-dtype tree is
-        # loop-invariant across the scan, so peak HBM during generation is NOT
-        # reduced (per-layer in-scan dequant is a later optimization)
+        # int8 weight-only quantization (parity: GroupQuantizer,
+        # module_inject/replace_module.py:144). Preferred path: the model
+        # quantizes its own layer stack ({"q","s"} leaves) and dequantizes ONE
+        # layer inside the decode scan — peak HBM holds int8 weights + a single
+        # layer's compute-dtype copy. Fallback (models without quantize_params):
+        # whole-tree dequant inside the compiled fn (storage-only savings).
         self._quant_scales = None
-        if self.config.quant.enabled:
+        self._per_layer_quant = False
+        if self.config.quant.enabled and hasattr(model, "quantize_params"):
+            params = model.quantize_params(
+                params, bits=self.config.quant.bits,
+                group_size=self.config.quant.group_size)
+            self._per_layer_quant = True
+            log_dist(f"inference engine: int{self.config.quant.bits} layer-stack "
+                     "weights, in-scan per-layer dequant")
+        elif self.config.quant.enabled:
             from ..compression import quantize_params_for_inference
 
             params, scales, meta = quantize_params_for_inference(
@@ -92,6 +90,22 @@ class InferenceEngine:
             self._quant_scales = scales
             log_dist(f"inference engine: int{self.config.quant.bits} weights for "
                      f"{len(meta['quantized'])} tensors")
+
+        shapes = jax.eval_shape(lambda: params)
+        specs = model.partition_specs(shapes) if hasattr(model, "partition_specs") else None
+        if specs is None and tp > 1 and not self._per_layer_quant:
+            # AutoTP: infer Megatron-style specs for unknown trees
+            # (parity: module_inject/auto_tp.py:7)
+            from ..module_inject import auto_tp_specs
+
+            specs = auto_tp_specs(params, tp_size=tp)
+            log_dist("inference engine: AutoTP-inferred tensor-parallel sharding")
+        if specs is not None:
+            if self._per_layer_quant:
+                from ..models.gpt import quantized_partition_specs
+
+                specs = quantized_partition_specs(params, specs)
+            specs = self._sanitize_specs(params, specs)
 
         if specs is not None:
             self.params = jax.tree_util.tree_map(
@@ -252,7 +266,41 @@ class _GPTInferenceAdapter:
     def partition_specs(self, shapes):
         return gpt_mod.partition_specs(self.cfg, shapes)
 
+    def quantize_params(self, params, bits: int, group_size: int):
+        return gpt_mod.quantize_for_inference(self.cfg, params, bits=bits,
+                                              group_size=group_size)
+
 
 def for_gpt(cfg: gpt_mod.GPTConfig, params) -> _GPTInferenceAdapter:
     """Adapter: GPT config + trained params -> InferenceEngine model."""
     return _GPTInferenceAdapter(cfg, params)
+
+
+class _GPTMoEInferenceAdapter:
+    """Expert-parallel generate: the MoE cached forward dispatches tokens over
+    the ``ep`` mesh axis inside every decode step (parity: the reference's MoE
+    inference layer, ``ops/transformer/inference/moe_inference.py``)."""
+
+    def __init__(self, cfg, params):
+        self.cfg = cfg
+        self.params = params
+
+    def init_cache(self, batch: int, max_len: int, dtype):
+        from ..models import gpt_moe
+
+        return gpt_moe.init_cache(self.cfg, batch, max_len, dtype)
+
+    def prefill(self, params, input_ids, cache):
+        from ..models import gpt_moe
+
+        return gpt_moe.forward_with_cache(self.cfg, params, input_ids, cache)
+
+    def partition_specs(self, shapes):
+        from ..models import gpt_moe
+
+        return gpt_moe.partition_specs(self.cfg, shapes)
+
+
+def for_gpt_moe(cfg, params) -> _GPTMoEInferenceAdapter:
+    """Adapter: GPT-MoE config + trained params -> InferenceEngine model."""
+    return _GPTMoEInferenceAdapter(cfg, params)

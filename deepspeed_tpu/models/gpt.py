@@ -379,6 +379,60 @@ def loss_fn(cfg: GPTConfig, params, batch: Dict[str, jnp.ndarray],
         cfg.max_seq_len, batch)
 
 
+# ------------------------------------------------------------- int8 weights
+def quantize_for_inference(cfg: GPTConfig, params, bits: int = 8,
+                           group_size: int = 64):
+    """Replace the stacked block weight matrices with per-layer-grouped int8
+    ``{"q", "s"}`` leaves. The cached forward dequantizes ONE layer inside the
+    scan body, so peak HBM holds the int8 stack plus a single layer's
+    compute-dtype copy — never a full dequantized tree (parity goal: the
+    reference's int8 inference kernels consuming quantized weights directly,
+    ``csrc/transformer/inference/csrc/dequantize.cu`` + GroupQuantizer,
+    ``module_inject/replace_module.py:144``)."""
+    from ..ops.quantizer import quantize
+
+    L = cfg.n_layer
+    blocks = {}
+    for k, v in params["blocks"].items():
+        per_layer = int(v.size) // L
+        if v.ndim >= 3 and per_layer % group_size == 0 and not k.startswith("ln"):
+            ng_l = max(1, per_layer // group_size)
+            q, s = quantize(v, bits=bits, num_groups=L * ng_l)
+            blocks[k] = {"q": q, "s": s.reshape(L, ng_l)}
+        else:
+            blocks[k] = v
+    out = dict(params)
+    out["blocks"] = blocks
+    return out
+
+
+def _is_qleaf(v) -> bool:
+    return isinstance(v, dict) and set(v.keys()) == {"q", "s"}
+
+
+def _dequant_layer(w, dtype):
+    """Dequantize one scan-sliced layer's quantized leaves to ``dtype``."""
+    from ..ops.quantizer import dequantize
+
+    return {k: (dequantize(v["q"], v["s"].reshape(-1), dtype=dtype)
+                if _is_qleaf(v) else v)
+            for k, v in w.items()}
+
+
+def quantized_partition_specs(params, specs):
+    """Expand spec leaves to match ``{"q", "s"}`` quantized leaves (int8 keeps
+    the weight's spec; per-layer scales replicate)."""
+    from jax.sharding import PartitionSpec as P_
+
+    def expand(leaf, spec):
+        if _is_qleaf(leaf):
+            return {"q": spec, "s": P_(None, None)}
+        return spec
+
+    return jax.tree_util.tree_map(
+        expand, params, specs, is_leaf=_is_qleaf)
+
+
 # --------------------------------------------------------------------- KV-cache decode
 def init_cache(cfg: GPTConfig, batch_size: int, max_len: int, dtype=jnp.bfloat16):
     """Per-layer stacked KV cache. Parity: the reference's inference workspace
@@ -390,11 +444,13 @@ def init_cache(cfg: GPTConfig, batch_size: int, max_len: int, dtype=jnp.bfloat16
             "pos": jnp.zeros((), jnp.int32)}
 
 
-def _block_with_cache(cfg: GPTConfig, x, w, k_cache, v_cache, pos):
-    """One transformer block consuming/updating a KV cache slice.
+def attn_with_cache(cfg: GPTConfig, x, w, k_cache, v_cache, pos):
+    """Cached self-attention sublayer (pre-LN + residual), shared by the dense
+    and MoE cached forwards.
 
     x: [B, T, D] new tokens (T=prompt len at prefill, 1 at decode);
     k_cache/v_cache: [B, H, S, Dh]; pos: scalar — tokens already in the cache.
+    Returns (x + attn_out, k_cache, v_cache).
     """
     B, T, D = x.shape
     H, Dh = cfg.n_head, cfg.head_dim
@@ -443,9 +499,15 @@ def _block_with_cache(cfg: GPTConfig, x, w, k_cache, v_cache, pos):
         attn = jnp.einsum("bhts,bhsd->bthd", probs.astype(v_cache.dtype), v_cache)
         attn = attn.reshape(B, T, D).astype(x.dtype)
     attn = attn @ w["attn_out_w"] + w["attn_out_b"]
+    return x + attn, k_cache, v_cache
+
+
+def _block_with_cache(cfg: GPTConfig, x, w, k_cache, v_cache, pos):
+    """One transformer block (attention + dense MLP) over a KV cache slice."""
     if cfg.parallel_residual:
-        return x + attn + _mlp_delta(cfg, x, w), k_cache, v_cache
-    x = x + attn
+        y, k_cache, v_cache = attn_with_cache(cfg, x, w, k_cache, v_cache, pos)
+        return y + _mlp_delta(cfg, x, w), k_cache, v_cache
+    x, k_cache, v_cache = attn_with_cache(cfg, x, w, k_cache, v_cache, pos)
     return x + _mlp_delta(cfg, x, w), k_cache, v_cache
 
 
@@ -461,12 +523,18 @@ def forward_with_cache(cfg: GPTConfig, params, input_ids: jnp.ndarray, cache):
     if cfg.embed_layernorm:
         x = layer_norm(x, params["emb_ln_scale"], params["emb_ln_bias"],
                        cfg.layer_norm_eps)
-    x = x.astype(params["blocks"]["qkv_w"].dtype)
+    qkv_w = params["blocks"]["qkv_w"]
+    compute_dtype = (params["lnf_scale"].dtype if _is_qleaf(qkv_w)
+                     else qkv_w.dtype)
+    x = x.astype(compute_dtype)
     x = maybe_shard(x, P(BATCH, None, None))
 
     def body(carry, layer_in):
         x = carry
         layer_w, k_c, v_c = layer_in
+        # int8 weights: dequantize THIS layer's slice only, inside the scan —
+        # peak HBM never holds a full dequantized stack
+        layer_w = _dequant_layer(layer_w, compute_dtype)
         x, k_c, v_c = _block_with_cache(cfg, x, layer_w, k_c, v_c, pos)
         return x, (k_c, v_c)
 

@@ -203,6 +203,14 @@ def forward(cfg: GPTMoEConfig, params, input_ids: jnp.ndarray,
             lambda a: a.reshape(cfg.n_super, n_dense_per_super, *a.shape[1:]),
             dense_stack)
 
+    # super-block loop through the ZeRO-3 gather window (stage3 knobs apply to
+    # MoE stacks too; plain scan when unconfigured — runtime/zero/gather.py)
+    from ..runtime.zero.gather import zero3_layer_scan
+
+    specs_all = partition_specs(cfg, None)
+    moe_specs_t = jax.tree_util.tree_map(
+        lambda s: P(*tuple(s)[1:]), specs_all["moe_blocks"],
+        is_leaf=lambda s: isinstance(s, P))
     if n_dense_per_super > 0:
         def body(carry, layer_in):
             x, idx, aux_sum = carry
@@ -211,6 +219,10 @@ def forward(cfg: GPTMoEConfig, params, input_ids: jnp.ndarray,
             return (x, idx, aux_sum + aux), None
 
         xs = (dense_stack, params["moe_blocks"])
+        dense_specs_t = jax.tree_util.tree_map(
+            lambda s: P(None, *tuple(s)[1:]), specs_all["blocks"],
+            is_leaf=lambda s: isinstance(s, P))
+        gathered = (dense_specs_t, moe_specs_t)
     else:
         def body(carry, moe_w):
             x, idx, aux_sum = carry
@@ -218,9 +230,10 @@ def forward(cfg: GPTMoEConfig, params, input_ids: jnp.ndarray,
             return (x, idx, aux_sum + aux), None
 
         xs = params["moe_blocks"]
+        gathered = moe_specs_t
 
-    (x, _, aux_sum), _ = jax.lax.scan(
-        body, (x, jnp.int32(0), jnp.float32(0.0)), xs)
+    (x, _, aux_sum) = zero3_layer_scan(
+        body, (x, jnp.int32(0), jnp.float32(0.0)), xs, gathered_spec=gathered)
 
     x = layer_norm(x, params["lnf_scale"], params["lnf_bias"], b.layer_norm_eps)
     head = params["wte"] if b.tie_embeddings else params["lm_head"]
@@ -250,3 +263,94 @@ def build(cfg_or_name) -> Tuple[Module, GPTMoEConfig]:
             cfg, params, batch, rngs=rngs, train=train),
         partition_specs=functools.partial(partition_specs, cfg),
     ), cfg
+
+
+# ------------------------------------------------------------- KV-cache decode
+def init_cache(cfg: GPTMoEConfig, batch_size: int, max_len: int,
+               dtype=jnp.bfloat16):
+    """Dense-block and MoE-block cache stacks (layouts as ``gpt.init_cache``).
+    Parity: the reference's MoE inference workspace
+    (``ops/transformer/inference/moe_inference.py`` + ``inference_context.h``)."""
+    b = cfg.base
+    dense_layers = b.n_layer - cfg.n_super
+    shape_d = (dense_layers, batch_size, b.n_head, max_len, b.head_dim)
+    shape_m = (cfg.n_super, batch_size, b.n_head, max_len, b.head_dim)
+    return {"k_dense": jnp.zeros(shape_d, dtype), "v_dense": jnp.zeros(shape_d, dtype),
+            "k_moe": jnp.zeros(shape_m, dtype), "v_moe": jnp.zeros(shape_m, dtype),
+            "pos": jnp.zeros((), jnp.int32)}
+
+
+def _moe_block_with_cache(cfg: GPTMoEConfig, x, w, k_c, v_c, pos):
+    """Cached MoE block: cached attention + expert-parallel MLP (eval gating:
+    no jitter/RTS, eval capacity factor). Parity: the reference's
+    ``DeepSpeedMoEInference`` layer (``ops/transformer/inference/moe_inference.py``)."""
+    b = cfg.base
+    from .gpt import attn_with_cache
+
+    x, k_c, v_c = attn_with_cache(b, x, w, k_c, v_c, pos)
+    h = layer_norm(x, w["ln2_scale"], w["ln2_bias"], b.layer_norm_eps)
+    y, _aux, _counts = apply_moe(cfg.moe_config(), w["moe"], h, rng=None,
+                                 train=False)
+    return x + y, k_c, v_c
+
+
+def forward_with_cache(cfg: GPTMoEConfig, params, input_ids: jnp.ndarray, cache):
+    """Prefill or decode through the dense/MoE super-block structure; returns
+    (logits [B, T, V], new_cache)."""
+    from .gpt import _block_with_cache
+
+    b = cfg.base
+    B, T = input_ids.shape
+    pos = cache["pos"]
+    x = jnp.take(params["wte"], input_ids, axis=0)
+    positions = pos + jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+    if not b.rotary:
+        x = x + jnp.take(params["wpe"], positions + b.pos_offset, axis=0)
+    x = x.astype(params["moe_blocks"]["qkv_w"].dtype)
+    x = maybe_shard(x, P(BATCH, None, None))
+
+    n_dense = cfg.moe_freq - 1
+
+    def super_body(carry, layer_in):
+        x = carry
+        if n_dense > 0:
+            dense_ws, kd, vd, moe_w, km, vm = layer_in
+
+            def dense_body(xx, lin):
+                layer_w, k_c, v_c = lin
+                xx, k_c, v_c = _block_with_cache(b, xx, layer_w, k_c, v_c, pos)
+                return xx, (k_c, v_c)
+
+            x, (kd, vd) = jax.lax.scan(dense_body, x, (dense_ws, kd, vd))
+        else:
+            moe_w, km, vm = layer_in
+            kd = vd = None
+        x, km, vm = _moe_block_with_cache(cfg, x, moe_w, km, vm, pos)
+        out = (kd, vd, km, vm) if n_dense > 0 else (km, vm)
+        return x, out
+
+    if n_dense > 0:
+        dense_stack = jax.tree_util.tree_map(
+            lambda a: a.reshape(cfg.n_super, n_dense, *a.shape[1:]),
+            params["blocks"])
+        kd = cache["k_dense"].reshape(cfg.n_super, n_dense, *cache["k_dense"].shape[1:])
+        vd = cache["v_dense"].reshape(cfg.n_super, n_dense, *cache["v_dense"].shape[1:])
+        xs = (dense_stack, kd, vd, params["moe_blocks"], cache["k_moe"], cache["v_moe"])
+    else:
+        xs = (params["moe_blocks"], cache["k_moe"], cache["v_moe"])
+
+    x, outs = jax.lax.scan(super_body, x, xs)
+    if n_dense > 0:
+        new_kd, new_vd, new_km, new_vm = outs
+        new_kd = new_kd.reshape(cache["k_dense"].shape)
+        new_vd = new_vd.reshape(cache["v_dense"].shape)
+    else:
+        new_km, new_vm = outs
+        new_kd, new_vd = cache["k_dense"], cache["v_dense"]
+
+    x = layer_norm(x, params["lnf_scale"], params["lnf_bias"], b.layer_norm_eps)
+    head = params["wte"] if b.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
+    new_cache = {"k_dense": new_kd, "v_dense": new_vd, "k_moe": new_km,
+                 "v_moe": new_vm, "pos": pos + T}
+    return logits, new_cache

@@ -79,6 +79,13 @@ def window_size(blocks, L: int) -> int:
     cfg = _active_cfg()
     if cfg is None or int(getattr(cfg, "stage", 0)) < 3:
         return 1
+    # opt-in: windowing engages only when the user explicitly set a stage-3
+    # knob — a bare {"stage": 3} config keeps the minimal-residency per-layer
+    # schedule (a silent default k>1 could OOM previously-fitting jobs)
+    set_fields = getattr(cfg, "model_fields_set", set())
+    if not {"stage3_prefetch_bucket_size",
+            "stage3_max_live_parameters"} & set(set_fields):
+        return 1
     prefetch = int(getattr(cfg, "stage3_prefetch_bucket_size", 0) or 0)
     max_live = int(getattr(cfg, "stage3_max_live_parameters", 0) or 0)
     per_layer = _params_per_layer(blocks)
@@ -88,6 +95,13 @@ def window_size(blocks, L: int) -> int:
     k = max(1, min(cap, prefetch // per_layer))
     while L % k:  # largest divisor of L not exceeding the budget
         k -= 1
+    if k > 1:
+        from ...utils.logging import warning_once
+
+        warning_once(
+            f"ZeRO-3 gather windowing: {k} layers per gather window "
+            f"(prefetch_bucket {prefetch}, max_live {max_live}, "
+            f"{per_layer} params/layer)")
     return k
 
 

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Measure peak HBM of bf16 vs int8 generate on the real chip (VERDICT r3 #5:
+'measured int8 generate peak HBM < bf16 generate peak HBM').
+
+Each mode runs in a fresh subprocess so memory_stats peaks don't bleed across.
+Usage: python scripts/int8_hbm.py [model] (default gpt2-350m)
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def run_one(model: str, quant: bool) -> None:
+    import numpy as np
+
+    import jax
+
+    from deepspeed_tpu.inference import DeepSpeedInferenceConfig, InferenceEngine
+    from deepspeed_tpu.inference.engine import for_gpt
+    from deepspeed_tpu.models import gpt
+
+    cfg = gpt.PRESETS[model]
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    eng = InferenceEngine(
+        for_gpt(cfg, params),
+        DeepSpeedInferenceConfig(
+            dtype="bfloat16", max_out_tokens=256,
+            quant={"enabled": quant, "bits": 8, "group_size": 64}))
+    del params
+    ids = np.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (1, 128)), np.int32)
+    out = eng.generate(ids, max_new_tokens=64)
+    assert out.shape == (1, 192)
+    stats = jax.local_devices()[0].memory_stats() or {}
+    print(json.dumps({
+        "model": model, "int8": quant,
+        "peak_hbm_gb": round(stats.get("peak_bytes_in_use", 0) / 2**30, 3),
+        "in_use_gb": round(stats.get("bytes_in_use", 0) / 2**30, 3),
+    }))
+
+
+def main() -> None:
+    model = sys.argv[1] if len(sys.argv) > 1 else "gpt2-350m"
+    results = []
+    for quant in (False, True):
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--one", model,
+             str(int(quant))],
+            capture_output=True, text=True, timeout=1200, cwd=REPO)
+        line = next((ln for ln in reversed(p.stdout.strip().splitlines())
+                     if ln.startswith("{")), None)
+        r = json.loads(line) if line else {"int8": quant,
+                                           "error": p.stderr[-300:]}
+        results.append(r)
+        print(json.dumps(r), flush=True)
+    if all("peak_hbm_gb" in r for r in results):
+        bf16, int8 = results
+        print(json.dumps({
+            "int8_saves_hbm": int8["peak_hbm_gb"] < bf16["peak_hbm_gb"],
+            "bf16_peak_gb": bf16["peak_hbm_gb"],
+            "int8_peak_gb": int8["peak_hbm_gb"],
+        }))
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 4 and sys.argv[1] == "--one":
+        run_one(sys.argv[2], bool(int(sys.argv[3])))
+    else:
+        main()

@@ -1,0 +1,80 @@
+"""Expert-parallel MoE inference (VERDICT r2 'next' #5).
+
+Parity: the reference's MoE inference layer
+(``/root/reference/deepspeed/ops/transformer/inference/moe_inference.py``) —
+generate with the expert bank sharded over the ``ep`` mesh axis, the
+dispatch/combine all-to-alls running inside every decode step.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference import DeepSpeedInferenceConfig, InferenceEngine
+from deepspeed_tpu.inference.engine import for_gpt_moe
+from deepspeed_tpu.models import gpt_moe
+from deepspeed_tpu.models.gpt import GPTConfig
+from deepspeed_tpu.models.gpt_moe import GPTMoEConfig
+
+
+CFG = GPTMoEConfig(
+    base=GPTConfig(vocab_size=64, n_layer=4, n_head=2, d_model=32,
+                   max_seq_len=64),
+    num_experts=4, moe_freq=2, capacity_factor=2.0, eval_capacity_factor=2.0)
+
+
+@pytest.fixture(scope="module")
+def moe_params():
+    return gpt_moe.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def test_cached_forward_matches_full_forward(moe_params, rng):
+    """Prefill + stepwise decode logits == full uncached forward logits."""
+    ids = rng.integers(0, 64, size=(2, 10)).astype(np.int32)
+    full_logits, _aux = gpt_moe.forward(CFG, moe_params, jnp.asarray(ids),
+                                        train=False)
+
+    cache = gpt_moe.init_cache(CFG, 2, 16, jnp.float32)
+    pre_logits, cache = gpt_moe.forward_with_cache(
+        CFG, moe_params, jnp.asarray(ids[:, :7]), cache)
+    np.testing.assert_allclose(np.asarray(pre_logits),
+                               np.asarray(full_logits[:, :7]),
+                               atol=2e-4, rtol=1e-3)
+    for t in range(7, 10):
+        step_logits, cache = gpt_moe.forward_with_cache(
+            CFG, moe_params, jnp.asarray(ids[:, t:t + 1]), cache)
+        np.testing.assert_allclose(np.asarray(step_logits[:, 0]),
+                                   np.asarray(full_logits[:, t]),
+                                   atol=2e-4, rtol=1e-3)
+
+
+def test_ep_generate_matches_replicated(moe_params, rng):
+    """Generate on an ep=4 mesh == generate replicated (same tokens)."""
+    ids = rng.integers(0, 64, size=(2, 8)).astype(np.int32)
+
+    def run(ep):
+        eng = InferenceEngine(
+            for_gpt_moe(CFG, moe_params),
+            DeepSpeedInferenceConfig(
+                dtype="float32", max_out_tokens=32,
+                moe={"ep_size": ep}))
+        return eng.generate(ids, max_new_tokens=8)
+
+    out_rep = run(ep=1)
+    out_ep = run(ep=4)
+    np.testing.assert_array_equal(out_rep, out_ep)
+    assert out_ep.shape == (2, 16)
+
+
+def test_ep_generate_expert_sharding_is_real(moe_params):
+    """The placed expert weights must actually be ep-sharded on the mesh."""
+    eng = InferenceEngine(
+        for_gpt_moe(CFG, moe_params),
+        DeepSpeedInferenceConfig(dtype="float32", max_out_tokens=16,
+                                 moe={"ep_size": 4}))
+    up_w = eng.params["moe_blocks"]["moe"]["experts"]["up_w"]
+    spec = tuple(up_w.sharding.spec)
+    assert "ep" in str(spec), spec
+    assert not up_w.sharding.is_fully_replicated

@@ -49,6 +49,10 @@ def test_window_size_math():
     with gather_window(cfg(prefetch=65, max_live=10**9)):
         assert window_size(blocks, 8) == 2  # 65//20 = 3 -> divisor of 8 -> 2
     assert window_size(blocks, 8) == 1  # no active config
+    # opt-in: a bare {"stage": 3} (knobs at pydantic defaults, not user-set)
+    # keeps the minimal-residency per-layer schedule
+    with gather_window(DeepSpeedZeroConfig(stage=3)):
+        assert window_size(blocks, 8) == 1
 
 
 def test_zero3_layer_scan_numerics_invariant():
