@@ -1,0 +1,186 @@
+"""Elastic agent: failure detection + resize-and-resume supervision.
+
+Capability parity with the reference's ``DSElasticAgent``
+(``elasticity/elastic_agent.py:23``, subclassing torchelastic's
+``LocalElasticAgent``): monitor the training workers, and on worker failure or
+cluster membership change restart the worker group at the new world size with a
+batch decomposition that keeps the effective batch constant.
+
+TPU-native shape: there is no per-GPU process group to re-rendezvous — a
+training job is ONE controller process over a device mesh, so the agent is a
+supervisor that
+
+1. resolves the elastic batch triangle for the current world size via
+   :func:`~deepspeed_tpu.elasticity.compute_elastic_config` (the same math the
+   reference's v0.1/0.2 elasticity uses);
+2. launches the worker process (``make_cmd(world, micro, gas)``) and watches it
+   (exit code + optional device-membership polling);
+3. on a non-zero exit or a membership change, kills the worker, re-resolves the
+   triangle at the new world size, and relaunches — the worker resumes from the
+   latest universal checkpoint (topology-free format: any dp/tp regrid reloads,
+   ``deepspeed_tpu/checkpoint/serialization.py``), which replaces torchelastic's
+   rendezvous-and-rebroadcast recovery path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import subprocess
+import sys
+import time
+from typing import Callable, List, Optional, Sequence
+
+from ..utils.logging import logger
+from .elasticity import ElasticityError, compute_elastic_config
+
+
+@dataclasses.dataclass
+class WorkerSpec:
+    """One launch decision: the resolved decomposition for a world size."""
+
+    world_size: int
+    micro_batch: int
+    gas: int
+    global_batch: int
+
+
+@dataclasses.dataclass
+class AgentResult:
+    state: str  # "SUCCEEDED" | "FAILED"
+    restarts: int
+    history: List[WorkerSpec]
+
+
+class DSElasticAgent:
+    """Supervise an elastic training worker. Parity: ``DSElasticAgent``
+    (``elasticity/elastic_agent.py:23``) — monitor/restart semantics of
+    ``_invoke_run``; rendezvous is replaced by checkpoint-resume.
+
+    Args:
+      make_cmd: ``(spec: WorkerSpec) -> argv`` building the worker command; the
+        worker must resume from its checkpoint dir on start.
+      ds_config: dict with the ``elasticity`` block (and anything the caller's
+        ``make_cmd`` needs).
+      device_count_fn: current usable world size (chips/hosts). Defaults to a
+        constant from the first call. A change triggers restart-at-new-size.
+      max_restarts: give up after this many failures (parity: torchelastic
+        ``max_restarts``).
+      poll_interval: seconds between membership checks while the worker runs.
+    """
+
+    def __init__(self, make_cmd: Callable[[WorkerSpec], Sequence[str]],
+                 ds_config: dict,
+                 device_count_fn: Optional[Callable[[], int]] = None,
+                 max_restarts: int = 10, poll_interval: float = 1.0):
+        self.make_cmd = make_cmd
+        self.ds_config = ds_config
+        self.device_count_fn = device_count_fn or (lambda: self._first_world)
+        self._first_world: Optional[int] = None
+        self.max_restarts = int(max_restarts)
+        self.poll_interval = float(poll_interval)
+
+    # ------------------------------------------------------------- resolution
+    def resolve(self, world_size: int) -> WorkerSpec:
+        """Largest valid world size <= ``world_size``, and its decomposition
+        keeping the elastic global batch fixed."""
+        final_bs, valid, _ = compute_elastic_config(self.ds_config, 0)
+        usable = [w for w in valid if w <= world_size]
+        if not usable:
+            raise ElasticityError(
+                f"no valid elastic world size <= {world_size} (valid: {valid})")
+        w = max(usable)
+        _, _, micro = compute_elastic_config(self.ds_config, w)
+        gas = final_bs // (micro * w)
+        return WorkerSpec(world_size=w, micro_batch=micro, gas=gas,
+                          global_batch=final_bs)
+
+    # ------------------------------------------------------------- supervision
+    def run(self) -> AgentResult:
+        restarts = 0
+        history: List[WorkerSpec] = []
+        while True:
+            world = self.device_count_fn()
+            if self._first_world is None:
+                self._first_world = world
+            spec = self.resolve(world)
+            history.append(spec)
+            argv = list(self.make_cmd(spec))
+            logger.info(
+                f"elastic agent: launching worker (attempt {restarts + 1}): "
+                f"world={spec.world_size} micro={spec.micro_batch} "
+                f"gas={spec.gas} global_batch={spec.global_batch}")
+            proc = subprocess.Popen(argv)
+            rc = self._watch(proc)
+            if rc == 0:
+                logger.info("elastic agent: worker SUCCEEDED")
+                return AgentResult("SUCCEEDED", restarts, history)
+            restarts += 1
+            if restarts > self.max_restarts:
+                logger.error(
+                    f"elastic agent: giving up after {restarts - 1} restarts")
+                return AgentResult("FAILED", restarts - 1, history)
+            logger.warning(
+                f"elastic agent: worker exited rc={rc}; restarting "
+                f"({restarts}/{self.max_restarts}) from the latest checkpoint")
+
+    def _watch(self, proc: subprocess.Popen) -> int:
+        """Wait on the worker, polling membership; a change kills + restarts
+        (returns a synthetic rc of -1 so the run loop re-resolves)."""
+        launched_world = self.device_count_fn()
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                return rc
+            time.sleep(self.poll_interval)
+            now = self.device_count_fn()
+            if now != launched_world:
+                logger.warning(
+                    f"elastic agent: membership change {launched_world} -> {now}; "
+                    "restarting worker group")
+                proc.terminate()
+                try:
+                    proc.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+                return -1
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """``ds_elastic`` CLI (parity: ``bin/ds_elastic``): supervise
+    ``python <script> ...`` with `--world/--micro/--gas` appended per launch."""
+    import argparse
+    import json
+    import os
+
+    p = argparse.ArgumentParser("ds_elastic")
+    p.add_argument("--config", required=True, help="DeepSpeed JSON with an elasticity block")
+    p.add_argument("--max-restarts", type=int, default=10)
+    p.add_argument("script", help="worker script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+    with open(args.config) as f:
+        ds_config = json.load(f)
+
+    def device_count() -> int:
+        forced = os.environ.get("DS_ELASTIC_WORLD")
+        if forced:
+            return int(forced)
+        import jax
+
+        return jax.device_count()
+
+    def make_cmd(spec: WorkerSpec):
+        return [sys.executable, args.script, *args.script_args,
+                "--elastic-world", str(spec.world_size),
+                "--elastic-micro", str(spec.micro_batch),
+                "--elastic-gas", str(spec.gas)]
+
+    agent = DSElasticAgent(make_cmd, ds_config, device_count_fn=device_count,
+                           max_restarts=args.max_restarts)
+    result = agent.run()
+    return 0 if result.state == "SUCCEEDED" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

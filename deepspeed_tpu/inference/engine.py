@@ -93,7 +93,14 @@ class InferenceEngine:
 
         shapes = jax.eval_shape(lambda: params)
         specs = model.partition_specs(shapes) if hasattr(model, "partition_specs") else None
-        if specs is None and tp > 1 and not self._per_layer_quant:
+        if specs is None and tp > 1:
+            if self._per_layer_quant:
+                # AutoTP's shape heuristics don't understand {"q","s"} leaves;
+                # replicating silently would waste tp x HBM — fail loudly
+                raise ValueError(
+                    "per-layer int8 quantization with tp>1 requires the model "
+                    "to provide partition_specs (AutoTP cannot infer sharding "
+                    "for quantized {'q','s'} leaves)")
             # AutoTP: infer Megatron-style specs for unknown trees
             # (parity: module_inject/auto_tp.py:7)
             from ..module_inject import auto_tp_specs

@@ -79,12 +79,12 @@ def window_size(blocks, L: int) -> int:
     cfg = _active_cfg()
     if cfg is None or int(getattr(cfg, "stage", 0)) < 3:
         return 1
-    # opt-in: windowing engages only when the user explicitly set a stage-3
-    # knob — a bare {"stage": 3} config keeps the minimal-residency per-layer
-    # schedule (a silent default k>1 could OOM previously-fitting jobs)
+    # opt-in: windowing engages only when the user explicitly set the PREFETCH
+    # knob (the gather-ahead request); max_live alone only expresses a cap, so
+    # a bare {"stage": 3} or a cap-only config keeps the minimal-residency
+    # per-layer schedule (a silent default k>1 could OOM previously-fitting jobs)
     set_fields = getattr(cfg, "model_fields_set", set())
-    if not {"stage3_prefetch_bucket_size",
-            "stage3_max_live_parameters"} & set(set_fields):
+    if "stage3_prefetch_bucket_size" not in set_fields:
         return 1
     prefetch = int(getattr(cfg, "stage3_prefetch_bucket_size", 0) or 0)
     max_live = int(getattr(cfg, "stage3_max_live_parameters", 0) or 0)

@@ -1,0 +1,86 @@
+"""Elastic-agent worker fixture: trains a tiny GPT on a forced-CPU mesh of
+``--elastic-world`` devices, checkpointing every step, resuming from the latest
+checkpoint on start. Used by test_elastic_agent.py (kill-and-resume)."""
+
+import argparse
+import json
+import os
+import sys
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--log", required=True)
+    p.add_argument("--steps", type=int, required=True)
+    p.add_argument("--crash-at", type=int, default=-1)
+    p.add_argument("--elastic-world", type=int, required=True)
+    p.add_argument("--elastic-micro", type=int, required=True)
+    p.add_argument("--elastic-gas", type=int, required=True)
+    args = p.parse_args()
+
+    # strip any inherited device-count flag so ours wins (XLA_FLAGS is read at
+    # backend init, which has not happened yet even though sitecustomize
+    # imported jax)
+    flags = " ".join(
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count"))
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={args.elastic_world}")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["DS_TPU_ACCELERATOR"] = "cpu"
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_gpt, gpt
+    from deepspeed_tpu.runtime.topology import MeshTopology
+
+    world, micro, gas = args.elastic_world, args.elastic_micro, args.elastic_gas
+    model, cfg = build_gpt(gpt.GPTConfig(
+        vocab_size=64, n_layer=2, n_head=2, d_model=32, max_seq_len=32))
+    topo = MeshTopology.create(dp=world, devices=jax.devices()[:world])
+    engine, _, _, _ = ds.initialize(model=model, topology=topo, config={
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 1},
+        "mesh": {"dp": world},
+        "bf16": {"enabled": False},
+        "steps_per_print": 0,
+    })
+    engine.load_checkpoint(args.ckpt_dir)  # no-op on the first launch
+
+    effective = micro * gas * world
+
+    def batch_for(step: int):
+        # deterministic per-step data, independent of the decomposition: the
+        # same `effective`-sized batch regardless of world/micro/gas. A small
+        # repeating set (2 distinct batches) so the loss measurably descends
+        # and a resumed run is distinguishable from a cold restart.
+        r = np.random.default_rng(1000 + step % 2)
+        ids = r.integers(0, 64, size=(effective, 16), dtype=np.int32)
+        if gas > 1:
+            ids = ids.reshape(gas, micro * world, 16)
+        return {"input_ids": ids}
+
+    while engine.global_steps < args.steps:
+        step = engine.global_steps
+        m = engine.train_batch(batch_for(step))
+        with open(args.log, "a") as f:
+            f.write(json.dumps({
+                "step": engine.global_steps, "loss": float(m["loss"]),
+                "world": world, "micro": micro, "gas": gas,
+                "effective": effective}) + "\n")
+        engine.save_checkpoint(args.ckpt_dir)
+        if args.crash_at >= 0 and engine.global_steps >= args.crash_at:
+            os._exit(17)  # simulated worker failure
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,102 @@
+"""Elastic agent: kill-and-resume at a different dp (VERDICT r2 'next' #6).
+
+Parity: ``DSElasticAgent`` (``/root/reference/deepspeed/elasticity/
+elastic_agent.py:23``) — worker failure triggers a restart; a membership change
+relaunches at the new world size with the SAME effective batch (elastic batch
+math) and training resumes from the universal checkpoint with continuing loss.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.elasticity import ElasticityError
+from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent, WorkerSpec
+
+ELASTIC_CONFIG = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 16,
+        "micro_batch_sizes": [2, 4],
+        "min_gpus": 1,
+        "max_gpus": 8,
+        "prefer_larger_batch": True,
+        "version": 0.2,
+    }
+}
+
+
+def test_resolve_keeps_effective_batch():
+    agent = DSElasticAgent(lambda s: ["true"], ELASTIC_CONFIG)
+    s4 = agent.resolve(4)
+    s2 = agent.resolve(2)
+    assert s4.global_batch == s2.global_batch == 16
+    assert s4.micro_batch * s4.gas * s4.world_size == 16
+    assert s2.micro_batch * s2.gas * s2.world_size == 16
+    # world 3 is not a valid size: falls back to the largest valid <= 3
+    s3 = agent.resolve(3)
+    assert s3.world_size == 2
+    with pytest.raises(ElasticityError):
+        agent.resolve(0)
+
+
+def test_kill_and_resume_at_new_dp(tmp_path):
+    """Worker crashes mid-run at world=4; the cluster 'shrinks' to 2; the agent
+    relaunches at dp=2 with identical effective batch and the loss continues
+    from the checkpoint instead of restarting."""
+    ckpt = tmp_path / "ckpt"
+    log = tmp_path / "log.jsonl"
+    worker = os.path.join(os.path.dirname(__file__), "elastic_worker.py")
+    world_file = tmp_path / "world"
+    world_file.write_text("4")
+
+    total_steps, crash_at = 6, 2
+
+    def device_count():
+        return int(world_file.read_text())
+
+    launches = []
+
+    def make_cmd(spec: WorkerSpec):
+        launches.append(spec)
+        if len(launches) == 1:
+            # first (and only first) launch crashes; afterwards the cluster
+            # has shrunk — flip the membership the agent will see next
+            world_file.write_text("2")
+            crash = ["--crash-at", str(crash_at)]
+        else:
+            crash = []
+        env_clean = [sys.executable, worker,
+                     "--ckpt-dir", str(ckpt), "--log", str(log),
+                     "--steps", str(total_steps),
+                     "--elastic-world", str(spec.world_size),
+                     "--elastic-micro", str(spec.micro_batch),
+                     "--elastic-gas", str(spec.gas)]
+        return env_clean + crash
+
+    agent = DSElasticAgent(make_cmd, ELASTIC_CONFIG,
+                           device_count_fn=device_count, max_restarts=3,
+                           poll_interval=0.2)
+    result = agent.run()
+    assert result.state == "SUCCEEDED"
+    assert result.restarts == 1
+    assert [s.world_size for s in launches] == [4, 2]
+
+    records = [json.loads(ln) for ln in log.read_text().splitlines()]
+    # identical effective batch across the resize
+    assert {r["effective"] for r in records} == {16}
+    # run 2 resumed from the checkpoint: steps continue, no reset to 1
+    steps = [r["step"] for r in records]
+    assert steps == sorted(steps)
+    run2 = [r for r in records if r["world"] == 2]
+    run1 = [r for r in records if r["world"] == 4]
+    assert run1 and run2
+    assert run2[0]["step"] == crash_at + 1
+    assert run2[-1]["step"] == total_steps
+    # loss continues (training on random data: resumed loss stays below the
+    # cold-start loss and remains finite)
+    assert run2[0]["loss"] < run1[0]["loss"]
+    assert all(np.isfinite(r["loss"]) for r in records)

@@ -53,6 +53,10 @@ def test_window_size_math():
     # keeps the minimal-residency per-layer schedule
     with gather_window(DeepSpeedZeroConfig(stage=3)):
         assert window_size(blocks, 8) == 1
+    # a cap-only config expresses a LIMIT, not a prefetch request: no windowing
+    with gather_window(DeepSpeedZeroConfig(
+            stage=3, stage3_max_live_parameters=10**9)):
+        assert window_size(blocks, 8) == 1
 
 
 def test_zero3_layer_scan_numerics_invariant():
