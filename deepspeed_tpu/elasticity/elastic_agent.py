@@ -34,6 +34,22 @@ from ..utils.logging import logger
 from .elasticity import ElasticityError, compute_elastic_config
 
 
+def probe_device_count(timeout: float = 120.0) -> int:
+    """Device count probed OUT of process: the supervisor must never acquire
+    the accelerator itself (libtpu grants exclusive per-process access — an
+    in-process ``jax.device_count()`` would lock the chips away from the very
+    worker this agent launches)."""
+    forced = __import__("os").environ.get("DS_ELASTIC_WORLD")
+    if forced:
+        return int(forced)
+    p = subprocess.run(
+        [sys.executable, "-c", "import jax; print(jax.device_count())"],
+        capture_output=True, text=True, timeout=timeout)
+    if p.returncode != 0:
+        raise RuntimeError(f"device probe failed: {p.stderr[-300:]}")
+    return int(p.stdout.strip().splitlines()[-1])
+
+
 @dataclasses.dataclass
 class WorkerSpec:
     """One launch decision: the resolved decomposition for a world size."""
@@ -61,8 +77,9 @@ class DSElasticAgent:
         worker must resume from its checkpoint dir on start.
       ds_config: dict with the ``elasticity`` block (and anything the caller's
         ``make_cmd`` needs).
-      device_count_fn: current usable world size (chips/hosts). Defaults to a
-        constant from the first call. A change triggers restart-at-new-size.
+      device_count_fn: current usable world size (chips/hosts). Defaults to
+        :func:`probe_device_count` (out-of-process, cached per poll). A change
+        triggers restart-at-new-size.
       max_restarts: give up after this many failures (parity: torchelastic
         ``max_restarts``).
       poll_interval: seconds between membership checks while the worker runs.
@@ -74,8 +91,7 @@ class DSElasticAgent:
                  max_restarts: int = 10, poll_interval: float = 1.0):
         self.make_cmd = make_cmd
         self.ds_config = ds_config
-        self.device_count_fn = device_count_fn or (lambda: self._first_world)
-        self._first_world: Optional[int] = None
+        self.device_count_fn = device_count_fn or probe_device_count
         self.max_restarts = int(max_restarts)
         self.poll_interval = float(poll_interval)
 
@@ -100,8 +116,6 @@ class DSElasticAgent:
         history: List[WorkerSpec] = []
         while True:
             world = self.device_count_fn()
-            if self._first_world is None:
-                self._first_world = world
             spec = self.resolve(world)
             history.append(spec)
             argv = list(self.make_cmd(spec))
@@ -110,7 +124,7 @@ class DSElasticAgent:
                 f"world={spec.world_size} micro={spec.micro_batch} "
                 f"gas={spec.gas} global_batch={spec.global_batch}")
             proc = subprocess.Popen(argv)
-            rc = self._watch(proc)
+            rc = self._watch(proc, launched_world=world)
             if rc == 0:
                 logger.info("elastic agent: worker SUCCEEDED")
                 return AgentResult("SUCCEEDED", restarts, history)
@@ -123,10 +137,10 @@ class DSElasticAgent:
                 f"elastic agent: worker exited rc={rc}; restarting "
                 f"({restarts}/{self.max_restarts}) from the latest checkpoint")
 
-    def _watch(self, proc: subprocess.Popen) -> int:
-        """Wait on the worker, polling membership; a change kills + restarts
-        (returns a synthetic rc of -1 so the run loop re-resolves)."""
-        launched_world = self.device_count_fn()
+    def _watch(self, proc: subprocess.Popen, launched_world: int) -> int:
+        """Wait on the worker, polling membership against the world size the
+        launch was RESOLVED for (a change in the launch window is caught on the
+        first poll); a change kills + restarts (synthetic rc -1 re-resolves)."""
         while True:
             rc = proc.poll()
             if rc is not None:
@@ -151,7 +165,6 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ``python <script> ...`` with `--world/--micro/--gas` appended per launch."""
     import argparse
     import json
-    import os
 
     p = argparse.ArgumentParser("ds_elastic")
     p.add_argument("--config", required=True, help="DeepSpeed JSON with an elasticity block")
@@ -162,22 +175,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     with open(args.config) as f:
         ds_config = json.load(f)
 
-    def device_count() -> int:
-        forced = os.environ.get("DS_ELASTIC_WORLD")
-        if forced:
-            return int(forced)
-        import jax
-
-        return jax.device_count()
-
     def make_cmd(spec: WorkerSpec):
         return [sys.executable, args.script, *args.script_args,
                 "--elastic-world", str(spec.world_size),
                 "--elastic-micro", str(spec.micro_batch),
                 "--elastic-gas", str(spec.gas)]
 
-    agent = DSElasticAgent(make_cmd, ds_config, device_count_fn=device_count,
-                           max_restarts=args.max_restarts)
+    agent = DSElasticAgent(make_cmd, ds_config,
+                           device_count_fn=probe_device_count,
+                           max_restarts=args.max_restarts,
+                           poll_interval=30.0)
     result = agent.run()
     return 0 if result.state == "SUCCEEDED" else 1
 

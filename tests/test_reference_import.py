@@ -1,0 +1,224 @@
+"""torch-DeepSpeed checkpoint interop (VERDICT r2 'next' #7).
+
+Synthesizes checkpoints in the reference's EXACT on-disk layout (torch-pickled
+``mp_rank_XX_model_states.pt`` + per-dp-rank ``*_optim_states.pt`` with flat
+fp32 master partitions — the format written by
+``/root/reference/deepspeed/runtime/engine.py:3284,3398`` and read back by its
+``zero_to_fp32.py``) and asserts our importer reconstructs the exact fp32
+weights for ZeRO-1/2, ZeRO-3, and no-ZeRO cases, plus end-to-end import of a
+GPT-2-named checkpoint into a runnable model.
+"""
+
+import collections
+import os
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from deepspeed_tpu.checkpoint.reference_import import (
+    get_fp32_state_dict_from_reference_checkpoint,
+    load_reference_checkpoint,
+)
+
+
+def _rand_sd(rng, spec):
+    return collections.OrderedDict(
+        (name, rng.normal(size=shape).astype(np.float32))
+        for name, shape in spec)
+
+
+def _write_model_states(tag_dir, sd, param_groups, stage, buffers=()):
+    """param_groups: list of lists of names, defining the group split."""
+    os.makedirs(tag_dir, exist_ok=True)
+    param_shapes = [
+        collections.OrderedDict(
+            (name, torch.Size(sd[name].shape)) for name in group)
+        for group in param_groups
+    ]
+    fname = ("zero_pp_rank_0_mp_rank_00_model_states.pt" if stage == 3
+             else "mp_rank_00_model_states.pt")
+    module = {k: torch.from_numpy(v) for k, v in sd.items()}
+    if stage == 3:  # params are placeholders under zero-3; keep buffers real
+        module = {k: (module[k] if k in buffers else torch.zeros(1))
+                  for k in module}
+    torch.save({
+        "module": module,
+        "buffer_names": list(buffers),
+        "param_shapes": param_shapes,
+        "ds_version": "0.8.1",
+    }, os.path.join(tag_dir, fname))
+
+
+def _write_zero12(tag_dir, sd, param_groups, world):
+    """Per-rank files: each group's flat fp32 vector padded to 2*world and
+    split into equal rank partitions (the reference's stage-1/2 layout)."""
+    parts_per_rank = [[] for _ in range(world)]
+    for group in param_groups:
+        flat = np.concatenate([sd[n].reshape(-1) for n in group])
+        align = 2 * world
+        padded = int(np.ceil(flat.size / align)) * align
+        flat = np.pad(flat, (0, padded - flat.size))
+        for r, chunk in enumerate(np.split(flat, world)):
+            parts_per_rank[r].append(torch.from_numpy(chunk.copy()))
+    for r in range(world):
+        torch.save({"optimizer_state_dict": {
+            "zero_stage": 2,
+            "partition_count": world,
+            "single_partition_of_fp32_groups": parts_per_rank[r],
+        }}, os.path.join(tag_dir, f"zero_pp_rank_{r}_mp_rank_00_optim_states.pt"))
+
+
+def _write_zero3(tag_dir, sd, param_groups, world):
+    """Per-rank files: one flat tensor per group, the rank's ceil(numel/world)
+    slice of every param concatenated (the reference's stage-3 layout)."""
+    rank_flats = [[[] for _ in param_groups] for _ in range(world)]
+    for g, group in enumerate(param_groups):
+        for name in group:
+            flat = sd[name].reshape(-1)
+            pn = -(-flat.size // world)
+            padded = np.pad(flat, (0, pn * world - flat.size))
+            for r in range(world):
+                rank_flats[r][g].append(padded[r * pn:(r + 1) * pn])
+    for r in range(world):
+        groups = [torch.from_numpy(np.concatenate(chunks))
+                  for chunks in rank_flats[r]]
+        torch.save({"optimizer_state_dict": {
+            "zero_stage": 3,
+            "partition_count": world,
+            "fp32_flat_groups": groups,
+        }}, os.path.join(tag_dir, f"bf16_zero_pp_rank_{r}_mp_rank_00_optim_states.pt"))
+
+
+def _finish(ckpt_dir, tag):
+    with open(os.path.join(ckpt_dir, "latest"), "w") as f:
+        f.write(tag)
+
+
+SPEC = [
+    ("embed.weight", (13, 8)),
+    ("layer.0.w", (8, 8)),
+    ("layer.0.b", (8,)),
+    ("layer.1.w", (8, 7)),  # odd sizes exercise the padding paths
+    ("head.weight", (7, 5)),
+]
+GROUPS = [["embed.weight", "layer.0.w", "layer.0.b"],
+          ["layer.1.w", "head.weight"]]
+
+
+@pytest.mark.parametrize("world", [1, 2, 4])
+def test_zero2_roundtrip(tmp_path, world):
+    rng = np.random.default_rng(world)
+    sd = _rand_sd(rng, SPEC)
+    tag_dir = str(tmp_path / "global_step5")
+    _write_model_states(tag_dir, sd, GROUPS, stage=2)
+    _write_zero12(tag_dir, sd, GROUPS, world)
+    _finish(str(tmp_path), "global_step5")
+
+    got = get_fp32_state_dict_from_reference_checkpoint(str(tmp_path))
+    assert set(got) == set(sd)
+    for k in sd:
+        np.testing.assert_array_equal(got[k], sd[k])
+
+
+@pytest.mark.parametrize("world", [2, 3])
+def test_zero3_roundtrip(tmp_path, world):
+    rng = np.random.default_rng(10 + world)
+    sd = _rand_sd(rng, SPEC)
+    tag_dir = str(tmp_path / "global_step9")
+    _write_model_states(tag_dir, sd, GROUPS, stage=3)
+    _write_zero3(tag_dir, sd, GROUPS, world)
+    _finish(str(tmp_path), "global_step9")
+
+    got = get_fp32_state_dict_from_reference_checkpoint(str(tmp_path))
+    for k in sd:
+        np.testing.assert_array_equal(got[k], sd[k])
+
+
+def test_no_zero_checkpoint(tmp_path):
+    rng = np.random.default_rng(0)
+    sd = _rand_sd(rng, SPEC)
+    tag_dir = str(tmp_path / "epoch1")
+    os.makedirs(tag_dir)
+    torch.save({"module": {k: torch.from_numpy(v) for k, v in sd.items()},
+                "ds_version": "0.8.1"},
+               os.path.join(tag_dir, "mp_rank_00_model_states.pt"))
+    _finish(str(tmp_path), "epoch1")
+    got = get_fp32_state_dict_from_reference_checkpoint(str(tmp_path))
+    for k in sd:
+        np.testing.assert_array_equal(got[k], sd[k])
+
+
+def test_incomplete_save_detected(tmp_path):
+    rng = np.random.default_rng(0)
+    sd = _rand_sd(rng, SPEC)
+    tag_dir = str(tmp_path / "global_step1")
+    _write_model_states(tag_dir, sd, GROUPS, stage=2)
+    _write_zero12(tag_dir, sd, GROUPS, world=4)
+    os.remove(os.path.join(tag_dir, "zero_pp_rank_3_mp_rank_00_optim_states.pt"))
+    _finish(str(tmp_path), "global_step1")
+    with pytest.raises(ValueError, match="incomplete"):
+        get_fp32_state_dict_from_reference_checkpoint(str(tmp_path))
+
+
+def test_gpt2_checkpoint_end_to_end(tmp_path, rng):
+    """A ZeRO-2 checkpoint of an HF-GPT-2-named module imports into a runnable
+    model whose forward matches the policy applied to the original weights."""
+    import jax
+
+    from deepspeed_tpu.models import gpt
+    from deepspeed_tpu.module_inject.replace_module import HF_POLICIES
+
+    L, D, H, V, T = 2, 16, 2, 32, 24
+    names = (["transformer.wte.weight", "transformer.wpe.weight"]
+             + [f"transformer.h.{i}.{p}" for i in range(L) for p in
+                ("ln_1.weight", "ln_1.bias", "attn.c_attn.weight",
+                 "attn.c_attn.bias", "attn.c_proj.weight", "attn.c_proj.bias",
+                 "ln_2.weight", "ln_2.bias", "mlp.c_fc.weight", "mlp.c_fc.bias",
+                 "mlp.c_proj.weight", "mlp.c_proj.bias")]
+             + ["transformer.ln_f.weight", "transformer.ln_f.bias"])
+    shapes = {
+        "ln_1.weight": (D,), "ln_1.bias": (D,),
+        "attn.c_attn.weight": (D, 3 * D), "attn.c_attn.bias": (3 * D,),
+        "attn.c_proj.weight": (D, D), "attn.c_proj.bias": (D,),
+        "ln_2.weight": (D,), "ln_2.bias": (D,),
+        "mlp.c_fc.weight": (D, 4 * D), "mlp.c_fc.bias": (4 * D,),
+        "mlp.c_proj.weight": (4 * D, D), "mlp.c_proj.bias": (D,),
+    }
+    spec = []
+    for n in names:
+        if n == "transformer.wte.weight":
+            spec.append((n, (V, D)))
+        elif n == "transformer.wpe.weight":
+            spec.append((n, (T, D)))
+        elif n.startswith("transformer.ln_f"):
+            spec.append((n, (D,)))
+        else:
+            spec.append((n, shapes[n.split(".", 3)[-1]]))
+    sd = _rand_sd(np.random.default_rng(7), spec)
+    sd = {k: (v * 0.05 if v.ndim > 1 else v) for k, v in sd.items()}
+
+    tag_dir = str(tmp_path / "global_step3")
+    groups = [[n for n, _ in spec]]
+    _write_model_states(tag_dir, collections.OrderedDict(sd), groups, stage=2)
+    _write_zero12(tag_dir, sd, groups, world=2)
+    _finish(str(tmp_path), "global_step3")
+
+    hf_config = dict(vocab_size=V, n_layer=L, n_head=H, n_embd=D,
+                     n_positions=T, layer_norm_epsilon=1e-5,
+                     activation_function="gelu_new")
+    cfg, params = load_reference_checkpoint(str(tmp_path), hf_config)
+
+    import types
+
+    ref_cfg, ref_params = HF_POLICIES["GPT2LMHeadModel"](
+        types.SimpleNamespace(**hf_config), sd)
+    assert cfg == ref_cfg
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(ref_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    ids = rng.integers(0, V, size=(2, 8)).astype(np.int32)
+    logits = gpt.forward(cfg, params, np.asarray(ids), train=False)
+    assert np.all(np.isfinite(np.asarray(logits)))
