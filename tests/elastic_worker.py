@@ -14,6 +14,9 @@ def main() -> int:
     p.add_argument("--log", required=True)
     p.add_argument("--steps", type=int, required=True)
     p.add_argument("--crash-at", type=int, default=-1)
+    p.add_argument("--on-crash-write", default=None,
+                   help="'path:text' written just before the simulated crash "
+                        "(models the membership change that caused it)")
     p.add_argument("--elastic-world", type=int, required=True)
     p.add_argument("--elastic-micro", type=int, required=True)
     p.add_argument("--elastic-gas", type=int, required=True)
@@ -78,6 +81,10 @@ def main() -> int:
                 "effective": effective}) + "\n")
         engine.save_checkpoint(args.ckpt_dir)
         if args.crash_at >= 0 and engine.global_steps >= args.crash_at:
+            if args.on_crash_write:
+                path, text = args.on_crash_write.rsplit(":", 1)
+                with open(path, "w") as f:
+                    f.write(text)
             os._exit(17)  # simulated worker failure
     return 0
 

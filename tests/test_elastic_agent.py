@@ -63,10 +63,10 @@ def test_kill_and_resume_at_new_dp(tmp_path):
     def make_cmd(spec: WorkerSpec):
         launches.append(spec)
         if len(launches) == 1:
-            # first (and only first) launch crashes; afterwards the cluster
-            # has shrunk — flip the membership the agent will see next
-            world_file.write_text("2")
-            crash = ["--crash-at", str(crash_at)]
+            # the first worker crashes mid-run AND shrinks the cluster at the
+            # moment of the crash (a lost node): the agent must re-resolve
+            crash = ["--crash-at", str(crash_at),
+                     "--on-crash-write", f"{world_file}:2"]
         else:
             crash = []
         env_clean = [sys.executable, worker,
