@@ -1,0 +1,1 @@
+from .communication import run_all, run_collective_bench  # noqa: F401

@@ -1,0 +1,353 @@
+"""Diffusion (Stable-Diffusion-family) inference models, TPU-native.
+
+Capability parity with the reference's diffusers inference surface — the
+CLIP/UNet/VAE injection policies (``model_implementations/diffusers/unet.py``,
+``vae.py``, ``module_inject/containers/{clip,unet,vae}.py``) and the spatial
+kernels (``csrc/spatial/csrc/opt_bias_add.cu``) — rebuilt as functional JAX:
+
+- a conditional **UNet2D** (timestep sinusoidal embedding + MLP, residual conv
+  blocks with GroupNorm/SiLU, self- and cross-attention at low resolution,
+  skip connections) in NHWC layout so XLA tiles convs onto the MXU directly;
+- a **VAE decoder** (conv + nearest-upsample stacks) mapping latents to images;
+- a **DDIM sampler** with classifier-free guidance, expressed as ``lax.scan``
+  over a precomputed timestep/alpha schedule — the whole sampling loop is ONE
+  compiled program (the reference gets loop fusion from CUDA graphs; here the
+  compiled scan IS the captured graph).
+
+The fused bias-add/GroupNorm/attention ops the reference hand-writes in CUDA
+are left to XLA fusion (NHWC elementwise chains fuse into the convolutions).
+Weights import from HF diffusers checkpoints via the standard policy route;
+this module owns architecture + sampling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+# ----------------------------------------------------------------- primitives
+def conv2d(x: jnp.ndarray, w: jnp.ndarray, b: Optional[jnp.ndarray] = None,
+           stride: int = 1, padding: str = "SAME") -> jnp.ndarray:
+    """NHWC conv; w: [kh, kw, cin, cout]."""
+    y = jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    if b is not None:
+        y = y + b
+    return y
+
+
+def group_norm(x: jnp.ndarray, scale: jnp.ndarray, bias: jnp.ndarray,
+               groups: int = 8, eps: float = 1e-5) -> jnp.ndarray:
+    """GroupNorm over NHWC channels (fp32 statistics)."""
+    n, h, w, c = x.shape
+    g = min(groups, c)
+    while c % g:
+        g -= 1
+    x32 = x.astype(jnp.float32).reshape(n, h, w, g, c // g)
+    mu = jnp.mean(x32, axis=(1, 2, 4), keepdims=True)
+    var = jnp.var(x32, axis=(1, 2, 4), keepdims=True)
+    y = ((x32 - mu) * jax.lax.rsqrt(var + eps)).reshape(n, h, w, c)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _silu(x):
+    return x * jax.nn.sigmoid(x)
+
+
+def timestep_embedding(t: jnp.ndarray, dim: int) -> jnp.ndarray:
+    """Sinusoidal timestep features [B, dim] (standard DDPM embedding)."""
+    half = dim // 2
+    freqs = jnp.exp(-np.log(10000.0) * jnp.arange(half, dtype=jnp.float32) / half)
+    ang = t.astype(jnp.float32)[:, None] * freqs[None, :]
+    return jnp.concatenate([jnp.cos(ang), jnp.sin(ang)], axis=-1)
+
+
+# ----------------------------------------------------------------- config
+@dataclasses.dataclass(frozen=True)
+class UNetConfig:
+    in_channels: int = 4              # latent channels
+    out_channels: int = 4
+    base_channels: int = 64
+    channel_mults: Tuple[int, ...] = (1, 2)
+    text_dim: int = 64                # cross-attention context width
+    n_head: int = 4
+    time_dim: int = 128
+    groups: int = 8
+
+
+@dataclasses.dataclass(frozen=True)
+class VAEDecoderConfig:
+    latent_channels: int = 4
+    base_channels: int = 32
+    out_channels: int = 3
+    upsamples: int = 2                # latent 8x8 -> image 32x32 at 2
+    scaling_factor: float = 0.18215   # SD latent scaling
+
+
+# ----------------------------------------------------------------- init
+def _conv_init(key, kh, kw, cin, cout, scale=1.0):
+    fan = kh * kw * cin
+    return jax.random.normal(key, (kh, kw, cin, cout), jnp.float32) * (
+        scale / np.sqrt(fan))
+
+
+def _dense_init(key, cin, cout, scale=1.0):
+    return jax.random.normal(key, (cin, cout), jnp.float32) * (scale / np.sqrt(cin))
+
+
+def _res_block_init(key, cin, cout, time_dim):
+    k = jax.random.split(key, 4)
+    p = {
+        "gn1_s": jnp.ones((cin,)), "gn1_b": jnp.zeros((cin,)),
+        "conv1_w": _conv_init(k[0], 3, 3, cin, cout), "conv1_b": jnp.zeros((cout,)),
+        "time_w": _dense_init(k[1], time_dim, cout), "time_b": jnp.zeros((cout,)),
+        "gn2_s": jnp.ones((cout,)), "gn2_b": jnp.zeros((cout,)),
+        "conv2_w": _conv_init(k[2], 3, 3, cout, cout, scale=0.1),
+        "conv2_b": jnp.zeros((cout,)),
+    }
+    if cin != cout:
+        p["skip_w"] = _conv_init(k[3], 1, 1, cin, cout)
+    return p
+
+
+def _attn_init(key, c, ctx_dim, n_head):
+    k = jax.random.split(key, 5)
+    return {
+        "gn_s": jnp.ones((c,)), "gn_b": jnp.zeros((c,)),
+        "q_w": _dense_init(k[0], c, c),
+        "k_w": _dense_init(k[1], ctx_dim, c),
+        "v_w": _dense_init(k[2], ctx_dim, c),
+        "o_w": _dense_init(k[3], c, c, scale=0.1),
+        "o_b": jnp.zeros((c,)),
+    }
+
+
+def init_unet(cfg: UNetConfig, rng: jax.Array) -> Dict[str, Any]:
+    keys = iter(jax.random.split(rng, 64))
+    ch = [cfg.base_channels * m for m in cfg.channel_mults]
+    td = cfg.time_dim
+    p: Dict[str, Any] = {
+        "time_w1": _dense_init(next(keys), td, td), "time_b1": jnp.zeros((td,)),
+        "time_w2": _dense_init(next(keys), td, td), "time_b2": jnp.zeros((td,)),
+        "in_w": _conv_init(next(keys), 3, 3, cfg.in_channels, ch[0]),
+        "in_b": jnp.zeros((ch[0],)),
+        "down": [], "up": [],
+    }
+    cur = ch[0]
+    for c in ch:
+        p["down"].append({
+            "res": _res_block_init(next(keys), cur, c, td),
+            "down_w": _conv_init(next(keys), 3, 3, c, c),
+            "down_b": jnp.zeros((c,)),
+        })
+        cur = c
+    p["mid_res1"] = _res_block_init(next(keys), cur, cur, td)
+    p["mid_self"] = _attn_init(next(keys), cur, cur, cfg.n_head)
+    p["mid_cross"] = _attn_init(next(keys), cur, cfg.text_dim, cfg.n_head)
+    p["mid_res2"] = _res_block_init(next(keys), cur, cur, td)
+    for c in reversed(ch):
+        p["up"].append({
+            # upsample conv maps the previous level's channels -> this level's;
+            # the residual block consumes [conv out (c) || skip (c)] = 2c
+            "res": _res_block_init(next(keys), 2 * c, c, td),
+            "up_w": _conv_init(next(keys), 3, 3, cur, c),
+            "up_b": jnp.zeros((c,)),
+        })
+        cur = c
+    p["out_gn_s"] = jnp.ones((cur,))
+    p["out_gn_b"] = jnp.zeros((cur,))
+    p["out_w"] = _conv_init(next(keys), 3, 3, cur, cfg.out_channels, scale=0.1)
+    p["out_b"] = jnp.zeros((cfg.out_channels,))
+    return p
+
+
+def init_vae_decoder(cfg: VAEDecoderConfig, rng: jax.Array) -> Dict[str, Any]:
+    keys = iter(jax.random.split(rng, 16))
+    c = cfg.base_channels
+    p: Dict[str, Any] = {
+        "in_w": _conv_init(next(keys), 3, 3, cfg.latent_channels, c),
+        "in_b": jnp.zeros((c,)),
+        "blocks": [],
+    }
+    for _ in range(cfg.upsamples):
+        p["blocks"].append({
+            "gn_s": jnp.ones((c,)), "gn_b": jnp.zeros((c,)),
+            "conv_w": _conv_init(next(keys), 3, 3, c, c),
+            "conv_b": jnp.zeros((c,)),
+        })
+    p["out_gn_s"] = jnp.ones((c,))
+    p["out_gn_b"] = jnp.zeros((c,))
+    p["out_w"] = _conv_init(next(keys), 3, 3, c, cfg.out_channels, scale=0.1)
+    p["out_b"] = jnp.zeros((cfg.out_channels,))
+    return p
+
+
+# ----------------------------------------------------------------- apply
+def _res_block(cfg: UNetConfig, p, x, temb):
+    h = group_norm(x, p["gn1_s"], p["gn1_b"], cfg.groups)
+    h = conv2d(_silu(h), p["conv1_w"], p["conv1_b"])
+    h = h + (_silu(temb) @ p["time_w"] + p["time_b"])[:, None, None, :]
+    h = group_norm(h, p["gn2_s"], p["gn2_b"], cfg.groups)
+    h = conv2d(_silu(h), p["conv2_w"], p["conv2_b"])
+    skip = conv2d(x, p["skip_w"]) if "skip_w" in p else x
+    return h + skip
+
+
+def _attention(cfg: UNetConfig, p, x, context=None):
+    """Spatial (self or cross) attention at [B, H, W, C]."""
+    B, H, W, C = x.shape
+    h = group_norm(x, p["gn_s"], p["gn_b"], cfg.groups)
+    q = h.reshape(B, H * W, C) @ p["q_w"]
+    ctx = h.reshape(B, H * W, C) if context is None else context
+    k = ctx @ p["k_w"]
+    v = ctx @ p["v_w"]
+    nh = cfg.n_head
+    dh = C // nh
+
+    def split(t):
+        return t.reshape(B, -1, nh, dh).transpose(0, 2, 1, 3)
+
+    s = jnp.einsum("bhqd,bhkd->bhqk", split(q), split(k)) / np.sqrt(dh)
+    a = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(x.dtype)
+    o = jnp.einsum("bhqk,bhkd->bhqd", a, split(v))
+    o = o.transpose(0, 2, 1, 3).reshape(B, H * W, C) @ p["o_w"] + p["o_b"]
+    return x + o.reshape(B, H, W, C)
+
+
+def apply_unet(cfg: UNetConfig, params, latents: jnp.ndarray, t: jnp.ndarray,
+               text_emb: jnp.ndarray) -> jnp.ndarray:
+    """Predict noise. latents [B,H,W,Cin]; t [B]; text_emb [B,S,text_dim]."""
+    temb = timestep_embedding(t, cfg.time_dim).astype(latents.dtype)
+    temb = _silu(temb @ params["time_w1"] + params["time_b1"])
+    temb = temb @ params["time_w2"] + params["time_b2"]
+
+    x = conv2d(latents, params["in_w"], params["in_b"])
+    skips = []
+    for blk in params["down"]:
+        x = _res_block(cfg, blk["res"], x, temb)
+        skips.append(x)
+        x = conv2d(x, blk["down_w"], blk["down_b"], stride=2)
+    x = _res_block(cfg, params["mid_res1"], x, temb)
+    x = _attention(cfg, params["mid_self"], x)
+    x = _attention(cfg, params["mid_cross"], x, context=text_emb)
+    x = _res_block(cfg, params["mid_res2"], x, temb)
+    for blk in params["up"]:
+        # nearest-neighbor upsample then conv (SD's Upsample2D)
+        B, H, W, C = x.shape
+        x = jax.image.resize(x, (B, H * 2, W * 2, C), "nearest")
+        x = conv2d(x, blk["up_w"], blk["up_b"])
+        x = jnp.concatenate([x, skips.pop()], axis=-1)
+        x = _res_block(cfg, blk["res"], x, temb)
+    x = group_norm(x, params["out_gn_s"], params["out_gn_b"], cfg.groups)
+    return conv2d(_silu(x), params["out_w"], params["out_b"])
+
+
+def apply_vae_decoder(cfg: VAEDecoderConfig, params, latents: jnp.ndarray
+                      ) -> jnp.ndarray:
+    """Latents [B,h,w,Cl] -> images [B, h*2^U, w*2^U, 3] in [-1, 1]."""
+    x = conv2d(latents / cfg.scaling_factor, params["in_w"], params["in_b"])
+    for blk in params["blocks"]:
+        B, H, W, C = x.shape
+        x = jax.image.resize(x, (B, H * 2, W * 2, C), "nearest")
+        x = group_norm(x, blk["gn_s"], blk["gn_b"])
+        x = conv2d(_silu(x), blk["conv_w"], blk["conv_b"])
+    x = group_norm(x, params["out_gn_s"], params["out_gn_b"])
+    return jnp.tanh(conv2d(_silu(x), params["out_w"], params["out_b"]))
+
+
+# ----------------------------------------------------------------- sampler
+def ddim_schedule(num_steps: int, num_train_timesteps: int = 1000,
+                  beta_start: float = 8.5e-4, beta_end: float = 1.2e-2):
+    """Precomputed (timesteps [S], alpha_bar [S+1]) for DDIM (scaled-linear
+    betas, the SD schedule)."""
+    betas = np.linspace(beta_start ** 0.5, beta_end ** 0.5,
+                        num_train_timesteps) ** 2
+    alpha_bar = np.cumprod(1.0 - betas)
+    step = num_train_timesteps // num_steps
+    ts = np.arange(num_train_timesteps - 1, -1, -step)[:num_steps]
+    abar = alpha_bar[ts]
+    abar_prev = np.concatenate([alpha_bar[ts[1:]], [1.0]])
+    return (jnp.asarray(ts, jnp.int32), jnp.asarray(abar, jnp.float32),
+            jnp.asarray(abar_prev, jnp.float32))
+
+
+def ddim_sample(cfg: UNetConfig, params, latents: jnp.ndarray,
+                text_emb: jnp.ndarray, uncond_emb: jnp.ndarray,
+                num_steps: int = 20, guidance_scale: float = 7.5) -> jnp.ndarray:
+    """Deterministic DDIM (eta=0) with classifier-free guidance, as one scan.
+
+    Parity: the reference's patched SD pipeline loop under CUDA graphs
+    (``model_implementations/diffusers/unet.py`` forward + graph replay).
+    """
+    ts, abar, abar_prev = ddim_schedule(num_steps)
+    B = latents.shape[0]
+    ctx = jnp.concatenate([text_emb, uncond_emb], axis=0)  # one batched UNet call
+
+    def step(x, sched):
+        t, ab, ab_prev = sched
+        tb = jnp.full((2 * B,), t, jnp.int32)
+        eps_both = apply_unet(cfg, params, jnp.concatenate([x, x], axis=0),
+                              tb, ctx)
+        eps_c, eps_u = eps_both[:B], eps_both[B:]
+        eps = eps_u + guidance_scale * (eps_c - eps_u)
+        x0 = (x - jnp.sqrt(1.0 - ab) * eps) / jnp.sqrt(ab)
+        x = jnp.sqrt(ab_prev) * x0 + jnp.sqrt(1.0 - ab_prev) * eps
+        return x, None
+
+    latents, _ = jax.lax.scan(step, latents, (ts, abar, abar_prev))
+    return latents
+
+
+# ----------------------------------------------------------------- pipeline
+@dataclasses.dataclass
+class StableDiffusionPipeline:
+    """Latent-diffusion text-to-image inference. Parity surface: the engine's
+    diffusers path (``init_inference`` on an SD pipeline; CLIP text encoding is
+    supplied by the caller as embeddings — any encoder works)."""
+
+    unet_cfg: UNetConfig
+    vae_cfg: VAEDecoderConfig
+    unet_params: Any
+    vae_params: Any
+    latent_size: int = 8
+
+    @classmethod
+    def init_random(cls, rng: jax.Array, unet_cfg: Optional[UNetConfig] = None,
+                    vae_cfg: Optional[VAEDecoderConfig] = None,
+                    latent_size: int = 8) -> "StableDiffusionPipeline":
+        unet_cfg = unet_cfg or UNetConfig()
+        vae_cfg = vae_cfg or VAEDecoderConfig()
+        k1, k2 = jax.random.split(rng)
+        return cls(unet_cfg, vae_cfg, init_unet(unet_cfg, k1),
+                   init_vae_decoder(vae_cfg, k2), latent_size)
+
+    @functools.cached_property
+    def _jitted(self):
+        def fn(unet_params, vae_params, text_emb, uncond_emb, noise,
+               guidance_scale, num_steps):
+            lat = ddim_sample(self.unet_cfg, unet_params, noise, text_emb,
+                              uncond_emb, num_steps=num_steps,
+                              guidance_scale=guidance_scale)
+            return apply_vae_decoder(self.vae_cfg, vae_params, lat)
+
+        return jax.jit(fn, static_argnames=("num_steps",))
+
+    def __call__(self, text_emb: jnp.ndarray, uncond_emb: jnp.ndarray,
+                 num_steps: int = 20, guidance_scale: float = 7.5,
+                 seed: int = 0) -> np.ndarray:
+        B = text_emb.shape[0]
+        noise = jax.random.normal(
+            jax.random.PRNGKey(seed),
+            (B, self.latent_size, self.latent_size, self.unet_cfg.in_channels))
+        img = self._jitted(self.unet_params, self.vae_params, text_emb,
+                           uncond_emb, noise, jnp.float32(guidance_scale),
+                           num_steps)
+        return np.asarray(img)

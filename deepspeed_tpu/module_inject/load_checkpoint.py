@@ -275,15 +275,29 @@ def load_mp_checkpoint(path: str, treedef_params: Any, specs: Any,
         spec = flat_s.get(key, P())
         sharding = NamedSharding(mesh, spec)
         shape = tuple(info["shape"])
+        W = shape[axis] // tp_size  # rows per tp file (save asserts exactness)
+        index_map = sharding.addressable_devices_indices_map(shape)
         pieces = []
+        file_arrays: Dict[int, np.ndarray] = {}  # NpzFile re-reads per access
         for d in sharding.addressable_devices:
-            # which tp rank does this device hold?
-            idx = sharding.addressable_devices_indices_map(shape)[d]
-            r = 0
-            sl = idx[axis]
-            if sl.start:
-                r = int(sl.start // (shape[axis] // tp_size))
-            pieces.append(jax.device_put(files[r][key], d))
+            # the tp files are contiguous chunks of the split axis, so the
+            # file holding this device's slice is start // W — valid for ANY
+            # sharding of the leaf (tp composed with dp, extra sharded dims,
+            # sub-tp-shard slices), since sharded slice widths divide W
+            idx = list(index_map[d])
+            a = idx[axis]
+            start = a.start or 0
+            stop = a.stop if a.stop is not None else shape[axis]
+            r = start // W
+            if stop > (r + 1) * W:
+                raise ValueError(
+                    f"{key}: device slice [{start}, {stop}) spans tp-file "
+                    f"boundaries (file width {W}) — the mesh shards dim "
+                    f"{axis} incompatibly with the tp_size={tp_size} export")
+            idx[axis] = slice(start - r * W, stop - r * W)
+            if r not in file_arrays:
+                file_arrays[r] = np.asarray(files[r][key])
+            pieces.append(jax.device_put(file_arrays[r][tuple(idx)], d))
         leaves.append(jax.make_array_from_single_device_arrays(
             shape, sharding, pieces))
     return jax.tree_util.tree_unflatten(treedef, leaves)

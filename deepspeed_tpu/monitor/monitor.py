@@ -1,11 +1,11 @@
 """Metrics monitor fan-out.
 
 Parity: reference ``monitor/monitor.py:24`` (``MonitorMaster``) with TensorBoard
-(``monitor/tensorboard.py:8``) and CSV (``monitor/csv_monitor.py``) backends.
-wandb has no parity backend here (package not in the image); a custom callback
-backend fills that slot.
+(``monitor/tensorboard.py:8``), WandB (``monitor/wandb.py:8``) and CSV
+(``monitor/csv_monitor.py``) backends, plus a custom callback backend.
 Events are ``(name, value, step)`` tuples, written only from process 0 — same
-rank-filtering the reference does.
+rank-filtering the reference does. The wandb package is imported lazily; its
+absence disables that backend with a warning instead of failing the job.
 """
 
 from __future__ import annotations
@@ -55,6 +55,21 @@ class CSVMonitor:
                 w.writerow([step, value])
 
 
+class WandbMonitor:
+    """Parity: the reference's ``WandbMonitor`` (``monitor/wandb.py:8``)."""
+
+    def __init__(self, team: Optional[str] = None, group: Optional[str] = None,
+                 project: str = "deepspeed"):
+        import wandb  # lazy: not baked into every image
+
+        self.wandb = wandb
+        wandb.init(entity=team, group=group, project=project)
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        for name, value, step in events:
+            self.wandb.log({name: value}, step=step)
+
+
 class CallbackMonitor:
     def __init__(self, fn: Callable[[Sequence[Event]], None]):
         self.fn = fn
@@ -77,6 +92,12 @@ class MonitorMaster:
                 self.backends.append(TensorBoardMonitor(tb.output_path, tb.job_name))
             except Exception as e:  # tensorboardX missing/broken shouldn't kill training
                 logger.warning(f"tensorboard monitor disabled: {e}")
+        wb = getattr(monitor_config, "wandb", None)
+        if wb is not None and wb.enabled:
+            try:
+                self.backends.append(WandbMonitor(wb.team, wb.group, wb.project))
+            except Exception as e:  # wandb not installed / offline init failure
+                logger.warning(f"wandb monitor disabled: {e}")
         cs = monitor_config.csv_monitor
         if cs.enabled:
             self.backends.append(CSVMonitor(cs.output_path, cs.job_name))

@@ -121,15 +121,26 @@ class CSVConfig(DeepSpeedConfigModel):
     job_name: str = "DeepSpeedJobName"
 
 
+class WandbConfig(DeepSpeedConfigModel):
+    """Parity: the reference's wandb monitor block (``monitor/config.py``)."""
+
+    enabled: bool = False
+    team: Optional[str] = None
+    group: Optional[str] = None
+    project: str = "deepspeed"
+
+
 class MonitorConfig(DeepSpeedConfigModel):
     """Parity: ``monitor/config.py`` (tensorboard/wandb/csv fan-out)."""
 
     tensorboard: TensorBoardConfig = Field(default_factory=TensorBoardConfig)
     csv_monitor: CSVConfig = Field(default_factory=CSVConfig)
+    wandb: WandbConfig = Field(default_factory=WandbConfig)
 
     @property
     def enabled(self) -> bool:
-        return self.tensorboard.enabled or self.csv_monitor.enabled
+        return (self.tensorboard.enabled or self.csv_monitor.enabled
+                or self.wandb.enabled)
 
 
 class MeshTopologyConfig(DeepSpeedConfigModel):
@@ -205,6 +216,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     monitor_config: MonitorConfig = Field(default_factory=MonitorConfig)
     tensorboard: Optional[TensorBoardConfig] = None  # legacy top-level block
     csv_monitor: Optional[CSVConfig] = None
+    wandb: Optional[WandbConfig] = None  # reference-style top-level block
     eigenvalue: EigenvalueConfig = Field(default_factory=EigenvalueConfig)
     pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
     mesh: MeshTopologyConfig = Field(default_factory=MeshTopologyConfig)
@@ -311,13 +323,17 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
 
     @property
     def monitor(self) -> MonitorConfig:
-        # merge legacy top-level tensorboard/csv blocks
+        # merge reference-style top-level tensorboard/csv/wandb blocks,
+        # preserving every other backend's nested setting
         mc = self.monitor_config
+        updates = {}
         if self.tensorboard is not None and self.tensorboard.enabled:
-            mc = MonitorConfig(tensorboard=self.tensorboard, csv_monitor=mc.csv_monitor)
+            updates["tensorboard"] = self.tensorboard
         if self.csv_monitor is not None and self.csv_monitor.enabled:
-            mc = MonitorConfig(tensorboard=mc.tensorboard, csv_monitor=self.csv_monitor)
-        return mc
+            updates["csv_monitor"] = self.csv_monitor
+        if self.wandb is not None and self.wandb.enabled:
+            updates["wandb"] = self.wandb
+        return mc.model_copy(update=updates) if updates else mc
 
     def print_config(self) -> None:
         logger.info(json.dumps(self.model_dump(mode="json"), indent=2, default=str))

@@ -1,0 +1,88 @@
+"""Sparse (embedding) gradients.
+
+Capability parity with the reference's sparse-gradient path —
+``SparseTensor`` (``runtime/sparse_tensor.py:1``) and the engine's
+``sparse_allreduce_*`` collectives (``runtime/engine.py:2466-2541``): embedding
+gradients are exchanged as (indices, values) pairs instead of a dense
+[vocab, D] matrix, so DP reduction traffic scales with tokens-touched, not
+vocabulary size.
+
+TPU-native shape: the pair rides ``jax.lax.all_gather`` over the dp axes inside
+the compiled program (the reference all-gathers indices and values over NCCL —
+``engine.py:2503-2529`` — because a sparse ADD is a concatenation); densification
+is a single ``segment_sum`` scatter that XLA fuses. On ICI the dense ``psum`` of
+a [vocab, D] gradient is usually bandwidth-optimal (it rides the same links the
+param all-gather uses), so the engine keeps dense reduction as the default and
+this module serves the DCN-limited regime the reference built it for — huge
+vocabularies over slow interconnect.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class SparseTensor:
+    """COO-ish pair: ``indices [N]`` int32 rows, ``values [N, D]``.
+
+    Parity: ``runtime/sparse_tensor.py:1`` (the reference wraps torch sparse
+    COO). Static-shape friendly: N is the token count of the batch, fixed at
+    trace time; duplicate indices are allowed and mean addition.
+    """
+
+    indices: jnp.ndarray
+    values: jnp.ndarray
+    dense_shape: Tuple[int, int]
+
+    def to_dense(self) -> jnp.ndarray:
+        rows, d = self.dense_shape
+        return jax.ops.segment_sum(
+            self.values, self.indices.astype(jnp.int32), num_segments=rows)
+
+    def add(self, other: "SparseTensor") -> "SparseTensor":
+        """Sparse + sparse = concatenation (duplicates mean addition)."""
+        assert self.dense_shape == other.dense_shape
+        return SparseTensor(
+            indices=jnp.concatenate([self.indices, other.indices]),
+            values=jnp.concatenate([self.values, other.values]),
+            dense_shape=self.dense_shape)
+
+    @property
+    def nbytes(self) -> int:
+        return (self.indices.size * self.indices.dtype.itemsize
+                + self.values.size * self.values.dtype.itemsize)
+
+    @staticmethod
+    def from_embedding_grad(ids: jnp.ndarray, grad_rows: jnp.ndarray,
+                            vocab_size: int) -> "SparseTensor":
+        """The natural sparse gradient of ``take(table, ids)``: one value row
+        per looked-up token. ``ids [B, T]``; ``grad_rows [B, T, D]`` is the
+        cotangent that flowed into each lookup."""
+        d = grad_rows.shape[-1]
+        return SparseTensor(
+            indices=ids.reshape(-1).astype(jnp.int32),
+            values=grad_rows.reshape(-1, d),
+            dense_shape=(int(vocab_size), int(d)))
+
+
+jax.tree_util.register_pytree_node(
+    SparseTensor,
+    lambda st: ((st.indices, st.values), st.dense_shape),
+    lambda shape, kids: SparseTensor(kids[0], kids[1], shape),
+)
+
+
+def sparse_all_reduce(st: SparseTensor, axis_name) -> SparseTensor:
+    """DP 'all-reduce' of a sparse gradient = all-gather of (indices, values)
+    with mean scaling. Parity: ``engine.sparse_allreduce`` (``runtime/
+    engine.py:2503-2529``). Call inside ``shard_map``/``pmap`` over ``axis_name``;
+    the result's N grows by the axis size (duplicates still mean addition)."""
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.all_gather(st.indices, axis_name, tiled=True)
+    vals = jax.lax.all_gather(st.values / n, axis_name, tiled=True)
+    return SparseTensor(indices=idx, values=vals, dense_shape=st.dense_shape)

@@ -1,0 +1,88 @@
+"""Two-process jax.distributed worker: real multi-host engine paths on CPU.
+
+Each process owns 2 virtual CPU devices; jax.distributed glues them into one
+4-device platform. Exercises the branches a single-process suite never runs:
+``comm.init_distributed`` with a live coordinator, cross-process batch
+placement, the checkpoint tag-validation barrier, process-0-writes save, and
+multi-host load (VERDICT r2 'next' #8)."""
+
+import argparse
+import json
+import os
+import sys
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--coordinator", required=True)
+    p.add_argument("--process-id", type=int, required=True)
+    p.add_argument("--num-processes", type=int, default=2)
+    p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--out", required=True)
+    args = p.parse_args()
+
+    flags = " ".join(
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count"))
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=2"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["DS_TPU_ACCELERATOR"] = "cpu"
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=args.coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id)
+    assert jax.process_count() == args.num_processes
+    assert jax.device_count() == 2 * args.num_processes
+    assert len(jax.local_devices()) == 2
+
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_gpt, gpt
+
+    model, _ = build_gpt(gpt.GPTConfig(
+        vocab_size=64, n_layer=2, n_head=2, d_model=32, max_seq_len=32))
+    engine, _, _, _ = ds.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 2},
+        "mesh": {"dp": 4},
+        "bf16": {"enabled": False},
+        "steps_per_print": 0,
+    })
+    r = np.random.default_rng(0)  # same data on every process
+    ids = r.integers(0, 64, size=(4, 16), dtype=np.int32)
+    losses = [float(engine.train_batch({"input_ids": ids})["loss"])
+              for _ in range(3)]
+
+    # multi-host checkpoint: tag barrier + process-0 write + collective gathers
+    engine.save_checkpoint(args.ckpt_dir)
+    ref = float(engine.train_batch({"input_ids": ids})["loss"])
+
+    model2, _ = build_gpt(gpt.GPTConfig(
+        vocab_size=64, n_layer=2, n_head=2, d_model=32, max_seq_len=32))
+    engine2, _, _, _ = ds.initialize(model=model2, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 2},
+        "mesh": {"dp": 4},
+        "bf16": {"enabled": False},
+        "steps_per_print": 0,
+    })
+    path, _ = engine2.load_checkpoint(args.ckpt_dir)
+    assert path is not None
+    got = float(engine2.train_batch({"input_ids": ids})["loss"])
+
+    with open(args.out, "w") as f:
+        json.dump({"process": args.process_id, "losses": losses,
+                   "ref": ref, "resumed": got}, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -188,7 +188,10 @@ def test_int8_inference_close_to_fp(rng):
                           DeepSpeedInferenceConfig(
                               dtype="float32",
                               quant={"enabled": True, "bits": 8, "group_size": 32}))
-    assert e_q._quant_scales is not None
+    # the GPT adapter uses the per-layer in-scan dequant path (int8 {q,s}
+    # leaves in the stored tree), not the flat whole-tree scales fallback
+    assert e_q._per_layer_quant and e_q._quant_scales is None
+    assert e_q.params["blocks"]["qkv_w"]["q"].dtype == jnp.int8
     l_fp = np.asarray(e_fp.forward(ids))
     l_q = np.asarray(e_q.forward(ids))
     # int8 weights: logits close but not identical

@@ -1,0 +1,164 @@
+"""Test-depth round-up (VERDICT r2 'next' #8).
+
+- load_mp_checkpoint rank mapping hardened: multi-axis-sharded leaves (tp
+  composed with dp on the same or different dims) reload exactly (weak #8);
+- fixed-seed convergence test with loss-curve bounds (the reference's
+  ``tests/model/`` discipline scaled to CI);
+- key engine paths exercised at world sizes {2, 4, 8} (the reference's
+  ``DistributedTest.world_size`` lists).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import build_gpt, gpt
+from deepspeed_tpu.runtime.topology import MeshTopology
+
+
+# -------------------------------------------------------- mp reload, multi-axis
+def _roundtrip(tmp_path, params, specs, topo):
+    from deepspeed_tpu.module_inject.load_checkpoint import (
+        load_mp_checkpoint,
+        save_mp_checkpoint,
+    )
+
+    save_mp_checkpoint(str(tmp_path), params, specs, tp_size=2)
+    shapes = jax.eval_shape(lambda: params)
+    loaded = load_mp_checkpoint(str(tmp_path), shapes, specs, mesh=topo.mesh)
+    for key in params:
+        np.testing.assert_array_equal(
+            np.asarray(loaded[key]), np.asarray(params[key]), err_msg=key)
+        got_spec = tuple(loaded[key].sharding.spec)
+        want = tuple(specs[key])
+        assert got_spec == want, (key, got_spec, want)
+
+
+def test_load_mp_checkpoint_multi_axis_sharding(tmp_path, devices):
+    """Leaves sharded over ('dp','tp') on ONE dim, tp+dp on different dims,
+    and plain tp must all reload bitwise-correctly (weak #8: the old mapping
+    silently placed rank-0 data for composite shardings)."""
+    rng = np.random.default_rng(0)
+    topo = MeshTopology.create(dp=4, tp=2, devices=devices)
+    params = {
+        "combined": jnp.asarray(rng.normal(size=(16, 6)), jnp.float32),
+        "two_dims": jnp.asarray(rng.normal(size=(8, 12)), jnp.float32),
+        "plain_tp": jnp.asarray(rng.normal(size=(4, 10)), jnp.float32),
+        "replicated": jnp.asarray(rng.normal(size=(5,)), jnp.float32),
+    }
+    specs = {
+        "combined": P(("dp", "tp"), None),   # one dim, composed axes
+        "two_dims": P("tp", "dp"),           # tp dim0, dp dim1
+        "plain_tp": P(None, "tp"),
+        "replicated": P(None),
+    }
+    _roundtrip(tmp_path, params, specs, topo)
+
+
+def test_load_mp_checkpoint_composed_order_and_downshard(tmp_path, devices):
+    """(a) a ('dp','tp')-composed reload of a tp=4 export is data-correct (any
+    aligned sub-slice lies inside one tp file); (b) reloading at a SMALLER tp
+    than exported needs device slices wider than a file — fail loudly."""
+    from deepspeed_tpu.module_inject.load_checkpoint import (
+        load_mp_checkpoint,
+        save_mp_checkpoint,
+    )
+
+    rng = np.random.default_rng(1)
+    params = {"w": jnp.asarray(rng.normal(size=(16, 4)), jnp.float32)}
+    save_mp_checkpoint(str(tmp_path), params, {"w": P("tp", None)}, tp_size=4)
+    shapes = jax.eval_shape(lambda: params)
+
+    topo = MeshTopology.create(dp=2, tp=4, devices=devices)
+    loaded = load_mp_checkpoint(str(tmp_path), shapes,
+                                {"w": P(("dp", "tp"), None)}, mesh=topo.mesh)
+    np.testing.assert_array_equal(np.asarray(loaded["w"]),
+                                  np.asarray(params["w"]))
+
+    topo2 = MeshTopology.create(dp=4, tp=2, devices=devices)
+    with pytest.raises(ValueError, match="spans tp-file"):
+        load_mp_checkpoint(str(tmp_path), shapes, {"w": P("tp", None)},
+                           mesh=topo2.mesh)
+
+
+# -------------------------------------------------------- convergence
+def test_fixed_seed_convergence():
+    """Small GPT memorizes a fixed batch: the loss curve must fall below
+    bounds at fixed step marks (parity: tests/model convergence checks)."""
+    model, _ = build_gpt(gpt.GPTConfig(
+        vocab_size=128, n_layer=2, n_head=4, d_model=64, max_seq_len=64))
+    engine, _, _, _ = ds.initialize(model=model, seed=7, config={
+        "train_micro_batch_size_per_gpu": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 3e-3}},
+        "zero_optimization": {"stage": 2},
+        "mesh": {"dp": 8},
+        "bf16": {"enabled": False},
+        "steps_per_print": 0,
+    })
+    r = np.random.default_rng(3)
+    batch = {"input_ids": r.integers(0, 128, size=(8, 32), dtype=np.int32)}
+    losses = [float(engine.train_batch(batch)["loss"]) for _ in range(30)]
+    assert losses[0] > 4.0  # ~ln(128) cold
+    assert losses[9] < losses[0]
+    assert losses[29] < 1.0, losses[-5:]  # memorization bound
+    assert all(np.isfinite(l) for l in losses)
+
+
+# -------------------------------------------------------- world-size sweep
+@pytest.mark.parametrize("world", [2, 4, 8])
+def test_zero3_train_and_checkpoint_at_world_sizes(world, tmp_path, devices):
+    """The reference runs key suites at several world sizes
+    (DistributedTest.world_size lists); sweep ZeRO-3 train + ckpt round-trip."""
+    model, _ = build_gpt(gpt.GPTConfig(
+        vocab_size=64, n_layer=2, n_head=2, d_model=32, max_seq_len=32))
+    topo = MeshTopology.create(dp=world, devices=devices[:world])
+    config = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 3, "stage3_param_persistence_threshold": 0},
+        "mesh": {"dp": world},
+        "bf16": {"enabled": False},
+        "steps_per_print": 0,
+    }
+    engine, _, _, _ = ds.initialize(model=model, topology=topo, config=config)
+    r = np.random.default_rng(0)
+    ids = r.integers(0, 64, size=(world, 16), dtype=np.int32)
+    losses = [float(engine.train_batch({"input_ids": ids})["loss"])
+              for _ in range(3)]
+    assert losses[-1] < losses[0]
+    engine.save_checkpoint(str(tmp_path / f"w{world}"))
+    ref = float(engine.train_batch({"input_ids": ids})["loss"])
+
+    model2, _ = build_gpt(gpt.GPTConfig(
+        vocab_size=64, n_layer=2, n_head=2, d_model=32, max_seq_len=32))
+    engine2, _, _, _ = ds.initialize(
+        model=model2, topology=MeshTopology.create(dp=world, devices=devices[:world]),
+        config=config)
+    engine2.load_checkpoint(str(tmp_path / f"w{world}"))
+    got = float(engine2.train_batch({"input_ids": ids})["loss"])
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+@pytest.mark.parametrize("world,tp", [(4, 2), (8, 4)])
+def test_tp_worlds(world, tp, devices):
+    model, _ = build_gpt(gpt.GPTConfig(
+        vocab_size=64, n_layer=2, n_head=4, d_model=32, max_seq_len=32))
+    topo = MeshTopology.create(dp=world // tp, tp=tp, devices=devices[:world])
+    engine, _, _, _ = ds.initialize(model=model, topology=topo, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 1},
+        "mesh": {"dp": world // tp, "tp": tp},
+        "bf16": {"enabled": False},
+        "steps_per_print": 0,
+    })
+    r = np.random.default_rng(0)
+    ids = r.integers(0, 64, size=(2 * (world // tp), 16), dtype=np.int32)
+    m = engine.train_batch({"input_ids": ids})
+    assert np.isfinite(float(m["loss"]))
+    qkv = engine.state["params"]["blocks"]["qkv_w"]
+    assert "tp" in str(qkv.sharding.spec)
