@@ -215,7 +215,9 @@ def _worker_train(cfg: dict) -> dict:
     platform = jax.devices()[0].platform
     mcfg = gpt_mod.PRESETS[cfg["model"]]
     if cfg.get("remat", True):
-        mcfg = dataclasses.replace(mcfg, remat=True)
+        mcfg = dataclasses.replace(
+            mcfg, remat=True,
+            remat_policy=cfg.get("remat_policy", "nothing_saveable"))
     model, mcfg = build_gpt(mcfg)
     n_chips = len(jax.devices())
     micro_bs, seq, steps = cfg["micro_bs"], cfg["seq"], cfg["steps"]
@@ -320,12 +322,20 @@ def main() -> None:
         bs = int(os.environ.get("BENCH_BS", "16"))
         seq = int(os.environ.get("BENCH_SEQ", "1024"))
         steps = int(os.environ.get("BENCH_STEPS", "20"))
+        big = os.environ.get("BENCH_BIG_MODEL", "gpt2-760m")
+        big_bs = int(os.environ.get("BENCH_BIG_BS", "16"))
         configs = [
             {"kind": "kernels", "name": "pallas-kernel-smoke"},
         ] + [
             {"kind": "train", "name": f"{model}-zero{s}", "model": model,
              "micro_bs": bs, "seq": seq, "stage": s, "steps": steps}
             for s in (1, 2, 3)
+        ] + [
+            # bigger model: fatter matmuls lift MXU utilization (measured r3:
+            # 350M 33% MFU vs 760M 44% at the same geometry)
+            {"kind": "train", "name": f"{big}-zero{s}", "model": big,
+             "micro_bs": big_bs, "seq": seq, "stage": s, "steps": steps}
+            for s in (1, 3)
         ] + [{"kind": "inference", "name": f"{model}-decode", "model": model,
               "batch": 1, "prompt": 128, "gen": 64}]
     else:

@@ -43,14 +43,22 @@ class TuningExperiment:
 
 def default_trial_runner(model_factory: Callable, batch_factory: Callable,
                          steps: int = 5) -> Callable[[Dict[str, Any]], float]:
-    """Returns a trial function: config -> tokens/sec (OOM/shape errors -> raise)."""
+    """Returns a trial function: config -> tokens/sec (OOM/shape errors -> raise).
+
+    ``"model.*"`` keys in the trial config are popped and passed to
+    ``model_factory(**overrides)`` — the channel through which MODEL knobs
+    (``remat``, ``remat_policy``, ``flash_block_q``/``flash_block_k``, ...)
+    join the search space alongside the engine's DeepSpeed-config knobs.
+    """
 
     def run(config: Dict[str, Any]) -> float:
         import numpy as np
 
         import deepspeed_tpu
 
-        model = model_factory()
+        config = copy.deepcopy(config)
+        overrides = config.pop("model", {}) or {}
+        model = model_factory(**overrides) if overrides else model_factory()
         engine, _, _, _ = deepspeed_tpu.initialize(
             model=model, config={**config, "steps_per_print": 0})
         batch = batch_factory(engine.train_batch_size)
@@ -68,7 +76,20 @@ def default_trial_runner(model_factory: Callable, batch_factory: Callable,
 
 
 class Autotuner:
-    """Grid/early-stopped search over micro-batch x ZeRO stage (x extras)."""
+    """Grid/early-stopped search over micro-batch x ZeRO stage x model knobs.
+
+    Default dimensions follow the reference's ``"autotuning"`` block
+    (micro_batch_sizes, zero_stages); TPU-native dimensions ride the same
+    dotted-key mechanism with a ``model.`` prefix and reach the model builder
+    through :func:`default_trial_runner` — e.g. the ``"tuner"`` sub-block::
+
+        "autotuning": {"tuner": {
+            "model.remat_policy": ["nothing_saveable",
+                                    "dots_with_no_batch_dims_saveable"],
+            "model.flash_block_q": [256, 512],
+            "model.flash_block_k": [256, 512],
+        }}
+    """
 
     def __init__(self, base_config: Dict[str, Any],
                  tuning_space: Optional[Dict[str, List[Any]]] = None,
@@ -90,6 +111,10 @@ class Autotuner:
         }
         for k, v in space.items():
             self.space.setdefault(k, v)
+        # extra dimensions from the config's "tuner" sub-block (incl. model.*)
+        for k, v in dict(at.get("tuner", {})).items():
+            if isinstance(v, list) and v:
+                self.space.setdefault(k, v)
         self.experiments: List[TuningExperiment] = []
 
     # ------------------------------------------------------------------ space

@@ -60,6 +60,8 @@ class GPTConfig:
     remat: bool = False  # activation checkpointing per block
     remat_policy: str = "nothing_saveable"  # jax.checkpoint_policies name
     use_flash: Optional[bool] = None  # None = auto dispatch
+    flash_block_q: int = 256  # flash-attention tile sizes (autotunable)
+    flash_block_k: int = 256
 
     @property
     def ffn_dim(self) -> int:
@@ -243,7 +245,9 @@ def _attention_delta(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray],
         k_ = _rope(k_, positions, rd, cfg.rotary_interleaved)
     bias = _alibi_bias(cfg, positions, T) if cfg.alibi else None
     attn = multihead_attention(q, k_, v, causal=True, bias=bias,
-                               use_flash=cfg.use_flash)
+                               use_flash=cfg.use_flash,
+                               block_q=cfg.flash_block_q,
+                               block_k=cfg.flash_block_k)
     attn = attn.reshape(B, T, D)
     return attn @ w["attn_out_w"] + w["attn_out_b"]
 

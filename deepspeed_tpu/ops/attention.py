@@ -56,10 +56,21 @@ def multihead_attention(
     causal: bool = True,
     bias: Optional[jnp.ndarray] = None,
     use_flash: Optional[bool] = None,
+    block_q: int = 256,
+    block_k: int = 256,
 ) -> jnp.ndarray:
-    """Kernel dispatch: Pallas flash attention on TPU when eligible, XLA otherwise."""
+    """Kernel dispatch: Pallas flash attention on TPU when eligible, XLA
+    otherwise. ``block_q``/``block_k`` tune the flash tiling (autotunable)."""
     if use_flash is None:
         use_flash = _flash_eligible(q, k, bias)
+    elif use_flash and bias is not None:
+        # the flash kernel has no bias input (same reason the decode path
+        # guards ALiBi); computing without it would be silently wrong
+        from ..utils.logging import warning_once
+
+        warning_once("flash attention forced on but an attention bias is "
+                     "present (ALiBi?); falling back to XLA attention")
+        use_flash = False
     if use_flash:
         try:
             from .pallas.flash_attention import flash_attention
@@ -68,7 +79,8 @@ def multihead_attention(
 
             warning_once("pallas flash attention unavailable; using XLA attention")
         else:
-            return flash_attention(q, k, v, causal=causal)
+            return flash_attention(q, k, v, causal=causal,
+                                   block_q=block_q, block_k=block_k)
     return dot_product_attention(q, k, v, causal=causal, bias=bias)
 
 
