@@ -65,10 +65,18 @@ class CommsLogger:
         if self.verbose:
             logger.info(f"comm: {op_name} {nbytes} bytes (trace-time)")
 
-    def log_summary(self) -> str:
-        lines = ["comm op summary (trace-time counts):"]
+    def log_summary(self, scale: int = 1) -> str:
+        """Per-op summary. ``scale``: number of executions of the compiled
+        program(s) — trace-time counts times ``scale`` estimate the RUN totals
+        (closes the per-compiled-program footgun: pass the engine's step count,
+        or use ``engine.comms_summary()`` which does)."""
+        hdr = ("comm op summary (trace-time counts"
+               + (f" x {scale} executions)" if scale != 1 else ")") + ":")
+        lines = [hdr]
         for name, rec in sorted(self.records.items()):
-            lines.append(f"  {name:<24} count={rec.count:<6} bytes={rec.bytes}")
+            lines.append(
+                f"  {name:<24} count={rec.count * scale:<8} "
+                f"bytes={rec.bytes * scale}")
         out = "\n".join(lines)
         log_dist(out)
         return out

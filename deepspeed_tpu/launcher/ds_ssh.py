@@ -1,0 +1,82 @@
+"""ds_ssh: run a command on every host of a hostfile.
+
+Parity: the reference's ``bin/ds_ssh`` (pdsh fan-out of an arbitrary command
+across the training hosts). TPU-native: plain ssh per host (TPU pods are
+flat-ssh reachable the same way), sequential or parallel, aggregated output
+prefixed per host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional, Sequence
+
+from .runner import filter_hosts, parse_hostfile
+
+DEFAULT_HOSTFILE = "/job/hostfile"
+
+
+def _run_on_host(host: str, command: str, ssh_opts: Sequence[str],
+                 timeout: float) -> tuple:
+    argv = ["ssh", "-o", "StrictHostKeyChecking=no", *ssh_opts, host, command]
+    try:
+        p = subprocess.run(argv, capture_output=True, text=True, timeout=timeout)
+        return host, p.returncode, p.stdout, p.stderr
+    except subprocess.TimeoutExpired:
+        return host, -1, "", f"timed out after {timeout}s"
+    except FileNotFoundError:
+        return host, 127, "", "ssh binary not found on this machine"
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        "ds_ssh", description="run a command on all hosts in the hostfile")
+    p.add_argument("-H", "--hostfile", default=DEFAULT_HOSTFILE)
+    p.add_argument("--include", default="", help="host selector (runner syntax)")
+    p.add_argument("--exclude", default="")
+    p.add_argument("--timeout", type=float, default=300.0)
+    p.add_argument("--serial", action="store_true", help="one host at a time")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="command to run on every host")
+    args = p.parse_args(argv)
+    if not args.command:
+        p.error("no command given")
+    import shlex
+
+    command = shlex.join(args.command)  # preserve argument boundaries remotely
+
+    try:
+        hosts = parse_hostfile(args.hostfile)
+    except FileNotFoundError:
+        print(f"ds_ssh: hostfile {args.hostfile} not found", file=sys.stderr)
+        return 2
+    pool = filter_hosts(hosts, include=args.include, exclude=args.exclude)
+    names: List[str] = list(pool)
+    if not names:
+        print("ds_ssh: no hosts selected", file=sys.stderr)
+        return 2
+
+    if args.serial:
+        results = [_run_on_host(h, command, (), args.timeout) for h in names]
+    else:
+        with ThreadPoolExecutor(max_workers=min(32, len(names))) as ex:
+            results = list(ex.map(
+                lambda h: _run_on_host(h, command, (), args.timeout), names))
+
+    worst = 0
+    for host, rc, out, err in results:
+        for line in out.splitlines():
+            print(f"{host}: {line}")
+        for line in err.splitlines():
+            print(f"{host}: {line}", file=sys.stderr)
+        if rc != 0:
+            print(f"{host}: exit {rc}", file=sys.stderr)
+            worst = worst or rc
+    return worst
+
+
+if __name__ == "__main__":
+    sys.exit(main())

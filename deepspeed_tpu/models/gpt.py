@@ -62,6 +62,10 @@ class GPTConfig:
     use_flash: Optional[bool] = None  # None = auto dispatch
     flash_block_q: int = 256  # flash-attention tile sizes (autotunable)
     flash_block_k: int = 256
+    # stochastic-mode training (parity: the reference's StochasticTransformer,
+    # op_builder/stochastic_transformer.py): drop whole blocks with prob p at
+    # train time, survivor delta scaled by 1/(1-p)
+    stochastic_depth: float = 0.0
 
     @property
     def ffn_dim(self) -> int:
@@ -318,10 +322,19 @@ def forward(cfg: GPTConfig, params: Dict[str, Any], input_ids: jnp.ndarray,
         policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
         block_fn = jax.checkpoint(block_fn, policy=policy)
 
+    sd = cfg.stochastic_depth if train else 0.0
+
     def body(carry, layer_w):
         x, i = carry
         lrng = jax.random.fold_in(drng, i) if drng is not None else None
-        x = block_fn(x, layer_w, positions, lrng)
+        y = block_fn(x, layer_w, positions, lrng)
+        if sd > 0.0 and lrng is not None:
+            # stochastic depth: drop the whole block with prob sd; the
+            # surviving delta is scaled so eval needs no correction
+            keep = jax.random.bernoulli(jax.random.fold_in(lrng, 0x5D), 1.0 - sd)
+            x = x + jnp.where(keep, (y - x) / (1.0 - sd), 0.0).astype(x.dtype)
+        else:
+            x = y
         return (x, i + 1), None
 
     # layer loop with explicit ZeRO-3 gather windowing (stage3_max_live_parameters

@@ -582,6 +582,12 @@ class DeepSpeedEngine:
     def zero_optimization_stage(self) -> int:
         return self.policy.stage
 
+    def comms_summary(self) -> str:
+        """Trace-time collective counts scaled by this engine's executed steps
+        — an estimated RUN total (fixes the per-compiled-program footgun of
+        trace-time accounting; see ``comm.CommsLogger``)."""
+        return comm.comms_logger.log_summary(scale=max(1, self.global_steps))
+
     def train_micro_batch_size_per_gpu(self) -> int:
         return self.micro_batch_size
 

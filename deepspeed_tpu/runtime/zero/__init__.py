@@ -1,0 +1,4 @@
+from .config import DeepSpeedZeroConfig, ZeroStageEnum  # noqa: F401
+from .partitioned_params import GatheredParameters, Init  # noqa: F401
+from .policy import ZeroShardingPolicy  # noqa: F401
+from .tiling import TiledLinear  # noqa: F401

@@ -1,0 +1,125 @@
+"""User surface for partitioned (ZeRO-3) parameters.
+
+Capability parity with the reference's ``zero.Init`` /
+``GatheredParameters`` user API (``runtime/zero/partition_parameters.py:539,
+1519``): users occasionally need the FULL value of sharded parameters — to
+inspect them, to initialize them from an external source, or to mutate them
+in place — and the reference gathers/partitions around a context manager.
+
+TPU-native mapping:
+
+- ``Init``: the reference monkeypatches ``nn.Module.__init__`` so params are
+  partitioned at construction. Here models are functional and the engine's
+  jitted init already constructs every leaf SHARDED on the mesh
+  (``DeepSpeedEngine._init_state`` — no full tensor ever materializes), so
+  ``Init`` is a no-op context kept for API familiarity.
+- ``GatheredParameters``: gathers the requested leaves to host numpy (the
+  explicit analog of the reference's all-gather), yields them for
+  mutation, and on exit re-places modified leaves with their original
+  shardings — the reference's ``modifier_rank`` semantics collapse to the
+  single controller.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, Iterable, Optional
+
+import numpy as np
+
+import jax
+
+from ...utils.logging import log_dist
+
+
+@contextlib.contextmanager
+def Init(config: Any = None, **kwargs):
+    """Parity shim for ``deepspeed.zero.Init``: sharded construction is the
+    engine's default on TPU (init is jitted with sharding constraints, so no
+    process ever holds the full fp32 tree). Yields nothing."""
+    log_dist("zero.Init: sharded construction is the engine default on TPU "
+             "(jitted init with sharding constraints); context is a no-op")
+    yield
+
+
+class GatheredParameters:
+    """Gather engine parameters to host, optionally writing mutations back.
+
+    Usage::
+
+        with GatheredParameters(engine, paths=["wte"], modify=True) as full:
+            full["wte"][:] = pretrained_embeddings   # numpy, full logical shape
+
+    ``paths``: iterable of top-level keys (or dotted paths) into
+    ``engine.state["params"]``; None = every leaf. ``modify``: write leaves
+    back on exit, preserving each leaf's original sharding and dtype. Keeping
+    the fp32 master (if any) consistent is handled too.
+    """
+
+    def __init__(self, engine, paths: Optional[Iterable[str]] = None,
+                 modify: bool = False):
+        self.engine = engine
+        self.paths = list(paths) if paths is not None else None
+        self.modify = modify
+        self._gathered: Dict[str, np.ndarray] = {}
+
+    def _leaf(self, tree, dotted: str):
+        node = tree
+        for p in dotted.split("."):
+            node = node[p]
+        return node
+
+    def _set_leaf(self, tree, dotted: str, value):
+        parts = dotted.split(".")
+        node = tree
+        for p in parts[:-1]:
+            node = node[p]
+        node[parts[-1]] = value
+
+    def _all_paths(self, tree, prefix="") -> Iterable[str]:
+        if isinstance(tree, dict):
+            for k, v in tree.items():
+                yield from self._all_paths(v, f"{prefix}{k}.")
+        else:
+            yield prefix[:-1]
+
+    def __enter__(self) -> Dict[str, np.ndarray]:
+        params = self.engine.state["params"]
+        paths = self.paths or list(self._all_paths(params))
+        # expand subtree paths (e.g. "blocks") into their leaves
+        expanded = []
+        for p in paths:
+            node = self._leaf(params, p)
+            if isinstance(node, dict):
+                expanded.extend(f"{p}.{sub}" for sub in self._all_paths(node))
+            else:
+                expanded.append(p)
+        for p in expanded:
+            leaf = self._leaf(params, p)
+            # device_get returns read-only views; users mutate these in place
+            self._gathered[p] = np.array(jax.device_get(leaf))
+        return self._gathered
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None or not self.modify:
+            return False
+        params = dict(self.engine.state["params"])
+        master = self.engine.state.get("master") or {}
+        for p, arr in self._gathered.items():
+            old = self._leaf(self.engine.state["params"], p)
+            new = jax.device_put(arr.astype(old.dtype), old.sharding)
+            self._set_leaf(params, p, new)
+            # keep the fp32 master in sync where one exists for this leaf
+            try:
+                m_old = self._leaf(master, p)
+            except (KeyError, TypeError):
+                m_old = None
+            if m_old is not None and hasattr(m_old, "sharding"):
+                self._set_leaf(master, p,
+                               jax.device_put(arr.astype(m_old.dtype),
+                                              m_old.sharding))
+        self.engine.state["params"] = params
+        if master:
+            self.engine.state["master"] = master
+        log_dist(f"GatheredParameters: wrote back {len(self._gathered)} leaves")
+        return False
