@@ -268,6 +268,14 @@ class DeepSpeedEngine:
 
     # ------------------------------------------------------------------ compiled fns
     def _loss_and_grads(self, params, batch, scale, rngs, step=None):
+        # prescale_gradients: shrink every cotangent by 1/predivide through the
+        # whole backward (including the grad reduction) to keep low-precision
+        # sums in range; the inverse below restores magnitudes (parity: the
+        # reference's predivide-before-allreduce, runtime/engine.py:2346-2465)
+        predivide = (float(self.config.gradient_predivide_factor or 1.0)
+                     if self.config.prescale_gradients else 1.0)
+        eff_scale = scale / predivide
+
         def loss_fn(p):
             if self._compression is not None and step is not None:
                 # inside the loss so the straight-through fake-quant gradient
@@ -275,7 +283,7 @@ class DeepSpeedEngine:
                 p = self._compression.transform(p, step)
             out = self.model.apply(p, batch, rngs=rngs, train=True)
             loss, aux = out if isinstance(out, tuple) else (out, {})
-            return loss.astype(jnp.float32) * scale, (loss, aux)
+            return loss.astype(jnp.float32) * eff_scale, (loss, aux)
 
         from .zero.gather import gather_window
 
@@ -283,7 +291,16 @@ class DeepSpeedEngine:
         # windows the layer loop accordingly; no-op below stage 3)
         with gather_window(self.config.zero_optimization):
             grads, (loss, aux) = jax.grad(loss_fn, has_aux=True)(params)
-        inv = 1.0 / scale
+        # communication_data_type: the dtype gradients ride the wire in — cast
+        # BEFORE the sharding constraint (where XLA places the reduce-scatter/
+        # all-reduce), then upcast to fp32
+        comm_dt = self.config.communication_data_type
+        if comm_dt:
+            cdt = jnp.dtype({"fp16": "float16", "bf16": "bfloat16",
+                             "fp32": "float32"}.get(comm_dt, comm_dt))
+            grads = jax.tree_util.tree_map(lambda g: g.astype(cdt), grads)
+            grads = _constrain(grads, self.grad_shardings)
+        inv = 1.0 / eff_scale
         grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) * inv, grads)
         grads = _constrain(grads, self.grad_shardings)
         return loss, aux, grads

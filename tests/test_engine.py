@@ -145,3 +145,35 @@ def test_lr_schedule_in_step(devices):
     m1 = engine.train_batch(make_batch(0, 4))
     m2 = engine.train_batch(make_batch(1, 4))
     assert float(m2["lr"]) > float(m1["lr"])
+
+
+# ----------------------------------------------------- comm-dtype / prescale
+def test_prescale_and_comm_dtype_numerics_match_default(rng):
+    """prescale_gradients + gradient_predivide_factor and a bf16
+    communication_data_type must leave fp32 training numerics (approximately)
+    unchanged — they are range/bandwidth knobs, not semantics changes."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_gpt, gpt as gpt_mod
+
+    def run(extra):
+        model, _ = build_gpt(gpt_mod.GPTConfig(
+            vocab_size=64, n_layer=2, n_head=2, d_model=32, max_seq_len=32))
+        engine, _, _, _ = ds.initialize(model=model, seed=11, config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 2},
+            "mesh": {"dp": 8},
+            "bf16": {"enabled": False},
+            "steps_per_print": 0,
+            **extra,
+        })
+        ids = np.random.default_rng(5).integers(0, 64, size=(8, 16), dtype=np.int32)
+        return [float(engine.train_batch({"input_ids": ids})["grad_norm"])
+                for _ in range(2)]
+
+    base = run({})
+    pre = run({"prescale_gradients": True, "gradient_predivide_factor": 32.0})
+    np.testing.assert_allclose(pre, base, rtol=1e-4)
+    comm_bf16 = run({"communication_data_type": "bf16"})
+    # bf16 wire dtype costs precision but must stay close on a tiny model
+    np.testing.assert_allclose(comm_bf16, base, rtol=0.05)
