@@ -90,6 +90,25 @@ class DeepSpeedEngine:
         if config.comms_logger.enabled:
             comm.configure(enabled=True, verbose=config.comms_logger.verbose)
 
+        # communication_data_type: on TPU the gradient reduction is fused into
+        # the backward by GSPMD AT THE COMPUTE DTYPE — bf16 training already
+        # reduces in bf16, which is exactly what the knob usually requests.
+        # Verified by HLO inspection: a post-grad cast cannot move the
+        # all-reduce dtype (the reduce is placed at the partial-sum dot output
+        # before any user cast runs), so a mismatching request is refused
+        # rather than faked with a lossy no-benefit round-trip.
+        comm_dt = config.communication_data_type
+        if comm_dt:
+            want = jnp.dtype({"fp16": "float16", "bf16": "bfloat16",
+                              "fp32": "float32"}.get(comm_dt, comm_dt))
+            have = jnp.dtype(self.pc.compute_dtype)
+            if want != have and want.itemsize < have.itemsize:
+                raise ValueError(
+                    f"communication_data_type={comm_dt}: the gradient wire "
+                    f"dtype on TPU equals the compute dtype ({have.name}); "
+                    f"enable bf16/fp16 training to reduce in {want.name} — a "
+                    "post-hoc cast cannot change the fused reduction's dtype")
+
         # parity: engine._configure_checkpointing → activation-ckpt global config.
         # An explicit user configure() wins unless the JSON actually carries a
         # non-default activation_checkpointing block (the reference honors the
@@ -291,15 +310,6 @@ class DeepSpeedEngine:
         # windows the layer loop accordingly; no-op below stage 3)
         with gather_window(self.config.zero_optimization):
             grads, (loss, aux) = jax.grad(loss_fn, has_aux=True)(params)
-        # communication_data_type: the dtype gradients ride the wire in — cast
-        # BEFORE the sharding constraint (where XLA places the reduce-scatter/
-        # all-reduce), then upcast to fp32
-        comm_dt = self.config.communication_data_type
-        if comm_dt:
-            cdt = jnp.dtype({"fp16": "float16", "bf16": "bfloat16",
-                             "fp32": "float32"}.get(comm_dt, comm_dt))
-            grads = jax.tree_util.tree_map(lambda g: g.astype(cdt), grads)
-            grads = _constrain(grads, self.grad_shardings)
         inv = 1.0 / eff_scale
         grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) * inv, grads)
         grads = _constrain(grads, self.grad_shardings)

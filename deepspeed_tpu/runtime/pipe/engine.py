@@ -72,6 +72,12 @@ class PipelineEngine:
         self.module = module
         self.config = config
         self.pc = PrecisionConfig.from_ds_config(config)
+        if config.prescale_gradients or config.communication_data_type:
+            raise ValueError(
+                "prescale_gradients / communication_data_type are not "
+                "supported on the MPMD PipelineEngine (its interpreter "
+                "computes grads outside the fused SPMD program); use the "
+                "mesh.pp>1 SPMD pipeline path for these knobs")
         self.S = module.num_stages
         gas = int(config.gradient_accumulation_steps or 1)
         micro = int(config.pipeline.micro_batches or 0)

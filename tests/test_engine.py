@@ -174,6 +174,10 @@ def test_prescale_and_comm_dtype_numerics_match_default(rng):
     base = run({})
     pre = run({"prescale_gradients": True, "gradient_predivide_factor": 32.0})
     np.testing.assert_allclose(pre, base, rtol=1e-4)
-    comm_bf16 = run({"communication_data_type": "bf16"})
-    # bf16 wire dtype costs precision but must stay close on a tiny model
-    np.testing.assert_allclose(comm_bf16, base, rtol=0.05)
+    # comm dtype below the compute dtype cannot change the fused reduction's
+    # wire dtype on TPU (HLO-verified) — refused, not faked
+    with pytest.raises(ValueError, match="communication_data_type"):
+        run({"communication_data_type": "bf16"})
+    # matching (or wider) requests are naturally satisfied
+    base2 = run({"communication_data_type": "fp32"})
+    np.testing.assert_allclose(base2, base, rtol=1e-6)
