@@ -182,6 +182,12 @@ def parse_args(argv=None):
     p.add_argument("--tpu_name", default=None)
     p.add_argument("--master_port", type=int, default=DEFAULT_COORDINATOR_PORT)
     p.add_argument("--no_ssh_check", action="store_true")
+    p.add_argument("--elastic_training", action="store_true",
+                   help="supervise the job with the elastic agent "
+                        "(failure/resize restart from checkpoint; parity: "
+                        "launcher/runner.py:365,383 wiring DSElasticAgent)")
+    p.add_argument("--deepspeed_config", default=None,
+                   help="JSON config (required for --elastic_training)")
     p.add_argument("user_script")
     p.add_argument("user_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -198,6 +204,24 @@ def build_environment(args, resource_pool) -> Dict[str, str]:
 
 def main(argv=None) -> int:
     args = parse_args(argv)
+    if args.elastic_training:
+        # elastic path: delegate supervision to the agent (parity: the
+        # reference's --elastic_training wiring of DSElasticAgent)
+        if not args.deepspeed_config:
+            raise SystemExit("--elastic_training requires --deepspeed_config")
+        if args.include or args.exclude or os.path.exists(args.hostfile):
+            raise SystemExit(
+                "--elastic_training supervises a single-controller job; "
+                "multi-host selection flags (hostfile/include/exclude) are "
+                "not supported on the elastic path")
+        from ..elasticity.elastic_agent import main as elastic_main
+
+        user_args = list(args.user_args)
+        if "--deepspeed_config" not in user_args:
+            # the worker reads its DeepSpeed config from its own argv
+            user_args += ["--deepspeed_config", args.deepspeed_config]
+        return elastic_main(["--config", args.deepspeed_config,
+                             args.user_script, *user_args])
     if os.path.exists(args.hostfile):
         hosts = parse_hostfile(args.hostfile)
     else:

@@ -299,6 +299,18 @@ def _gptj_policy(c, sd) -> Tuple[GPTConfig, Dict[str, Any]]:
     return cfg, params
 
 
+def _fuse_qkv(sd, fmt: str, parts, n_layer: int):
+    """Stack per-layer fused qkv from separate [out,in] q/k/v Linears:
+    returns (qkv_w [L, D, 3D], qkv_b [L, 3D])."""
+    ws, bs = [], []
+    for i in range(n_layer):
+        ws.append(np.concatenate(
+            [sd[fmt.format(i, p) + ".weight"].T for p in parts], axis=1))
+        bs.append(np.concatenate(
+            [sd[fmt.format(i, p) + ".bias"] for p in parts]))
+    return jnp.asarray(np.stack(ws)), jnp.asarray(np.stack(bs))
+
+
 def _bert_policy(c, sd):
     """HF BertForMaskedLM -> (BertConfig, params). Parity:
     ``containers/bert.py`` (HFBertLayerPolicy)."""
@@ -311,14 +323,8 @@ def _bert_policy(c, sd):
         type_vocab_size=c.type_vocab_size, layer_norm_eps=c.layer_norm_eps)
     L = c.num_hidden_layers
     pre = "bert.encoder.layer.{}"
-    qkv_ws, qkv_bs = [], []
-    for i in range(L):
-        ws = [sd[f"bert.encoder.layer.{i}.attention.self.{p}.weight"].T
-              for p in ("query", "key", "value")]
-        bs = [sd[f"bert.encoder.layer.{i}.attention.self.{p}.bias"]
-              for p in ("query", "key", "value")]
-        qkv_ws.append(np.concatenate(ws, axis=1))
-        qkv_bs.append(np.concatenate(bs))
+    qkv_w, qkv_b = _fuse_qkv(
+        sd, "bert.encoder.layer.{}.attention.self.{}", ("query", "key", "value"), L)
     params = {
         "wte": jnp.asarray(sd["bert.embeddings.word_embeddings.weight"]),
         "wpe": jnp.asarray(sd["bert.embeddings.position_embeddings.weight"]),
@@ -326,8 +332,8 @@ def _bert_policy(c, sd):
         "emb_ln_scale": jnp.asarray(sd["bert.embeddings.LayerNorm.weight"]),
         "emb_ln_bias": jnp.asarray(sd["bert.embeddings.LayerNorm.bias"]),
         "blocks": {
-            "qkv_w": jnp.asarray(np.stack(qkv_ws)),
-            "qkv_b": jnp.asarray(np.stack(qkv_bs)),
+            "qkv_w": qkv_w,
+            "qkv_b": qkv_b,
             "attn_out_w": _stack(sd, pre + ".attention.output.dense.weight", L,
                                  transpose=True),
             "attn_out_b": _stack(sd, pre + ".attention.output.dense.bias", L),
@@ -357,6 +363,60 @@ def _bert_policy(c, sd):
     return cfg, params
 
 
+def _distilbert_policy(c, sd):
+    """HF DistilBertForMaskedLM -> (BertConfig, params). Parity:
+    ``containers/distil_bert.py`` (HFDistilBertLayerPolicy). DistilBERT is a
+    BERT encoder without token-type embeddings (a one-row zero table keeps the
+    tree shape; type ids default to 0) and with flat layer/head key names."""
+    from ..models.bert import BertConfig
+
+    act = str(getattr(c, "activation", "gelu")).lower()
+    if act != "gelu":
+        raise ValueError(
+            f"DistilBERT: unsupported activation {act!r} — the BERT encoder "
+            "here applies exact gelu; importing would silently change numerics")
+    cfg = BertConfig(
+        vocab_size=c.vocab_size, n_layer=c.n_layers, n_head=c.n_heads,
+        d_model=c.dim, d_ff=c.hidden_dim,
+        max_seq_len=c.max_position_embeddings, type_vocab_size=1,
+        layer_norm_eps=1e-12)
+    L = c.n_layers
+    pre = "distilbert.transformer.layer.{}"
+    qkv_w, qkv_b = _fuse_qkv(
+        sd, "distilbert.transformer.layer.{}.attention.{}_lin", ("q", "k", "v"), L)
+    params = {
+        "wte": jnp.asarray(sd["distilbert.embeddings.word_embeddings.weight"]),
+        "wpe": jnp.asarray(sd["distilbert.embeddings.position_embeddings.weight"]),
+        "wtt": jnp.zeros((1, c.dim), jnp.float32),
+        "emb_ln_scale": jnp.asarray(sd["distilbert.embeddings.LayerNorm.weight"]),
+        "emb_ln_bias": jnp.asarray(sd["distilbert.embeddings.LayerNorm.bias"]),
+        "blocks": {
+            "qkv_w": qkv_w,
+            "qkv_b": qkv_b,
+            "attn_out_w": _stack(sd, pre + ".attention.out_lin.weight", L,
+                                 transpose=True),
+            "attn_out_b": _stack(sd, pre + ".attention.out_lin.bias", L),
+            "ln1_scale": _stack(sd, pre + ".sa_layer_norm.weight", L),
+            "ln1_bias": _stack(sd, pre + ".sa_layer_norm.bias", L),
+            "mlp_up_w": _stack(sd, pre + ".ffn.lin1.weight", L, transpose=True),
+            "mlp_up_b": _stack(sd, pre + ".ffn.lin1.bias", L),
+            "mlp_down_w": _stack(sd, pre + ".ffn.lin2.weight", L,
+                                 transpose=True),
+            "mlp_down_b": _stack(sd, pre + ".ffn.lin2.bias", L),
+            "ln2_scale": _stack(sd, pre + ".output_layer_norm.weight", L),
+            "ln2_bias": _stack(sd, pre + ".output_layer_norm.bias", L),
+        },
+        "mlm_dense_w": jnp.asarray(sd["vocab_transform.weight"].T),
+        "mlm_dense_b": jnp.asarray(sd["vocab_transform.bias"]),
+        "mlm_ln_scale": jnp.asarray(sd["vocab_layer_norm.weight"]),
+        "mlm_ln_bias": jnp.asarray(sd["vocab_layer_norm.bias"]),
+        "mlm_bias": jnp.asarray(sd["vocab_projector.bias"]),
+        "pooler_w": jnp.zeros((c.dim, c.dim), jnp.float32),
+        "pooler_b": jnp.zeros((c.dim,), jnp.float32),
+    }
+    return cfg, params
+
+
 HF_POLICIES = {
     "GPT2LMHeadModel": _gpt2_policy,
     "GPTNeoXForCausalLM": _gptneox_policy,
@@ -364,6 +424,7 @@ HF_POLICIES = {
     "BloomForCausalLM": _bloom_policy,
     "GPTJForCausalLM": _gptj_policy,
     "BertForMaskedLM": _bert_policy,
+    "DistilBertForMaskedLM": _distilbert_policy,
 }
 
 

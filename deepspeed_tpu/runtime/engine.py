@@ -49,6 +49,7 @@ from .precision import (
     init_scaler_state,
     make_master,
     update_scaler,
+    validate_comm_dtype,
 )
 from .topology import MeshTopology, mesh_context
 from .utils import clip_by_global_norm, count_parameters, global_norm
@@ -90,24 +91,10 @@ class DeepSpeedEngine:
         if config.comms_logger.enabled:
             comm.configure(enabled=True, verbose=config.comms_logger.verbose)
 
-        # communication_data_type: on TPU the gradient reduction is fused into
-        # the backward by GSPMD AT THE COMPUTE DTYPE — bf16 training already
-        # reduces in bf16, which is exactly what the knob usually requests.
-        # Verified by HLO inspection: a post-grad cast cannot move the
-        # all-reduce dtype (the reduce is placed at the partial-sum dot output
-        # before any user cast runs), so a mismatching request is refused
-        # rather than faked with a lossy no-benefit round-trip.
-        comm_dt = config.communication_data_type
-        if comm_dt:
-            want = jnp.dtype({"fp16": "float16", "bf16": "bfloat16",
-                              "fp32": "float32"}.get(comm_dt, comm_dt))
-            have = jnp.dtype(self.pc.compute_dtype)
-            if want != have and want.itemsize < have.itemsize:
-                raise ValueError(
-                    f"communication_data_type={comm_dt}: the gradient wire "
-                    f"dtype on TPU equals the compute dtype ({have.name}); "
-                    f"enable bf16/fp16 training to reduce in {want.name} — a "
-                    "post-hoc cast cannot change the fused reduction's dtype")
+        # communication_data_type: honorable only when it equals the compute
+        # dtype (the wire dtype GSPMD fuses the grad reduction at); any other
+        # request is refused rather than silently unhonored
+        validate_comm_dtype(config.communication_data_type, self.pc.compute_dtype)
 
         # parity: engine._configure_checkpointing → activation-ckpt global config.
         # An explicit user configure() wins unless the JSON actually carries a

@@ -49,7 +49,7 @@ from ...ops.optimizers import Optimizer, get_optimizer
 from ...utils.logging import log_dist
 from ..config import DeepSpeedConfig
 from ..lr_schedules import schedule_fn_from_config
-from ..precision import PrecisionConfig, init_scaler_state
+from ..precision import PrecisionConfig, init_scaler_state, validate_comm_dtype
 from ..utils import clip_by_global_norm, global_norm
 from .module import PipelineModule
 from .mpmd import MPMDPipelineEngine
@@ -72,12 +72,14 @@ class PipelineEngine:
         self.module = module
         self.config = config
         self.pc = PrecisionConfig.from_ds_config(config)
-        if config.prescale_gradients or config.communication_data_type:
+        if config.prescale_gradients:
             raise ValueError(
-                "prescale_gradients / communication_data_type are not "
-                "supported on the MPMD PipelineEngine (its interpreter "
-                "computes grads outside the fused SPMD program); use the "
-                "mesh.pp>1 SPMD pipeline path for these knobs")
+                "prescale_gradients is not supported on the MPMD "
+                "PipelineEngine (its interpreter computes grads outside the "
+                "fused SPMD program); use the mesh.pp>1 SPMD pipeline path")
+        # same dtype contract as the dense engine: equal-to-compute is
+        # naturally satisfied, anything else refused
+        validate_comm_dtype(config.communication_data_type, self.pc.compute_dtype)
         self.S = module.num_stages
         gas = int(config.gradient_accumulation_steps or 1)
         micro = int(config.pipeline.micro_batches or 0)

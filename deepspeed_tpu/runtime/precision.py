@@ -53,6 +53,26 @@ class PrecisionConfig:
         return cls(compute_dtype=jnp.float32, master_weights=False, loss_scaling=False)
 
 
+def validate_comm_dtype(comm_dt, compute_dtype) -> None:
+    """``communication_data_type`` on TPU: the gradient reduction is fused into
+    the backward by GSPMD AT THE COMPUTE DTYPE (HLO-verified — a post-grad cast
+    cannot move the all-reduce dtype). A request is therefore only honorable
+    when it EQUALS the compute dtype; anything else is refused rather than
+    silently unhonored or faked with a lossy round-trip."""
+    if not comm_dt:
+        return
+    want = jnp.dtype({"fp16": "float16", "bf16": "bfloat16",
+                      "fp32": "float32"}.get(comm_dt, comm_dt))
+    have = jnp.dtype(compute_dtype)
+    if want != have:
+        raise ValueError(
+            f"communication_data_type={comm_dt}: the gradient wire dtype on "
+            f"TPU equals the compute dtype ({have.name}) — requests for "
+            f"{want.name} cannot be honored (narrower: the fused reduction "
+            "ignores post-hoc casts; wider: reductions would need fp32 "
+            "compute). Set the training dtype to match the wire request.")
+
+
 class ScalerState(NamedTuple):
     scale: jnp.ndarray  # f32 scalar
     good_steps: jnp.ndarray  # i32 consecutive non-overflow steps

@@ -325,3 +325,20 @@ def test_streamed_checkpoint_preserves_bf16(tmp_path):
     _, params = load_hf_checkpoint(str(tmp_path))
     assert params["wte"].dtype == jnp.bfloat16, params["wte"].dtype
     assert params["blocks"]["qkv_w"].dtype == jnp.bfloat16
+
+
+def test_distilbert_import_matches_hf(rng):
+    from deepspeed_tpu.models import bert as B
+
+    hf_cfg = transformers.DistilBertConfig(
+        vocab_size=89, dim=32, n_layers=2, n_heads=4, hidden_dim=64,
+        max_position_embeddings=64, dropout=0.0, attention_dropout=0.0)
+    torch.manual_seed(0)
+    model = transformers.DistilBertForMaskedLM(hf_cfg).eval()
+    cfg, params = import_hf_model(model)
+    ids = rng.integers(0, 89, size=(2, 10)).astype(np.int64)
+    hidden = B.encode(cfg, params, jnp.asarray(ids))
+    ours = np.asarray(B.mlm_logits(cfg, params, hidden))
+    with torch.no_grad():
+        theirs = model(torch.from_numpy(ids).long()).logits.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-3, rtol=1e-3)

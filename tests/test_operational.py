@@ -249,3 +249,10 @@ def test_ds_report_runs():
     ops = dict(op_report())
     assert ops.get("ds_cpu_ops") is True
     assert main() == 0
+
+
+def test_launcher_elastic_flag_requires_config():
+    from deepspeed_tpu.launcher.runner import main as launcher_main
+
+    with pytest.raises(SystemExit, match="elastic_training"):
+        launcher_main(["--elastic_training", "train.py"])
