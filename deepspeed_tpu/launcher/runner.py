@@ -228,6 +228,10 @@ def main(argv=None) -> int:
         logger.info("no hostfile: single-host launch")
         hosts = {"localhost": 1}
     pool = filter_hosts(hosts, args.include, args.exclude)
+    if args.deepspeed_config and "--deepspeed_config" not in args.user_args:
+        # the launcher-level flag reaches the worker on every path
+        args.user_args = list(args.user_args) + [
+            "--deepspeed_config", args.deepspeed_config]
     args.launch_cmd = " ".join(
         [shlex.quote(sys.executable), shlex.quote(args.user_script),
          *map(shlex.quote, args.user_args)])

@@ -66,6 +66,12 @@ class GPTConfig:
     # op_builder/stochastic_transformer.py): drop whole blocks with prob p at
     # train time, survivor delta scaled by 1/(1-p)
     stochastic_depth: float = 0.0
+    # GPT-Neo-style alternating local attention: every `period`-th layer
+    # (1-indexed within the period; GPT-Neo = period 2, layers 1,3,... local)
+    # attends only to the trailing `window_size` positions
+    local_attention_period: int = 0  # 0 = all layers global
+    window_size: int = 256
+    attention_scale: Optional[float] = None  # None = 1/sqrt(head_dim); GPT-Neo = 1.0
 
     @property
     def ffn_dim(self) -> int:
@@ -231,8 +237,29 @@ def _act(cfg: GPTConfig, h: jnp.ndarray) -> jnp.ndarray:
     return jax.nn.gelu(h, approximate=True)
 
 
+def _is_local_layer(cfg: GPTConfig, layer_idx) -> Optional[jnp.ndarray]:
+    """Traced bool: does this layer use windowed (local) attention?
+    GPT-Neo alternates [global, local] — the last layer of each period is
+    local. None when the config never uses local attention."""
+    if cfg.local_attention_period <= 1 or layer_idx is None:
+        return None
+    p = cfg.local_attention_period
+    return (jnp.asarray(layer_idx) % p) == (p - 1)
+
+
+def _local_window_bias(cfg: GPTConfig, q_positions: jnp.ndarray, kv_len: int,
+                       is_local) -> jnp.ndarray:
+    """[B, 1, T, S] additive bias masking keys older than window_size
+    (inert for global layers: is_local is traced, the program is uniform)."""
+    s_idx = jnp.arange(kv_len)[None, None, None, :]
+    t_abs = q_positions[:, None, :, None]
+    too_old = s_idx <= t_abs - cfg.window_size
+    return jnp.where(jnp.logical_and(is_local, too_old),
+                     jnp.float32(-1e30), jnp.float32(0.0))
+
+
 def _attention_delta(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray],
-                     positions: jnp.ndarray) -> jnp.ndarray:
+                     positions: jnp.ndarray, layer_idx=None) -> jnp.ndarray:
     """Attention output (pre-residual): attn_out(MHA(ln1(x)))."""
     B, T, D = x.shape
     H, Dh = cfg.n_head, cfg.head_dim
@@ -248,8 +275,13 @@ def _attention_delta(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray],
         q = _rope(q, positions, rd, cfg.rotary_interleaved)
         k_ = _rope(k_, positions, rd, cfg.rotary_interleaved)
     bias = _alibi_bias(cfg, positions, T) if cfg.alibi else None
+    is_local = _is_local_layer(cfg, layer_idx)
+    if is_local is not None:
+        lb = _local_window_bias(cfg, positions, T, is_local)
+        bias = lb if bias is None else bias + lb
     attn = multihead_attention(q, k_, v, causal=True, bias=bias,
                                use_flash=cfg.use_flash,
+                               softmax_scale=cfg.attention_scale,
                                block_q=cfg.flash_block_q,
                                block_k=cfg.flash_block_k)
     attn = attn.reshape(B, T, D)
@@ -265,21 +297,24 @@ def _mlp_delta(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray]) -> jnp
 
 
 def attention_sublayer(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray],
-                       positions: jnp.ndarray, dropout_rng, train: bool) -> jnp.ndarray:
+                       positions: jnp.ndarray, dropout_rng, train: bool,
+                       layer_idx=None) -> jnp.ndarray:
     """Pre-LN self-attention + residual (shared by dense and MoE blocks)."""
-    attn = _attention_delta(cfg, x, w, positions)
+    attn = _attention_delta(cfg, x, w, positions, layer_idx=layer_idx)
     return x + _dropout(attn, cfg.dropout, dropout_rng, train, salt=0)
 
 
 def _block(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray],
-           positions: jnp.ndarray, dropout_rng, train: bool) -> jnp.ndarray:
+           positions: jnp.ndarray, dropout_rng, train: bool,
+           layer_idx=None) -> jnp.ndarray:
     if cfg.parallel_residual:
         # NeoX/GPT-J style: both sublayers read the same input
-        attn = _dropout(_attention_delta(cfg, x, w, positions),
+        attn = _dropout(_attention_delta(cfg, x, w, positions, layer_idx=layer_idx),
                         cfg.dropout, dropout_rng, train, salt=0)
         mlp = _dropout(_mlp_delta(cfg, x, w), cfg.dropout, dropout_rng, train, salt=1)
         return x + attn + mlp
-    x = attention_sublayer(cfg, x, w, positions, dropout_rng, train)
+    x = attention_sublayer(cfg, x, w, positions, dropout_rng, train,
+                           layer_idx=layer_idx)
     h = _mlp_delta(cfg, x, w)
     x = x + _dropout(h, cfg.dropout, dropout_rng, train, salt=1)
     return x
@@ -315,8 +350,8 @@ def forward(cfg: GPTConfig, params: Dict[str, Any], input_ids: jnp.ndarray,
 
     drng = (rngs or {}).get("dropout")
 
-    def block_fn(x, layer_w, pos, lrng):
-        return _block(cfg, x, layer_w, pos, lrng, train)
+    def block_fn(x, layer_w, pos, lrng, layer_idx):
+        return _block(cfg, x, layer_w, pos, lrng, train, layer_idx=layer_idx)
 
     if cfg.remat:
         policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
@@ -327,7 +362,7 @@ def forward(cfg: GPTConfig, params: Dict[str, Any], input_ids: jnp.ndarray,
     def body(carry, layer_w):
         x, i = carry
         lrng = jax.random.fold_in(drng, i) if drng is not None else None
-        y = block_fn(x, layer_w, positions, lrng)
+        y = block_fn(x, layer_w, positions, lrng, i)
         if sd > 0.0 and lrng is not None:
             # stochastic depth: drop the whole block with prob sd; the
             # surviving delta is scaled so eval needs no correction
@@ -461,7 +496,7 @@ def init_cache(cfg: GPTConfig, batch_size: int, max_len: int, dtype=jnp.bfloat16
             "pos": jnp.zeros((), jnp.int32)}
 
 
-def attn_with_cache(cfg: GPTConfig, x, w, k_cache, v_cache, pos):
+def attn_with_cache(cfg: GPTConfig, x, w, k_cache, v_cache, pos, layer_idx=None):
     """Cached self-attention sublayer (pre-LN + residual), shared by the dense
     and MoE cached forwards.
 
@@ -488,11 +523,12 @@ def attn_with_cache(cfg: GPTConfig, x, w, k_cache, v_cache, pos):
         k_cache, k_.transpose(0, 2, 1, 3).astype(k_cache.dtype), (0, 0, pos, 0))
     v_cache = jax.lax.dynamic_update_slice(
         v_cache, v.transpose(0, 2, 1, 3).astype(v_cache.dtype), (0, 0, pos, 0))
-    scale = 1.0 / np.sqrt(Dh)
+    scale = (cfg.attention_scale if cfg.attention_scale is not None
+             else 1.0 / np.sqrt(Dh))
     use_kernel = (cfg.use_flash is True
                   or (cfg.use_flash is None and jax.default_backend() == "tpu"))
-    if cfg.alibi:
-        use_kernel = False  # decode kernel has no bias input yet
+    if cfg.alibi or cfg.local_attention_period > 1:
+        use_kernel = False  # decode kernel has no bias/window input yet
     if T == 1 and use_kernel:
         # per-token decode: fused Pallas cache-attention kernel (parity:
         # softmax_context, csrc/transformer/inference); auto mode gates on the
@@ -509,6 +545,11 @@ def attn_with_cache(cfg: GPTConfig, x, w, k_cache, v_cache, pos):
         s_idx = jnp.arange(S)[None, :]
         t_idx = positions[:, :, None]  # absolute position of each query token
         mask = s_idx <= t_idx  # [B, T, S]
+        is_local = _is_local_layer(cfg, layer_idx)
+        if is_local is not None:
+            # windowed layers additionally drop keys older than window_size
+            mask = jnp.logical_and(
+                mask, jnp.logical_or(~is_local, s_idx > t_idx - cfg.window_size))
         if cfg.alibi:
             logits = logits + _alibi_bias(cfg, positions, S)
         logits = jnp.where(mask[:, None, :, :], logits, jnp.float32(-1e30))
@@ -519,12 +560,15 @@ def attn_with_cache(cfg: GPTConfig, x, w, k_cache, v_cache, pos):
     return x + attn, k_cache, v_cache
 
 
-def _block_with_cache(cfg: GPTConfig, x, w, k_cache, v_cache, pos):
+def _block_with_cache(cfg: GPTConfig, x, w, k_cache, v_cache, pos,
+                      layer_idx=None):
     """One transformer block (attention + dense MLP) over a KV cache slice."""
     if cfg.parallel_residual:
-        y, k_cache, v_cache = attn_with_cache(cfg, x, w, k_cache, v_cache, pos)
+        y, k_cache, v_cache = attn_with_cache(cfg, x, w, k_cache, v_cache, pos,
+                                              layer_idx=layer_idx)
         return y + _mlp_delta(cfg, x, w), k_cache, v_cache
-    x, k_cache, v_cache = attn_with_cache(cfg, x, w, k_cache, v_cache, pos)
+    x, k_cache, v_cache = attn_with_cache(cfg, x, w, k_cache, v_cache, pos,
+                                          layer_idx=layer_idx)
     return x + _mlp_delta(cfg, x, w), k_cache, v_cache
 
 
@@ -547,15 +591,17 @@ def forward_with_cache(cfg: GPTConfig, params, input_ids: jnp.ndarray, cache):
     x = maybe_shard(x, P(BATCH, None, None))
 
     def body(carry, layer_in):
-        x = carry
+        x, i = carry
         layer_w, k_c, v_c = layer_in
         # int8 weights: dequantize THIS layer's slice only, inside the scan —
         # peak HBM never holds a full dequantized stack
         layer_w = _dequant_layer(layer_w, compute_dtype)
-        x, k_c, v_c = _block_with_cache(cfg, x, layer_w, k_c, v_c, pos)
-        return x, (k_c, v_c)
+        x, k_c, v_c = _block_with_cache(cfg, x, layer_w, k_c, v_c, pos,
+                                        layer_idx=i)
+        return (x, i + 1), (k_c, v_c)
 
-    x, (new_k, new_v) = jax.lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
+    (x, _), (new_k, new_v) = jax.lax.scan(
+        body, (x, jnp.int32(0)), (params["blocks"], cache["k"], cache["v"]))
     x = layer_norm(x, params["lnf_scale"], params["lnf_bias"], cfg.layer_norm_eps)
     head = params["wte"] if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))

@@ -147,9 +147,9 @@ def partition_specs(cfg: GPTMoEConfig, param_shapes) -> Dict[str, Any]:
     return specs
 
 
-def _moe_block(cfg: GPTMoEConfig, x, w, positions, rng, train):
+def _moe_block(cfg: GPTMoEConfig, x, w, positions, rng, train, layer_idx=None):
     b = cfg.base
-    x = attention_sublayer(b, x, w, positions, rng, train)
+    x = attention_sublayer(b, x, w, positions, rng, train, layer_idx=layer_idx)
     h = layer_norm(x, w["ln2_scale"], w["ln2_bias"], b.layer_norm_eps)
     # decorrelate gating noise/RTS draws from the dropout mask (both fold small
     # constants into their key; give the gate its own subtree of the key space)
@@ -184,12 +184,13 @@ def forward(cfg: GPTMoEConfig, params, input_ids: jnp.ndarray,
             def dense_body(carry, layer_w):
                 xx, i = carry
                 lrng = jax.random.fold_in(drng, i) if drng is not None else None
-                xx = _block(b, xx, layer_w, positions, lrng, train)
+                xx = _block(b, xx, layer_w, positions, lrng, train, layer_idx=i)
                 return (xx, i + 1), None
 
             (x, idx), _ = jax.lax.scan(dense_body, (x, idx), dense_ws)
         lrng = jax.random.fold_in(drng, idx) if drng is not None else None
-        x, aux = _moe_block(cfg, x, moe_w, positions, lrng, train)
+        x, aux = _moe_block(cfg, x, moe_w, positions, lrng, train,
+                            layer_idx=idx)
         return x, idx + 1, aux
 
     if cfg.base.remat:
@@ -280,14 +281,15 @@ def init_cache(cfg: GPTMoEConfig, batch_size: int, max_len: int,
             "pos": jnp.zeros((), jnp.int32)}
 
 
-def _moe_block_with_cache(cfg: GPTMoEConfig, x, w, k_c, v_c, pos):
+def _moe_block_with_cache(cfg: GPTMoEConfig, x, w, k_c, v_c, pos,
+                          layer_idx=None):
     """Cached MoE block: cached attention + expert-parallel MLP (eval gating:
     no jitter/RTS, eval capacity factor). Parity: the reference's
     ``DeepSpeedMoEInference`` layer (``ops/transformer/inference/moe_inference.py``)."""
     b = cfg.base
     from .gpt import attn_with_cache
 
-    x, k_c, v_c = attn_with_cache(b, x, w, k_c, v_c, pos)
+    x, k_c, v_c = attn_with_cache(b, x, w, k_c, v_c, pos, layer_idx=layer_idx)
     h = layer_norm(x, w["ln2_scale"], w["ln2_bias"], b.layer_norm_eps)
     y, _aux, _counts = apply_moe(cfg.moe_config(), w["moe"], h, rng=None,
                                  train=False)
@@ -312,22 +314,26 @@ def forward_with_cache(cfg: GPTMoEConfig, params, input_ids: jnp.ndarray, cache)
     n_dense = cfg.moe_freq - 1
 
     def super_body(carry, layer_in):
-        x = carry
+        x, idx = carry  # idx = global layer index (local-attention schedule)
         if n_dense > 0:
             dense_ws, kd, vd, moe_w, km, vm = layer_in
 
-            def dense_body(xx, lin):
+            def dense_body(c, lin):
+                xx, i = c
                 layer_w, k_c, v_c = lin
-                xx, k_c, v_c = _block_with_cache(b, xx, layer_w, k_c, v_c, pos)
-                return xx, (k_c, v_c)
+                xx, k_c, v_c = _block_with_cache(b, xx, layer_w, k_c, v_c, pos,
+                                                 layer_idx=i)
+                return (xx, i + 1), (k_c, v_c)
 
-            x, (kd, vd) = jax.lax.scan(dense_body, x, (dense_ws, kd, vd))
+            (x, idx), (kd, vd) = jax.lax.scan(
+                dense_body, (x, idx), (dense_ws, kd, vd))
         else:
             moe_w, km, vm = layer_in
             kd = vd = None
-        x, km, vm = _moe_block_with_cache(cfg, x, moe_w, km, vm, pos)
+        x, km, vm = _moe_block_with_cache(cfg, x, moe_w, km, vm, pos,
+                                          layer_idx=idx)
         out = (kd, vd, km, vm) if n_dense > 0 else (km, vm)
-        return x, out
+        return (x, idx + 1), out
 
     if n_dense > 0:
         dense_stack = jax.tree_util.tree_map(
@@ -339,7 +345,7 @@ def forward_with_cache(cfg: GPTMoEConfig, params, input_ids: jnp.ndarray, cache)
     else:
         xs = (params["moe_blocks"], cache["k_moe"], cache["v_moe"])
 
-    x, outs = jax.lax.scan(super_body, x, xs)
+    (x, _), outs = jax.lax.scan(super_body, (x, jnp.int32(0)), xs)
     if n_dense > 0:
         new_kd, new_vd, new_km, new_vm = outs
         new_kd = new_kd.reshape(cache["k_dense"].shape)

@@ -79,7 +79,7 @@ def forward(cfg: G.GPTConfig, num_stages: int, num_micro: int, params,
             x, i = carry  # i = GLOBAL layer index (matches dense rng folding)
             lrng = (jax.random.fold_in(jax.random.fold_in(drng, micro_id), i)
                     if drng is not None else None)
-            x = G._block(cfg, x, layer_w, pos, lrng, train)
+            x = G._block(cfg, x, layer_w, pos, lrng, train, layer_idx=i)
             return (x, i + 1), None
 
         (x, _), _ = jax.lax.scan(

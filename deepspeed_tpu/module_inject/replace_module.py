@@ -299,15 +299,23 @@ def _gptj_policy(c, sd) -> Tuple[GPTConfig, Dict[str, Any]]:
     return cfg, params
 
 
-def _fuse_qkv(sd, fmt: str, parts, n_layer: int):
+def _fuse_qkv(sd, fmt: str, parts, n_layer: int, bias_optional: bool = False):
     """Stack per-layer fused qkv from separate [out,in] q/k/v Linears:
-    returns (qkv_w [L, D, 3D], qkv_b [L, 3D])."""
+    returns (qkv_w [L, D, 3D], qkv_b [L, 3D]). ``bias_optional``: missing
+    biases (GPT-Neo's bias-free q/k/v) become zeros."""
     ws, bs = [], []
     for i in range(n_layer):
-        ws.append(np.concatenate(
-            [sd[fmt.format(i, p) + ".weight"].T for p in parts], axis=1))
-        bs.append(np.concatenate(
-            [sd[fmt.format(i, p) + ".bias"] for p in parts]))
+        mats = [sd[fmt.format(i, p) + ".weight"].T for p in parts]
+        ws.append(np.concatenate(mats, axis=1))
+        vecs = []
+        for p, m in zip(parts, mats):
+            key = fmt.format(i, p) + ".bias"
+            if bias_optional and key not in sd:
+                # synthesized zeros keep the weight's dtype (bf16 preservation)
+                vecs.append(np.zeros(m.shape[1], np.asarray(m).dtype))
+            else:
+                vecs.append(sd[key])
+        bs.append(np.concatenate(vecs))
     return jnp.asarray(np.stack(ws)), jnp.asarray(np.stack(bs))
 
 
@@ -359,6 +367,60 @@ def _bert_policy(c, sd):
         # shape of models/bert.init_params
         "pooler_w": jnp.zeros((c.hidden_size, c.hidden_size), jnp.float32),
         "pooler_b": jnp.zeros((c.hidden_size,), jnp.float32),
+    }
+    return cfg, params
+
+
+def _gptneo_policy(c, sd) -> Tuple[GPTConfig, Dict[str, Any]]:
+    """HF GPTNeoForCausalLM -> params. Parity: ``containers/gptneo.py``
+    (HFGPTNEOLayerPolicy). GPT-Neo alternates global/local (windowed)
+    attention — mapped to ``local_attention_period=2`` with the config's
+    window — uses separate bias-free q/k/v Linears, and learned positions."""
+    attn_types = [t for pattern, n in c.attention_types for t in pattern * n] \
+        if isinstance(c.attention_types, (list, tuple)) else ["global"]
+    if any(t == "local" for t in attn_types):
+        if attn_types != ["global", "local"] * (len(attn_types) // 2):
+            raise ValueError(
+                f"GPT-Neo attention_types {attn_types} is not the alternating "
+                "[global, local] pattern; only period-2 alternation is mapped")
+        period = 2
+    else:
+        period = 0
+    cfg = GPTConfig(
+        vocab_size=c.vocab_size, n_layer=c.num_layers, n_head=c.num_heads,
+        d_model=c.hidden_size,
+        d_ff=c.intermediate_size if c.intermediate_size else 4 * c.hidden_size,
+        max_seq_len=c.max_position_embeddings, rotary=False,
+        tie_embeddings=True, layer_norm_eps=c.layer_norm_epsilon,
+        activation=_map_activation(c.activation_function, "GPTNeo"),
+        local_attention_period=period, window_size=int(getattr(c, "window_size", 256)),
+        attention_scale=1.0)  # GPT-Neo famously skips the 1/sqrt(d) scaling
+    L = c.num_layers
+    pre = "transformer.h.{}"
+    qkv_w, qkv_b = _fuse_qkv(
+        sd, "transformer.h.{}.attn.attention.{}_proj", ("q", "k", "v"), L,
+        bias_optional=True)
+    params = {
+        "wte": jnp.asarray(sd["transformer.wte.weight"]),
+        "wpe": jnp.asarray(sd["transformer.wpe.weight"]),
+        "blocks": {
+            "ln1_scale": _stack(sd, pre + ".ln_1.weight", L),
+            "ln1_bias": _stack(sd, pre + ".ln_1.bias", L),
+            "qkv_w": qkv_w,
+            "qkv_b": qkv_b,
+            "attn_out_w": _stack(sd, pre + ".attn.attention.out_proj.weight", L,
+                                 transpose=True),
+            "attn_out_b": _stack(sd, pre + ".attn.attention.out_proj.bias", L),
+            "ln2_scale": _stack(sd, pre + ".ln_2.weight", L),
+            "ln2_bias": _stack(sd, pre + ".ln_2.bias", L),
+            "mlp_up_w": _stack(sd, pre + ".mlp.c_fc.weight", L, transpose=True),
+            "mlp_up_b": _stack(sd, pre + ".mlp.c_fc.bias", L),
+            "mlp_down_w": _stack(sd, pre + ".mlp.c_proj.weight", L,
+                                 transpose=True),
+            "mlp_down_b": _stack(sd, pre + ".mlp.c_proj.bias", L),
+        },
+        "lnf_scale": jnp.asarray(sd["transformer.ln_f.weight"]),
+        "lnf_bias": jnp.asarray(sd["transformer.ln_f.bias"]),
     }
     return cfg, params
 
@@ -423,6 +485,7 @@ HF_POLICIES = {
     "OPTForCausalLM": _opt_policy,
     "BloomForCausalLM": _bloom_policy,
     "GPTJForCausalLM": _gptj_policy,
+    "GPTNeoForCausalLM": _gptneo_policy,
     "BertForMaskedLM": _bert_policy,
     "DistilBertForMaskedLM": _distilbert_policy,
 }

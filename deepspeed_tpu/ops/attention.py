@@ -56,6 +56,7 @@ def multihead_attention(
     causal: bool = True,
     bias: Optional[jnp.ndarray] = None,
     use_flash: Optional[bool] = None,
+    softmax_scale: Optional[float] = None,
     block_q: int = 256,
     block_k: int = 256,
 ) -> jnp.ndarray:
@@ -80,8 +81,10 @@ def multihead_attention(
             warning_once("pallas flash attention unavailable; using XLA attention")
         else:
             return flash_attention(q, k, v, causal=causal,
+                                   softmax_scale=softmax_scale,
                                    block_q=block_q, block_k=block_k)
-    return dot_product_attention(q, k, v, causal=causal, bias=bias)
+    return dot_product_attention(q, k, v, causal=causal, bias=bias,
+                                 softmax_scale=softmax_scale)
 
 
 def _flash_eligible(q, k, bias) -> bool:

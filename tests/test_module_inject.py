@@ -342,3 +342,44 @@ def test_distilbert_import_matches_hf(rng):
     with torch.no_grad():
         theirs = model(torch.from_numpy(ids).long()).logits.numpy()
     np.testing.assert_allclose(ours, theirs, atol=2e-3, rtol=1e-3)
+
+
+def test_gptneo_import_matches_hf(rng):
+    """GPT-Neo's alternating global/local attention must match HF exactly —
+    the windowed layers are the point of this policy."""
+    hf_cfg = transformers.GPTNeoConfig(
+        vocab_size=87, hidden_size=32, num_layers=4, num_heads=4,
+        intermediate_size=64, max_position_embeddings=64,
+        attention_types=[[["global", "local"], 2]], window_size=8,
+        activation_function="gelu_new",
+        attention_dropout=0.0, embed_dropout=0.0, resid_dropout=0.0)
+    torch.manual_seed(0)
+    model = transformers.GPTNeoForCausalLM(hf_cfg).eval()
+    # sequence LONGER than the window so local masking is actually exercised
+    ids = rng.integers(0, 87, size=(2, 24)).astype(np.int64)
+    cfg, _ = _compare_logits(model, ids)
+    assert cfg.local_attention_period == 2 and cfg.window_size == 8
+
+
+def test_gptneo_cached_decode_matches_full_forward(rng):
+    """The cached (generate) path must honor the local-attention window too."""
+    from deepspeed_tpu.models import gpt as G
+
+    hf_cfg = transformers.GPTNeoConfig(
+        vocab_size=61, hidden_size=32, num_layers=2, num_heads=4,
+        intermediate_size=64, max_position_embeddings=64,
+        attention_types=[[["global", "local"], 1]], window_size=4,
+        attention_dropout=0.0, embed_dropout=0.0, resid_dropout=0.0)
+    torch.manual_seed(0)
+    cfg, params = import_hf_model(transformers.GPTNeoForCausalLM(hf_cfg).eval())
+    ids = rng.integers(0, 61, size=(2, 12)).astype(np.int32)
+    full = np.asarray(G.forward(cfg, params, jnp.asarray(ids), train=False))
+
+    cache = G.init_cache(cfg, 2, 16, jnp.float32)
+    pre, cache = G.forward_with_cache(cfg, params, jnp.asarray(ids[:, :8]), cache)
+    np.testing.assert_allclose(np.asarray(pre), full[:, :8], atol=2e-4, rtol=1e-3)
+    for t in range(8, 12):
+        step, cache = G.forward_with_cache(
+            cfg, params, jnp.asarray(ids[:, t:t + 1]), cache)
+        np.testing.assert_allclose(np.asarray(step[:, 0]), full[:, t],
+                                   atol=2e-4, rtol=1e-3)
