@@ -124,8 +124,9 @@ def run_collective_bench(
         out.append({
             "op": op, "bytes": nbytes, "world": n,
             "latency_us": round(dt * 1e6, 1),
-            "algbw_GBps": round(algbw / 1e9, 3),
-            "busbw_GBps": round(algbw * _busbw_factor(op, n) / 1e9, 3),
+            # 6 decimals: tiny payloads on a loaded host must not round to 0
+            "algbw_GBps": round(algbw / 1e9, 6),
+            "busbw_GBps": round(algbw * _busbw_factor(op, n) / 1e9, 6),
         })
     return out
 
