@@ -306,6 +306,15 @@ def ddim_sample(cfg: UNetConfig, params, latents: jnp.ndarray,
     return latents
 
 
+def clip_text_embeddings(cfg, params, input_ids) -> jnp.ndarray:
+    """Text conditioning from an imported CLIP text tower
+    (``module_inject``'s ``CLIPTextModel`` policy): the final-LN hidden states
+    [B, S, D] fed to the UNet's cross-attention."""
+    from . import gpt as G
+
+    return G.forward(cfg, params, input_ids, train=False, return_hidden=True)
+
+
 # ----------------------------------------------------------------- pipeline
 @dataclasses.dataclass
 class StableDiffusionPipeline:

@@ -72,6 +72,8 @@ class GPTConfig:
     local_attention_period: int = 0  # 0 = all layers global
     window_size: int = 256
     attention_scale: Optional[float] = None  # None = 1/sqrt(head_dim); GPT-Neo = 1.0
+    has_lm_head: bool = True  # False: pure encoder (CLIP text tower) — only
+    # return_hidden=True is valid; the logits path raises instead of fabricating
 
     @property
     def ffn_dim(self) -> int:
@@ -234,6 +236,8 @@ def _act(cfg: GPTConfig, h: jnp.ndarray) -> jnp.ndarray:
         return jax.nn.relu(h)
     if cfg.activation == "gelu_exact":
         return jax.nn.gelu(h, approximate=False)
+    if cfg.activation == "quick_gelu":  # CLIP: x * sigmoid(1.702 x)
+        return h * jax.nn.sigmoid(1.702 * h)
     return jax.nn.gelu(h, approximate=True)
 
 
@@ -330,8 +334,10 @@ def _dropout(x, rate, rng, train, salt: int):
 
 # --------------------------------------------------------------------------- forward
 def forward(cfg: GPTConfig, params: Dict[str, Any], input_ids: jnp.ndarray,
-            rngs: Optional[Dict[str, jax.Array]] = None, train: bool = True) -> jnp.ndarray:
-    """Return logits [B, T, V]."""
+            rngs: Optional[Dict[str, jax.Array]] = None, train: bool = True,
+            return_hidden: bool = False) -> jnp.ndarray:
+    """Return logits [B, T, V] (or the final-LN hidden states [B, T, D] with
+    ``return_hidden`` — the encoder surface CLIP-style text towers need)."""
     B, T = input_ids.shape
     if T > cfg.max_seq_len:
         raise ValueError(
@@ -382,6 +388,13 @@ def forward(cfg: GPTConfig, params: Dict[str, Any], input_ids: jnp.ndarray,
     (x, _) = zero3_layer_scan(body, (x, jnp.int32(0)), params["blocks"],
                               gathered_spec=layer_specs)
     x = layer_norm(x, params["lnf_scale"], params["lnf_bias"], cfg.layer_norm_eps)
+    if return_hidden:
+        return x
+    if not cfg.has_lm_head:
+        raise ValueError(
+            "this config is a pure encoder (has_lm_head=False, e.g. an "
+            "imported CLIP text tower): call forward(..., return_hidden=True) "
+            "— there is no LM head to produce logits with")
     head = params["wte"] if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
     if cfg.lm_head_bias and not cfg.tie_embeddings:

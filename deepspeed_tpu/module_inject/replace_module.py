@@ -40,6 +40,7 @@ _ACT_MAP = {
     "gelu_fast": "gelu",
     "gelu_pytorch_tanh": "gelu",
     "gelu_python": "gelu_exact",
+    "quick_gelu": "quick_gelu",  # CLIP: x * sigmoid(1.702 x)
 }
 
 
@@ -425,6 +426,47 @@ def _gptneo_policy(c, sd) -> Tuple[GPTConfig, Dict[str, Any]]:
     return cfg, params
 
 
+def _clip_text_policy(c, sd) -> Tuple[GPTConfig, Dict[str, Any]]:
+    """HF CLIPTextModel -> params. Parity: ``containers/clip.py``
+    (HFCLIPLayerPolicy). CLIP's text tower IS a pre-LN causal transformer —
+    the GPT skeleton with quick_gelu and no LM head; consumers read the
+    final-LN hidden states (``gpt.forward(..., return_hidden=True)``), e.g.
+    as Stable-Diffusion text conditioning (models/diffusion.py)."""
+    cfg = GPTConfig(
+        vocab_size=c.vocab_size, n_layer=c.num_hidden_layers,
+        n_head=c.num_attention_heads, d_model=c.hidden_size,
+        d_ff=c.intermediate_size, max_seq_len=c.max_position_embeddings,
+        rotary=False, tie_embeddings=True, has_lm_head=False,
+        layer_norm_eps=c.layer_norm_eps,
+        activation=_map_activation(c.hidden_act, "CLIPText"))
+    L = c.num_hidden_layers
+    pre = "text_model.encoder.layers.{}"
+    qkv_w, qkv_b = _fuse_qkv(
+        sd, "text_model.encoder.layers.{}.self_attn.{}_proj", ("q", "k", "v"), L)
+    params = {
+        "wte": jnp.asarray(sd["text_model.embeddings.token_embedding.weight"]),
+        "wpe": jnp.asarray(sd["text_model.embeddings.position_embedding.weight"]),
+        "blocks": {
+            "ln1_scale": _stack(sd, pre + ".layer_norm1.weight", L),
+            "ln1_bias": _stack(sd, pre + ".layer_norm1.bias", L),
+            "qkv_w": qkv_w,
+            "qkv_b": qkv_b,
+            "attn_out_w": _stack(sd, pre + ".self_attn.out_proj.weight", L,
+                                 transpose=True),
+            "attn_out_b": _stack(sd, pre + ".self_attn.out_proj.bias", L),
+            "ln2_scale": _stack(sd, pre + ".layer_norm2.weight", L),
+            "ln2_bias": _stack(sd, pre + ".layer_norm2.bias", L),
+            "mlp_up_w": _stack(sd, pre + ".mlp.fc1.weight", L, transpose=True),
+            "mlp_up_b": _stack(sd, pre + ".mlp.fc1.bias", L),
+            "mlp_down_w": _stack(sd, pre + ".mlp.fc2.weight", L, transpose=True),
+            "mlp_down_b": _stack(sd, pre + ".mlp.fc2.bias", L),
+        },
+        "lnf_scale": jnp.asarray(sd["text_model.final_layer_norm.weight"]),
+        "lnf_bias": jnp.asarray(sd["text_model.final_layer_norm.bias"]),
+    }
+    return cfg, params
+
+
 def _distilbert_policy(c, sd):
     """HF DistilBertForMaskedLM -> (BertConfig, params). Parity:
     ``containers/distil_bert.py`` (HFDistilBertLayerPolicy). DistilBERT is a
@@ -488,6 +530,7 @@ HF_POLICIES = {
     "GPTNeoForCausalLM": _gptneo_policy,
     "BertForMaskedLM": _bert_policy,
     "DistilBertForMaskedLM": _distilbert_policy,
+    "CLIPTextModel": _clip_text_policy,
 }
 
 

@@ -383,3 +383,36 @@ def test_gptneo_cached_decode_matches_full_forward(rng):
             cfg, params, jnp.asarray(ids[:, t:t + 1]), cache)
         np.testing.assert_allclose(np.asarray(step[:, 0]), full[:, t],
                                    atol=2e-4, rtol=1e-3)
+
+
+def test_clip_text_import_matches_hf(rng):
+    """CLIP text tower (SD's conditioning encoder) hidden states match HF."""
+    from deepspeed_tpu.models.diffusion import clip_text_embeddings
+
+    hf_cfg = transformers.CLIPTextConfig(
+        vocab_size=77, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=32, hidden_act="quick_gelu")
+    torch.manual_seed(0)
+    model = transformers.CLIPTextModel(hf_cfg).eval()
+    cfg, params = import_hf_model(model)
+    assert cfg.activation == "quick_gelu"
+    ids = rng.integers(0, 77, size=(2, 10)).astype(np.int64)
+    ours = np.asarray(clip_text_embeddings(cfg, params, jnp.asarray(ids)))
+    with torch.no_grad():
+        theirs = model(torch.from_numpy(ids).long()).last_hidden_state.numpy()
+    np.testing.assert_allclose(ours, theirs, atol=2e-3, rtol=1e-3)
+
+
+def test_clip_text_logits_path_refuses(rng):
+    from deepspeed_tpu.models import gpt as G
+
+    hf_cfg = transformers.CLIPTextConfig(
+        vocab_size=61, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=16, hidden_act="quick_gelu")
+    torch.manual_seed(0)
+    cfg, params = import_hf_model(transformers.CLIPTextModel(hf_cfg).eval())
+    ids = jnp.zeros((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match="pure encoder"):
+        G.forward(cfg, params, ids, train=False)
