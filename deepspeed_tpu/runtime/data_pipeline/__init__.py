@@ -5,3 +5,8 @@ from .data_routing.random_ltd import (  # noqa: F401
     random_ltd_gather,
     random_ltd_scatter,
 )
+from .data_analyzer import (  # noqa: F401
+    DataAnalyzer,
+    IndexedMetricStore,
+    seqlen_metric,
+)

@@ -1,0 +1,146 @@
+"""Offline dataset analysis + indexed metric store.
+
+Capability parity with the reference's data-efficiency analysis tooling —
+``data_sampling/data_analyzer.py`` (map metric functions over the dataset with
+worker sharding, write per-metric index files, merge) and
+``data_sampling/indexed_dataset.py`` (the memory-mapped store those files use).
+The curriculum sampler consumes the stored metric as its ``difficulty_fn``, so
+"analyze once, train many" works the same way.
+
+TPU-native simplifications: metrics are plain per-sample scalars stored as one
+memory-mapped ``.npy`` per metric plus a JSON manifest — no custom binary
+framing (numpy's format IS an indexed flat store), no torch Dataset coupling
+(any indexable yielding dict/array samples works). Worker sharding is
+contiguous ranges; ``merge`` concatenates worker shards in rank order.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, Mapping, Optional, Sequence
+
+import numpy as np
+
+_MANIFEST = "ds_metric_index.json"
+
+
+def seqlen_metric(sample) -> float:
+    """The stock difficulty metric: token count (curriculum seqlen)."""
+    if isinstance(sample, Mapping):
+        sample = sample.get("input_ids", next(iter(sample.values())))
+    return float(np.asarray(sample).reshape(-1).shape[0])
+
+
+class IndexedMetricStore:
+    """Memory-mapped per-sample metric values.
+
+    Parity: the reference's ``MMapIndexedDataset`` as used by curriculum
+    sampling (``indexed_dataset.py``) — random access without loading the
+    file; one file per metric, a JSON manifest tying them together.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        manifest = os.path.join(path, _MANIFEST)
+        if not os.path.exists(manifest):
+            raise FileNotFoundError(f"no metric index at {path}")
+        with open(manifest) as f:
+            self.manifest = json.load(f)
+        self._arrays: Dict[str, np.ndarray] = {}
+
+    @property
+    def num_samples(self) -> int:
+        return int(self.manifest["num_samples"])
+
+    @property
+    def metrics(self) -> Sequence[str]:
+        return list(self.manifest["metrics"])
+
+    def values(self, metric: str) -> np.ndarray:
+        if metric not in self._arrays:
+            if metric not in self.manifest["metrics"]:
+                raise KeyError(f"metric {metric!r} not in {self.metrics}")
+            self._arrays[metric] = np.load(
+                os.path.join(self.path, f"{metric}.npy"), mmap_mode="r")
+        return self._arrays[metric]
+
+    def difficulty_fn(self, metric: str) -> Callable[[int], float]:
+        """The curriculum sampler's per-index difficulty lookup."""
+        vals = self.values(metric)
+        return lambda idx: float(vals[idx])
+
+    def buckets(self, metric: str, edges: Sequence[float]) -> Dict[int, np.ndarray]:
+        """Sample indices grouped by difficulty bucket (the reference's
+        seqlen -> sample-index map used for curriculum batching)."""
+        vals = np.asarray(self.values(metric))
+        which = np.digitize(vals, np.asarray(edges))
+        return {b: np.nonzero(which == b)[0] for b in range(len(edges) + 1)}
+
+
+class DataAnalyzer:
+    """Map metric functions over a dataset; write the indexed store.
+
+    Parity: ``DataAnalyzer.run_map`` / ``run_reduce``
+    (``data_sampling/data_analyzer.py``): ``worker_id``/``num_workers`` shard
+    the dataset into contiguous ranges, each worker writes its shard files,
+    and :meth:`merge` concatenates them into the final store.
+    """
+
+    def __init__(self, metric_fns: Optional[Dict[str, Callable[[Any], float]]] = None,
+                 worker_id: int = 0, num_workers: int = 1):
+        self.metric_fns = dict(metric_fns or {"seqlen": seqlen_metric})
+        self.worker_id = int(worker_id)
+        self.num_workers = int(num_workers)
+
+    def _shard_range(self, n: int):
+        per = -(-n // self.num_workers)
+        lo = min(n, self.worker_id * per)
+        return lo, min(n, lo + per)
+
+    def run(self, dataset, out_dir: str) -> Dict[str, np.ndarray]:
+        """Analyze this worker's shard; write ``<metric>.worker<id>.npy``."""
+        os.makedirs(out_dir, exist_ok=True)
+        n = len(dataset)
+        lo, hi = self._shard_range(n)
+        out = {m: np.empty(hi - lo, np.float32) for m in self.metric_fns}
+        for i in range(lo, hi):
+            sample = dataset[i]
+            for m, fn in self.metric_fns.items():
+                out[m][i - lo] = fn(sample)
+        for m, vals in out.items():
+            np.save(os.path.join(out_dir, f"{m}.worker{self.worker_id}.npy"),
+                    vals)
+        with open(os.path.join(
+                out_dir, f"shard{self.worker_id}.json"), "w") as f:
+            json.dump({"worker": self.worker_id, "lo": lo, "hi": hi,
+                       "num_workers": self.num_workers}, f)
+        return out
+
+    @staticmethod
+    def merge(out_dir: str) -> IndexedMetricStore:
+        """Concatenate every worker's shard files into the final store."""
+        shards = sorted(
+            (json.load(open(os.path.join(out_dir, f)))
+             for f in os.listdir(out_dir)
+             if f.startswith("shard") and f.endswith(".json")),
+            key=lambda s: s["worker"])
+        if not shards:
+            raise FileNotFoundError(f"no analyzer shards in {out_dir}")
+        expect = shards[0]["num_workers"]
+        if len(shards) != expect or [s["worker"] for s in shards] != list(range(expect)):
+            raise ValueError(
+                f"incomplete analysis: found workers "
+                f"{[s['worker'] for s in shards]} of {expect}")
+        metrics = sorted({f.split(".worker")[0] for f in os.listdir(out_dir)
+                          if ".worker" in f and f.endswith(".npy")})
+        total = 0
+        for m in metrics:
+            parts = [np.load(os.path.join(out_dir, f"{m}.worker{s['worker']}.npy"))
+                     for s in shards]
+            full = np.concatenate(parts)
+            np.save(os.path.join(out_dir, f"{m}.npy"), full)
+            total = full.shape[0]
+        with open(os.path.join(out_dir, _MANIFEST), "w") as f:
+            json.dump({"num_samples": total, "metrics": metrics}, f)
+        return IndexedMetricStore(out_dir)

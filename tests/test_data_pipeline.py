@@ -215,3 +215,57 @@ def test_engine_curriculum_truncates_seqlen():
     for _ in range(4):
         m = engine.train_batch(batch)
     assert engine.curriculum_scheduler.get_current_difficulty() == 32
+
+
+# ----------------------------------------------------------- data analyzer
+def test_data_analyzer_shards_merge_and_feed_curriculum(tmp_path, rng):
+    """Parity: data_sampling/data_analyzer.py + indexed_dataset.py — sharded
+    analysis, merged indexed store, consumed by the curriculum sampler."""
+    from deepspeed_tpu.runtime.data_pipeline import (
+        CurriculumScheduler,
+        DataAnalyzer,
+        DeepSpeedDataSampler,
+        IndexedMetricStore,
+        seqlen_metric,
+    )
+
+    lengths = rng.integers(4, 33, size=23)
+    dataset = [{"input_ids": np.zeros(l, np.int32)} for l in lengths]
+
+    out = str(tmp_path / "analysis")
+    for w in range(3):  # 3 analysis workers over 23 samples
+        DataAnalyzer({"seqlen": seqlen_metric}, worker_id=w,
+                     num_workers=3).run(dataset, out)
+    store = DataAnalyzer.merge(out)
+    assert store.num_samples == 23 and store.metrics == ["seqlen"]
+    np.testing.assert_array_equal(np.asarray(store.values("seqlen")),
+                                  lengths.astype(np.float32))
+
+    # random access without loading (mmap) + bucket map
+    buckets = store.buckets("seqlen", edges=[16])
+    assert sorted(np.concatenate(list(buckets.values()))) == list(range(23))
+    assert all(lengths[i] < 16 for i in buckets[0])
+
+    # incomplete merges fail loudly
+    import os
+
+    os.remove(str(tmp_path / "analysis" / "shard1.json"))
+    with pytest.raises(ValueError, match="incomplete"):
+        DataAnalyzer.merge(out)
+
+    # the store drives curriculum sampling (difficulty gate = stored metric)
+    sched = CurriculumScheduler({
+        "enabled": True, "curriculum_type": "seqlen",
+        "min_difficulty": 8, "max_difficulty": 33,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 10, "difficulty_step": 1}})
+    step = {"n": 1}
+    sampler = DeepSpeedDataSampler(
+        total_samples=23, micro_batch_size=4,
+        curriculum_scheduler=sched,
+        difficulty_fn=store.difficulty_fn("seqlen"),
+        global_steps_fn=lambda: step["n"])
+    level = sched.update_difficulty(step["n"])
+    batch = next(iter(sampler))
+    assert level < 33  # curriculum still ramping at step 1
+    assert all(lengths[i] <= level for i in batch)  # only easy-enough samples
