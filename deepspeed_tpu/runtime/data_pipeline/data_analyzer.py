@@ -120,27 +120,44 @@ class DataAnalyzer:
     @staticmethod
     def merge(out_dir: str) -> IndexedMetricStore:
         """Concatenate every worker's shard files into the final store."""
-        shards = sorted(
-            (json.load(open(os.path.join(out_dir, f)))
-             for f in os.listdir(out_dir)
-             if f.startswith("shard") and f.endswith(".json")),
-            key=lambda s: s["worker"])
+        shards = []
+        for f in os.listdir(out_dir):
+            if f.startswith("shard") and f.endswith(".json"):
+                with open(os.path.join(out_dir, f)) as fh:
+                    shards.append(json.load(fh))
+        shards.sort(key=lambda s: s["worker"])
         if not shards:
             raise FileNotFoundError(f"no analyzer shards in {out_dir}")
         expect = shards[0]["num_workers"]
-        if len(shards) != expect or [s["worker"] for s in shards] != list(range(expect)):
+        if (len(shards) != expect
+                or [s["worker"] for s in shards] != list(range(expect))
+                or any(s["num_workers"] != expect for s in shards)):
             raise ValueError(
                 f"incomplete analysis: found workers "
-                f"{[s['worker'] for s in shards]} of {expect}")
+                f"{[(s['worker'], s['num_workers']) for s in shards]} "
+                f"of {expect}")
+        # shards must tile [0, total) contiguously — stale files from a run
+        # with a different sharding would silently mis-index the dataset
+        pos = 0
+        for s in shards:
+            if s["lo"] != pos:
+                raise ValueError(
+                    f"incomplete analysis: worker {s['worker']} covers "
+                    f"[{s['lo']}, {s['hi']}) but expected start {pos} — "
+                    "stale shard files from a different run?")
+            pos = s["hi"]
+        total = pos
         metrics = sorted({f.split(".worker")[0] for f in os.listdir(out_dir)
                           if ".worker" in f and f.endswith(".npy")})
-        total = 0
         for m in metrics:
             parts = [np.load(os.path.join(out_dir, f"{m}.worker{s['worker']}.npy"))
                      for s in shards]
             full = np.concatenate(parts)
+            if full.shape[0] != total:
+                raise ValueError(
+                    f"metric {m!r}: {full.shape[0]} values for {total} samples "
+                    "— stale worker files from a different analysis?")
             np.save(os.path.join(out_dir, f"{m}.npy"), full)
-            total = full.shape[0]
         with open(os.path.join(out_dir, _MANIFEST), "w") as f:
             json.dump({"num_samples": total, "metrics": metrics}, f)
         return IndexedMetricStore(out_dir)
