@@ -91,6 +91,9 @@ class CompressionScheduler:
             log_dist(f"compression: {len(self.plan)} tensors under "
                      f"{'QAT ' if wq['shared']['enabled'] else ''}"
                      f"{'pruning' if sp['shared']['enabled'] else ''}".strip())
+        # key-path prefix of the stacked layer subtree ([n_layer, ...] leaves);
+        # the engine overwrites it with the eigenvalue probe's resolved subtree
+        self.curvature_scope = "blocks"
 
     @staticmethod
     def _group_lookup(key: str, groups: Dict[str, Any], first: Tuple[str, Any],
@@ -108,9 +111,16 @@ class CompressionScheduler:
         return bool(self.plan)
 
     # ------------------------------------------------------------------ in-step
-    def transform(self, params, step: jnp.ndarray):
+    def transform(self, params, step: jnp.ndarray, curvature=None):
         """Apply scheduled fake-quant / pruning to planned leaves. ``step`` is
-        traced; gating is a select so one program covers the schedule."""
+        traced; gating is a select so one program covers the schedule.
+
+        ``curvature``: optional traced ``[n_layer]`` vector of normalized
+        ([0, 1]) per-layer Hessian eigenvalues (``runtime/eigenvalue.py``).
+        Stacked per-layer leaves (leading dim == n_layer) then quantize on a
+        per-layer stretched schedule — offset x (1 + floor(ev * 4)) — so
+        high-curvature layers quantize later. Parity: the reference quantizer's
+        eigenvalue factor (``runtime/quantize.py:63-68``)."""
         if not self.plan:
             return params
         flat, treedef = jax.tree_util.tree_flatten_with_path(params)
@@ -121,7 +131,17 @@ class CompressionScheduler:
             if entry is not None:
                 if "quant_bits" in entry:
                     xq = fake_quant(x, entry["quant_bits"], entry["quant_groups"])
-                    x = jnp.where(step >= entry["quant_offset"], xq, x)
+                    offset = entry["quant_offset"]
+                    key = _path_str(path)
+                    in_scope = key.startswith(self.curvature_scope + "/")
+                    if (curvature is not None and in_scope and x.ndim >= 1
+                            and x.shape[0] == curvature.shape[0]):
+                        factor = 1.0 + jnp.floor(curvature * 4.0)
+                        gate = step >= (offset * factor).astype(step.dtype)
+                        x = jnp.where(
+                            gate.reshape((-1,) + (1,) * (x.ndim - 1)), xq, x)
+                    else:
+                        x = jnp.where(step >= offset, xq, x)
                 if "prune_ratio" in entry:
                     # lax.cond, not where: the pruning branch sorts |W| (O(n log n))
                     # and must not execute during the pre-offset steps

@@ -128,6 +128,24 @@ class DeepSpeedEngine:
             if sched.enabled:
                 self._compression = sched
 
+        # eigenvalue: per-layer Hessian curvature probe driving the MoQ
+        # schedule (parity: runtime/eigenvalue.py, configured at engine.py:361)
+        self._eigenvalue = None
+        self._ev_last_batch = None
+        if config.eigenvalue.enabled:
+            from .eigenvalue import Eigenvalue
+
+            self._eigenvalue = Eigenvalue.from_config(config.eigenvalue)
+
+            # ONE stable function object: Eigenvalue.compute keys its compiled
+            # HVP on loss-fn identity (params/batch are traced arguments)
+            def _ev_loss(p, b):
+                out = self.model.apply(p, b, train=False)
+                loss, _ = out if isinstance(out, tuple) else (out, {})
+                return loss.astype(jnp.float32)
+
+            self._ev_loss_fn = _ev_loss
+
         # curriculum learning: step-scheduled sequence truncation (parity:
         # engine.py:1810-1816; legacy "curriculum_learning" block)
         self.curriculum_scheduler = None
@@ -177,6 +195,13 @@ class DeepSpeedEngine:
         seed = seed if seed is not None else config.seed
         self._rng = jax.random.PRNGKey(seed)
         param_shapes = jax.eval_shape(model.init, self._rng)
+        self._n_curvature = 0
+        if self._eigenvalue is not None:
+            ev_scope, _, self._n_curvature = self._eigenvalue._blocks(param_shapes)
+            if self._compression is not None:
+                # scope the per-layer MoQ gate to the probed subtree so a
+                # non-layer leaf whose leading dim coincides is never gated
+                self._compression.curvature_scope = ev_scope.replace(".", "/")
         base_specs = model.specs(param_shapes)
         self.param_specs = jax.tree_util.tree_map(
             lambda s, b: self.policy.param_spec(s.shape, b), param_shapes, base_specs)
@@ -270,10 +295,17 @@ class DeepSpeedEngine:
             state = jax.jit(init_fn)(self._rng)
         if self._onebit is not None:
             state["onebit"] = self._onebit.init_state()
+        if self._n_curvature:
+            # normalized per-layer Hessian eigenvalues; 0 = "not yet probed"
+            # (factor 1 in the MoQ gate), refreshed by _update_curvature
+            state["curvature"] = jax.device_put(
+                jnp.zeros((self._n_curvature,), jnp.float32),
+                NamedSharding(self.mesh, P()))
         return state
 
     # ------------------------------------------------------------------ compiled fns
-    def _loss_and_grads(self, params, batch, scale, rngs, step=None):
+    def _loss_and_grads(self, params, batch, scale, rngs, step=None,
+                        curvature=None):
         # prescale_gradients: shrink every cotangent by 1/predivide through the
         # whole backward (including the grad reduction) to keep low-precision
         # sums in range; the inverse below restores magnitudes (parity: the
@@ -286,7 +318,7 @@ class DeepSpeedEngine:
             if self._compression is not None and step is not None:
                 # inside the loss so the straight-through fake-quant gradient
                 # reaches the unquantized master weights
-                p = self._compression.transform(p, step)
+                p = self._compression.transform(p, step, curvature=curvature)
             out = self.model.apply(p, batch, rngs=rngs, train=True)
             loss, aux = out if isinstance(out, tuple) else (out, {})
             return loss.astype(jnp.float32) * eff_scale, (loss, aux)
@@ -312,7 +344,8 @@ class DeepSpeedEngine:
         scale = state["scaler"].scale if self.pc.loss_scaling else jnp.float32(1.0)
         rngs = {"dropout": rng}
         loss, aux, grads = self._loss_and_grads(
-            state["params"], batch, scale, rngs, step=state["step"])
+            state["params"], batch, scale, rngs, step=state["step"],
+            curvature=state.get("curvature"))
         # accumulate with 1/gas scaling (the reference scales loss by 1/gas at
         # engine.py:1945; scaling the grads is numerically identical)
         inv_gas = 1.0 / float(self.gas)
@@ -493,6 +526,8 @@ class DeepSpeedEngine:
             self.state, self._grad_acc, loss = self._micro_jit(
                 self.state, self._grad_acc, batch, self._next_rng())
         self._last_loss = loss
+        if self._eigenvalue is not None:  # probed at the next step() boundary
+            self._ev_last_batch = batch
         if self.wall_clock_breakdown():
             self.timers("forward").stop(sync_on=loss)
         return loss
@@ -532,6 +567,8 @@ class DeepSpeedEngine:
         # out of HBM during the inter-step window
         self._grad_acc = None
         self._finish_step(metrics)
+        if self._eigenvalue is not None and self._ev_last_batch is not None:
+            self._update_curvature(self._ev_last_batch, leading_gas=False)
         if self.wall_clock_breakdown():
             self.timers("step").stop(sync_on=self.state["step"])
 
@@ -558,8 +595,37 @@ class DeepSpeedEngine:
         self.micro_steps += self.gas
         self._last_loss = metrics["loss"]
         self._finish_step(metrics)
+        if self._eigenvalue is not None:
+            self._update_curvature(batch)
         self.tput_timer.stop(sync_on=metrics["loss"])
         return metrics
+
+    def _update_curvature(self, placed_batch, leading_gas: bool = True) -> None:
+        """Refresh the per-layer Hessian-eigenvalue vector at every
+        ``gas_boundary_resolution``-th boundary (parity: the reference computes
+        ``block_eigenvalue`` before ``_take_model_step``, engine.py:2160).
+        A model whose attention kernel blocks double-backward (``custom_vjp``
+        flash — same class as the reference's fused transformer kernel) logs a
+        warning and disables the probe, mirroring ``eigenvalue.py:104``."""
+        if self.global_steps % self._eigenvalue.gas_boundary_resolution != 0:
+            return
+        mb = (placed_batch if self.gas == 1 or not leading_gas else
+              jax.tree_util.tree_map(lambda x: x[0], placed_batch))
+        try:
+            ev = self._eigenvalue.compute(
+                self._ev_loss_fn, self.state["params"], batch=mb)
+        except (TypeError, NotImplementedError) as e:
+            # double-backward unsupported (e.g. custom_vjp attention kernels
+            # have no JVP rule); anything else — a real bug or OOM — propagates
+            log_dist(f"eigenvalue: model does not support second-order "
+                     f"differentiation ({e}); disabling probe")
+            self._eigenvalue = None
+            return
+        self.state["curvature"] = jax.device_put(
+            jnp.asarray(ev, jnp.float32), NamedSharding(self.mesh, P()))
+        if self._monitor is not None:
+            self._monitor.write_events([
+                ("Train/eigenvalue_mean", float(np.mean(ev)), self.global_steps)])
 
     def _finish_step(self, metrics: Dict[str, Any]) -> None:
         self.global_steps += 1
