@@ -191,10 +191,6 @@ class MPMDPipelineEngine:
         scheds = [TrainSchedule(M, S, s) for s in range(S)]
         streams = [list(sched.steps()) for sched in scheds]
         n_slots = len(streams[0])
-        micro_of_slot = [
-            [scheds[s]._step_to_micro_batch(t) for t in range(n_slots)]
-            for s in range(S)
-        ]
 
         def micro_batch(m):
             return jax.tree_util.tree_map(lambda leaf: leaf[m], batch)
@@ -222,21 +218,23 @@ class MPMDPipelineEngine:
 
         done = {"step": False}
         for t in range(n_slots):
-            # phase 1: sends (depend only on prior slots' compute)
+            # phase 1: sends (depend only on prior slots' compute); each Send
+            # carries its micro-batch id (set by the schedule), so no slot-
+            # parity inference is needed
             for s in range(S):
                 for cmd in streams[s][t]:
                     if isinstance(cmd, SendActivation):
-                        m = self._send_micro(micro_of_slot[s], t, forward=True)
+                        m = cmd.micro_batch
                         act_ch[(s + 1, m)] = jax.device_put(
                             outputs[s].pop(m), self.devices[s + 1])
                     elif isinstance(cmd, SendGrad):
-                        m = self._send_micro(micro_of_slot[s], t, forward=False)
+                        m = cmd.micro_batch
                         grad_ch[(s - 1, m)] = jax.device_put(
                             dx_out[s].pop(m), self.devices[s - 1])
             # phase 2: loads, recvs, compute
             for s in range(S):
-                m, is_fwd = micro_of_slot[s][t]
                 for cmd in streams[s][t]:
+                    m = getattr(cmd, "micro_batch", -1)
                     if isinstance(cmd, LoadMicroBatch):
                         mb = micro_batch(m)
                         x = mb["input_ids"] if isinstance(mb, dict) else mb
@@ -307,16 +305,6 @@ class MPMDPipelineEngine:
                     if updates["tied"] is not None else params["tied"])
         return {"stages": new_stages, "tied": new_tied}, opt_state
 
-    @staticmethod
-    def _send_micro(slot_micros, t: int, forward: bool) -> int:
-        """The micro-batch a Send instruction at slot ``t`` refers to: the
-        schedule emits a send exactly one slot after the matching compute
-        (``TrainSchedule.steps`` tracks ``prev_micro_batch_id``), and fwd/bwd
-        slots strictly alternate, so the previous slot is the matching one."""
-        m, is_fwd = slot_micros[t - 1]
-        assert is_fwd == forward and m >= 0, (t, m, is_fwd, forward)
-        return m
-
     # ------------------------------------------------------------ inference
     def forward_batch(self, params, batch) -> jnp.ndarray:
         """Forward-only pipelining driven by :class:`InferenceSchedule`; returns
@@ -325,6 +313,7 @@ class MPMDPipelineEngine:
         streams = [list(InferenceSchedule(M, S, s).steps()) for s in range(S)]
         act_ch: Dict[Tuple[int, int], Any] = {}
         inputs: List[Dict[int, Any]] = [{} for _ in range(S)]
+        outputs: List[Dict[int, Any]] = [{} for _ in range(S)]
         outs: Dict[int, Any] = {}
         stage_params, tied = params["stages"], params["tied"]
         tied_per_stage = [jax.device_put(tied, self.devices[s]) for s in range(S)]
@@ -335,8 +324,8 @@ class MPMDPipelineEngine:
         n_slots = len(streams[0])
         for t in range(n_slots):
             for s in reversed(range(S)):  # sends precede the recv one slot later
-                m = t - s
                 for cmd in streams[s][t]:
+                    m = cmd.micro_batch
                     if isinstance(cmd, LoadMicroBatch):
                         mb = micro_batch(m)
                         x = mb["input_ids"] if isinstance(mb, dict) else mb
@@ -349,8 +338,8 @@ class MPMDPipelineEngine:
                         if s == S - 1:
                             outs[m] = y
                         else:
-                            inputs[s][("out", m)] = y
+                            outputs[s][m] = y
                     elif isinstance(cmd, SendActivation):
-                        y = inputs[s].pop(("out", m))
-                        act_ch[(s + 1, m)] = jax.device_put(y, self.devices[s + 1])
+                        act_ch[(s + 1, m)] = jax.device_put(
+                            outputs[s].pop(m), self.devices[s + 1])
         return jnp.stack([outs[m] for m in range(M)])

@@ -145,12 +145,12 @@ class InferenceSchedule(PipeSchedule):
             if 0 <= micro_batch_id < self.micro_batches:
                 buf = self._buffer_idx(micro_batch_id)
                 if self.is_first_stage:
-                    cmds.append(LoadMicroBatch(buf))
+                    cmds.append(LoadMicroBatch(buf, micro_batch=micro_batch_id))
                 else:
-                    cmds.append(RecvActivation(buf))
-                cmds.append(ForwardPass(buf))
+                    cmds.append(RecvActivation(buf, micro_batch=micro_batch_id))
+                cmds.append(ForwardPass(buf, micro_batch=micro_batch_id))
                 if not self.is_last_stage:
-                    cmds.append(SendActivation(buf))
+                    cmds.append(SendActivation(buf, micro_batch=micro_batch_id))
             yield cmds
 
     def num_pipe_buffers(self) -> int:
@@ -176,24 +176,31 @@ class TrainSchedule(PipeSchedule):
             # communication with neighbors (recv for this step, send of prev result)
             if self._valid_micro_batch(prev_micro_batch_id):
                 prev_buf = self._buffer_idx(prev_micro_batch_id)
+                # sends carry the micro-batch of the *previous* slot's compute
+                # explicitly, so executors never infer it from slot parity (an
+                # interleaved schedule variant would break that inference)
                 if is_forward:
                     if not self.is_first_stage:
-                        cmds.append(SendGrad(prev_buf))
+                        cmds.append(SendGrad(prev_buf,
+                                             micro_batch=prev_micro_batch_id))
                 else:
                     if not self.is_last_stage:
-                        cmds.append(SendActivation(prev_buf))
+                        cmds.append(SendActivation(
+                            prev_buf, micro_batch=prev_micro_batch_id))
             if valid:
                 buf = self._buffer_idx(micro_batch_id)
                 if is_forward:
                     if self.is_first_stage:
-                        cmds.append(LoadMicroBatch(buf))
+                        cmds.append(LoadMicroBatch(buf,
+                                                   micro_batch=micro_batch_id))
                     else:
-                        cmds.append(RecvActivation(buf))
-                    cmds.append(ForwardPass(buf))
+                        cmds.append(RecvActivation(buf,
+                                                   micro_batch=micro_batch_id))
+                    cmds.append(ForwardPass(buf, micro_batch=micro_batch_id))
                 else:
                     if not self.is_last_stage:
-                        cmds.append(RecvGrad(buf))
-                    cmds.append(BackwardPass(buf))
+                        cmds.append(RecvGrad(buf, micro_batch=micro_batch_id))
+                    cmds.append(BackwardPass(buf, micro_batch=micro_batch_id))
 
             # final step: reduce + optimizer (parity :233-241)
             if step_id == total_steps - 1:
@@ -227,9 +234,9 @@ class DataParallelSchedule(PipeSchedule):
     def steps(self):
         for micro_batch_id in range(self.micro_batches):
             cmds: List[PipeInstruction] = [
-                LoadMicroBatch(0),
-                ForwardPass(0),
-                BackwardPass(0),
+                LoadMicroBatch(0, micro_batch=micro_batch_id),
+                ForwardPass(0, micro_batch=micro_batch_id),
+                BackwardPass(0, micro_batch=micro_batch_id),
             ]
             if micro_batch_id == self.micro_batches - 1:
                 cmds.extend([ReduceGrads(), OptimizerStep()])
