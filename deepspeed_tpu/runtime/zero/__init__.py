@@ -1,4 +1,13 @@
 from .config import DeepSpeedZeroConfig, ZeroStageEnum  # noqa: F401
+from .mem_estimator import (  # noqa: F401
+    compiled_memory_analysis,
+    estimate_zero2_model_states_mem_needs,
+    estimate_zero2_model_states_mem_needs_all_cold,
+    estimate_zero2_model_states_mem_needs_all_live,
+    estimate_zero3_model_states_mem_needs,
+    estimate_zero3_model_states_mem_needs_all_cold,
+    estimate_zero3_model_states_mem_needs_all_live,
+)
 from .partitioned_params import GatheredParameters, Init  # noqa: F401
 from .policy import ZeroShardingPolicy  # noqa: F401
 from .tiling import TiledLinear  # noqa: F401
