@@ -280,24 +280,29 @@ def load_mp_checkpoint(path: str, treedef_params: Any, specs: Any,
         pieces = []
         file_arrays: Dict[int, np.ndarray] = {}  # NpzFile re-reads per access
         for d in sharding.addressable_devices:
-            # the tp files are contiguous chunks of the split axis, so the
-            # file holding this device's slice is start // W — valid for ANY
-            # sharding of the leaf (tp composed with dp, extra sharded dims,
-            # sub-tp-shard slices), since sharded slice widths divide W
+            # the tp files are contiguous chunks of the split axis, so a
+            # device slice [start, stop) maps to files start//W .. (stop-1)//W.
+            # One file: slice it directly (tp composed with dp, extra sharded
+            # dims, sub-tp-shard slices — widths divide W). Several files
+            # (loading at a SMALLER tp than the export): assemble the slice by
+            # concatenating the spanned files' pieces — the merge direction of
+            # the reference's state-dict factory (state_dict_factory.py:474).
             idx = list(index_map[d])
             a = idx[axis]
             start = a.start or 0
             stop = a.stop if a.stop is not None else shape[axis]
-            r = start // W
-            if stop > (r + 1) * W:
-                raise ValueError(
-                    f"{key}: device slice [{start}, {stop}) spans tp-file "
-                    f"boundaries (file width {W}) — the mesh shards dim "
-                    f"{axis} incompatibly with the tp_size={tp_size} export")
-            idx[axis] = slice(start - r * W, stop - r * W)
-            if r not in file_arrays:
-                file_arrays[r] = np.asarray(files[r][key])
-            pieces.append(jax.device_put(file_arrays[r][tuple(idx)], d))
+            parts = []
+            for r in range(start // W, (stop - 1) // W + 1):
+                if r not in file_arrays:
+                    file_arrays[r] = np.asarray(files[r][key])
+                lo = max(start, r * W) - r * W
+                hi = min(stop, (r + 1) * W) - r * W
+                pidx = list(idx)
+                pidx[axis] = slice(lo, hi)
+                parts.append(file_arrays[r][tuple(pidx)])
+            piece = parts[0] if len(parts) == 1 else np.concatenate(
+                parts, axis=axis)
+            pieces.append(jax.device_put(piece, d))
         leaves.append(jax.make_array_from_single_device_arrays(
             shape, sharding, pieces))
     return jax.tree_util.tree_unflatten(treedef, leaves)

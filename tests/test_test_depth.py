@@ -62,7 +62,8 @@ def test_load_mp_checkpoint_multi_axis_sharding(tmp_path, devices):
 def test_load_mp_checkpoint_composed_order_and_downshard(tmp_path, devices):
     """(a) a ('dp','tp')-composed reload of a tp=4 export is data-correct (any
     aligned sub-slice lies inside one tp file); (b) reloading at a SMALLER tp
-    than exported needs device slices wider than a file — fail loudly."""
+    than exported merges spanned files per device slice (the merge direction
+    of the reference's state-dict factory, state_dict_factory.py:474)."""
     from deepspeed_tpu.module_inject.load_checkpoint import (
         load_mp_checkpoint,
         save_mp_checkpoint,
@@ -79,10 +80,20 @@ def test_load_mp_checkpoint_composed_order_and_downshard(tmp_path, devices):
     np.testing.assert_array_equal(np.asarray(loaded["w"]),
                                   np.asarray(params["w"]))
 
+    # downshard: tp=4 export onto a tp=2 mesh — each device slice spans two
+    # files and is assembled by concatenation
     topo2 = MeshTopology.create(dp=4, tp=2, devices=devices)
-    with pytest.raises(ValueError, match="spans tp-file"):
-        load_mp_checkpoint(str(tmp_path), shapes, {"w": P("tp", None)},
-                           mesh=topo2.mesh)
+    merged = load_mp_checkpoint(str(tmp_path), shapes, {"w": P("tp", None)},
+                                mesh=topo2.mesh)
+    np.testing.assert_array_equal(np.asarray(merged["w"]),
+                                  np.asarray(params["w"]))
+    assert tuple(merged["w"].sharding.spec) == ("tp", None)
+
+    # full merge: tp=1 view (replicated) of the tp=4 export
+    solo = load_mp_checkpoint(str(tmp_path), shapes, {"w": P(None, None)},
+                              mesh=topo2.mesh)
+    np.testing.assert_array_equal(np.asarray(solo["w"]),
+                                  np.asarray(params["w"]))
 
 
 # -------------------------------------------------------- convergence
