@@ -29,6 +29,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import multihead_attention
@@ -289,7 +290,7 @@ def _attention_delta(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray],
                                block_q=cfg.flash_block_q,
                                block_k=cfg.flash_block_k)
     attn = attn.reshape(B, T, D)
-    return attn @ w["attn_out_w"] + w["attn_out_b"]
+    return checkpoint_name(attn @ w["attn_out_w"] + w["attn_out_b"], "attn_out")
 
 
 def _mlp_delta(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray]) -> jnp.ndarray:
@@ -297,7 +298,7 @@ def _mlp_delta(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray]) -> jnp
     h = layer_norm(x, w["ln2_scale"], w["ln2_bias"], cfg.layer_norm_eps)
     h = h @ w["mlp_up_w"] + w["mlp_up_b"]
     h = _act(cfg, h)
-    return h @ w["mlp_down_w"] + w["mlp_down_b"]
+    return checkpoint_name(h @ w["mlp_down_w"] + w["mlp_down_b"], "mlp_out")
 
 
 def attention_sublayer(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray],
@@ -360,7 +361,14 @@ def forward(cfg: GPTConfig, params: Dict[str, Any], input_ids: jnp.ndarray,
         return _block(cfg, x, layer_w, pos, lrng, train, layer_idx=layer_idx)
 
     if cfg.remat:
-        policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
+        if cfg.remat_policy == "save_attn_mlp_out":
+            # selective: keep each sublayer's projected output (2*d_model per
+            # token per layer) so backward skips recomputing the output
+            # projections; everything else (flash internals, ln, gelu) remats
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "mlp_out")
+        else:
+            policy = getattr(jax.checkpoint_policies, cfg.remat_policy)
         block_fn = jax.checkpoint(block_fn, policy=policy)
 
     sd = cfg.stochastic_depth if train else 0.0

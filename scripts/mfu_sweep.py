@@ -76,9 +76,15 @@ def main():
          "policy": "dots_with_no_batch_dims_saveable", "tag": "save-dots"},
         {"model": "gpt2-350m", "micro_bs": 32, "seq": 1024, "remat": True,
          "policy": "nothing_saveable", "tag": "350m-bs32"},
+        {"model": "gpt2-350m", "micro_bs": 16, "seq": 1024, "remat": True,
+         "policy": "save_attn_mlp_out", "tag": "350m-save-sublayer"},
         # bigger model: fatter matmuls -> better MXU utilization
         {"model": "gpt2-760m", "micro_bs": 24, "seq": 1024, "remat": True,
          "policy": "nothing_saveable", "tag": "760m-bs24"},
+        {"model": "gpt2-760m", "micro_bs": 16, "seq": 1024, "remat": True,
+         "policy": "save_attn_mlp_out", "tag": "760m-save-sublayer"},
+        {"model": "gpt2-760m", "micro_bs": 16, "seq": 1024, "remat": True,
+         "policy": "dots_with_no_batch_dims_saveable", "tag": "760m-save-dots"},
         {"model": "gpt2-760m", "micro_bs": 16, "seq": 2048, "remat": True,
          "policy": "nothing_saveable", "tag": "760m-seq2048"},
         {"model": "gpt2-760m", "micro_bs": 8, "seq": 1024, "remat": True,

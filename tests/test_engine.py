@@ -181,3 +181,31 @@ def test_prescale_and_comm_dtype_numerics_match_default(rng):
     # matching (or wider) requests are naturally satisfied
     base2 = run({"communication_data_type": "fp32"})
     np.testing.assert_allclose(base2, base, rtol=1e-6)
+
+
+def test_remat_policies_loss_and_grad_parity():
+    """Every remat policy (incl. the named selective save_attn_mlp_out) is a
+    pure memory/recompute trade — loss and grads must match no-remat exactly."""
+    import dataclasses
+
+    from deepspeed_tpu.models.gpt import GPTConfig, init_params, loss_fn
+
+    cfg = GPTConfig(vocab_size=128, d_model=64, n_layer=2, n_head=4,
+                    max_seq_len=32)
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, 128, (2, 32), np.int32)}
+    outs = {}
+    for pol in (None, "nothing_saveable", "save_attn_mlp_out",
+                "dots_with_no_batch_dims_saveable"):
+        c = dataclasses.replace(cfg, remat=pol is not None,
+                                remat_policy=pol or "nothing_saveable")
+        params = init_params(c, jax.random.PRNGKey(0))
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(c, p, batch, train=False)[0])(params)
+        gsum = float(jax.tree_util.tree_reduce(
+            lambda a, b: a + jnp.abs(b).sum(), grads, jnp.float32(0.0)))
+        outs[pol] = (float(loss), gsum)
+    ref = outs[None]
+    for pol, v in outs.items():
+        np.testing.assert_allclose(v[0], ref[0], rtol=1e-6, err_msg=str(pol))
+        np.testing.assert_allclose(v[1], ref[1], rtol=1e-4, err_msg=str(pol))
