@@ -156,3 +156,17 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
 
 
 __all__ = ["save_checkpoint", "load_checkpoint", "save_pytree", "load_pytree"]
+
+
+def __getattr__(name):
+    # lazy: the importers pull in torch, which most sessions never need
+    if name in ("MegatronDSCheckpoint", "import_megatron_checkpoint"):
+        from . import megatron_import
+
+        return getattr(megatron_import, name)
+    if name in ("load_reference_checkpoint",
+                "get_fp32_state_dict_from_reference_checkpoint"):
+        from . import reference_import
+
+        return getattr(reference_import, name)
+    raise AttributeError(name)

@@ -1,0 +1,148 @@
+"""Megatron-DeepSpeed 3D checkpoint import (checkpoint/megatron_import.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from deepspeed_tpu.checkpoint.megatron_import import (
+    MegatronDSCheckpoint,
+    import_megatron_checkpoint,
+)
+from deepspeed_tpu.models.gpt import GPTConfig, init_params
+
+torch = pytest.importorskip("torch")
+
+H, DH = 4, 8
+D = H * DH
+
+
+def _to_megatron_qkv(qkv_w: np.ndarray, qkv_b: np.ndarray):
+    """Our [D, 3D] q|k|v columns -> Megatron [3D, D] per-head-interleaved rows."""
+    wt = qkv_w.T  # [3D, D]
+    q, k, v = np.split(wt, 3, axis=0)  # each [D, D]
+    w = np.stack([q.reshape(H, DH, D), k.reshape(H, DH, D),
+                  v.reshape(H, DH, D)], axis=1)  # [H, 3, DH, D]
+    bq, bk, bv = np.split(qkv_b, 3)
+    b = np.stack([bq.reshape(H, DH), bk.reshape(H, DH),
+                  bv.reshape(H, DH)], axis=1)  # [H, 3, DH]
+    return w.reshape(3 * D, D), b.reshape(3 * D)
+
+
+def _write_megatron_ckpt(path, cfg: GPTConfig, params, tp: int):
+    """Emit layer_XX-model_YY files the way Megatron-DeepSpeed's pipeline
+    module saves them (runtime/pipe/module.py:549 naming; column-parallel
+    split on rows, row-parallel on cols, replicated layernorms)."""
+    path.mkdir(parents=True, exist_ok=True)
+    b = {k: np.asarray(v) for k, v in params["blocks"].items()}
+
+    def save(idx, rank, sd):
+        torch.save({k: torch.from_numpy(np.ascontiguousarray(v))
+                    for k, v in sd.items()},
+                   str(path / f"layer_{idx:02d}-model_{rank:02d}-model_states.pt"))
+
+    for r in range(tp):
+        vs = np.asarray(params["wte"]).shape[0] // tp
+        save(0, r, {
+            "word_embeddings.weight":
+                np.asarray(params["wte"])[r * vs:(r + 1) * vs],
+            "position_embeddings.weight": np.asarray(params["wpe"]),
+        })
+    for li in range(cfg.n_layer):
+        w_meg, b_meg = _to_megatron_qkv(b["qkv_w"][li], b["qkv_b"][li])
+        rows = w_meg.shape[0] // tp  # = heads-per-rank * 3 * DH
+        up_rows = b["mlp_up_w"].shape[-1] // tp
+        dense_cols = D // tp
+        down_cols = b["mlp_down_w"].shape[1] // tp
+        for r in range(tp):
+            save(2 + li, r, {
+                "input_layernorm.weight": b["ln1_scale"][li],
+                "input_layernorm.bias": b["ln1_bias"][li],
+                "self_attention.query_key_value.weight":
+                    w_meg[r * rows:(r + 1) * rows],
+                "self_attention.query_key_value.bias":
+                    b_meg[r * rows:(r + 1) * rows],
+                "self_attention.dense.weight":
+                    b["attn_out_w"][li].T[:, r * dense_cols:(r + 1) * dense_cols],
+                "self_attention.dense.bias": b["attn_out_b"][li],
+                "post_attention_layernorm.weight": b["ln2_scale"][li],
+                "post_attention_layernorm.bias": b["ln2_bias"][li],
+                "mlp.dense_h_to_4h.weight":
+                    b["mlp_up_w"][li].T[r * up_rows:(r + 1) * up_rows],
+                "mlp.dense_h_to_4h.bias":
+                    b["mlp_up_b"][li][r * up_rows:(r + 1) * up_rows],
+                "mlp.dense_4h_to_h.weight":
+                    b["mlp_down_w"][li].T[:, r * down_cols:(r + 1) * down_cols],
+                "mlp.dense_4h_to_h.bias": b["mlp_down_b"][li],
+            })
+    for r in range(tp):
+        save(2 + cfg.n_layer + 1, r, {
+            "weight": np.asarray(params["lnf_scale"]),
+            "bias": np.asarray(params["lnf_bias"]),
+        })
+
+
+@pytest.fixture()
+def synthetic(tmp_path):
+    cfg = GPTConfig(vocab_size=64, n_layer=3, n_head=H, d_model=D,
+                    max_seq_len=32, rotary=False)
+    params = jax.tree_util.tree_map(
+        np.asarray, init_params(cfg, jax.random.PRNGKey(3)))
+    # non-degenerate norms/biases so replication handling is actually tested
+    r = np.random.default_rng(0)
+    for k in ("ln1_scale", "ln1_bias", "ln2_scale", "ln2_bias", "qkv_b",
+              "attn_out_b", "mlp_up_b", "mlp_down_b"):
+        params["blocks"][k] = r.normal(
+            size=params["blocks"][k].shape).astype(np.float32)
+    params["lnf_scale"] = r.normal(size=(D,)).astype(np.float32)
+    params["lnf_bias"] = r.normal(size=(D,)).astype(np.float32)
+    _write_megatron_ckpt(tmp_path, cfg, params, tp=2)
+    return tmp_path, cfg, params
+
+
+def test_discovery_and_tp_degree(synthetic):
+    path, cfg, _ = synthetic
+    ckpt = MegatronDSCheckpoint(str(path))
+    assert ckpt.tp_degree == 2
+    assert len(ckpt.layer_indices) == cfg.n_layer + 2  # embed + L + final norm
+
+
+def test_import_roundtrips_bitwise(synthetic):
+    path, cfg, params = synthetic
+    got_cfg, got = import_megatron_checkpoint(str(path), n_head=H)
+    assert got_cfg.n_layer == cfg.n_layer
+    assert got_cfg.d_model == cfg.d_model
+    assert got_cfg.vocab_size == cfg.vocab_size
+    assert not got_cfg.rotary  # wpe present => learned positions
+    flat_want, _ = jax.tree_util.tree_flatten_with_path(params)
+    flat_got = dict(jax.tree_util.tree_flatten_with_path(got)[0])
+    for kp, want in flat_want:
+        np.testing.assert_array_equal(
+            flat_got[kp], np.asarray(want),
+            err_msg=jax.tree_util.keystr(kp))
+
+
+def test_imported_model_runs(synthetic):
+    path, _, _ = synthetic
+    from deepspeed_tpu.models import build_gpt
+    from deepspeed_tpu.models.gpt import loss_fn
+
+    cfg, params = import_megatron_checkpoint(str(path), n_head=H)
+    build_gpt(cfg)  # config is valid
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (2, 16), np.int32)}
+    loss, _ = loss_fn(cfg, jax.tree_util.tree_map(np.asarray, params), batch,
+                      train=False)
+    assert np.isfinite(float(loss))
+
+
+def test_mismatched_shard_count_raises(synthetic):
+    path, _, _ = synthetic
+    (path / "layer_02-model_01-model_states.pt").unlink()
+    with pytest.raises(ValueError, match="tp shards"):
+        MegatronDSCheckpoint(str(path))
+
+
+def test_empty_dir_raises(tmp_path):
+    with pytest.raises(ValueError, match="not a Megatron-DeepSpeed"):
+        MegatronDSCheckpoint(str(tmp_path))
