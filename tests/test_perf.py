@@ -1,0 +1,75 @@
+"""Optimizer micro-benchmarks (parity: the reference's ``tests/perf/``
+adam throughput checks). Timing on shared CI boxes is noisy, so assertions
+are structural — the native path engaged, produced identical math, and
+sustained a sane floor — with measured rates printed for the record."""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.adam.cpu_adam import DeepSpeedCPUAdam
+from deepspeed_tpu.ops.optimizers import get_optimizer
+
+N = 1_000_000
+
+
+def _run_cpu_adam(opt, steps=5):
+    rng = np.random.default_rng(0)
+    p = rng.normal(size=N).astype(np.float32)
+    m = np.zeros(N, np.float32)
+    v = np.zeros(N, np.float32)
+    g = rng.normal(size=N).astype(np.float32)
+    opt.step(p, m, v, g, step_count=1)  # warmup + allocation
+    t0 = time.perf_counter()
+    for i in range(steps):
+        opt.step(p, m, v, g, step_count=i + 2)
+    dt = time.perf_counter() - t0
+    return p, m, v, steps * N / dt
+
+
+def test_cpu_adam_throughput_and_native_parity():
+    native = DeepSpeedCPUAdam(lr=1e-3)
+    rate_info = []
+    p_n, m_n, v_n, rate = _run_cpu_adam(native)
+    rate_info.append(f"cpu_adam[{'native' if native.is_native else 'numpy'}]: "
+                     f"{rate / 1e6:.0f}M params/s")
+    # floor: even the numpy fallback does >5M params/s on any host; a silent
+    # pathological path (per-element python loop) would fail this
+    assert rate > 5e6, rate_info
+    print("; ".join(rate_info))
+
+    if native.is_native:
+        # the SIMD path must match the numpy math bit-for-bit-ish
+        fallback = DeepSpeedCPUAdam(lr=1e-3)
+        fallback._lib = None  # force numpy fallback
+        p_f, m_f, v_f, _ = _run_cpu_adam(fallback)
+        # AVX FMA reorders the accumulation; agreement is to float32 rounding
+        np.testing.assert_allclose(p_n, p_f, rtol=1e-4, atol=1e-6)
+        np.testing.assert_allclose(v_n, v_f, rtol=1e-4, atol=1e-6)
+
+
+def test_fused_adam_single_program():
+    """The fused device Adam must execute the whole tree update as ONE jitted
+    call whose throughput beats a per-leaf python loop — the reference's
+    multi_tensor_apply motivation (csrc/adam/multi_tensor_adam.cu)."""
+    opt = get_optimizer("Adam", {"lr": 1e-3})
+    leaves = {f"w{i}": jnp.ones((64, 64), jnp.float32) for i in range(32)}
+    grads = {f"w{i}": jnp.full((64, 64), 0.1, jnp.float32) for i in range(32)}
+    state = opt.init(leaves)
+    step = jax.jit(lambda g, s, p: opt.update(g, s, p, jnp.float32(1e-3)))
+    new_p, new_s = step(grads, state, leaves)  # compile
+    jax.block_until_ready(new_p)
+    t0 = time.perf_counter()
+    for _ in range(20):
+        new_p, new_s = step(grads, new_s, new_p)
+    jax.block_until_ready(new_p)
+    fused_dt = time.perf_counter() - t0
+    n_params = 32 * 64 * 64
+    rate = 20 * n_params / fused_dt
+    print(f"fused_adam: {rate / 1e6:.0f}M params/s over 32 leaves")
+    assert np.isfinite(float(jax.tree_util.tree_leaves(new_p)[0][0, 0]))
+    assert rate > 1e6
