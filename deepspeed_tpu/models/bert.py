@@ -45,6 +45,9 @@ class BertConfig:
     dropout: float = 0.0
     layer_norm_eps: float = 1e-12
     use_flash: Optional[bool] = None
+    # a SparsityConfig routes attention through the blocksparse kernel
+    # (graft via ops.sparse_attention.sparse_attention_utils)
+    sparse_attention: Optional[Any] = None
 
     @property
     def ffn_dim(self) -> int:
@@ -124,9 +127,19 @@ def _block(cfg: BertConfig, x, w, pad_bias):
     q = q.reshape(B, T, H, Dh)
     k_ = k_.reshape(B, T, H, Dh)
     v = v.reshape(B, T, H, Dh)
-    attn = multihead_attention(q, k_, v, causal=False, bias=pad_bias,
-                               use_flash=False if pad_bias is not None
-                               else cfg.use_flash)
+    if getattr(cfg, "sparse_attention", None) is not None:
+        if pad_bias is not None:
+            raise ValueError(
+                "sparse_attention + attention_mask is unsupported (the "
+                "blocksparse kernel has no bias input); drop the mask or pad "
+                "with ops.sparse_attention.sparse_attention_utils helpers")
+        from ..ops.sparse_attention import sparse_attention as _sparse
+
+        attn = _sparse(q, k_, v, cfg.sparse_attention, causal=False)
+    else:
+        attn = multihead_attention(q, k_, v, causal=False, bias=pad_bias,
+                                   use_flash=False if pad_bias is not None
+                                   else cfg.use_flash)
     attn = attn.reshape(B, T, D) @ w["attn_out_w"] + w["attn_out_b"]
     x = layer_norm(x + attn, w["ln1_scale"], w["ln1_bias"], cfg.layer_norm_eps)
     h = jax.nn.gelu(x @ w["mlp_up_w"] + w["mlp_up_b"], approximate=False)

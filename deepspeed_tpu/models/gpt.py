@@ -75,6 +75,10 @@ class GPTConfig:
     attention_scale: Optional[float] = None  # None = 1/sqrt(head_dim); GPT-Neo = 1.0
     has_lm_head: bool = True  # False: pure encoder (CLIP text tower) — only
     # return_hidden=True is valid; the logits path raises instead of fabricating
+    # blocksparse attention: a SparsityConfig here routes every layer through
+    # the Pallas blocksparse kernel (graft via ops.sparse_attention.
+    # sparse_attention_utils; parity: sparse_attention_utils.py:225)
+    sparse_attention: Optional[Any] = None
 
     @property
     def ffn_dim(self) -> int:
@@ -284,11 +288,21 @@ def _attention_delta(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray],
     if is_local is not None:
         lb = _local_window_bias(cfg, positions, T, is_local)
         bias = lb if bias is None else bias + lb
-    attn = multihead_attention(q, k_, v, causal=True, bias=bias,
-                               use_flash=cfg.use_flash,
-                               softmax_scale=cfg.attention_scale,
-                               block_q=cfg.flash_block_q,
-                               block_k=cfg.flash_block_k)
+    if cfg.sparse_attention is not None:
+        if bias is not None:
+            raise ValueError(
+                "sparse_attention cannot compose with alibi/local-window "
+                "biases (the blocksparse kernel has no bias input)")
+        from ..ops.sparse_attention import sparse_attention as _sparse
+
+        attn = _sparse(q, k_, v, cfg.sparse_attention, causal=True,
+                       softmax_scale=cfg.attention_scale)
+    else:
+        attn = multihead_attention(q, k_, v, causal=True, bias=bias,
+                                   use_flash=cfg.use_flash,
+                                   softmax_scale=cfg.attention_scale,
+                                   block_q=cfg.flash_block_q,
+                                   block_k=cfg.flash_block_k)
     attn = attn.reshape(B, T, D)
     return checkpoint_name(attn @ w["attn_out_w"] + w["attn_out_b"], "attn_out")
 

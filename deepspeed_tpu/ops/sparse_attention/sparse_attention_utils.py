@@ -1,0 +1,96 @@
+"""Grafting utilities: retrofit blocksparse attention onto existing models.
+
+Capability parity with the reference's ``ops/sparse_attention/
+sparse_attention_utils.py:225`` (``replace_model_self_attention_with_sparse_
+self_attention`` for HF BERT, ``extend_position_embedding`` replicating the
+learned position table to longer sequences, ``pad_to_block_size``/unpad).
+
+TPU-native shape: models here are (config, params) pairs, so grafting is a
+config transform (``replace_self_attention_with_sparse``) plus a parameter
+transform (``extend_position_embedding``) — no module-tree surgery.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...utils.logging import log_dist
+from .sparsity_config import SparsityConfig
+
+_POSITION_KEYS = ("wpe",)  # learned-position tables across model families
+
+
+def replace_self_attention_with_sparse(cfg, sparsity_config: SparsityConfig):
+    """Return a config whose every attention layer runs the blocksparse
+    kernel. Works for any model config with a ``sparse_attention`` field
+    (GPTConfig, BertConfig). Parity: ``replace_model_self_attention_with_
+    sparse_self_attention`` (sparse_attention_utils.py:225).
+    """
+    if not hasattr(cfg, "sparse_attention"):
+        raise TypeError(
+            f"{type(cfg).__name__} has no sparse_attention field — model "
+            f"family not graftable")
+    if sparsity_config.num_heads != cfg.n_head:
+        raise ValueError(
+            f"sparsity config declares {sparsity_config.num_heads} heads, "
+            f"model has {cfg.n_head}")
+    new = dataclasses.replace(cfg, sparse_attention=sparsity_config)
+    log_dist(f"grafted {type(sparsity_config).__name__} onto "
+             f"{type(cfg).__name__} ({cfg.n_layer} layers)")
+    return new
+
+
+def extend_position_embedding(params: Dict[str, Any], new_max_seq: int,
+                              key: Optional[str] = None) -> Dict[str, Any]:
+    """Stretch a learned position table to ``new_max_seq`` rows by tiling the
+    original embeddings (the reference replicates the trained table rather
+    than re-initializing — ``extend_position_embedding``). Returns a new
+    params dict; pair with ``dataclasses.replace(cfg, max_seq_len=...)``.
+    """
+    if key is None:
+        key = next((k for k in _POSITION_KEYS if k in params), None)
+        if key is None:
+            raise ValueError(
+                f"no learned position table among {_POSITION_KEYS} — rotary/"
+                f"ALiBi models extend for free (no table to stretch)")
+    table = np.asarray(params[key])
+    old = table.shape[0]
+    if new_max_seq <= old:
+        raise ValueError(f"new_max_seq {new_max_seq} <= current {old}")
+    reps = -(-new_max_seq // old)  # ceil
+    out = dict(params)
+    out[key] = jnp.asarray(np.tile(table, (reps, 1))[:new_max_seq])
+    log_dist(f"extended position embedding {old} -> {new_max_seq} "
+             f"(tiled x{reps})")
+    return out
+
+
+def pad_to_block_size(input_ids: jnp.ndarray, block: int,
+                      pad_token_id: int = 0,
+                      attention_mask: Optional[jnp.ndarray] = None
+                      ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray], int]:
+    """Right-pad ``[B, T]`` token ids (and mask) so T is a block multiple —
+    the kernel's layout granularity. Returns (ids, mask, pad_len). Parity:
+    ``sparse_attention_utils.py`` pad_to_block_size."""
+    T = input_ids.shape[-1]
+    pad = (-T) % block
+    if pad == 0:
+        return input_ids, attention_mask, 0
+    widths = [(0, 0)] * (input_ids.ndim - 1) + [(0, pad)]
+    ids = jnp.pad(input_ids, widths, constant_values=pad_token_id)
+    mask = None
+    if attention_mask is not None:
+        mask = jnp.pad(attention_mask, widths, constant_values=0)
+    return ids, mask, pad
+
+
+def unpad_sequence_output(output: jnp.ndarray, pad_len: int) -> jnp.ndarray:
+    """Drop the rows ``pad_to_block_size`` appended ([B, T+pad, ...] -> [B, T, ...])."""
+    if pad_len == 0:
+        return output
+    return output[:, :-pad_len]

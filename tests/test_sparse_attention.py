@@ -166,3 +166,114 @@ def test_sparse_self_attention_module(rng):
     # head-count mismatch guard
     with pytest.raises(ValueError, match="heads"):
         module(q[:, :, :1], k[:, :, :1], v[:, :, :1])
+
+
+# ------------------------------------------------------- grafting utilities
+def test_graft_sparse_attention_dense_config_matches_dense():
+    """DenseSparsityConfig layout is all-ones, so the grafted model must
+    reproduce the ungrafted forward exactly (kernel-equivalence check)."""
+    import dataclasses
+
+    from deepspeed_tpu.models.gpt import GPTConfig, init_params, loss_fn
+    from deepspeed_tpu.ops.sparse_attention import (
+        DenseSparsityConfig,
+        replace_self_attention_with_sparse,
+    )
+
+    cfg = GPTConfig(vocab_size=64, n_layer=2, n_head=4, d_model=32,
+                    max_seq_len=64, use_flash=False)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, 64, (2, 64), np.int32)}
+    dense_loss, _ = loss_fn(cfg, params, batch, train=False)
+    sc = DenseSparsityConfig(num_heads=4, block=16)
+    sparse_cfg = replace_self_attention_with_sparse(cfg, sc)
+    sparse_loss, _ = loss_fn(sparse_cfg, params, batch, train=False)
+    np.testing.assert_allclose(float(sparse_loss), float(dense_loss),
+                               rtol=2e-5)
+
+
+def test_graft_bigbird_runs_and_differs():
+    from deepspeed_tpu.models.gpt import GPTConfig, init_params, loss_fn
+    from deepspeed_tpu.ops.sparse_attention import (
+        BigBirdSparsityConfig,
+        replace_self_attention_with_sparse,
+    )
+
+    cfg = GPTConfig(vocab_size=64, n_layer=2, n_head=4, d_model=32,
+                    max_seq_len=128, use_flash=False)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    batch = {"input_ids": np.random.default_rng(1).integers(
+        0, 64, (2, 128), np.int32)}
+    dense_loss, _ = loss_fn(cfg, params, batch, train=False)
+    sc = BigBirdSparsityConfig(num_heads=4, block=16, num_random_blocks=1,
+                               num_sliding_window_blocks=2,
+                               num_global_blocks=1)
+    sparse_cfg = replace_self_attention_with_sparse(cfg, sc)
+    sparse_loss, _ = loss_fn(sparse_cfg, params, batch, train=False)
+    assert np.isfinite(float(sparse_loss))
+    assert abs(float(sparse_loss) - float(dense_loss)) > 1e-6
+
+
+def test_graft_head_mismatch_raises():
+    from deepspeed_tpu.models.gpt import GPTConfig
+    from deepspeed_tpu.ops.sparse_attention import (
+        FixedSparsityConfig,
+        replace_self_attention_with_sparse,
+    )
+
+    with pytest.raises(ValueError, match="heads"):
+        replace_self_attention_with_sparse(
+            GPTConfig(n_head=4), FixedSparsityConfig(num_heads=8))
+
+
+def test_extend_position_embedding_tiles_table():
+    from deepspeed_tpu.ops.sparse_attention import extend_position_embedding
+
+    table = np.arange(12, dtype=np.float32).reshape(6, 2)
+    out = extend_position_embedding({"wpe": table}, 15)
+    got = np.asarray(out["wpe"])
+    assert got.shape == (15, 2)
+    np.testing.assert_array_equal(got[:6], table)
+    np.testing.assert_array_equal(got[6:12], table)
+    np.testing.assert_array_equal(got[12:], table[:3])
+    with pytest.raises(ValueError, match="<= current"):
+        extend_position_embedding({"wpe": table}, 4)
+    with pytest.raises(ValueError, match="no learned position"):
+        extend_position_embedding({"other": table}, 32)
+
+
+def test_extended_model_runs_longer_sequences():
+    import dataclasses
+
+    from deepspeed_tpu.models.gpt import GPTConfig, init_params, loss_fn
+    from deepspeed_tpu.ops.sparse_attention import extend_position_embedding
+
+    cfg = GPTConfig(vocab_size=64, n_layer=1, n_head=2, d_model=16,
+                    max_seq_len=32, use_flash=False)
+    params = extend_position_embedding(
+        init_params(cfg, jax.random.PRNGKey(0)), 64)
+    long_cfg = dataclasses.replace(cfg, max_seq_len=64)
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, 64, (1, 64), np.int32)}
+    loss, _ = loss_fn(long_cfg, params, batch, train=False)
+    assert np.isfinite(float(loss))
+
+
+def test_pad_unpad_roundtrip():
+    from deepspeed_tpu.ops.sparse_attention import (
+        pad_to_block_size,
+        unpad_sequence_output,
+    )
+
+    ids = jnp.ones((2, 30), jnp.int32)
+    mask = jnp.ones((2, 30), jnp.int32)
+    pids, pmask, pad = pad_to_block_size(ids, 16, pad_token_id=9,
+                                         attention_mask=mask)
+    assert pids.shape == (2, 32) and pad == 2
+    assert int(pids[0, -1]) == 9 and int(pmask[0, -1]) == 0
+    out = unpad_sequence_output(jnp.zeros((2, 32, 4)), pad)
+    assert out.shape == (2, 30, 4)
+    # already aligned: no-op
+    pids2, _, pad2 = pad_to_block_size(jnp.ones((2, 32), jnp.int32), 16)
+    assert pad2 == 0 and pids2.shape == (2, 32)
