@@ -114,7 +114,7 @@ def _worker(cfg: dict) -> None:
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
     fn = {"train": _worker_train, "inference": _worker_infer,
-          "kernels": _worker_kernels}[cfg["kind"]]
+          "kernels": _worker_kernels, "diffusion": _worker_diffusion}[cfg["kind"]]
     print(json.dumps(fn(cfg)))
 
 
@@ -310,6 +310,49 @@ def _worker_infer(cfg: dict) -> dict:
     }
 
 
+def _worker_diffusion(cfg: dict) -> dict:
+    """Stable-Diffusion-family latent inference (BASELINE.json config #5):
+    full DDIM scan + CFG + VAE decode as one compiled program; reports
+    per-image latency."""
+    import dataclasses as _dc
+
+    import numpy as np
+
+    import jax
+
+    from deepspeed_tpu.models.diffusion import (
+        StableDiffusionPipeline, UNetConfig, VAEDecoderConfig)
+
+    platform = jax.devices()[0].platform
+    pipe = StableDiffusionPipeline.init_random(
+        jax.random.PRNGKey(0),
+        unet_cfg=UNetConfig(base_channels=cfg.get("base_channels", 128),
+                            channel_mults=(1, 2, 4),
+                            text_dim=cfg.get("text_dim", 256), n_head=8),
+        vae_cfg=VAEDecoderConfig(base_channels=64, upsamples=3),
+        latent_size=cfg.get("latent", 32))
+    rng = np.random.default_rng(0)
+    B, S = cfg.get("batch", 1), 77
+    text = np.asarray(rng.normal(size=(B, S, pipe.unet_cfg.text_dim)),
+                      np.float32)
+    uncond = np.asarray(rng.normal(size=(B, S, pipe.unet_cfg.text_dim)),
+                        np.float32)
+    steps = cfg.get("ddim_steps", 20)
+    img = pipe(text, uncond, num_steps=steps)  # warmup/compile
+    lat = []
+    for i in range(cfg.get("reps", 3)):
+        t0 = time.perf_counter()
+        img = pipe(text, uncond, num_steps=steps, seed=i)
+        lat.append((time.perf_counter() - t0) / B * 1e3)
+    lat.sort()
+    return {
+        "config": cfg["name"], "kind": "diffusion", "platform": platform,
+        "image_ms_p50": round(lat[len(lat) // 2], 1),
+        "ddim_steps": steps, "batch": B,
+        "image_px": int(img.shape[1]),
+    }
+
+
 # ---------------------------------------------------------------- parent side
 
 def main() -> None:
@@ -336,8 +379,12 @@ def main() -> None:
             {"kind": "train", "name": f"{big}-zero{s}", "model": big,
              "micro_bs": big_bs, "seq": seq, "stage": s, "steps": steps}
             for s in (1, 3)
-        ] + [{"kind": "inference", "name": f"{model}-decode", "model": model,
-              "batch": 1, "prompt": 128, "gen": 64}]
+        ] + [
+            {"kind": "inference", "name": f"{model}-decode", "model": model,
+             "batch": 1, "prompt": 128, "gen": 64},
+            {"kind": "diffusion", "name": "sd-ddim20", "latent": 32,
+             "ddim_steps": 20},
+        ]
     else:
         # forced-CPU fallback: tiny shapes, still real measurements
         configs = [
@@ -377,6 +424,10 @@ def main() -> None:
         })
     if infer_ok:
         result["decode_p50_ms"] = infer_ok[0]["decode_p50_ms"]
+    diff_ok = [r for r in sweep if r.get("kind") == "diffusion"
+               and "error" not in r]
+    if diff_ok:
+        result["sd_image_ms_p50"] = diff_ok[0]["image_ms_p50"]
     print(json.dumps(result))
 
 
