@@ -26,7 +26,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..ops.quantizer import fake_quant, quantize
+from ..ops.quantizer import annealed_bits, fake_quant, fake_quant_dynamic, quantize
 from ..utils.logging import log_dist
 from .config import get_compression_config
 
@@ -72,13 +72,17 @@ class CompressionScheduler:
                 continue
             entry: Dict[str, Any] = {}
             if wq["shared"]["enabled"]:
-                bits, groups = self._group_lookup(
-                    key, wq["groups"],
-                    ("start_bits", 8), ("quantize_groups",
-                                        wq["shared"]["quantize_groups"]))
-                entry["quant_bits"] = int(bits)
-                entry["quant_groups"] = int(groups)
+                gp = self._group_params(key, wq["groups"])
+                entry["quant_bits"] = int(gp.get("start_bits", 8))
+                entry["quant_groups"] = int(gp.get(
+                    "quantize_groups", wq["shared"]["quantize_groups"]))
                 entry["quant_offset"] = int(wq["shared"]["schedule_offset"])
+                # progressive MoQ: bits anneal start->target over doubling
+                # periods (parity: runtime/quantize.py compute_quantization)
+                entry["quant_target_bits"] = int(gp.get(
+                    "target_bits", entry["quant_bits"]))
+                entry["quant_period"] = int(gp.get(
+                    "quantization_period", 1000))
             if sp["shared"]["enabled"]:
                 ratio, _ = self._group_lookup(
                     key, sp["groups"], ("dense_ratio", 0.5), ("unused", 0))
@@ -106,6 +110,14 @@ class CompressionScheduler:
                 return p.get(first[0], first[1]), p.get(second[0], second[1])
         return first[1], second[1]
 
+    @staticmethod
+    def _group_params(key: str, groups: Dict[str, Any]) -> Dict[str, Any]:
+        """The full params dict of the first matching different_groups entry."""
+        for _, g in (groups or {}).items():
+            if _matches(key, g.get("modules", ["*"])):
+                return g.get("params", {})
+        return {}
+
     @property
     def enabled(self) -> bool:
         return bool(self.plan)
@@ -130,13 +142,27 @@ class CompressionScheduler:
             x = leaf
             if entry is not None:
                 if "quant_bits" in entry:
-                    xq = fake_quant(x, entry["quant_bits"], entry["quant_groups"])
                     offset = entry["quant_offset"]
+                    start_b = entry["quant_bits"]
+                    target_b = entry.get("quant_target_bits", start_b)
                     key = _path_str(path)
                     in_scope = key.startswith(self.curvature_scope + "/")
-                    if (curvature is not None and in_scope and x.ndim >= 1
-                            and x.shape[0] == curvature.shape[0]):
-                        factor = 1.0 + jnp.floor(curvature * 4.0)
+                    per_layer = (curvature is not None and in_scope
+                                 and x.ndim >= 1
+                                 and x.shape[0] == curvature.shape[0])
+                    factor = (1.0 + jnp.floor(curvature * 4.0) if per_layer
+                              else jnp.float32(1.0))
+                    if target_b < start_b:
+                        # progressive anneal; the eigenvalue factor stretches
+                        # both the onset and the drop periods per layer
+                        bits_now = annealed_bits(
+                            step - (offset * factor).astype(jnp.float32),
+                            start_b, target_b, entry["quant_period"], factor)
+                        xq = fake_quant_dynamic(x, bits_now,
+                                                entry["quant_groups"])
+                    else:
+                        xq = fake_quant(x, start_b, entry["quant_groups"])
+                    if per_layer:
                         gate = step >= (offset * factor).astype(step.dtype)
                         x = jnp.where(
                             gate.reshape((-1,) + (1,) * (x.ndim - 1)), xq, x)

@@ -50,6 +50,64 @@ def dequantize(q: jnp.ndarray, scales: jnp.ndarray, dtype=jnp.float32) -> jnp.nd
     return (g * scales[:, None]).reshape(q.shape).astype(dtype)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def fake_quant_dynamic(x: jnp.ndarray, bits: jnp.ndarray,
+                       num_groups: int = 1) -> jnp.ndarray:
+    """Fake-quant with a TRACED bit count (scalar, or ``[L]`` matching ``x``'s
+    leading dim for per-layer schedules). Powers MoQ's progressive bit
+    annealing (parity: ``runtime/quantize.py:76`` ``quantize_highbit`` with
+    the step-scheduled ``start_bits`` countdown): because the bit width is
+    ordinary arithmetic on the scale/clip bounds, the entire anneal runs
+    inside ONE compiled program — no recompile per precision change.
+    Straight-through gradient to ``x``."""
+    xf = x.astype(jnp.float32)
+    per_layer = getattr(bits, "ndim", 0) == 1
+    if per_layer:
+        L = x.shape[0]
+        g = xf.reshape(L, num_groups, -1)
+        b = bits.reshape(L, 1, 1).astype(jnp.float32)
+    else:
+        g = _group(xf, num_groups)
+        b = jnp.asarray(bits, jnp.float32)
+    qmax = 2.0 ** (b - 1.0) - 1.0
+    absmax = jnp.max(jnp.abs(g), axis=-1, keepdims=True)
+    scales = jnp.where(absmax > 0, absmax / qmax, 1.0)
+    q = jnp.clip(jnp.round(g / scales), -qmax - 1.0, qmax)
+    return (q * scales).reshape(x.shape).astype(x.dtype)
+
+
+def _fqd_fwd(x, bits, num_groups):
+    return fake_quant_dynamic(x, bits, num_groups), None
+
+
+def _fqd_bwd(num_groups, _, g):
+    return g, None  # straight-through to x; bits get no gradient
+
+
+fake_quant_dynamic.defvjp(_fqd_fwd, _fqd_bwd)
+
+
+def annealed_bits(step, start_bits: int, target_bits: int, period: int,
+                  factor=1.0):
+    """Scheduled bit width at ``step`` (steps since quantization onset).
+
+    Drop k (1-based) fires at ``period * (2*factor)**(k-1)`` — each drop
+    doubles the period, stretched by the eigenvalue ``factor`` (parity:
+    ``runtime/quantize.py:138-143``: ``q_period <<= 1; q_period *= factor;
+    start_bits -= 1``). ``step`` and ``factor`` may be traced (factor ``[L]``
+    for per-layer schedules); the result broadcasts accordingly."""
+    if target_bits >= start_bits:
+        return jnp.asarray(float(start_bits))
+    t = jnp.asarray(step, jnp.float32)
+    f = jnp.asarray(factor, jnp.float32)
+    safe_t = jnp.maximum(t, 1.0)
+    drops = jnp.where(
+        t >= period,
+        1.0 + jnp.floor(jnp.log(safe_t / period) / jnp.log(2.0 * f)),
+        0.0)
+    return jnp.maximum(float(target_bits), start_bits - drops)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
 def fake_quant(x: jnp.ndarray, bits: int = 8, num_groups: int = 1) -> jnp.ndarray:
     """Quantize-dequantize with a straight-through gradient (QAT).

@@ -150,3 +150,75 @@ def test_quantize_params_for_inference(rng):
     deq = meta["dequantize"](dtype=jnp.float32)
     np.testing.assert_allclose(np.asarray(deq["blocks"]["qkv_w"]),
                                np.asarray(tree["blocks"]["qkv_w"]), atol=0.05)
+
+
+# ------------------------------------------------------- progressive MoQ anneal
+def test_annealed_bits_drop_points():
+    from deepspeed_tpu.ops.quantizer import annealed_bits
+
+    # period 4, factor 1: drops at t=4, 8, 16 (doubling), clamped at target
+    for t, want in ((0, 8), (3, 8), (4, 7), (7, 7), (8, 6), (15, 6), (16, 5),
+                    (1000, 5)):
+        got = float(annealed_bits(t, 8, 5, 4, 1.0))
+        assert got == want, (t, got, want)
+    # factor 5 (max curvature): first drop still at period, then 10x spacing
+    assert float(annealed_bits(4, 8, 5, 4, 5.0)) == 7
+    assert float(annealed_bits(39, 8, 5, 4, 5.0)) == 7
+    assert float(annealed_bits(40, 8, 5, 4, 5.0)) == 6
+    # per-layer vector factor broadcasts
+    out = np.asarray(annealed_bits(8, 8, 5, 4, jnp.asarray([1.0, 5.0])))
+    np.testing.assert_array_equal(out, [6.0, 7.0])
+    # no-anneal config is exact
+    assert float(annealed_bits(10_000, 8, 8, 4, 1.0)) == 8
+
+
+def test_fake_quant_dynamic_matches_static_and_coarsens(rng):
+    from deepspeed_tpu.ops.quantizer import fake_quant, fake_quant_dynamic
+
+    x = jnp.asarray(rng.normal(size=(4, 64)), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(fake_quant_dynamic(x, jnp.float32(8.0), 4)),
+        np.asarray(fake_quant(x, 8, 4)), rtol=1e-6)
+    err8 = float(jnp.abs(fake_quant_dynamic(x, jnp.float32(8.0), 4) - x).mean())
+    err4 = float(jnp.abs(fake_quant_dynamic(x, jnp.float32(4.0), 4) - x).mean())
+    assert err4 > err8 > 0
+    # per-layer bits: layer 0 at 8 bits must be finer than layer 1 at 3 bits
+    out = fake_quant_dynamic(x.reshape(2, 2, 64),
+                             jnp.asarray([8.0, 3.0]), 2)
+    e0 = float(jnp.abs(out[0] - x.reshape(2, 2, 64)[0]).mean())
+    e1 = float(jnp.abs(out[1] - x.reshape(2, 2, 64)[1]).mean())
+    assert e1 > e0
+    # straight-through gradient
+    g = jax.grad(lambda t: fake_quant_dynamic(t, jnp.float32(6.0), 4).sum())(x)
+    np.testing.assert_array_equal(np.asarray(g), np.ones_like(np.asarray(g)))
+
+
+def test_engine_progressive_anneal_trains():
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_gpt
+    from deepspeed_tpu.models.gpt import GPTConfig
+
+    model, _ = build_gpt(GPTConfig(
+        vocab_size=64, d_model=32, n_layer=1, n_head=2, max_seq_len=16))
+    engine, _, _, _ = ds.initialize(
+        model=model,
+        config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "compression_training": {
+                "weight_quantization": {
+                    "shared_parameters": {"enabled": True, "schedule_offset": 1},
+                    "different_groups": {
+                        "g0": {"params": {"start_bits": 12, "target_bits": 8,
+                                          "quantization_period": 2,
+                                          "quantize_groups": 1}}},
+                }},
+            "steps_per_print": 0,
+        })
+    sched = engine._compression
+    entry = next(iter(sched.plan.values()))
+    assert entry["quant_target_bits"] == 8 and entry["quant_period"] == 2
+    r = np.random.default_rng(0)
+    b = {"input_ids": r.integers(0, 64, size=(8, 16), dtype=np.int32)}
+    losses = [float(engine.train_batch(b)["loss"]) for _ in range(8)]
+    assert all(np.isfinite(l) for l in losses)
