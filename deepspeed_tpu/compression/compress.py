@@ -89,8 +89,43 @@ class CompressionScheduler:
                 entry["prune_ratio"] = float(ratio)
                 entry["prune_offset"] = int(sp["shared"]["schedule_offset"])
                 entry["prune_method"] = sp["shared"]["method"]
+            # structured pruning families (parity: compression/basic_layer.py
+            # LinearLayer_Compress row/head pruning, Conv2dLayer channel)
+            rp = self.cfg["row_pruning"]
+            if rp["shared"]["enabled"] and leaf.ndim >= 2:
+                ratio, _ = self._group_lookup(
+                    key, rp["groups"], ("dense_ratio", 0.5), ("unused", 0))
+                entry["row_ratio"] = float(ratio)
+                entry["row_offset"] = int(rp["shared"]["schedule_offset"])
+            hp = self.cfg["head_pruning"]
+            if hp["shared"]["enabled"] and "attn_out" in key.lower():
+                gp = self._group_params(key, hp["groups"])
+                heads = gp.get("num_heads", hp["shared"].get("num_heads"))
+                if heads is None:
+                    raise ValueError(
+                        "head_pruning requires num_heads (shared_parameters "
+                        "or the matching group's params)")
+                ratio, _ = self._group_lookup(
+                    key, hp["groups"], ("dense_ratio", 0.5), ("unused", 0))
+                entry["head_ratio"] = float(ratio)
+                entry["head_offset"] = int(hp["shared"]["schedule_offset"])
+                entry["num_heads"] = int(heads)
+            cp = self.cfg["channel_pruning"]
+            if cp["shared"]["enabled"] and leaf.ndim >= 4:
+                ratio, _ = self._group_lookup(
+                    key, cp["groups"], ("dense_ratio", 0.5), ("unused", 0))
+                entry["chan_ratio"] = float(ratio)
+                entry["chan_offset"] = int(cp["shared"]["schedule_offset"])
             if entry:
                 self.plan[key] = entry
+        if self.cfg["activation_quantization"]["shared"]["enabled"]:
+            # activations are produced inside the model, out of reach of a
+            # parameter transform; refusing loudly beats training
+            # full-precision under a config that claims otherwise
+            raise NotImplementedError(
+                "activation_quantization is not supported: this framework "
+                "applies compression as a parameter-tree transform inside the "
+                "loss; quantizing activations requires model support")
         if self.plan:
             log_dist(f"compression: {len(self.plan)} tensors under "
                      f"{'QAT ' if wq['shared']['enabled'] else ''}"
@@ -175,6 +210,22 @@ class CompressionScheduler:
                         step >= entry["prune_offset"],
                         lambda t: _prune_l1(t, entry["prune_ratio"]),
                         lambda t: t, x)
+                if "row_ratio" in entry:
+                    x = jax.lax.cond(
+                        step >= entry["row_offset"],
+                        lambda t: _prune_rows(t, entry["row_ratio"]),
+                        lambda t: t, x)
+                if "head_ratio" in entry:
+                    x = jax.lax.cond(
+                        step >= entry["head_offset"],
+                        lambda t: _prune_heads(t, entry["head_ratio"],
+                                               entry["num_heads"]),
+                        lambda t: t, x)
+                if "chan_ratio" in entry:
+                    x = jax.lax.cond(
+                        step >= entry["chan_offset"],
+                        lambda t: _prune_rows(t, entry["chan_ratio"]),
+                        lambda t: t, x)
             out.append(x)
         return jax.tree_util.tree_unflatten(treedef, out)
 
@@ -186,6 +237,37 @@ def _prune_l1(x: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
     flat = jnp.abs(x.ravel())
     threshold = jnp.sort(flat)[x.size - k]
     return jnp.where(jnp.abs(x) >= threshold, x, 0.0).astype(x.dtype)
+
+
+def _prune_rows(x: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    """Structured pruning: zero whole output units (last dim) below the
+    top-``dense_ratio`` by L2 norm. Parity: ``LinearLayer_Compress`` row
+    pruning (and Conv2d channel pruning, whose kernels are ``[..., cout]``)."""
+    norms = jnp.sqrt(jnp.sum(x.astype(jnp.float32) ** 2,
+                             axis=tuple(range(x.ndim - 1))))
+    n = norms.shape[0]
+    k = max(1, int(round(n * dense_ratio)))
+    thr = jnp.sort(norms)[n - k]
+    return jnp.where(norms >= thr, x, 0.0).astype(x.dtype)
+
+
+def _prune_heads(x: jnp.ndarray, dense_ratio: float,
+                 num_heads: int) -> jnp.ndarray:
+    """Structured pruning of whole attention heads: the output projection's
+    input dim (-2) groups into ``[num_heads, head_dim]``; the lowest-norm
+    heads are zeroed per layer. Parity: ``LinearLayer_Compress`` head
+    pruning on the attention output matrix."""
+    d_in = x.shape[-2]
+    if d_in % num_heads:
+        raise ValueError(f"head_pruning: dim {d_in} not divisible by "
+                         f"{num_heads} heads")
+    dh = d_in // num_heads
+    xh = x.reshape(x.shape[:-2] + (num_heads, dh, x.shape[-1]))
+    norms = jnp.sqrt(jnp.sum(xh.astype(jnp.float32) ** 2, axis=(-2, -1)))
+    k = max(1, int(round(num_heads * dense_ratio)))
+    thr = jnp.sort(norms, axis=-1)[..., num_heads - k]
+    mask = norms >= thr[..., None]
+    return (xh * mask[..., None, None]).reshape(x.shape).astype(x.dtype)
 
 
 def init_compression(param_tree, ds_config) -> CompressionScheduler:

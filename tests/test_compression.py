@@ -222,3 +222,69 @@ def test_engine_progressive_anneal_trains():
     b = {"input_ids": r.integers(0, 64, size=(8, 16), dtype=np.int32)}
     losses = [float(engine.train_batch(b)["loss"]) for _ in range(8)]
     assert all(np.isfinite(l) for l in losses)
+
+
+# ------------------------------------------------------- structured pruning
+def test_row_pruning_zeroes_whole_output_units(rng):
+    tree = {"blocks": {"mlp_up_w": jnp.asarray(rng.normal(size=(2, 8, 16)),
+                                               jnp.float32)}}
+    sched = CompressionScheduler({
+        "row_pruning": {"shared_parameters": {"enabled": True,
+                                              "schedule_offset": 3},
+                        "different_groups": {
+                            "r0": {"params": {"dense_ratio": 0.5}}}}}, tree)
+    before = np.asarray(sched.transform(tree, jnp.int32(0))
+                        ["blocks"]["mlp_up_w"])
+    np.testing.assert_array_equal(before,
+                                  np.asarray(tree["blocks"]["mlp_up_w"]))
+    after = np.asarray(sched.transform(tree, jnp.int32(5))
+                       ["blocks"]["mlp_up_w"])
+    col_zero = np.all(after == 0, axis=(0, 1))
+    assert col_zero.sum() == 8  # half of 16 output units zeroed, whole column
+    assert np.all(np.any(after[:, :, ~col_zero] != 0, axis=(0, 1)))
+
+
+def test_head_pruning_zeroes_whole_heads(rng):
+    H, Dh, D = 4, 4, 16
+    tree = {"blocks": {"attn_out_w": jnp.asarray(
+        rng.normal(size=(2, H * Dh, D)), jnp.float32)}}
+    sched = CompressionScheduler({
+        "head_pruning": {"shared_parameters": {"enabled": True,
+                                               "schedule_offset": 0,
+                                               "num_heads": H},
+                         "different_groups": {
+                             "h0": {"params": {"dense_ratio": 0.5}}}}}, tree)
+    out = np.asarray(sched.transform(tree, jnp.int32(1))
+                     ["blocks"]["attn_out_w"])
+    per_head = out.reshape(2, H, Dh, D)
+    zero_heads = np.all(per_head == 0, axis=(2, 3))  # [L, H]
+    assert (zero_heads.sum(axis=1) == 2).all()  # exactly half per layer
+
+
+def test_head_pruning_requires_num_heads(rng):
+    tree = {"blocks": {"attn_out_w": jnp.ones((2, 16, 16), jnp.float32)}}
+    with pytest.raises(ValueError, match="num_heads"):
+        CompressionScheduler({
+            "head_pruning": {"shared_parameters": {"enabled": True},
+                             "different_groups": {}}}, tree)
+
+
+def test_channel_pruning_on_conv_kernels(rng):
+    tree = {"conv_w": jnp.asarray(rng.normal(size=(3, 3, 8, 16)), jnp.float32)}
+    sched = CompressionScheduler({
+        "channel_pruning": {"shared_parameters": {"enabled": True,
+                                                  "schedule_offset": 0},
+                            "different_groups": {
+                                "c0": {"params": {"dense_ratio": 0.25}}}}},
+        tree)
+    out = np.asarray(sched.transform(tree, jnp.int32(1))["conv_w"])
+    zero_ch = np.all(out == 0, axis=(0, 1, 2))
+    assert zero_ch.sum() == 12  # 75% of 16 output channels zeroed
+
+
+def test_activation_quantization_refused():
+    with pytest.raises(NotImplementedError, match="activation_quantization"):
+        CompressionScheduler({
+            "activation_quantization": {
+                "shared_parameters": {"enabled": True},
+                "different_groups": {}}}, {"w": jnp.ones((4, 4))})
