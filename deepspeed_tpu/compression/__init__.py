@@ -1,6 +1,8 @@
 from .compress import (  # noqa: F401
     CompressionScheduler,
     init_compression,
+    layer_reduction_map,
     quantize_params_for_inference,
+    redundancy_clean,
 )
 from .config import get_compression_config  # noqa: F401

@@ -176,6 +176,33 @@ class CompressionScheduler:
             entry = self.plan.get(_path_str(path))
             x = leaf
             if entry is not None:
+                # pruning masks apply to the raw weights, THEN fake-quant
+                # (the reference's LinearLayer_Compress order: weight*mask
+                # before quantization — also avoids magnitude ties on the
+                # quantized grid inflating the keep set)
+                if "prune_ratio" in entry:
+                    # lax.cond, not where: the pruning branch sorts |W| (O(n log n))
+                    # and must not execute during the pre-offset steps
+                    x = jax.lax.cond(
+                        step >= entry["prune_offset"],
+                        lambda t: _prune_l1(t, entry["prune_ratio"]),
+                        lambda t: t, x)
+                if "row_ratio" in entry:
+                    x = jax.lax.cond(
+                        step >= entry["row_offset"],
+                        lambda t: _prune_rows(t, entry["row_ratio"]),
+                        lambda t: t, x)
+                if "head_ratio" in entry:
+                    x = jax.lax.cond(
+                        step >= entry["head_offset"],
+                        lambda t: _prune_heads(t, entry["head_ratio"],
+                                               entry["num_heads"]),
+                        lambda t: t, x)
+                if "chan_ratio" in entry:
+                    x = jax.lax.cond(
+                        step >= entry["chan_offset"],
+                        lambda t: _prune_rows(t, entry["chan_ratio"]),
+                        lambda t: t, x)
                 if "quant_bits" in entry:
                     offset = entry["quant_offset"]
                     start_b = entry["quant_bits"]
@@ -203,29 +230,6 @@ class CompressionScheduler:
                             gate.reshape((-1,) + (1,) * (x.ndim - 1)), xq, x)
                     else:
                         x = jnp.where(step >= offset, xq, x)
-                if "prune_ratio" in entry:
-                    # lax.cond, not where: the pruning branch sorts |W| (O(n log n))
-                    # and must not execute during the pre-offset steps
-                    x = jax.lax.cond(
-                        step >= entry["prune_offset"],
-                        lambda t: _prune_l1(t, entry["prune_ratio"]),
-                        lambda t: t, x)
-                if "row_ratio" in entry:
-                    x = jax.lax.cond(
-                        step >= entry["row_offset"],
-                        lambda t: _prune_rows(t, entry["row_ratio"]),
-                        lambda t: t, x)
-                if "head_ratio" in entry:
-                    x = jax.lax.cond(
-                        step >= entry["head_offset"],
-                        lambda t: _prune_heads(t, entry["head_ratio"],
-                                               entry["num_heads"]),
-                        lambda t: t, x)
-                if "chan_ratio" in entry:
-                    x = jax.lax.cond(
-                        step >= entry["chan_offset"],
-                        lambda t: _prune_rows(t, entry["chan_ratio"]),
-                        lambda t: t, x)
             out.append(x)
         return jax.tree_util.tree_unflatten(treedef, out)
 
@@ -276,6 +280,26 @@ def init_compression(param_tree, ds_config) -> CompressionScheduler:
     block = (ds_config.compression_training
              if hasattr(ds_config, "compression_training") else ds_config)
     return CompressionScheduler(block, param_tree)
+
+
+def redundancy_clean(params, ds_config, step: Optional[int] = None):
+    """Bake the terminal compression transform into the weights for
+    deployment: fake-quant at the annealed target bits and every pruning mask
+    applied permanently, so inference runs on the cleaned tree with no
+    scheduler in the loop. Parity: ``compression/compress.py:127``
+    ``redundancy_clean`` (the reference mutates modules in place; here a new
+    tree is returned).
+
+    ``step`` defaults to far past every schedule (offsets and anneals fully
+    realized)."""
+    sched = CompressionScheduler(
+        ds_config.get("compression_training", ds_config)
+        if isinstance(ds_config, dict) else ds_config, params)
+    if not sched.enabled:
+        return params
+    horizon = step if step is not None else 2**30
+    return jax.tree_util.tree_map(
+        lambda x: x, sched.transform(params, jnp.int32(horizon)))
 
 
 def layer_reduction_map(n_teacher_layers: int, keep: int,

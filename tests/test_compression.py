@@ -288,3 +288,33 @@ def test_activation_quantization_refused():
             "activation_quantization": {
                 "shared_parameters": {"enabled": True},
                 "different_groups": {}}}, {"w": jnp.ones((4, 4))})
+
+
+def test_redundancy_clean_bakes_final_transform(rng):
+    from deepspeed_tpu.compression import redundancy_clean
+
+    tree = {"blocks": {"qkv_w": jnp.asarray(rng.normal(size=(2, 16, 16)),
+                                            jnp.float32)}}
+    cfg = {"compression_training": {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 5},
+            "different_groups": {
+                "g0": {"params": {"start_bits": 12, "target_bits": 4,
+                                  "quantization_period": 10,
+                                  "quantize_groups": 1}}}},
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 5},
+            "different_groups": {"s0": {"params": {"dense_ratio": 0.5}}}},
+    }}
+    out = redundancy_clean(tree, cfg)
+    w = np.asarray(out["blocks"]["qkv_w"])
+    ref = np.asarray(tree["blocks"]["qkv_w"])
+    # pruned to half density
+    assert (w == 0).mean() >= 0.5
+    # survivors quantized at the TARGET bits: few distinct magnitudes per tensor
+    nz = np.abs(w[w != 0])
+    assert len(np.unique(np.round(nz / nz.min(), 4))) <= 16  # 4-bit grid
+    assert not np.array_equal(w, ref)
+    # no compression config: identity
+    same = redundancy_clean(tree, {"compression_training": {}})
+    np.testing.assert_array_equal(np.asarray(same["blocks"]["qkv_w"]), ref)
