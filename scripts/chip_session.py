@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""One-shot on-chip measurement session (run when the axon tunnel is up).
+
+Runs, in order, each in its own subprocess with a timeout so a tunnel drop
+costs one config and the partial results survive in chip_session_results.json:
+  1. pallas kernel smoke (Mosaic-compiles all 5 kernels)
+  2. MFU sweep grid (scripts/mfu_sweep.py, incl. selective-remat policies)
+  3. decode p50/p90
+  4. Stable-Diffusion DDIM latency
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "chip_session_results.json")
+
+
+def run(tag, argv, timeout):
+    print(f"[chip_session] {tag}...", flush=True)
+    try:
+        p = subprocess.run(argv, capture_output=True, text=True,
+                           timeout=timeout, cwd=REPO)
+        line = next((ln for ln in reversed(p.stdout.strip().splitlines())
+                     if ln.strip().startswith("{")), None)
+        rec = {"tag": tag, "rc": p.returncode,
+               "result": json.loads(line) if line else None}
+        if p.returncode != 0:
+            rec["stderr"] = p.stderr[-400:]
+    except subprocess.TimeoutExpired:
+        rec = {"tag": tag, "rc": -1, "error": f"timeout {timeout}s"}
+    except Exception as e:  # noqa: BLE001
+        rec = {"tag": tag, "rc": -1, "error": str(e)[:200]}
+    print(f"[chip_session] {tag}: {json.dumps(rec)[:300]}", flush=True)
+    return rec
+
+
+def main():
+    results = []
+
+    def save():
+        with open(OUT, "w") as f:
+            json.dump(results, f, indent=1)
+
+    results.append(run("kernel-smoke", [
+        sys.executable, os.path.join(REPO, "bench.py"), "--worker",
+        json.dumps({"kind": "kernels", "name": "pallas-kernel-smoke"})], 900))
+    save()
+    if results[-1]["rc"] != 0:
+        print("[chip_session] chip unusable; stopping")
+        return
+
+    sweep_grid = [
+        {"model": "gpt2-350m", "micro_bs": 16, "seq": 1024, "remat": True,
+         "policy": "dots_with_no_batch_dims_saveable", "tag": "350m-save-dots"},
+        {"model": "gpt2-350m", "micro_bs": 16, "seq": 1024, "remat": True,
+         "policy": "save_attn_mlp_out", "tag": "350m-save-sublayer"},
+        {"model": "gpt2-760m", "micro_bs": 16, "seq": 1024, "remat": True,
+         "policy": "nothing_saveable", "tag": "760m-bs16"},
+        {"model": "gpt2-760m", "micro_bs": 16, "seq": 1024, "remat": True,
+         "policy": "save_attn_mlp_out", "tag": "760m-save-sublayer"},
+        {"model": "gpt2-760m", "micro_bs": 24, "seq": 1024, "remat": True,
+         "policy": "nothing_saveable", "tag": "760m-bs24"},
+        {"model": "gpt2-760m", "micro_bs": 8, "seq": 1024, "remat": True,
+         "policy": "dots_with_no_batch_dims_saveable", "tag": "760m-bs8-save-dots"},
+    ]
+    for spec in sweep_grid:
+        results.append(run(f"mfu:{spec['tag']}", [
+            sys.executable, os.path.join(REPO, "scripts", "mfu_sweep.py"),
+            "--one", json.dumps(spec)], 1500))
+        save()
+
+    results.append(run("decode", [
+        sys.executable, os.path.join(REPO, "bench.py"), "--worker",
+        json.dumps({"kind": "inference", "name": "gpt2-350m-decode",
+                    "model": "gpt2-350m", "batch": 1, "prompt": 128,
+                    "gen": 64})], 1500))
+    save()
+    results.append(run("sd-ddim20", [
+        sys.executable, os.path.join(REPO, "bench.py"), "--worker",
+        json.dumps({"kind": "diffusion", "name": "sd-ddim20", "latent": 32,
+                    "ddim_steps": 20})], 1500))
+    save()
+    print(f"[chip_session] done -> {OUT}")
+
+
+if __name__ == "__main__":
+    main()
