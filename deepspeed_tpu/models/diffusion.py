@@ -281,21 +281,25 @@ def ddim_schedule(num_steps: int, num_train_timesteps: int = 1000,
 
 def ddim_sample(cfg: UNetConfig, params, latents: jnp.ndarray,
                 text_emb: jnp.ndarray, uncond_emb: jnp.ndarray,
-                num_steps: int = 20, guidance_scale: float = 7.5) -> jnp.ndarray:
+                num_steps: int = 20, guidance_scale: float = 7.5,
+                apply_fn=None) -> jnp.ndarray:
     """Deterministic DDIM (eta=0) with classifier-free guidance, as one scan.
 
     Parity: the reference's patched SD pipeline loop under CUDA graphs
     (``model_implementations/diffusers/unet.py`` forward + graph replay).
+    ``apply_fn(cfg, params, latents, t, ctx)`` selects the denoiser —
+    defaults to the lightweight :func:`apply_unet`; pass
+    ``models.sd_unet.apply_sd_unet`` to drive the faithful SD-1.x UNet.
     """
     ts, abar, abar_prev = ddim_schedule(num_steps)
     B = latents.shape[0]
+    fn = apply_fn or apply_unet
     ctx = jnp.concatenate([text_emb, uncond_emb], axis=0)  # one batched UNet call
 
     def step(x, sched):
         t, ab, ab_prev = sched
         tb = jnp.full((2 * B,), t, jnp.int32)
-        eps_both = apply_unet(cfg, params, jnp.concatenate([x, x], axis=0),
-                              tb, ctx)
+        eps_both = fn(cfg, params, jnp.concatenate([x, x], axis=0), tb, ctx)
         eps_c, eps_u = eps_both[:B], eps_both[B:]
         eps = eps_u + guidance_scale * (eps_c - eps_u)
         x0 = (x - jnp.sqrt(1.0 - ab) * eps) / jnp.sqrt(ab)

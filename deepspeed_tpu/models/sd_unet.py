@@ -442,6 +442,75 @@ def import_sd_vae_decoder_state(sd: Dict[str, Any],
     return cfg, params
 
 
+def _read_component_state(root: str, name: str) -> Dict[str, Any]:
+    """Read ``<root>/<name>/diffusion_pytorch_model.{safetensors,bin}`` — the
+    diffusers on-disk layout the reference's SD path consumes."""
+    import os
+
+    base = os.path.join(root, name, "diffusion_pytorch_model")
+    if os.path.exists(base + ".safetensors"):
+        from safetensors import safe_open
+
+        sd = {}
+        with safe_open(base + ".safetensors", framework="np") as f:
+            for k in f.keys():
+                sd[k] = f.get_tensor(k)
+        return sd
+    if os.path.exists(base + ".bin"):
+        import torch
+
+        return torch.load(base + ".bin", map_location="cpu",
+                          weights_only=False)
+    raise FileNotFoundError(f"{base}.safetensors|.bin not found")
+
+
+@dataclasses.dataclass
+class SDPipeline:
+    """Text-to-image inference on the FAITHFUL SD-1.x architecture: DDIM +
+    classifier-free guidance + VAE decode as one compiled program (the
+    sampling scan and schedule live in ``models/diffusion.py``)."""
+
+    unet_cfg: SDUNetConfig
+    vae_cfg: SDVAEDecoderConfig
+    unet_params: Dict[str, jnp.ndarray]
+    vae_params: Dict[str, jnp.ndarray]
+    latent_size: int = 64
+
+    @classmethod
+    def from_diffusers_dir(cls, root: str, n_head: int = 8,
+                           norm_groups: int = 32,
+                           latent_size: int = 64) -> "SDPipeline":
+        """Load ``unet/`` and ``vae/`` component weights from a local
+        Stable-Diffusion checkpoint directory (diffusers layout)."""
+        ucfg, up = import_sd_unet_state(
+            _read_component_state(root, "unet"), n_head=n_head,
+            norm_groups=norm_groups)
+        vcfg, vp = import_sd_vae_decoder_state(
+            _read_component_state(root, "vae"), norm_groups=norm_groups)
+        return cls(ucfg, vcfg, up, vp, latent_size)
+
+    def __call__(self, text_emb: jnp.ndarray, uncond_emb: jnp.ndarray,
+                 num_steps: int = 20, guidance_scale: float = 7.5,
+                 seed: int = 0) -> np.ndarray:
+        from .diffusion import ddim_sample
+
+        B = text_emb.shape[0]
+        noise = jax.random.normal(
+            jax.random.PRNGKey(seed),
+            (B, self.latent_size, self.latent_size,
+             self.unet_cfg.in_channels))
+
+        def fn(unet_params, vae_params, text, uncond, x, gs):
+            lat = ddim_sample(self.unet_cfg, unet_params, x, text, uncond,
+                              num_steps=num_steps, guidance_scale=gs,
+                              apply_fn=apply_sd_unet)
+            return apply_sd_vae_decoder(self.vae_cfg, vae_params, lat)
+
+        img = jax.jit(fn)(self.unet_params, self.vae_params, text_emb,
+                          uncond_emb, noise, jnp.float32(guidance_scale))
+        return np.asarray(img)
+
+
 def _np32(t) -> np.ndarray:
     try:
         import torch
