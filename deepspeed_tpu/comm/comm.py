@@ -54,11 +54,16 @@ class CommsLogger:
 
     enabled: bool = False
     verbose: bool = False
+    prof_all: bool = True
+    prof_ops: list = field(default_factory=list)
     records: Dict[str, _OpRecord] = field(default_factory=dict)
 
     def record(self, op_name: str, nbytes: int) -> None:
         if not self.enabled:
             return
+        if not self.prof_all and self.prof_ops and not any(
+                op_name.startswith(p) for p in self.prof_ops):
+            return  # prof_ops filter (parity: comms config prof_all/prof_ops)
         rec = self.records.setdefault(op_name, _OpRecord())
         rec.count += 1
         rec.bytes += int(nbytes)
@@ -88,9 +93,13 @@ class CommsLogger:
 comms_logger = CommsLogger()
 
 
-def configure(enabled: bool = True, verbose: bool = False) -> None:
+def configure(enabled: bool = True, verbose: bool = False,
+              prof_all: bool = True, prof_ops: Optional[Sequence[str]] = None
+              ) -> None:
     comms_logger.enabled = enabled
     comms_logger.verbose = verbose
+    comms_logger.prof_all = prof_all
+    comms_logger.prof_ops = list(prof_ops or [])
 
 
 def _nbytes(x: Any) -> int:

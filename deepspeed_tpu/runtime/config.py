@@ -232,7 +232,9 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     compression_training: Dict[str, Any] = Field(default_factory=dict)
     aio: Dict[str, Any] = Field(default_factory=dict)
 
-    zero_allow_untested_optimizer: bool = True
+    # must be opted into before handing ZeRO a client optimizer (the
+    # reference's default; engine enforces it)
+    zero_allow_untested_optimizer: bool = False
     checkpoint: Dict[str, Any] = Field(default_factory=dict)
     load_universal_checkpoint: bool = False
 

@@ -89,12 +89,30 @@ class DeepSpeedEngine:
         self.train_batch_size = int(config.train_batch_size or 1)
 
         if config.comms_logger.enabled:
-            comm.configure(enabled=True, verbose=config.comms_logger.verbose)
+            comm.configure(enabled=True,
+                           verbose=(config.comms_logger.verbose
+                                    or config.comms_logger.debug),
+                           prof_all=config.comms_logger.prof_all,
+                           prof_ops=config.comms_logger.prof_ops)
 
         # communication_data_type: honorable only when it equals the compute
         # dtype (the wire dtype GSPMD fuses the grad reduction at); any other
         # request is refused rather than silently unhonored
         validate_comm_dtype(config.communication_data_type, self.pc.compute_dtype)
+
+        # sparse embedding gradients (runtime/sparse_tensor.py): the engine's
+        # grad exchange is fused into the backward by GSPMD, where embedding
+        # grads are scatter-adds XLA keeps unmaterialized until the reduction
+        # — so there is no separate sparse wire format to select. The
+        # reference's own constraint still holds: ZeRO >= 2 partitions flat
+        # grad buckets and cannot carry sparse layouts.
+        if config.sparse_gradients and self.policy.stage >= 2:
+            raise ValueError(
+                "sparse_gradients is incompatible with ZeRO stage >= 2 "
+                "(gradient partitioning), matching the reference's constraint")
+        if config.disable_allgather:
+            log_dist("disable_allgather accepted for config compatibility; "
+                     "no-op here (GSPMD chooses the gather/broadcast pattern)")
 
         # parity: engine._configure_checkpointing → activation-ckpt global config.
         # An explicit user configure() wins unless the JSON actually carries a
@@ -147,9 +165,32 @@ class DeepSpeedEngine:
             self._ev_loss_fn = _ev_loss
 
         # curriculum learning: step-scheduled sequence truncation (parity:
-        # engine.py:1810-1816; legacy "curriculum_learning" block)
+        # engine.py:1810-1816; legacy "curriculum_learning" block, or the
+        # data-efficiency schema's data_sampling.curriculum_learning with a
+        # seqlen metric — data_sampler.py:33)
         self.curriculum_scheduler = None
         cl = config.curriculum_learning
+        if not (cl and cl.get("enabled")):
+            de = config.data_efficiency or {}
+            ds_blk = de.get("data_sampling", {})
+            decl = ds_blk.get("curriculum_learning", {})
+            if (de.get("enabled") and ds_blk.get("enabled", True)
+                    and decl.get("enabled")):
+                metrics = decl.get("curriculum_metrics", {})
+                if set(metrics) == {"seqlen"}:
+                    m = metrics["seqlen"]
+                    cl = {"enabled": True, "curriculum_type": "seqlen",
+                          "min_difficulty": m["min_difficulty"],
+                          "max_difficulty": m["max_difficulty"],
+                          "schedule_type": m.get("schedule_type",
+                                                 "fixed_linear"),
+                          "schedule_config": m.get("schedule_config", {})}
+                elif metrics:
+                    raise NotImplementedError(
+                        f"data_efficiency curriculum metrics {sorted(metrics)} "
+                        f"unsupported in-engine (only 'seqlen' truncation is; "
+                        f"metric-file sampling goes through "
+                        f"DeepSpeedDataSampler)")
         if cl and cl.get("enabled"):
             from .data_pipeline import CurriculumScheduler
 
@@ -174,7 +215,14 @@ class DeepSpeedEngine:
         opt_cfg = config.optimizer
         if client_optimizer is not None:
             # parity: a client optimizer overrides the config block
-            # (``runtime/engine.py:1261`` _configure_optimizer)
+            # (``runtime/engine.py:1261`` _configure_optimizer); under ZeRO a
+            # client optimizer must be explicitly allowed, as in the
+            # reference's _do_sanity_check
+            if self.policy.stage > 0 and not config.zero_allow_untested_optimizer:
+                raise ValueError(
+                    "a client optimizer with ZeRO requires "
+                    "zero_allow_untested_optimizer=true (its state layout "
+                    "must tolerate sharding)")
             self.optimizer = client_optimizer
             self.base_lr = float(opt_cfg.params.get("lr", 1e-3)) if opt_cfg else 1e-3
         elif opt_cfg is None:
@@ -249,6 +297,9 @@ class DeepSpeedEngine:
             f"engine ready: {n_params/1e6:.1f}M params, ZeRO stage {self.policy.stage}, "
             f"dtype {jnp.dtype(self.pc.compute_dtype).name}, mesh {self.topo.axes}, "
             f"micro_bs {self.micro_batch_size} x gas {self.gas}")
+        if config.dump_state:
+            # parity: the reference's dump_state prints the resolved config
+            log_dist("config state dump:\n" + config.model_dump_json(indent=2))
 
     # ------------------------------------------------------------------ state init
     def _init_state(self) -> Dict[str, Any]:
@@ -466,8 +517,19 @@ class DeepSpeedEngine:
         sharding = self.batch_sharding
         if leading_gas and self.gas > 1:
             sharding = NamedSharding(self.mesh, P(None, *self.topo.batch_spec()))
-        return jax.tree_util.tree_map(
-            lambda x: jax.device_put(jnp.asarray(x), sharding), batch)
+        cast = (self.pc.compute_dtype
+                if (self.config.fp16.enabled and self.config.fp16.auto_cast)
+                else None)
+
+        def place(x):
+            x = jnp.asarray(x)
+            if cast is not None and jnp.issubdtype(x.dtype, jnp.floating):
+                # fp16 auto_cast: float inputs ride the compute dtype
+                # (parity: engine.py _cast_inputs under fp16.auto_cast)
+                x = x.astype(cast)
+            return jax.device_put(x, sharding)
+
+        return jax.tree_util.tree_map(place, batch)
 
     def _next_rng(self):
         self._rng, sub = jax.random.split(self._rng)
@@ -649,6 +711,13 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------ info surface
     def get_global_grad_norm(self) -> float:
         return float(self._last_metrics.get("grad_norm", 0.0))
+
+    def load_universal_checkpoint(self) -> bool:
+        """Parity accessor (``runtime/engine.py:828``). Always satisfiable:
+        the native checkpoint format stores full logical arrays per leaf, so
+        EVERY checkpoint reloads at any topology — the flag selects no
+        special path."""
+        return bool(self.config.load_universal_checkpoint)
 
     def get_lr(self):
         return [float(self.lr_fn(self.state["step"]))]

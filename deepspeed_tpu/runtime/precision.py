@@ -35,6 +35,10 @@ class PrecisionConfig:
     hysteresis: int = 2
     min_scale: float = 1.0
     static_scale: Optional[float] = None
+    # True: refill the hysteresis budget after every good step (the reference's
+    # consecutive_hysteresis, loss_scaler.py); False (default): the budget
+    # stays depleted until a scale cut
+    consecutive_hysteresis: bool = False
 
     @classmethod
     def from_ds_config(cls, cfg) -> "PrecisionConfig":
@@ -49,7 +53,8 @@ class PrecisionConfig:
                 scale_window=cfg.fp16.loss_scale_window,
                 hysteresis=cfg.fp16.hysteresis,
                 min_scale=cfg.fp16.min_loss_scale,
-                static_scale=None if cfg.fp16.dynamic_loss_scale else cfg.fp16.loss_scale)
+                static_scale=None if cfg.fp16.dynamic_loss_scale else cfg.fp16.loss_scale,
+                consecutive_hysteresis=cfg.fp16.consecutive_hysteresis)
         return cls(compute_dtype=jnp.float32, master_weights=False, loss_scaling=False)
 
 
@@ -103,8 +108,10 @@ def update_scaler(pc: PrecisionConfig, state: ScalerState, finite: jnp.ndarray) 
         grown = s.good_steps + 1 >= pc.scale_window
         new_scale = jnp.where(grown, s.scale * 2.0, s.scale)
         new_good = jnp.where(grown, 0, s.good_steps + 1)
+        hyst = (jnp.asarray(pc.hysteresis, jnp.int32)
+                if pc.consecutive_hysteresis else s.hysteresis)
         return ScalerState(scale=new_scale, good_steps=new_good,
-                           hysteresis=jnp.asarray(pc.hysteresis, jnp.int32))
+                           hysteresis=hyst)
 
     def on_overflow(s: ScalerState) -> ScalerState:
         cut = s.hysteresis <= 1

@@ -1,0 +1,144 @@
+"""Every accepted config knob must act (or refuse loudly) — no decorative
+fields. Covers the round-3 audit: consecutive_hysteresis, auto_cast,
+prof_all/prof_ops, zero_allow_untested_optimizer, sparse_gradients,
+dump_state, load_universal_checkpoint, data_efficiency curriculum."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import build_gpt
+from deepspeed_tpu.models.gpt import GPTConfig
+
+
+def _tiny():
+    return build_gpt(GPTConfig(vocab_size=64, d_model=32, n_layer=1,
+                               n_head=2, max_seq_len=16))[0]
+
+
+def _init(extra, **kw):
+    return ds.initialize(model=_tiny(), config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 0,
+        **extra,
+    }, **kw)[0]
+
+
+# ----------------------------------------------------------- loss-scaler knob
+def test_consecutive_hysteresis_controls_refill():
+    from deepspeed_tpu.runtime.precision import (
+        PrecisionConfig, ScalerState, init_scaler_state, update_scaler)
+
+    def pc(consecutive):
+        return PrecisionConfig(
+            compute_dtype=jnp.float16, master_weights=True, loss_scaling=True,
+            hysteresis=3, consecutive_hysteresis=consecutive)
+
+    for consecutive in (False, True):
+        p = pc(consecutive)
+        s = init_scaler_state(p)
+        s = update_scaler(p, s, jnp.bool_(False))  # overflow: budget 3 -> 2
+        assert int(s.hysteresis) == 2
+        s = update_scaler(p, s, jnp.bool_(True))   # good step
+        assert int(s.hysteresis) == (3 if consecutive else 2)
+
+
+# ----------------------------------------------------------------- auto_cast
+def test_fp16_auto_cast_casts_float_inputs():
+    engine = _init({"fp16": {"enabled": True, "auto_cast": True},
+                    "mesh": {"dp": 8}})
+    placed = engine._place_batch({
+        "input_ids": np.zeros((8, 16), np.int32),
+        "emb": np.zeros((8, 16), np.float32)})
+    assert placed["input_ids"].dtype == jnp.int32  # ints untouched
+    assert placed["emb"].dtype == jnp.float16
+    # without the knob, floats keep their dtype
+    engine2 = _init({"fp16": {"enabled": True, "auto_cast": False},
+                     "mesh": {"dp": 8}})
+    assert engine2._place_batch(
+        {"x": np.zeros((8, 4), np.float32)})["x"].dtype == jnp.float32
+
+
+# -------------------------------------------------------------- comms filter
+def test_prof_ops_filters_recorded_ops():
+    from deepspeed_tpu.comm.comm import CommsLogger
+
+    lg = CommsLogger(enabled=True, prof_all=False, prof_ops=["all_reduce"])
+    lg.record("all_reduce[dp]", 100)
+    lg.record("all_gather[tp]", 100)
+    assert list(lg.records) == ["all_reduce[dp]"]
+    lg2 = CommsLogger(enabled=True, prof_all=True, prof_ops=["all_reduce"])
+    lg2.record("all_gather[tp]", 100)
+    assert "all_gather[tp]" in lg2.records
+
+
+# ------------------------------------------------- client optimizer under ZeRO
+def test_zero_client_optimizer_requires_allow_flag():
+    from deepspeed_tpu.ops.optimizers import get_optimizer
+
+    opt = get_optimizer("Adam", {"lr": 1e-3})
+    with pytest.raises(ValueError, match="zero_allow_untested_optimizer"):
+        _init({"zero_optimization": {"stage": 2}, "mesh": {"dp": 8}},
+              optimizer=opt)
+    engine = _init({"zero_optimization": {"stage": 2}, "mesh": {"dp": 8},
+                    "zero_allow_untested_optimizer": True},
+                   optimizer=opt)
+    m = engine.train_batch({"input_ids": np.zeros((8, 16), np.int32)})
+    assert np.isfinite(float(m["loss"]))
+
+
+# ----------------------------------------------------------- sparse gradients
+def test_sparse_gradients_rejected_with_zero2():
+    with pytest.raises(ValueError, match="sparse_gradients"):
+        _init({"sparse_gradients": True, "zero_optimization": {"stage": 2},
+               "mesh": {"dp": 8}})
+    engine = _init({"sparse_gradients": True,
+                    "zero_optimization": {"stage": 1}, "mesh": {"dp": 8}})
+    assert engine.config.sparse_gradients
+
+
+# ------------------------------------------------------------------ dump_state
+def test_dump_state_prints_config(caplog, monkeypatch):
+    import logging
+
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+
+    monkeypatch.setattr(ds_logger, "propagate", True)  # let caplog see it
+    with caplog.at_level(logging.INFO, logger=ds_logger.name):
+        _init({"dump_state": True, "mesh": {"dp": 8}})
+    assert any("config state dump" in r.message for r in caplog.records)
+
+
+def test_load_universal_checkpoint_accessor():
+    engine = _init({"load_universal_checkpoint": True, "mesh": {"dp": 8}})
+    assert engine.load_universal_checkpoint() is True
+
+
+# ---------------------------------------------------- data_efficiency schema
+def test_data_efficiency_seqlen_curriculum_truncates():
+    engine = _init({"mesh": {"dp": 8}, "data_efficiency": {
+        "enabled": True,
+        "data_sampling": {"enabled": True, "curriculum_learning": {
+            "enabled": True,
+            "curriculum_metrics": {"seqlen": {
+                "min_difficulty": 4, "max_difficulty": 16,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 10,
+                                    "difficulty_step": 4}}}}}}})
+    assert engine.curriculum_scheduler is not None
+    b = engine._apply_curriculum({"input_ids": np.zeros((8, 16), np.int32)})
+    assert b["input_ids"].shape[-1] < 16  # early steps truncate
+
+
+def test_data_efficiency_unknown_metric_refused():
+    with pytest.raises(NotImplementedError, match="unsupported"):
+        _init({"mesh": {"dp": 8}, "data_efficiency": {
+            "enabled": True,
+            "data_sampling": {"enabled": True, "curriculum_learning": {
+                "enabled": True,
+                "curriculum_metrics": {"vocabularyrarity": {
+                    "min_difficulty": 1, "max_difficulty": 100}}}}}})
