@@ -211,6 +211,34 @@ def broadcast(x, axis_name: AxisName, src_index: int = 0):
     return jax.tree_util.tree_map(lambda f: f[src_index], full)
 
 
+def reduce(x, axis_name: AxisName, dst_index: int = 0, op: str = "sum"):
+    """Parity: ``comm/comm.py`` (reduce): the reduction lands on ``dst``;
+    other ranks get zeros. SPMD form: full psum masked by axis index."""
+    full = all_reduce(x, axis_name, op=op)
+    on_dst = lax.axis_index(axis_name) == dst_index
+    return jax.tree_util.tree_map(
+        lambda f: jnp.where(on_dst, f, jnp.zeros_like(f)), full)
+
+
+def gather(x, axis_name: AxisName, dst_index: int = 0, axis: int = 0):
+    """Parity: ``comm/comm.py`` (gather): dst holds the concatenation; other
+    ranks get zeros of the gathered shape."""
+    full = all_gather(x, axis_name, axis=axis, tiled=True)
+    on_dst = lax.axis_index(axis_name) == dst_index
+    return jnp.where(on_dst, full, jnp.zeros_like(full))
+
+
+def scatter(x, axis_name: AxisName, src_index: int = 0, axis: int = 0):
+    """Parity: ``comm/comm.py`` (scatter): each rank takes its chunk of
+    src's array along ``axis``."""
+    comms_logger.record(f"scatter[{axis_name}]", _nbytes(x))
+    src = broadcast(x, axis_name, src_index)
+    n = lax.axis_size(axis_name)
+    chunk = src.shape[axis] // n
+    idx = lax.axis_index(axis_name) * chunk
+    return lax.dynamic_slice_in_dim(src, idx, chunk, axis=axis)
+
+
 def ppermute(x, axis_name: AxisName, perm):
     """Point-to-point send/recv ring. Parity: ``comm/comm.py:430-470`` (send/recv) and
     the pipeline's p2p exchange (``runtime/pipe/p2p.py:48``): on TPU, neighbor
@@ -249,6 +277,22 @@ def barrier(name: str = "barrier") -> None:
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices(name)
+
+
+def monitored_barrier(name: str = "monitored_barrier",
+                      timeout_s: float = 300.0) -> float:
+    """Parity: ``comm/comm.py`` (monitored_barrier): a barrier that reports
+    how long the slowest participant made everyone wait; the debugging tool
+    for straggling hosts. Returns the wait in seconds."""
+    t0 = time.perf_counter()
+    barrier(name)
+    dt = time.perf_counter() - t0
+    if dt > timeout_s:
+        logger.warning(f"monitored_barrier '{name}': waited {dt:.1f}s "
+                       f"(> timeout {timeout_s:.0f}s)")
+    elif dt > 1.0:
+        log_dist(f"monitored_barrier '{name}': waited {dt:.1f}s")
+    return dt
 
 
 @contextmanager
