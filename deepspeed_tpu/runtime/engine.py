@@ -712,6 +712,27 @@ class DeepSpeedEngine:
     def get_global_grad_norm(self) -> float:
         return float(self._last_metrics.get("grad_norm", 0.0))
 
+    def set_train_batch_size(self, train_batch_size: int) -> None:
+        """Change the global batch size by adjusting gradient-accumulation
+        steps; the micro-batch size is untouched. Parity:
+        ``runtime/engine.py:440`` — the elastic-resize hook. The fused step is
+        recompiled for the new gas (one compile, amortized across the run)."""
+        per_pass = self.micro_batch_size * self.topo.data_parallel_size
+        if train_batch_size % per_pass != 0:
+            raise ValueError(
+                f"train_batch_size {train_batch_size} not divisible by "
+                f"micro_batch x dp = {per_pass}")
+        new_gas = train_batch_size // per_pass
+        if new_gas == self.gas:
+            return
+        self.gas = new_gas
+        self.train_batch_size = train_batch_size
+        self.config.gradient_accumulation_steps = new_gas
+        self.config.train_batch_size = train_batch_size
+        self._compile_steps()
+        log_dist(f"train_batch_size -> {train_batch_size} "
+                 f"(gas {new_gas}, micro_bs {self.micro_batch_size})")
+
     def load_universal_checkpoint(self) -> bool:
         """Parity accessor (``runtime/engine.py:828``). Always satisfiable:
         the native checkpoint format stores full logical arrays per leaf, so

@@ -142,3 +142,17 @@ def test_data_efficiency_unknown_metric_refused():
                 "enabled": True,
                 "curriculum_metrics": {"vocabularyrarity": {
                     "min_difficulty": 1, "max_difficulty": 100}}}}}})
+
+
+# ------------------------------------------------------ elastic batch resize
+def test_set_train_batch_size_adjusts_gas():
+    engine = _init({"mesh": {"dp": 8},
+                    "gradient_accumulation_steps": 1})
+    assert engine.gas == 1
+    b2 = {"input_ids": np.zeros((2, 8, 16), np.int32)}  # [gas, batch, T]
+    engine.set_train_batch_size(16)  # micro 1 x dp 8 x gas 2
+    assert engine.gas == 2
+    m = engine.train_batch(b2)
+    assert np.isfinite(float(m["loss"]))
+    with pytest.raises(ValueError, match="divisible"):
+        engine.set_train_batch_size(12)
