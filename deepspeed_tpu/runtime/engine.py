@@ -697,10 +697,23 @@ class DeepSpeedEngine:
             log_dist(f"step {self.global_steps}: grad overflow, step skipped; "
                      f"loss scale -> {float(self.state['scaler'].scale)}")
         if self._monitor is not None and "loss" in metrics:
-            self._monitor.write_events([
+            # parity: the reference's gas-boundary event set
+            # (engine.py:2183-2206: Train/Samples/{train_loss,lr,loss_scale})
+            events = [
                 ("Train/loss", float(metrics["loss"]), self.global_steps),
                 ("Train/lr", float(metrics["lr"]), self.global_steps),
-            ])
+                ("Train/grad_norm", float(metrics.get("grad_norm", 0.0)),
+                 self.global_steps),
+            ]
+            if self.pc.loss_scaling:
+                events.append(("Train/loss_scale",
+                               float(metrics.get("loss_scale", 1.0)),
+                               self.global_steps))
+            sps = self.tput_timer.avg_samples_per_sec()
+            if sps:
+                events.append(("Train/samples_per_sec", sps,
+                               self.global_steps))
+            self._monitor.write_events(events)
         if self.config.steps_per_print and self.global_steps % self.config.steps_per_print == 0:
             loss = metrics.get("loss")
             loss_str = f"loss={float(loss):.4f} " if loss is not None else ""

@@ -174,3 +174,31 @@ def test_stable_diffusion_pipeline_end_to_end(rng):
     # guidance scale changes the output (classifier-free guidance is live)
     img2 = pipe(txt, un, num_steps=4, guidance_scale=1.0)
     assert np.abs(img - img2).max() > 0
+
+
+def test_engine_emits_full_event_set():
+    """The gas-boundary monitor events must include loss/lr/grad_norm (and
+    loss_scale under fp16) — the reference's engine.py:2183-2206 set."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_gpt
+    from deepspeed_tpu.models.gpt import GPTConfig
+
+    from deepspeed_tpu.monitor.monitor import CallbackMonitor, MonitorMaster
+    from deepspeed_tpu.runtime.config import MonitorConfig
+
+    events = []
+    model, _ = build_gpt(GPTConfig(vocab_size=64, d_model=32, n_layer=1,
+                                   n_head=2, max_seq_len=16))
+    engine, _, _, _ = ds.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "fp16": {"enabled": True},
+        "mesh": {"dp": 8},
+        "steps_per_print": 0,
+    })
+    engine._monitor = MonitorMaster(
+        MonitorConfig(), extra_backends=[CallbackMonitor(events.extend)])
+    engine.train_batch({"input_ids": np.zeros((8, 16), np.int32)})
+    keys = {name for name, _, _ in events}
+    assert {"Train/loss", "Train/lr", "Train/grad_norm",
+            "Train/loss_scale"} <= keys
