@@ -18,7 +18,9 @@ __version__ = "0.1.0"
 
 from . import comm  # noqa: F401
 from .accelerator import get_accelerator  # noqa: F401
+from .comm import init_distributed  # noqa: F401  (deepspeed.init_distributed)
 from .runtime import zero  # noqa: F401  (deepspeed.zero parity surface)
+from .runtime.pipe.module import LayerSpec, PipelineModule  # noqa: F401
 from .models.api import Module  # noqa: F401
 from .runtime.config import DeepSpeedConfig  # noqa: F401
 from .runtime.engine import DeepSpeedEngine  # noqa: F401
