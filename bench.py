@@ -380,8 +380,19 @@ def main() -> None:
              "micro_bs": big_bs, "seq": seq, "stage": s, "steps": steps}
             for s in (1, 3)
         ] + [
+            # MFU hedges: selective remat (saves 2*d_model/token/layer, skips
+            # the output-projection recompute) and a fatter batch
+            {"kind": "train", "name": f"{big}-zero1-selrm", "model": big,
+             "micro_bs": big_bs, "seq": seq, "stage": 1, "steps": steps,
+             "remat_policy": "save_attn_mlp_out"},
+            {"kind": "train", "name": f"{big}-zero1-bs24", "model": big,
+             "micro_bs": 24, "seq": seq, "stage": 1, "steps": steps},
+        ] + [
             {"kind": "inference", "name": f"{model}-decode", "model": model,
              "batch": 1, "prompt": 128, "gen": 64},
+            # batched decode: amortized per-token throughput
+            {"kind": "inference", "name": f"{model}-decode-b8", "model": model,
+             "batch": 8, "prompt": 128, "gen": 64},
             {"kind": "diffusion", "name": "sd-ddim20", "latent": 32,
              "ddim_steps": 20},
         ]
