@@ -24,6 +24,7 @@ import numpy as np
 
 from ..module_inject.replace_module import _neox_qkv_permute
 from ..utils.logging import log_dist
+from .reference_import import _np32, _torch_load  # shared torch interop
 
 LAYER_RE = re.compile(r"layer_(\d+)-model_(\d+)-model_states\.pt$")
 
@@ -40,19 +41,6 @@ _REPLICATED = (
 _CONCAT_DIM1 = ("self_attention.dense.weight", "attention.dense.weight",
                 "mlp.dense_4h_to_h.weight")
 
-
-def _torch_load(path: str):
-    import torch
-
-    return torch.load(path, map_location="cpu", weights_only=False)
-
-
-def _np32(t) -> np.ndarray:
-    import torch
-
-    if isinstance(t, torch.Tensor):
-        return t.detach().to(torch.float32).numpy()
-    return np.asarray(t, np.float32)
 
 
 class MegatronDSCheckpoint:

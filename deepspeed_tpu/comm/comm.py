@@ -222,21 +222,25 @@ def reduce(x, axis_name: AxisName, dst_index: int = 0, op: str = "sum"):
 
 def gather(x, axis_name: AxisName, dst_index: int = 0, axis: int = 0):
     """Parity: ``comm/comm.py`` (gather): dst holds the concatenation; other
-    ranks get zeros of the gathered shape."""
+    ranks get zeros of the gathered shape. Pytrees supported like the other
+    collectives."""
     full = all_gather(x, axis_name, axis=axis, tiled=True)
     on_dst = lax.axis_index(axis_name) == dst_index
-    return jnp.where(on_dst, full, jnp.zeros_like(full))
+    return jax.tree_util.tree_map(
+        lambda f: jnp.where(on_dst, f, jnp.zeros_like(f)), full)
 
 
 def scatter(x, axis_name: AxisName, src_index: int = 0, axis: int = 0):
     """Parity: ``comm/comm.py`` (scatter): each rank takes its chunk of
-    src's array along ``axis``."""
+    src's array along ``axis``. Pytrees supported."""
     comms_logger.record(f"scatter[{axis_name}]", _nbytes(x))
     src = broadcast(x, axis_name, src_index)
     n = lax.axis_size(axis_name)
-    chunk = src.shape[axis] // n
-    idx = lax.axis_index(axis_name) * chunk
-    return lax.dynamic_slice_in_dim(src, idx, chunk, axis=axis)
+    idx = lax.axis_index(axis_name)
+    return jax.tree_util.tree_map(
+        lambda s: lax.dynamic_slice_in_dim(
+            s, idx * (s.shape[axis] // n), s.shape[axis] // n, axis=axis),
+        src)
 
 
 def ppermute(x, axis_name: AxisName, perm):

@@ -292,9 +292,11 @@ def redundancy_clean(params, ds_config, step: Optional[int] = None):
 
     ``step`` defaults to far past every schedule (offsets and anneals fully
     realized)."""
-    sched = CompressionScheduler(
-        ds_config.get("compression_training", ds_config)
-        if isinstance(ds_config, dict) else ds_config, params)
+    if isinstance(ds_config, dict):
+        cfg = ds_config.get("compression_training", ds_config)
+    else:  # a DeepSpeedConfig model (e.g. engine.config)
+        cfg = getattr(ds_config, "compression_training", None) or {}
+    sched = CompressionScheduler(cfg, params)
     if not sched.enabled:
         return params
     horizon = step if step is not None else 2**30

@@ -489,25 +489,34 @@ class SDPipeline:
             _read_component_state(root, "vae"), norm_groups=norm_groups)
         return cls(ucfg, vcfg, up, vp, latent_size)
 
+    def _jitted(self, num_steps: int):
+        """One compiled program per num_steps, cached for the pipeline's
+        lifetime (a per-call jit would recompile the full DDIM+UNet+VAE
+        program for every image)."""
+        cache = self.__dict__.setdefault("_jit_cache", {})
+        if num_steps not in cache:
+            from .diffusion import ddim_sample
+
+            def fn(unet_params, vae_params, text, uncond, x, gs):
+                lat = ddim_sample(self.unet_cfg, unet_params, x, text, uncond,
+                                  num_steps=num_steps, guidance_scale=gs,
+                                  apply_fn=apply_sd_unet)
+                return apply_sd_vae_decoder(self.vae_cfg, vae_params, lat)
+
+            cache[num_steps] = jax.jit(fn)
+        return cache[num_steps]
+
     def __call__(self, text_emb: jnp.ndarray, uncond_emb: jnp.ndarray,
                  num_steps: int = 20, guidance_scale: float = 7.5,
                  seed: int = 0) -> np.ndarray:
-        from .diffusion import ddim_sample
-
         B = text_emb.shape[0]
         noise = jax.random.normal(
             jax.random.PRNGKey(seed),
             (B, self.latent_size, self.latent_size,
              self.unet_cfg.in_channels))
-
-        def fn(unet_params, vae_params, text, uncond, x, gs):
-            lat = ddim_sample(self.unet_cfg, unet_params, x, text, uncond,
-                              num_steps=num_steps, guidance_scale=gs,
-                              apply_fn=apply_sd_unet)
-            return apply_sd_vae_decoder(self.vae_cfg, vae_params, lat)
-
-        img = jax.jit(fn)(self.unet_params, self.vae_params, text_emb,
-                          uncond_emb, noise, jnp.float32(guidance_scale))
+        img = self._jitted(num_steps)(
+            self.unet_params, self.vae_params, text_emb, uncond_emb, noise,
+            jnp.float32(guidance_scale))
         return np.asarray(img)
 
 

@@ -152,10 +152,11 @@ class Eigenvalue:
         + ``post_process`` (``/root/reference/deepspeed/runtime/eigenvalue.py:60-152``).
         """
         name, blocks, n_layer = self._blocks(params)
-        if self._iter_fn is None or self._iter_loss_fn is not loss_fn:
+        cache_key = (loss_fn, batch is not None)  # arity is part of the key
+        if self._iter_fn is None or self._iter_loss_fn != cache_key:
             self._iter_fn = self._build_iter_fn(loss_fn, name,
                                                 with_batch=batch is not None)
-            self._iter_loss_fn = loss_fn
+            self._iter_loss_fn = cache_key
         # the reference save/restores torch RNG state so the probe vector does
         # not perturb training randomness; a dedicated fixed key here is the
         # functional equivalent

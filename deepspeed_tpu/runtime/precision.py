@@ -108,8 +108,14 @@ def update_scaler(pc: PrecisionConfig, state: ScalerState, finite: jnp.ndarray) 
         grown = s.good_steps + 1 >= pc.scale_window
         new_scale = jnp.where(grown, s.scale * 2.0, s.scale)
         new_good = jnp.where(grown, 0, s.good_steps + 1)
-        hyst = (jnp.asarray(pc.hysteresis, jnp.int32)
-                if pc.consecutive_hysteresis else s.hysteresis)
+        full = jnp.asarray(pc.hysteresis, jnp.int32)
+        if pc.consecutive_hysteresis:
+            hyst = full  # refill after EVERY good step
+        else:
+            # reference default: the budget refills only at scale-growth
+            # boundaries (DynamicLossScaler.update_scale), so isolated
+            # overflows hours apart don't permanently strip the protection
+            hyst = jnp.where(grown, full, s.hysteresis)
         return ScalerState(scale=new_scale, good_steps=new_good,
                            hysteresis=hyst)
 

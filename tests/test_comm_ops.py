@@ -55,3 +55,25 @@ def test_scatter_distributes_src_chunks(devices):
 def test_monitored_barrier_returns_wait():
     dt = comm.monitored_barrier("test", timeout_s=10.0)
     assert dt >= 0.0
+
+
+def test_gather_scatter_support_pytrees(devices):
+    mesh = _mesh(devices)
+    x = {"a": jnp.arange(4, dtype=jnp.float32),
+         "b": jnp.arange(8, dtype=jnp.float32).reshape(4, 2)}
+
+    def g(xs):
+        return comm.gather(xs, "dp", dst_index=0)
+
+    out = shard_map(g, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))(x)
+    got_a = np.asarray(out["a"]).reshape(4, 4)
+    np.testing.assert_array_equal(got_a[0], [0, 1, 2, 3])
+
+    full = {"w": jnp.tile(jnp.arange(8, dtype=jnp.float32)[None], (4, 1))}
+
+    def sc(xs):
+        return comm.scatter({"w": xs["w"][0]}, "dp", src_index=0)
+
+    out2 = shard_map(sc, mesh=mesh, in_specs=P("dp", None),
+                     out_specs=P("dp"))(full)
+    np.testing.assert_array_equal(np.asarray(out2["w"]), np.arange(8))

@@ -318,3 +318,23 @@ def test_redundancy_clean_bakes_final_transform(rng):
     # no compression config: identity
     same = redundancy_clean(tree, {"compression_training": {}})
     np.testing.assert_array_equal(np.asarray(same["blocks"]["qkv_w"]), ref)
+
+
+def test_redundancy_clean_accepts_config_object(rng):
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.compression import redundancy_clean
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    tree = {"blocks": {"qkv_w": jnp.asarray(rng.normal(size=(1, 8, 8)),
+                                            jnp.float32)}}
+    cfg = DeepSpeedConfig(**{
+        "train_micro_batch_size_per_gpu": 1,
+        "compression_training": {
+            "weight_quantization": {
+                "shared_parameters": {"enabled": True, "schedule_offset": 0},
+                "different_groups": {
+                    "g0": {"params": {"start_bits": 4,
+                                      "quantize_groups": 1}}}}}})
+    out = redundancy_clean(tree, cfg)
+    assert not np.array_equal(np.asarray(out["blocks"]["qkv_w"]),
+                              np.asarray(tree["blocks"]["qkv_w"]))

@@ -156,3 +156,19 @@ def test_set_train_batch_size_adjusts_gas():
     assert np.isfinite(float(m["loss"]))
     with pytest.raises(ValueError, match="divisible"):
         engine.set_train_batch_size(12)
+
+
+def test_hysteresis_refills_at_scale_growth():
+    """Default (non-consecutive) hysteresis refills when the scale grows, so
+    isolated overflows far apart never permanently strip the protection."""
+    from deepspeed_tpu.runtime.precision import (
+        PrecisionConfig, init_scaler_state, update_scaler)
+
+    p = PrecisionConfig(compute_dtype=jnp.float16, master_weights=True,
+                        loss_scaling=True, hysteresis=2, scale_window=3)
+    s = init_scaler_state(p)
+    s = update_scaler(p, s, jnp.bool_(False))   # deplete: 2 -> 1
+    assert int(s.hysteresis) == 1
+    for _ in range(3):                          # ride to a growth boundary
+        s = update_scaler(p, s, jnp.bool_(True))
+    assert int(s.hysteresis) == 2               # refilled at growth

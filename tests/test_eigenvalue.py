@@ -188,3 +188,18 @@ def test_imperative_api_probes_curvature():
     engine.step()
     curv = np.asarray(engine.state["curvature"])
     assert curv.max() > 0.0  # forward/backward/step path probed too
+
+
+def test_batch_arity_is_part_of_the_cache_key():
+    params = {"blocks": {"w": jnp.ones((1, 4), jnp.float32)}}
+
+    def loss_fn(p, b=None):
+        w = p["blocks"]["w"]
+        base = 0.5 * jnp.sum(w * w)
+        return base if b is None else base * b
+
+    ev_obj = Eigenvalue(max_iter=10, tol=1e-3)
+    a = ev_obj.compute(loss_fn, params)                    # no batch
+    b = ev_obj.compute(loss_fn, params, batch=jnp.float32(2.0))  # with batch
+    np.testing.assert_allclose(a, [1.0])
+    np.testing.assert_allclose(b, [1.0])  # rebuilt with batch arity, no crash
