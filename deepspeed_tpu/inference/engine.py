@@ -191,16 +191,17 @@ class InferenceEngine:
 
     # ------------------------------------------------------------------ generate
     def generate(self, input_ids, max_new_tokens: Optional[int] = None,
-                 temperature: float = 0.0, top_k: int = 0,
+                 temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
                  eos_token_id: Optional[int] = None, seed: int = 0) -> np.ndarray:
-        """Autoregressive generation with KV cache; greedy when temperature==0.
+        """Autoregressive generation with KV cache; greedy when temperature==0,
+        else categorical with optional top-k and/or nucleus (top-p) filtering.
         Parity: the patched ``generate`` + per-token decode hot loop
         (``inference/engine.py:537``)."""
         input_ids = jnp.asarray(input_ids)
         B, T = input_ids.shape
         max_new = max_new_tokens or self.config.max_out_tokens
         key = jax.random.PRNGKey(seed)
-        gen_key = (B, T, max_new, temperature, top_k,
+        gen_key = (B, T, max_new, temperature, top_k, top_p,
                    -1 if eos_token_id is None else eos_token_id)
         if gen_key not in self._decode_fns:
             self._decode_fns[gen_key] = self._build_generate_fn(*gen_key)
@@ -214,7 +215,7 @@ class InferenceEngine:
         return out
 
     def _build_generate_fn(self, B: int, T: int, max_new: int, temperature: float,
-                           top_k: int, eos: int):
+                           top_k: int, top_p: float, eos: int):
         model = self.model
         dtype = self.dtype
         # cache sequence axis padded to a 128-multiple so the Pallas decode
@@ -229,6 +230,16 @@ class InferenceEngine:
             if top_k > 0:
                 kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
                 logits = jnp.where(logits < kth, -jnp.inf, logits)
+            if 0.0 < top_p < 1.0:
+                # nucleus: keep the smallest prefix of the sorted distribution
+                # whose mass reaches top_p (the kept set always includes the
+                # top token)
+                desc = jnp.sort(logits, axis=-1)[..., ::-1]
+                probs = jax.nn.softmax(desc, axis=-1)
+                exclusive_cum = jnp.cumsum(probs, axis=-1) - probs
+                kept = jnp.where(exclusive_cum >= top_p, jnp.inf, desc)
+                thr = jnp.min(kept, axis=-1, keepdims=True)
+                logits = jnp.where(logits < thr, -jnp.inf, logits)
             return jax.random.categorical(key, logits, axis=-1)
 
         def fn(params, input_ids, key):

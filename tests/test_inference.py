@@ -75,3 +75,26 @@ def test_init_inference_api(params, devices):
         model=for_gpt(CFG, params), config={"dtype": "float32"})
     logits = eng.forward(np.zeros((1, 8), np.int32))
     assert logits.shape == (1, 8, 128)
+
+
+def test_generate_top_p_nucleus_sampling():
+    """top_p ~ 0 degenerates to greedy; top_p = 0.999 still samples."""
+    from deepspeed_tpu.inference import DeepSpeedInferenceConfig, InferenceEngine
+    from deepspeed_tpu.inference.engine import for_gpt
+    from deepspeed_tpu.models import gpt as gpt_mod
+
+    cfg = gpt_mod.GPTConfig(vocab_size=128, d_model=32, n_layer=1, n_head=2,
+                            max_seq_len=64)
+    params = gpt_mod.init_params(cfg, jax.random.PRNGKey(0))
+    eng = InferenceEngine(for_gpt(cfg, params),
+                          DeepSpeedInferenceConfig(dtype="float32",
+                                                   max_out_tokens=40))
+    ids = np.random.default_rng(0).integers(0, 128, (1, 8), np.int32)
+    greedy = np.asarray(eng.generate(ids, max_new_tokens=8))
+    tiny_p = np.asarray(eng.generate(ids, max_new_tokens=8, temperature=1.0,
+                                     top_p=1e-6))
+    np.testing.assert_array_equal(tiny_p, greedy)  # nucleus of one = argmax
+    wide_p = np.asarray(eng.generate(ids, max_new_tokens=8, temperature=1.0,
+                                     top_p=0.999, seed=3))
+    assert wide_p.shape == greedy.shape
+    assert np.isfinite(wide_p).all()
