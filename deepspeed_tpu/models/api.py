@@ -57,6 +57,10 @@ class Module:
     partition_specs: Optional[Callable[[Any], Any]] = None
     to_pipeline: Optional[Callable[[int, int], "Module"]] = None
     pipelined: bool = False  # True: apply() already pipelines over the pp axis
+    # optional random-LTD rebuild: (keep, layer_ids) -> Module whose listed
+    # layers train on `keep`-token subsets (the engine calls it when the
+    # data_efficiency random_ltd schedule moves to a new compile bucket)
+    with_ltd_keep: Optional[Callable[[int, Tuple[int, ...]], "Module"]] = None
 
     def specs(self, param_shapes) -> Any:
         if self.partition_specs is None:

@@ -79,6 +79,12 @@ class GPTConfig:
     # the Pallas blocksparse kernel (graft via ops.sparse_attention.
     # sparse_attention_utils; parity: sparse_attention_utils.py:225)
     sparse_attention: Optional[Any] = None
+    # random-LTD (layer token dropping): the listed layers process only a
+    # random `random_ltd_keep`-token subset at train time, dropped tokens
+    # bypassing the layer (parity: data_routing/basic_layer.py:13; the engine
+    # drives `keep` from the scheduled data_efficiency config)
+    random_ltd_layer_ids: Tuple[int, ...] = ()
+    random_ltd_keep: Optional[int] = None
 
     @property
     def ffn_dim(self) -> int:
@@ -386,11 +392,30 @@ def forward(cfg: GPTConfig, params: Dict[str, Any], input_ids: jnp.ndarray,
         block_fn = jax.checkpoint(block_fn, policy=policy)
 
     sd = cfg.stochastic_depth if train else 0.0
+    use_ltd = (train and cfg.random_ltd_keep is not None
+               and cfg.random_ltd_keep < T and cfg.random_ltd_layer_ids)
+    ltd_ids = jnp.asarray(cfg.random_ltd_layer_ids or (0,), jnp.int32)
 
     def body(carry, layer_w):
         x, i = carry
         lrng = jax.random.fold_in(drng, i) if drng is not None else None
-        y = block_fn(x, layer_w, positions, lrng, i)
+        if use_ltd:
+            from ..runtime.data_pipeline.data_routing.random_ltd import (
+                random_ltd_gather, random_ltd_scatter)
+
+            def ltd_branch(xx):
+                krng = jax.random.fold_in(
+                    lrng if lrng is not None else jax.random.PRNGKey(0x17D), i)
+                kept, idx = random_ltd_gather(xx, cfg.random_ltd_keep, krng)
+                kept_pos = jnp.take_along_axis(positions, idx, axis=1)
+                out = block_fn(kept, layer_w, kept_pos, lrng, i)
+                return random_ltd_scatter(out, idx, xx)
+
+            y = jax.lax.cond(jnp.isin(i, ltd_ids), ltd_branch,
+                             lambda xx: block_fn(xx, layer_w, positions,
+                                                 lrng, i), x)
+        else:
+            y = block_fn(x, layer_w, positions, lrng, i)
         if sd > 0.0 and lrng is not None:
             # stochastic depth: drop the whole block with prob sd; the
             # surviving delta is scaled so eval needs no correction
@@ -655,10 +680,16 @@ def build(cfg_or_name) -> Tuple[Module, GPTConfig]:
         module, _ = gpt_pipe.build(cfg, num_stages, num_micro)
         return module
 
+    def with_ltd_keep(keep: int, layer_ids) -> Module:
+        return build(dataclasses.replace(
+            cfg, random_ltd_keep=int(keep),
+            random_ltd_layer_ids=tuple(layer_ids)))[0]
+
     return Module(
         init=functools.partial(init_params, cfg),
         apply=lambda params, batch, rngs=None, train=True: loss_fn(
             cfg, params, batch, rngs=rngs, train=train),
         partition_specs=functools.partial(partition_specs, cfg),
         to_pipeline=to_pipeline,
+        with_ltd_keep=with_ltd_keep,
     ), cfg

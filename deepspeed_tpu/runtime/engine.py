@@ -196,6 +196,31 @@ class DeepSpeedEngine:
 
             self.curriculum_scheduler = CurriculumScheduler(cl)
 
+        # random-LTD: scheduled layer token dropping (parity: the reference's
+        # convert_to_random_ltd + data_routing scheduler). The model's listed
+        # layers train on keep-token subsets; bucket changes rebuild the model
+        # via Module.with_ltd_keep and recompile (a few buckets per run).
+        self._random_ltd = None
+        self._ltd_keep = None
+        de = config.data_efficiency or {}
+        rl = de.get("data_routing", {}).get("random_ltd", {})
+        if (de.get("enabled") and de.get("data_routing", {}).get(
+                "enabled", True) and rl.get("enabled")):
+            from .data_pipeline.data_routing.random_ltd import (
+                RandomLTDScheduler)
+
+            if model.with_ltd_keep is None:
+                raise ValueError(
+                    "random_ltd requires a model with a with_ltd_keep rebuild "
+                    "hook (build_gpt provides one)")
+            self._random_ltd = RandomLTDScheduler(rl)
+            if not self._random_ltd.layer_ids:
+                n = int(rl.get("random_ltd_layer_num", 0))
+                total = int(rl.get("total_layer_num", n + 2))
+                # default sandwich: first/last layers stay dense
+                self._random_ltd.layer_ids = list(range(1, min(n + 1,
+                                                               total - 1)))
+
         # ZeRO-Offload: optimizer state in host RAM, stepped by the native C++
         # SIMD optimizer (runtime/zero/offload.py); device keeps bf16 params only
         self._offload = None
@@ -645,6 +670,7 @@ class DeepSpeedEngine:
             self._flops_profiler.print_model_profile(
                 profile_step=self.config.flops_profiler.profile_step,
                 output_file=self.config.flops_profiler.output_file)
+        self._apply_random_ltd()
         batch = self._apply_curriculum(batch)
         batch = self._place_batch(batch, leading_gas=True)
         runner = self._onebit or self._offload
@@ -661,6 +687,22 @@ class DeepSpeedEngine:
             self._update_curvature(batch)
         self.tput_timer.stop(sync_on=metrics["loss"])
         return metrics
+
+    def _apply_random_ltd(self) -> None:
+        """Move the model to the scheduled keep-token bucket when it changes
+        (each distinct keep value is one compile; seq_per_step quantization
+        bounds the bucket count)."""
+        if self._random_ltd is None:
+            return
+        keep = self._random_ltd.update(self.global_steps)
+        if keep == self._ltd_keep:
+            return
+        self._ltd_keep = keep
+        self.model = self.model.with_ltd_keep(
+            keep, tuple(self._random_ltd.layer_ids))
+        self._compile_steps()
+        log_dist(f"random_ltd: keep -> {keep} tokens "
+                 f"(layers {self._random_ltd.layer_ids})")
 
     def _update_curvature(self, placed_batch, leading_gas: bool = True) -> None:
         """Refresh the per-layer Hessian-eigenvalue vector at every

@@ -269,3 +269,65 @@ def test_data_analyzer_shards_merge_and_feed_curriculum(tmp_path, rng):
     batch = next(iter(sampler))
     assert level < 33  # curriculum still ramping at step 1
     assert all(lengths[i] <= level for i in batch)  # only easy-enough samples
+
+
+# ---------------------------------------------------- model/engine integration
+def test_gpt_random_ltd_layers_drop_tokens(rng):
+    import dataclasses
+
+    from deepspeed_tpu.models.gpt import GPTConfig, init_params, loss_fn
+
+    base = GPTConfig(vocab_size=64, d_model=32, n_layer=3, n_head=2,
+                     max_seq_len=32)
+    params = init_params(base, jax.random.PRNGKey(0))
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, 64, (2, 32), np.int32)}
+    dense, _ = loss_fn(base, params, batch, train=True)
+    ltd_cfg = dataclasses.replace(base, random_ltd_layer_ids=(1,),
+                                  random_ltd_keep=16)
+    ltd, _ = loss_fn(ltd_cfg, params, batch, train=True)
+    assert np.isfinite(float(ltd))
+    assert abs(float(ltd) - float(dense)) > 1e-7  # layer 1 saw fewer tokens
+    # eval path ignores LTD entirely
+    e1, _ = loss_fn(base, params, batch, train=False)
+    e2, _ = loss_fn(ltd_cfg, params, batch, train=False)
+    np.testing.assert_allclose(float(e1), float(e2), rtol=1e-6)
+    # gradients flow through the gather/scatter
+    g = jax.grad(lambda p: loss_fn(ltd_cfg, p, batch, train=True)[0])(params)
+    gsum = float(jax.tree_util.tree_reduce(
+        lambda a, b: a + jnp.abs(b).sum(), g, jnp.float32(0.0)))
+    assert np.isfinite(gsum) and gsum > 0
+
+
+def test_engine_random_ltd_schedule_rebuilds_buckets():
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_gpt
+    from deepspeed_tpu.models.gpt import GPTConfig
+
+    model, _ = build_gpt(GPTConfig(vocab_size=64, d_model=32, n_layer=3,
+                                   n_head=2, max_seq_len=32))
+    engine, _, _, _ = ds.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "mesh": {"dp": 8},
+        "steps_per_print": 0,
+        "data_efficiency": {
+            "enabled": True,
+            "data_routing": {"enabled": True, "random_ltd": {
+                "enabled": True,
+                "total_layer_num": 3, "random_ltd_layer_num": 1,
+                "random_ltd_schedule": {
+                    "min_value": 16, "max_value": 32,
+                    "schedule_type": "fixed_linear",
+                    "schedule_config": {"seq_per_step": 8,
+                                        "require_steps": 4}}}}},
+    })
+    b = {"input_ids": np.random.default_rng(0).integers(
+        0, 64, (8, 32), np.int32)}
+    keeps = []
+    for _ in range(6):
+        m = engine.train_batch(b)
+        assert np.isfinite(float(m["loss"]))
+        keeps.append(engine._ltd_keep)
+    assert keeps[0] == 16 and keeps[-1] == 32  # schedule walked the buckets
+    assert engine._random_ltd.layer_ids == [1]  # sandwich default
