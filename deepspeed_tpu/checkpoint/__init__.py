@@ -89,6 +89,18 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         }
         with open(os.path.join(ckpt_dir, "meta.json"), "w") as f:
             json.dump(meta, f, indent=2, default=str)
+        # standalone recovery script next to the data (parity: the reference
+        # auto-copies zero_to_fp32.py at engine.py:3388): weights are
+        # recoverable with numpy+msgpack alone, no framework install
+        try:
+            import shutil
+
+            from ..utils import zero_to_fp32 as _z2f
+
+            shutil.copyfile(_z2f.__file__,
+                            os.path.join(ckpt_dir, "zero_to_fp32.py"))
+        except Exception as e:  # never fail a save over the convenience copy
+            log_dist(f"zero_to_fp32.py copy skipped: {e}")
     # ZeRO-Offload: the fp32 master + moments live in host RAM/SSD on the runner.
     # Written BEFORE the 'latest' pointer so a crash in between can never leave a
     # resolvable tag with missing optimizer state.

@@ -105,3 +105,31 @@ def test_mid_accumulation_roundtrip(tmp_path, devices):
     l = e_b.forward(b1); e_b.backward(l); e_b.step()
     assert int(e_b.state["step"]) == 1
     tree_equal(e_ref.state["params"], e_b.state["params"])
+
+
+def test_checkpoint_embeds_standalone_recovery_script(tmp_path):
+    """Every checkpoint carries zero_to_fp32.py (parity: the reference's
+    auto-copy) and the copy runs standalone against its own directory."""
+    import os
+    import subprocess
+    import sys
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_gpt
+    from deepspeed_tpu.models.gpt import GPTConfig
+
+    model, _ = build_gpt(GPTConfig(vocab_size=64, d_model=32, n_layer=1,
+                                   n_head=2, max_seq_len=16))
+    engine, _, _, _ = ds.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "mesh": {"dp": 8}, "steps_per_print": 0})
+    engine.train_batch({"input_ids": np.zeros((8, 16), np.int32)})
+    ckpt = engine.save_checkpoint(str(tmp_path))
+    script = os.path.join(ckpt, "zero_to_fp32.py")
+    assert os.path.exists(script)
+    out = str(tmp_path / "fp32.npz")
+    p = subprocess.run([sys.executable, script, str(tmp_path), out],
+                       capture_output=True, text=True)
+    assert p.returncode == 0, p.stderr[-400:]
+    assert len(np.load(out).files) > 0
