@@ -85,6 +85,11 @@ class GPTConfig:
     # drives `keep` from the scheduled data_efficiency config)
     random_ltd_layer_ids: Tuple[int, ...] = ()
     random_ltd_keep: Optional[int] = None
+    # sequence-parallel attention over the sp mesh axis: "dense" lets GSPMD
+    # gather k/v (O(T) memory per chip); "ring" streams k/v blocks by
+    # collective-permute, "ulysses" all-to-alls heads<->sequence — the
+    # long-context memory savers (parallel/{ring_attention,ulysses}.py)
+    seq_parallel_impl: str = "dense"
 
     @property
     def ffn_dim(self) -> int:
@@ -303,6 +308,18 @@ def _attention_delta(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray],
 
         attn = _sparse(q, k_, v, cfg.sparse_attention, causal=True,
                        softmax_scale=cfg.attention_scale)
+    elif cfg.seq_parallel_impl in ("ring", "ulysses") and _sp_active():
+        if bias is not None:
+            raise ValueError(
+                f"seq_parallel_impl='{cfg.seq_parallel_impl}' cannot compose "
+                f"with alibi/local-window biases")
+        from ..parallel import ring_attention, ulysses_attention
+        from ..runtime.topology import get_topology
+
+        fn = (ring_attention if cfg.seq_parallel_impl == "ring"
+              else ulysses_attention)
+        attn = fn(q, k_, v, get_topology().mesh, causal=True,
+                  softmax_scale=cfg.attention_scale)
     else:
         attn = multihead_attention(q, k_, v, causal=True, bias=bias,
                                    use_flash=cfg.use_flash,
@@ -311,6 +328,18 @@ def _attention_delta(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray],
                                    block_k=cfg.flash_block_k)
     attn = attn.reshape(B, T, D)
     return checkpoint_name(attn @ w["attn_out_w"] + w["attn_out_b"], "attn_out")
+
+
+def _sp_active() -> bool:
+    """True when a topology with sp > 1 is bound (the ring/Ulysses paths
+    only make sense with the sequence dim actually sharded)."""
+    from ..runtime.topology import get_topology
+
+    try:
+        topo = get_topology()
+    except Exception:
+        return False
+    return topo is not None and topo.axes.get("sp", 1) > 1
 
 
 def _mlp_delta(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray]) -> jnp.ndarray:

@@ -47,11 +47,13 @@ def ulysses_attention(
     batch_axes=("dp", "ep"),
     head_axis: Optional[str] = "tp",
     attn_fn: Optional[Callable] = None,
+    softmax_scale: Optional[float] = None,
 ) -> jnp.ndarray:
     """Sequence-parallel attention via two all-to-alls. The head count must divide
     by the ``axis_name`` extent (times ``head_axis`` extent if TP-sharded)."""
     if attn_fn is None:
-        attn_fn = functools.partial(dot_product_attention, causal=causal)
+        attn_fn = functools.partial(dot_product_attention, causal=causal,
+                                    softmax_scale=softmax_scale)
     spec = P(batch_axes, axis_name, head_axis, None)
     body = functools.partial(_ulysses_local, attn_fn=attn_fn, axis_name=axis_name)
     return shard_map(

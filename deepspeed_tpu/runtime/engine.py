@@ -51,7 +51,7 @@ from .precision import (
     update_scaler,
     validate_comm_dtype,
 )
-from .topology import MeshTopology, mesh_context
+from .topology import MeshTopology, mesh_context, set_topology
 from .utils import clip_by_global_norm, count_parameters, global_norm
 from .zero.policy import ZeroShardingPolicy
 
@@ -82,6 +82,7 @@ class DeepSpeedEngine:
         m = config.mesh
         self.topo = topology or MeshTopology.create(dp=m.dp, tp=m.tp, pp=m.pp, ep=m.ep, sp=m.sp)
         self.mesh = self.topo.mesh
+        set_topology(self.topo)  # model-level sp dispatch reads the bound topo
         self.pc = PrecisionConfig.from_ds_config(config)
         self.policy = ZeroShardingPolicy(self.topo, config.zero_optimization)
         self.gas = int(config.gradient_accumulation_steps or 1)
