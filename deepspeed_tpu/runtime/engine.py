@@ -671,9 +671,15 @@ class DeepSpeedEngine:
             self._flops_profiler.print_model_profile(
                 profile_step=self.config.flops_profiler.profile_step,
                 output_file=self.config.flops_profiler.output_file)
+        wcb = self.wall_clock_breakdown()
         self._apply_random_ltd()
+        if wcb:
+            self.timers("batch_input").start()
         batch = self._apply_curriculum(batch)
         batch = self._place_batch(batch, leading_gas=True)
+        if wcb:
+            self.timers("batch_input").stop()
+            self.timers("train_batch").start()
         runner = self._onebit or self._offload
         if runner is not None:
             self.state, metrics = runner.train_batch(batch, self._next_rng())
@@ -681,11 +687,19 @@ class DeepSpeedEngine:
             with mesh_context(self.mesh):
                 self.state, metrics = self._train_batch_jit(
                     self.state, batch, self._next_rng())
+        if wcb:
+            # the fused program is one dispatch; fwd/bwd/step attribution
+            # inside it comes from jax.profiler traces (module docstring)
+            self.timers("train_batch").stop(sync_on=metrics["loss"])
         self.micro_steps += self.gas
         self._last_loss = metrics["loss"]
         self._finish_step(metrics)
         if self._eigenvalue is not None:
             self._update_curvature(batch)
+        if (wcb and self.config.steps_per_print and
+                self.global_steps % self.config.steps_per_print == 0):
+            # parity: the step-end timer breakdown (engine.py:2226-2241)
+            log_dist(self.timers.log(["batch_input", "train_batch"]))
         self.tput_timer.stop(sync_on=metrics["loss"])
         return metrics
 

@@ -202,3 +202,27 @@ def test_engine_emits_full_event_set():
     keys = {name for name, _, _ in events}
     assert {"Train/loss", "Train/lr", "Train/grad_norm",
             "Train/loss_scale"} <= keys
+
+
+def test_wall_clock_breakdown_logs_fused_timers(caplog, monkeypatch):
+    import logging
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_gpt
+    from deepspeed_tpu.models.gpt import GPTConfig
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+
+    model, _ = build_gpt(GPTConfig(vocab_size=64, d_model=32, n_layer=1,
+                                   n_head=2, max_seq_len=16))
+    engine, _, _, _ = ds.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "mesh": {"dp": 8},
+        "wall_clock_breakdown": True,
+        "steps_per_print": 1,
+    })
+    monkeypatch.setattr(ds_logger, "propagate", True)
+    with caplog.at_level(logging.INFO, logger=ds_logger.name):
+        engine.train_batch({"input_ids": np.zeros((8, 16), np.int32)})
+    joined = "\n".join(r.message for r in caplog.records)
+    assert "train_batch" in joined and "batch_input" in joined
