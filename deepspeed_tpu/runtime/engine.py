@@ -779,6 +779,12 @@ class DeepSpeedEngine:
                 f"lr={float(metrics['lr']):.3e} grad_norm={float(metrics['grad_norm']):.3f}")
 
     # ------------------------------------------------------------------ info surface
+    @property
+    def module(self):
+        """Parity alias: the reference exposes the wrapped model as
+        ``engine.module``."""
+        return self.model
+
     def get_global_grad_norm(self) -> float:
         return float(self._last_metrics.get("grad_norm", 0.0))
 
