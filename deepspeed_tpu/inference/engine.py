@@ -192,19 +192,31 @@ class InferenceEngine:
     # ------------------------------------------------------------------ generate
     def generate(self, input_ids, max_new_tokens: Optional[int] = None,
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
+                 num_beams: int = 1,
                  eos_token_id: Optional[int] = None, seed: int = 0) -> np.ndarray:
         """Autoregressive generation with KV cache; greedy when temperature==0,
-        else categorical with optional top-k and/or nucleus (top-p) filtering.
+        else categorical with optional top-k and/or nucleus (top-p) filtering;
+        ``num_beams > 1`` runs deterministic beam search (the HF-generate
+        capability the reference reaches by patching modules under HF's loop).
         Parity: the patched ``generate`` + per-token decode hot loop
         (``inference/engine.py:537``)."""
         input_ids = jnp.asarray(input_ids)
         B, T = input_ids.shape
         max_new = max_new_tokens or self.config.max_out_tokens
         key = jax.random.PRNGKey(seed)
-        gen_key = (B, T, max_new, temperature, top_k, top_p,
-                   -1 if eos_token_id is None else eos_token_id)
-        if gen_key not in self._decode_fns:
-            self._decode_fns[gen_key] = self._build_generate_fn(*gen_key)
+        eos = -1 if eos_token_id is None else eos_token_id
+        if num_beams > 1:
+            if temperature != 0.0 or top_k or top_p:
+                raise ValueError("beam search is deterministic; sampling "
+                                 "knobs cannot combine with num_beams > 1")
+            gen_key = (B, T, max_new, "beam", num_beams, eos)
+            if gen_key not in self._decode_fns:
+                self._decode_fns[gen_key] = self._build_beam_fn(
+                    B, T, max_new, num_beams, eos)
+        else:
+            gen_key = (B, T, max_new, temperature, top_k, top_p, eos)
+            if gen_key not in self._decode_fns:
+                self._decode_fns[gen_key] = self._build_generate_fn(*gen_key)
         fn = self._decode_fns[gen_key]
         t0 = time.perf_counter()
         with mesh_context(self.mesh):
@@ -267,6 +279,67 @@ class InferenceEngine:
 
         if self.config.enable_cuda_graph:
             return jax.jit(fn)  # compiled executable == captured graph
+        return fn
+
+    def _build_beam_fn(self, B: int, T: int, max_new: int, K: int, eos: int):
+        """Deterministic beam search as one compiled scan: K beams per row
+        share one [B*K]-row KV cache, reordered along the batch axis by a
+        gather at every step; finished beams continue on a zero-cost eos
+        lane. Returns the highest-scoring beam per row, same [B, T+max_new]
+        contract as the sampling path."""
+        model = self.model
+        dtype = self.dtype
+        total = -(-(T + max_new) // 128) * 128
+
+        def fn(params, input_ids, key):
+            del key  # beam search is deterministic
+            params = self._materialize(params)
+            ids_rep = jnp.repeat(input_ids, K, axis=0)  # [B*K, T]
+            cache = model.init_cache(B * K, total, dtype)
+            logits, cache = model.prefill(params, ids_rep, cache)
+            V = logits.shape[-1]
+            logp = jax.nn.log_softmax(
+                logits[:, -1, :].astype(jnp.float32)).reshape(B, K, V)
+            # beams are identical after prefill: diversify on the FIRST step
+            # by taking the row's top-K tokens
+            scores, toks = jax.lax.top_k(logp[:, 0, :], K)  # [B, K]
+            done = toks == eos
+            out = jnp.zeros((B, K, max_new), jnp.int32).at[:, :, 0].set(toks)
+            eos_lane = jnp.full((V,), -jnp.inf,
+                                jnp.float32).at[eos].set(0.0)
+
+            def body(carry, t):
+                cache, scores, toks, done, out = carry
+                logits, cache = model.prefill(params, toks.reshape(B * K, 1),
+                                              cache)
+                logp = jax.nn.log_softmax(
+                    logits[:, -1, :].astype(jnp.float32)).reshape(B, K, V)
+                logp = jnp.where(done[:, :, None], eos_lane[None, None, :],
+                                 logp)
+                flat = (scores[:, :, None] + logp).reshape(B, K * V)
+                new_scores, idx = jax.lax.top_k(flat, K)
+                src = idx // V   # which beam each winner extends
+                tok = idx % V
+                rows = (jnp.arange(B)[:, None] * K + src).reshape(-1)
+                cache = jax.tree_util.tree_map(
+                    lambda a: (jnp.take(a, rows, axis=1)
+                               if a.ndim >= 2 and a.shape[1] == B * K else a),
+                    cache)
+                out = jnp.take_along_axis(out, src[:, :, None], axis=1)
+                out = out.at[:, :, t].set(tok)
+                done = jnp.take_along_axis(done, src, axis=1) | (tok == eos)
+                return (cache, new_scores, tok, done, out), None
+
+            if max_new > 1:
+                (cache, scores, toks, done, out), _ = jax.lax.scan(
+                    body, (cache, scores, toks, done, out),
+                    jnp.arange(1, max_new))
+            best = jnp.argmax(scores, axis=1)
+            seq = jnp.take_along_axis(out, best[:, None, None], axis=1)[:, 0]
+            return jnp.concatenate([input_ids, seq], axis=1)
+
+        if self.config.enable_cuda_graph:
+            return jax.jit(fn)
         return fn
 
 

@@ -98,3 +98,40 @@ def test_generate_top_p_nucleus_sampling():
                                      top_p=0.999, seed=3))
     assert wide_p.shape == greedy.shape
     assert np.isfinite(wide_p).all()
+
+
+def test_beam_search_beats_or_matches_greedy_logprob():
+    """num_beams=1-equivalence and score dominance: the beam-4 sequence's
+    total logprob must be >= the greedy sequence's under the same model."""
+    from deepspeed_tpu.inference import DeepSpeedInferenceConfig, InferenceEngine
+    from deepspeed_tpu.inference.engine import for_gpt
+    from deepspeed_tpu.models import gpt as gpt_mod
+
+    cfg = gpt_mod.GPTConfig(vocab_size=64, d_model=32, n_layer=2, n_head=2,
+                            max_seq_len=96)
+    params = gpt_mod.init_params(cfg, jax.random.PRNGKey(1))
+    eng = InferenceEngine(for_gpt(cfg, params),
+                          DeepSpeedInferenceConfig(dtype="float32",
+                                                   max_out_tokens=48))
+    ids = np.random.default_rng(1).integers(0, 64, (2, 8), np.int32)
+    T, N = 8, 6
+    greedy = np.asarray(eng.generate(ids, max_new_tokens=N))
+    beam = np.asarray(eng.generate(ids, max_new_tokens=N, num_beams=4))
+    assert beam.shape == greedy.shape == (2, T + N)
+    np.testing.assert_array_equal(beam[:, :T], ids)
+
+    def seq_logprob(seq):
+        # score continuations under the dense forward
+        logits = gpt_mod.forward(cfg, params, jnp.asarray(seq), train=False)
+        logp = jax.nn.log_softmax(np.asarray(logits, np.float32), axis=-1)
+        tot = np.zeros(seq.shape[0])
+        for b in range(seq.shape[0]):
+            for t in range(T - 1, T + N - 1):
+                tot[b] += float(logp[b, t, seq[b, t + 1]])
+        return tot
+
+    g, bm = seq_logprob(greedy), seq_logprob(beam)
+    assert (bm >= g - 1e-4).all(), (bm, g)
+
+    with pytest.raises(ValueError, match="deterministic"):
+        eng.generate(ids, max_new_tokens=4, num_beams=2, temperature=1.0)
