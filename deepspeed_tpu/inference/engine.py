@@ -192,7 +192,7 @@ class InferenceEngine:
     # ------------------------------------------------------------------ generate
     def generate(self, input_ids, max_new_tokens: Optional[int] = None,
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 0.0,
-                 num_beams: int = 1,
+                 num_beams: int = 1, repetition_penalty: float = 1.0,
                  eos_token_id: Optional[int] = None, seed: int = 0) -> np.ndarray:
         """Autoregressive generation with KV cache; greedy when temperature==0,
         else categorical with optional top-k and/or nucleus (top-p) filtering;
@@ -206,7 +206,7 @@ class InferenceEngine:
         key = jax.random.PRNGKey(seed)
         eos = -1 if eos_token_id is None else eos_token_id
         if num_beams > 1:
-            if temperature != 0.0 or top_k or top_p:
+            if temperature != 0.0 or top_k or top_p or repetition_penalty != 1.0:
                 raise ValueError("beam search is deterministic; sampling "
                                  "knobs cannot combine with num_beams > 1")
             gen_key = (B, T, max_new, "beam", num_beams, eos)
@@ -214,7 +214,8 @@ class InferenceEngine:
                 self._decode_fns[gen_key] = self._build_beam_fn(
                     B, T, max_new, num_beams, eos)
         else:
-            gen_key = (B, T, max_new, temperature, top_k, top_p, eos)
+            gen_key = (B, T, max_new, temperature, top_k, top_p,
+                       repetition_penalty, eos)
             if gen_key not in self._decode_fns:
                 self._decode_fns[gen_key] = self._build_generate_fn(*gen_key)
         fn = self._decode_fns[gen_key]
@@ -227,13 +228,23 @@ class InferenceEngine:
         return out
 
     def _build_generate_fn(self, B: int, T: int, max_new: int, temperature: float,
-                           top_k: int, top_p: float, eos: int):
+                           top_k: int, top_p: float,
+                           repetition_penalty: float, eos: int):
         model = self.model
         dtype = self.dtype
         # cache sequence axis padded to a 128-multiple so the Pallas decode
         # kernel's (block_k, Dh) tiles stay sublane-aligned; the validity mask
         # makes the padding inert
         total = -(-(T + max_new) // 128) * 128
+
+        def penalize(logits, seen):
+            # CTRL-style repetition penalty: seen tokens' logits shrink
+            # toward improbability (divide if positive, multiply if negative)
+            if repetition_penalty == 1.0:
+                return logits
+            p = repetition_penalty
+            pen = jnp.where(logits > 0, logits / p, logits * p)
+            return jnp.where(seen, pen, logits)
 
         def sample(logits, key):
             if temperature == 0.0:
@@ -258,20 +269,27 @@ class InferenceEngine:
             params = self._materialize(params)
             cache = model.init_cache(B, total, dtype)
             logits, cache = model.prefill(params, input_ids, cache)
-            next_tok = sample(logits[:, -1, :], key)
+            V = logits.shape[-1]
+            seen = jnp.zeros((B, V), bool)
+            if repetition_penalty != 1.0:
+                seen = seen.at[jnp.arange(B)[:, None], input_ids].set(True)
+            next_tok = sample(penalize(logits[:, -1, :], seen), key)
+            seen = seen.at[jnp.arange(B), next_tok].set(True)
             done = (next_tok == eos)
 
             def body(carry, step_key):
-                cache, tok, done = carry
+                cache, tok, done, seen = carry
                 logits, cache = model.prefill(params, tok[:, None], cache)
-                nxt = sample(logits[:, -1, :], step_key)
+                nxt = sample(penalize(logits[:, -1, :], seen), step_key)
                 nxt = jnp.where(done, tok, nxt)  # freeze finished rows
+                seen = seen.at[jnp.arange(B), nxt].set(True)
                 done = done | (nxt == eos)
-                return (cache, nxt, done), nxt
+                return (cache, nxt, done, seen), nxt
 
             if max_new > 1:
                 keys = jax.random.split(key, max_new - 1)
-                (_, _, _), toks = jax.lax.scan(body, (cache, next_tok, done), keys)
+                (_, _, _, _), toks = jax.lax.scan(
+                    body, (cache, next_tok, done, seen), keys)
                 gen = jnp.concatenate([next_tok[:, None], toks.T], axis=1)
             else:
                 gen = next_tok[:, None]

@@ -135,3 +135,23 @@ def test_beam_search_beats_or_matches_greedy_logprob():
 
     with pytest.raises(ValueError, match="deterministic"):
         eng.generate(ids, max_new_tokens=4, num_beams=2, temperature=1.0)
+
+
+def test_repetition_penalty_reduces_repeats():
+    from deepspeed_tpu.inference import DeepSpeedInferenceConfig, InferenceEngine
+    from deepspeed_tpu.inference.engine import for_gpt
+    from deepspeed_tpu.models import gpt as gpt_mod
+
+    cfg = gpt_mod.GPTConfig(vocab_size=32, d_model=16, n_layer=1, n_head=2,
+                            max_seq_len=96)
+    params = gpt_mod.init_params(cfg, jax.random.PRNGKey(0))
+    eng = InferenceEngine(for_gpt(cfg, params),
+                          DeepSpeedInferenceConfig(dtype="float32",
+                                                   max_out_tokens=64))
+    ids = np.zeros((1, 4), np.int32)
+    plain = np.asarray(eng.generate(ids, max_new_tokens=24))[0, 4:]
+    pen = np.asarray(eng.generate(ids, max_new_tokens=24,
+                                  repetition_penalty=5.0))[0, 4:]
+    # a tiny random model degenerates into loops greedily; a strong penalty
+    # must strictly increase the distinct-token count
+    assert len(np.unique(pen)) > len(np.unique(plain))
