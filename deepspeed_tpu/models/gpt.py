@@ -314,11 +314,10 @@ def _attention_delta(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray],
                 f"seq_parallel_impl='{cfg.seq_parallel_impl}' cannot compose "
                 f"with alibi/local-window biases")
         from ..parallel import ring_attention, ulysses_attention
-        from ..runtime.topology import get_topology
 
         fn = (ring_attention if cfg.seq_parallel_impl == "ring"
               else ulysses_attention)
-        attn = fn(q, k_, v, get_topology().mesh, causal=True,
+        attn = fn(q, k_, v, _bound_mesh(), causal=True,
                   softmax_scale=cfg.attention_scale)
     else:
         attn = multihead_attention(q, k_, v, causal=True, bias=bias,
@@ -330,16 +329,31 @@ def _attention_delta(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray],
     return checkpoint_name(attn @ w["attn_out_w"] + w["attn_out_b"], "attn_out")
 
 
-def _sp_active() -> bool:
-    """True when a topology with sp > 1 is bound (the ring/Ulysses paths
-    only make sense with the sequence dim actually sharded)."""
+def _bound_mesh():
+    """The mesh governing the CURRENT trace: the engine traces its programs
+    inside ``mesh_context(engine.mesh)``, so the thread-resources mesh is the
+    right one even when several engines with different topologies coexist
+    (a process-global would go stale). Falls back to the default topology for
+    direct (non-engine) calls."""
+    from jax._src import mesh as mesh_lib
+
+    pm = mesh_lib.thread_resources.env.physical_mesh
+    if pm is not None and not pm.empty:
+        return pm
     from ..runtime.topology import get_topology
 
     try:
         topo = get_topology()
     except Exception:
-        return False
-    return topo is not None and topo.axes.get("sp", 1) > 1
+        return None
+    return topo.mesh if topo is not None else None
+
+
+def _sp_active() -> bool:
+    """True when the trace-bound mesh has sp > 1 (the ring/Ulysses paths only
+    make sense with the sequence dim actually sharded)."""
+    mesh = _bound_mesh()
+    return mesh is not None and dict(mesh.shape).get("sp", 1) > 1
 
 
 def _mlp_delta(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray]) -> jnp.ndarray:

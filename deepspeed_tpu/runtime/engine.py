@@ -214,6 +214,15 @@ class DeepSpeedEngine:
                 raise ValueError(
                     "random_ltd requires a model with a with_ltd_keep rebuild "
                     "hook (build_gpt provides one)")
+            if (self._onebit is not None
+                    or config.zero_optimization.offload_optimizer_device
+                    in ("cpu", "nvme")):
+                # those runners cache programs traced from the FIRST model;
+                # a bucket change would silently freeze the keep schedule
+                raise ValueError(
+                    "random_ltd is not supported together with ZeRO-Offload "
+                    "or 1-bit optimizers (their compiled programs cannot "
+                    "follow the keep-schedule's model rebuilds)")
             self._random_ltd = RandomLTDScheduler(rl)
             if not self._random_ltd.layer_ids:
                 n = int(rl.get("random_ltd_layer_num", 0))
@@ -221,6 +230,11 @@ class DeepSpeedEngine:
                 # default sandwich: first/last layers stay dense
                 self._random_ltd.layer_ids = list(range(1, min(n + 1,
                                                                total - 1)))
+            if not self._random_ltd.layer_ids:
+                raise ValueError(
+                    "random_ltd resolved ZERO layers to drop tokens in — set "
+                    "random_ltd_layer_id or a positive random_ltd_layer_num "
+                    "(a silently inert schedule would still log transitions)")
 
         # ZeRO-Offload: optimizer state in host RAM, stepped by the native C++
         # SIMD optimizer (runtime/zero/offload.py); device keeps bf16 params only

@@ -331,3 +331,33 @@ def test_engine_random_ltd_schedule_rebuilds_buckets():
         keeps.append(engine._ltd_keep)
     assert keeps[0] == 16 and keeps[-1] == 32  # schedule walked the buckets
     assert engine._random_ltd.layer_ids == [1]  # sandwich default
+
+
+def test_random_ltd_refuses_inert_and_runner_configs():
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_gpt
+    from deepspeed_tpu.models.gpt import GPTConfig
+
+    def make(extra_rl=None, extra_cfg=None):
+        model, _ = build_gpt(GPTConfig(vocab_size=64, d_model=32, n_layer=3,
+                                       n_head=2, max_seq_len=32))
+        rl = {"enabled": True,
+              "random_ltd_schedule": {"min_value": 16, "max_value": 32,
+                                      "schedule_config": {"seq_per_step": 8,
+                                                          "require_steps": 4}}}
+        rl.update(extra_rl or {})
+        return ds.initialize(model=model, config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "mesh": {"dp": 8}, "steps_per_print": 0,
+            "data_efficiency": {"enabled": True,
+                                "data_routing": {"enabled": True,
+                                                 "random_ltd": rl}},
+            **(extra_cfg or {})})
+
+    with pytest.raises(ValueError, match="ZERO layers"):
+        make()  # no layer_num/layer_id -> inert; refuse
+    with pytest.raises(ValueError, match="ZeRO-Offload"):
+        make(extra_rl={"random_ltd_layer_num": 1, "total_layer_num": 3},
+             extra_cfg={"zero_optimization": {
+                 "stage": 2, "offload_optimizer": {"device": "cpu"}}})

@@ -120,3 +120,45 @@ def test_engine_sp_ring_and_ulysses_match_dense(devices):
     uly = loss_for("ulysses")
     np.testing.assert_allclose(ring, dense, rtol=2e-5)
     np.testing.assert_allclose(uly, dense, rtol=2e-5)
+
+
+def test_sp_dispatch_survives_a_second_engine(devices):
+    """A later engine binding a different topology must NOT downgrade a ring
+    SP engine to dense attention: dispatch reads the trace-bound mesh."""
+    import dataclasses
+    from unittest import mock
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu import parallel as par
+    from deepspeed_tpu.models import build_gpt
+    from deepspeed_tpu.models.gpt import GPTConfig
+    from deepspeed_tpu.runtime.topology import MeshTopology
+
+    base = GPTConfig(vocab_size=64, d_model=32, n_layer=1, n_head=4,
+                     max_seq_len=32, use_flash=False)
+    model, _ = build_gpt(dataclasses.replace(base, seq_parallel_impl="ring"))
+    ring_engine, _, _, _ = ds.initialize(
+        model=model,
+        topology=MeshTopology.create(dp=4, sp=2, devices=devices),
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "mesh": {"dp": 4, "sp": 2}, "steps_per_print": 0})
+    # a second, dp-only engine rebinds the global default topology
+    other, _, _, _ = ds.initialize(
+        model=build_gpt(base)[0],
+        topology=MeshTopology.create(dp=8, devices=devices),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "mesh": {"dp": 8}, "steps_per_print": 0})
+    calls = {"n": 0}
+    real = par.ring_attention
+
+    def spy(*a, **k):
+        calls["n"] += 1
+        return real(*a, **k)
+
+    with mock.patch("deepspeed_tpu.parallel.ring_attention", side_effect=spy):
+        b = {"input_ids": np.zeros((8, 32), np.int32)}
+        m = ring_engine.train_batch(b)
+    assert np.isfinite(float(m["loss"]))
+    assert calls["n"] > 0  # the ring path actually traced
