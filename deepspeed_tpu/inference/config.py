@@ -46,7 +46,10 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     quant: QuantizationConfig = Field(default_factory=QuantizationConfig)
     max_out_tokens: int = Field(1024, alias="max_tokens")
     min_out_tokens: int = 1
-    max_batch_size: int = 1
+    # 0 = unbounded (the engine compiles per batch shape anyway); a positive
+    # value is ENFORCED at generate() — unlike the reference, which accepts
+    # the field but never checks it
+    max_batch_size: int = 0
     replace_with_kernel_inject: bool = Field(False, alias="kernel_inject")
     enable_cuda_graph: bool = True  # TPU analog: AOT-compiled fixed-shape decode step
     replace_method: str = "auto"

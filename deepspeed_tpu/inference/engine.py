@@ -202,7 +202,16 @@ class InferenceEngine:
         (``inference/engine.py:537``)."""
         input_ids = jnp.asarray(input_ids)
         B, T = input_ids.shape
+        if self.config.max_batch_size and B > self.config.max_batch_size:
+            raise ValueError(
+                f"batch {B} exceeds max_batch_size "
+                f"{self.config.max_batch_size} (the workspace bound the "
+                f"engine was configured for)")
         max_new = max_new_tokens or self.config.max_out_tokens
+        if max_new < self.config.min_out_tokens:
+            raise ValueError(
+                f"max_new_tokens {max_new} < min_out_tokens "
+                f"{self.config.min_out_tokens}")
         key = jax.random.PRNGKey(seed)
         eos = -1 if eos_token_id is None else eos_token_id
         if num_beams > 1:

@@ -877,7 +877,16 @@ class DeepSpeedEngine:
         """Gather the full 16-bit weights to host and write one consolidated
         file. Parity: ``engine.save_16bit_model`` / the stage-3 consolidated
         save (``runtime/engine.py:3410,3480``) — here every ZeRO stage gathers
-        the same way (leaves are logical arrays; device_get resolves shards)."""
+        the same way (leaves are logical arrays; device_get resolves shards).
+        Under stage 3 the gather must be opted into, as in the reference
+        (which returns False and saves nothing without the flag — an error
+        beats that silent skip)."""
+        if (self.policy.stage == 3 and not
+                self.config.zero_optimization.stage3_gather_16bit_weights_on_model_save):
+            raise ValueError(
+                "save_16bit_model under ZeRO-3 requires "
+                "stage3_gather_16bit_weights_on_model_save=true (the gather "
+                "materializes the full model on host)")
         from ..checkpoint.serialization import (
             _UINT_FOR_SIZE,
             _fetch_full,

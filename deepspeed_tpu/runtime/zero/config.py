@@ -83,9 +83,6 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     stage3_gather_16bit_weights_on_model_save: bool = False
     ignore_unused_parameters: bool = True
     round_robin_gradients: bool = False
-    zero_hpz_partition_size: int = Field(1, ge=0)
-    zero_quantized_weights: bool = False
-    zero_quantized_gradients: bool = False
 
     def model_post_init(self, __context) -> None:
         # legacy cpu_offload=true means offload_optimizer={"device": "cpu"}

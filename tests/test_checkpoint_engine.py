@@ -146,3 +146,17 @@ def test_save_16bit_model(tmp_path):
         arr = d[wte_key].view(ml_dtypes.bfloat16)
         np.testing.assert_array_equal(
             arr, np.asarray(engine.state["params"]["wte"]))
+
+
+def test_save_16bit_model_stage3_requires_flag(tmp_path):
+    engine, cfg = _engine({"bf16": {"enabled": True},
+                           "zero_optimization": {"stage": 3}})
+    engine.train_batch(_batch(cfg))
+    with pytest.raises(ValueError, match="stage3_gather_16bit"):
+        engine.save_16bit_model(str(tmp_path))
+    engine2, cfg2 = _engine({
+        "bf16": {"enabled": True},
+        "zero_optimization": {
+            "stage": 3, "stage3_gather_16bit_weights_on_model_save": True}})
+    engine2.train_batch(_batch(cfg2))
+    assert os.path.exists(engine2.save_16bit_model(str(tmp_path)))
