@@ -78,3 +78,25 @@ def test_ep_generate_expert_sharding_is_real(moe_params):
     spec = tuple(up_w.sharding.spec)
     assert "ep" in str(spec), spec
     assert not up_w.sharding.is_fully_replicated
+
+
+def test_moe_beam_search_runs():
+    """Beam search's cache-reorder gather works on the MoE cache stacks too
+    (both [L, B, H, S, Dh] layouts, batch axis 1)."""
+    from deepspeed_tpu.inference import DeepSpeedInferenceConfig, InferenceEngine
+    from deepspeed_tpu.inference.engine import for_gpt_moe
+    from deepspeed_tpu.models import gpt_moe
+    from deepspeed_tpu.models.gpt import GPTConfig
+
+    cfg = gpt_moe.GPTMoEConfig(
+        base=GPTConfig(vocab_size=64, d_model=32, n_layer=2, n_head=2,
+                       max_seq_len=96),
+        num_experts=2, moe_freq=2)
+    params = gpt_moe.init_params(cfg, jax.random.PRNGKey(0))
+    eng = InferenceEngine(for_gpt_moe(cfg, params),
+                          DeepSpeedInferenceConfig(dtype="float32",
+                                                   max_out_tokens=32))
+    ids = np.random.default_rng(0).integers(0, 64, (1, 6), np.int32)
+    out = np.asarray(eng.generate(ids, max_new_tokens=5, num_beams=3))
+    assert out.shape == (1, 11)
+    np.testing.assert_array_equal(out[:, :6], ids)
