@@ -193,6 +193,26 @@ def pooled_output(params, hidden: jnp.ndarray) -> jnp.ndarray:
                     + params["pooler_b"].astype(cls.dtype))
 
 
+def init_classifier(cfg: BertConfig, num_labels: int,
+                    rng: jax.Array) -> Dict[str, jnp.ndarray]:
+    """Sentence-task head over the pooled [CLS] (parity: the fine-tuning
+    surface the reference's fused BERT kernel targets — SQuAD/GLUE heads)."""
+    w = jax.random.normal(rng, (cfg.d_model, num_labels), jnp.float32) * 0.02
+    return {"cls_w": w, "cls_b": jnp.zeros((num_labels,))}
+
+
+def classification_logits(cfg: BertConfig, params, head,
+                          input_ids: jnp.ndarray,
+                          attention_mask=None,
+                          token_type_ids=None) -> jnp.ndarray:
+    """[B, num_labels] logits from pooled encoder output."""
+    hidden = encode(cfg, params, input_ids, attention_mask=attention_mask,
+                    token_type_ids=token_type_ids)
+    pooled = pooled_output(params, hidden)
+    return pooled @ head["cls_w"].astype(pooled.dtype) + \
+        head["cls_b"].astype(pooled.dtype)
+
+
 def mlm_loss(cfg: BertConfig, params, batch: Dict[str, jnp.ndarray],
              rngs=None, train: bool = True):
     """Masked-LM cross-entropy; labels==-100 positions are ignored (HF

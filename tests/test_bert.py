@@ -88,3 +88,28 @@ def test_bert_import_matches_hf(rng):
                        attention_mask=torch.from_numpy(am).long(),
                        token_type_ids=torch.from_numpy(tt).long()).logits.numpy()
     np.testing.assert_allclose(ours, theirs, atol=2e-3, rtol=1e-3)
+
+
+def test_classification_head_trains():
+    from deepspeed_tpu.models.bert import (
+        BertConfig, classification_logits, init_classifier, init_params)
+
+    cfg = BertConfig(vocab_size=64, d_model=32, n_layer=1, n_head=2,
+                     max_seq_len=16)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    head = init_classifier(cfg, 3, jax.random.PRNGKey(1))
+    ids = np.random.default_rng(0).integers(0, 64, (4, 16), np.int32)
+    labels = np.asarray([0, 1, 2, 1])
+
+    def loss_fn(h):
+        logits = classification_logits(cfg, params, h, jnp.asarray(ids))
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        return -lp[jnp.arange(4), labels].mean()
+
+    l0 = float(loss_fn(head))
+    g = jax.grad(loss_fn)(head)
+    head2 = jax.tree_util.tree_map(lambda p, gg: p - 0.5 * gg, head, g)
+    assert float(loss_fn(head2)) < l0  # the head learns
+    logits = classification_logits(cfg, params, head, jnp.asarray(ids),
+                                   attention_mask=np.ones((4, 16), np.int32))
+    assert logits.shape == (4, 3)
