@@ -83,6 +83,9 @@ def main():
         json.dumps({"kind": "diffusion", "name": "sd-ddim20", "latent": 32,
                     "ddim_steps": 20})], 1500))
     save()
+    results.append(run("int8-hbm", [
+        sys.executable, os.path.join(REPO, "scripts", "int8_hbm.py")], 1500))
+    save()
     print(f"[chip_session] done -> {OUT}")
 
 
