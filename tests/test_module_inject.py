@@ -442,3 +442,36 @@ def test_imported_gpt2_greedy_generate_matches_hf():
                              pad_token_id=0).numpy()
     ours = np.asarray(eng.generate(ids, max_new_tokens=8))
     np.testing.assert_array_equal(ours, theirs)
+
+
+@pytest.mark.parametrize("family", ["gptneox", "opt"])
+def test_imported_model_greedy_generate_matches_hf(family):
+    """Rope (NeoX) and offset-positions (OPT) decode paths also reproduce
+    HF's greedy generate on imported weights."""
+    import torch
+
+    from deepspeed_tpu.inference import DeepSpeedInferenceConfig, InferenceEngine
+    from deepspeed_tpu.inference.engine import for_gpt
+    from deepspeed_tpu.module_inject import import_hf_model
+
+    torch.manual_seed(1)
+    if family == "gptneox":
+        hf = transformers.GPTNeoXForCausalLM(transformers.GPTNeoXConfig(
+            vocab_size=96, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=2, intermediate_size=64,
+            max_position_embeddings=64, rotary_pct=1.0)).eval()
+    else:
+        hf = transformers.OPTForCausalLM(transformers.OPTConfig(
+            vocab_size=96, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=2, ffn_dim=64,
+            max_position_embeddings=64, do_layer_norm_before=True)).eval()
+    cfg, params = import_hf_model(hf)
+    eng = InferenceEngine(for_gpt(cfg, params),
+                          DeepSpeedInferenceConfig(dtype="float32",
+                                                   max_out_tokens=40))
+    ids = np.random.default_rng(4).integers(5, 90, (1, 6), np.int32)
+    with torch.no_grad():
+        theirs = hf.generate(torch.from_numpy(ids).long(), max_new_tokens=6,
+                             do_sample=False, pad_token_id=0).numpy()
+    ours = np.asarray(eng.generate(ids, max_new_tokens=6))
+    np.testing.assert_array_equal(ours, theirs)
