@@ -444,7 +444,7 @@ def test_imported_gpt2_greedy_generate_matches_hf():
     np.testing.assert_array_equal(ours, theirs)
 
 
-@pytest.mark.parametrize("family", ["gptneox", "opt"])
+@pytest.mark.parametrize("family", ["gptneox", "opt", "bloom", "gptj"])
 def test_imported_model_greedy_generate_matches_hf(family):
     """Rope (NeoX) and offset-positions (OPT) decode paths also reproduce
     HF's greedy generate on imported weights."""
@@ -460,11 +460,18 @@ def test_imported_model_greedy_generate_matches_hf(family):
             vocab_size=96, hidden_size=32, num_hidden_layers=2,
             num_attention_heads=2, intermediate_size=64,
             max_position_embeddings=64, rotary_pct=1.0)).eval()
-    else:
+    elif family == "opt":
         hf = transformers.OPTForCausalLM(transformers.OPTConfig(
             vocab_size=96, hidden_size=32, num_hidden_layers=2,
             num_attention_heads=2, ffn_dim=64,
             max_position_embeddings=64, do_layer_norm_before=True)).eval()
+    elif family == "bloom":
+        hf = transformers.BloomForCausalLM(transformers.BloomConfig(
+            vocab_size=96, hidden_size=32, n_layer=2, n_head=2)).eval()
+    else:
+        hf = transformers.GPTJForCausalLM(transformers.GPTJConfig(
+            vocab_size=96, n_embd=32, n_layer=2, n_head=2, rotary_dim=16,
+            n_positions=64)).eval()
     cfg, params = import_hf_model(hf)
     eng = InferenceEngine(for_gpt(cfg, params),
                           DeepSpeedInferenceConfig(dtype="float32",
