@@ -444,7 +444,7 @@ def test_imported_gpt2_greedy_generate_matches_hf():
     np.testing.assert_array_equal(ours, theirs)
 
 
-@pytest.mark.parametrize("family", ["gptneox", "opt", "bloom", "gptj"])
+@pytest.mark.parametrize("family", ["gptneox", "opt", "bloom", "gptj", "gptneo"])
 def test_imported_model_greedy_generate_matches_hf(family):
     """Rope (NeoX) and offset-positions (OPT) decode paths also reproduce
     HF's greedy generate on imported weights."""
@@ -468,10 +468,15 @@ def test_imported_model_greedy_generate_matches_hf(family):
     elif family == "bloom":
         hf = transformers.BloomForCausalLM(transformers.BloomConfig(
             vocab_size=96, hidden_size=32, n_layer=2, n_head=2)).eval()
-    else:
+    elif family == "gptj":
         hf = transformers.GPTJForCausalLM(transformers.GPTJConfig(
             vocab_size=96, n_embd=32, n_layer=2, n_head=2, rotary_dim=16,
             n_positions=64)).eval()
+    else:
+        hf = transformers.GPTNeoForCausalLM(transformers.GPTNeoConfig(
+            vocab_size=96, hidden_size=32, num_layers=2, num_heads=2,
+            attention_types=[[["global", "local"], 1]], window_size=4,
+            max_position_embeddings=64)).eval()
     cfg, params = import_hf_model(hf)
     eng = InferenceEngine(for_gpt(cfg, params),
                           DeepSpeedInferenceConfig(dtype="float32",
