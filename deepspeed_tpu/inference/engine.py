@@ -207,7 +207,8 @@ class InferenceEngine:
                 f"batch {B} exceeds max_batch_size "
                 f"{self.config.max_batch_size} (the workspace bound the "
                 f"engine was configured for)")
-        max_new = max_new_tokens or self.config.max_out_tokens
+        max_new = (self.config.max_out_tokens if max_new_tokens is None
+                   else max_new_tokens)
         if max_new < self.config.min_out_tokens:
             raise ValueError(
                 f"max_new_tokens {max_new} < min_out_tokens "

@@ -176,3 +176,19 @@ def test_generate_enforces_batch_and_token_bounds():
         eng.generate(np.zeros((1, 4), np.int32), max_new_tokens=2)
     out = eng.generate(np.zeros((2, 4), np.int32), max_new_tokens=4)
     assert out.shape == (2, 8)
+
+
+def test_generate_zero_max_new_tokens_rejected():
+    from deepspeed_tpu.inference import DeepSpeedInferenceConfig, InferenceEngine
+    from deepspeed_tpu.inference.engine import for_gpt
+    from deepspeed_tpu.models import gpt as gpt_mod
+
+    cfg = gpt_mod.GPTConfig(vocab_size=32, d_model=16, n_layer=1, n_head=2,
+                            max_seq_len=64)
+    params = gpt_mod.init_params(cfg, jax.random.PRNGKey(0))
+    eng = InferenceEngine(for_gpt(cfg, params),
+                          DeepSpeedInferenceConfig(dtype="float32",
+                                                   max_out_tokens=32,
+                                                   min_out_tokens=1))
+    with pytest.raises(ValueError, match="min_out_tokens"):
+        eng.generate(np.zeros((1, 4), np.int32), max_new_tokens=0)
