@@ -93,3 +93,13 @@ def test_int8_with_tensor_parallel(params, rng):
     ids = rng.integers(0, 64, size=(1, 6)).astype(np.int32)
     out = eng.generate(ids, max_new_tokens=4)
     assert out.shape == (1, 10)
+
+
+def test_int8_beam_search_runs(params):
+    """Beam search composes with per-layer int8 weights (cache reorder only
+    touches the KV stacks; quantized {'q','s'} leaves pass through)."""
+    eng = _engine(params, quant=True)
+    ids = np.random.default_rng(0).integers(0, CFG.vocab_size, (1, 6), np.int32)
+    out = np.asarray(eng.generate(ids, max_new_tokens=4, num_beams=3))
+    assert out.shape == (1, 10)
+    np.testing.assert_array_equal(out[:, :6], ids)
