@@ -311,32 +311,51 @@ def _worker_infer(cfg: dict) -> dict:
 
 
 def _worker_diffusion(cfg: dict) -> dict:
-    """Stable-Diffusion-family latent inference (BASELINE.json config #5):
+    """Stable-Diffusion latent inference (BASELINE.json config #5) on the
+    FAITHFUL SD-1.x architecture (CrossAttn UNet + AutoencoderKL decoder):
     full DDIM scan + CFG + VAE decode as one compiled program; reports
-    per-image latency."""
-    import dataclasses as _dc
-
+    per-image latency. ``arch: "skeleton"`` selects the lightweight model."""
     import numpy as np
 
     import jax
 
-    from deepspeed_tpu.models.diffusion import (
-        StableDiffusionPipeline, UNetConfig, VAEDecoderConfig)
-
     platform = jax.devices()[0].platform
-    pipe = StableDiffusionPipeline.init_random(
-        jax.random.PRNGKey(0),
-        unet_cfg=UNetConfig(base_channels=cfg.get("base_channels", 128),
-                            channel_mults=(1, 2, 4),
-                            text_dim=cfg.get("text_dim", 256), n_head=8),
-        vae_cfg=VAEDecoderConfig(base_channels=64, upsamples=3),
-        latent_size=cfg.get("latent", 32))
+    if cfg.get("arch", "sd15") == "skeleton":
+        from deepspeed_tpu.models.diffusion import (
+            StableDiffusionPipeline, UNetConfig, VAEDecoderConfig)
+
+        pipe = StableDiffusionPipeline.init_random(
+            jax.random.PRNGKey(0),
+            unet_cfg=UNetConfig(base_channels=cfg.get("base_channels", 128),
+                                channel_mults=(1, 2, 4),
+                                text_dim=cfg.get("text_dim", 256), n_head=8),
+            vae_cfg=VAEDecoderConfig(base_channels=64, upsamples=3),
+            latent_size=cfg.get("latent", 32))
+        text_dim = pipe.unet_cfg.text_dim
+    else:
+        from deepspeed_tpu.models.sd_unet import (
+            SDPipeline, SDUNetConfig, SDVAEDecoderConfig, init_sd_unet,
+            init_sd_vae_decoder)
+
+        chans = tuple(cfg.get("channels", (128, 256, 512)))
+        groups = min(32, min(chans))
+        ucfg = SDUNetConfig(
+            block_out_channels=chans,
+            cross_attn=tuple(i < len(chans) - 1 for i in range(len(chans))),
+            cross_attention_dim=cfg.get("text_dim", 512), n_head=8,
+            norm_groups=groups)
+        vcfg = SDVAEDecoderConfig(
+            block_out_channels=tuple(max(c // 2, groups) for c in chans),
+            norm_groups=groups)
+        k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+        pipe = SDPipeline(ucfg, vcfg, init_sd_unet(ucfg, k1),
+                          init_sd_vae_decoder(vcfg, k2),
+                          latent_size=cfg.get("latent", 32))
+        text_dim = ucfg.cross_attention_dim
     rng = np.random.default_rng(0)
     B, S = cfg.get("batch", 1), 77
-    text = np.asarray(rng.normal(size=(B, S, pipe.unet_cfg.text_dim)),
-                      np.float32)
-    uncond = np.asarray(rng.normal(size=(B, S, pipe.unet_cfg.text_dim)),
-                        np.float32)
+    text = np.asarray(rng.normal(size=(B, S, text_dim)), np.float32)
+    uncond = np.asarray(rng.normal(size=(B, S, text_dim)), np.float32)
     steps = cfg.get("ddim_steps", 20)
     img = pipe(text, uncond, num_steps=steps)  # warmup/compile
     lat = []
