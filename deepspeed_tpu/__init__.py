@@ -77,6 +77,17 @@ def initialize(
     if isinstance(model, PipelineModule):
         from .runtime.pipe.engine import PipelineEngine as _PipeEngineT
 
+        # features the MPMD interpreter does not implement must fail loudly
+        # here — DeepSpeedEngine.__init__'s exclusivity checks never run on
+        # this path, and a silently inert config is worse than an error
+        if ds_config.progressive_layer_drop.enabled:
+            raise ValueError(
+                "progressive_layer_drop is not supported on the MPMD "
+                "PipelineEngine path (use a functional model)")
+        if ds_config.zero_optimization.offload_param_device in ("cpu", "nvme"):
+            raise ValueError(
+                "offload_param (ZeRO-Infinity param streaming) is not "
+                "supported on the MPMD PipelineEngine path")
         if topology is not None:
             raise ValueError(
                 "topology is not supported with a PipelineModule — the MPMD "

@@ -104,7 +104,8 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     # ZeRO-Offload: the fp32 master + moments live in host RAM/SSD on the runner.
     # Written BEFORE the 'latest' pointer so a crash in between can never leave a
     # resolvable tag with missing optimizer state.
-    offload = getattr(engine, "_offload", None)
+    offload = (getattr(engine, "_offload", None)
+               or getattr(engine, "_param_stream", None))
     if offload is not None and is_writer:
         if offload.master is None:  # checkpoint before the first step
             offload.init_host_state()
@@ -123,6 +124,14 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
 
 def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                     load_optimizer_states: bool = True) -> Tuple[Optional[str], dict]:
+    if (getattr(engine, "_param_stream", None) is not None
+            and not load_optimizer_states):
+        # checked BEFORE any engine state mutates: offload_param checkpoints
+        # keep the weights INSIDE the host master state (host_optimizer.npz);
+        # load_optimizer_states=False would restore no weights at all
+        raise ValueError(
+            "offload_param checkpoints keep the weights inside the host master "
+            "state; load_optimizer_states=False would restore no weights")
     if tag is None:
         latest_path = os.path.join(load_dir, LATEST_FILE)
         if not os.path.exists(latest_path):
@@ -149,7 +158,8 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     engine.global_steps = int(meta.get("global_steps", 0))
     engine.micro_steps = int(meta.get("micro_steps", 0))
     engine.skipped_steps = int(meta.get("skipped_steps", 0))
-    offload = getattr(engine, "_offload", None)
+    offload = (getattr(engine, "_offload", None)
+               or getattr(engine, "_param_stream", None))
     if offload is not None and load_optimizer_states:
         host_path = os.path.join(ckpt_dir, "host_optimizer.npz")
         if not os.path.exists(host_path):

@@ -61,6 +61,13 @@ class Module:
     # layers train on `keep`-token subsets (the engine calls it when the
     # data_efficiency random_ltd schedule moves to a new compile bucket)
     with_ltd_keep: Optional[Callable[[int, Tuple[int, ...]], "Module"]] = None
+    # optional ZeRO-Infinity decomposition: () -> StreamSpec (models/gpt.py
+    # make_stream). Exposes the model as embed / repeated-layer / head units so
+    # the param-stream runner (runtime/zero/infinity.py) can keep master
+    # weights on host and stream one unit at a time through HBM — the
+    # offload_param capability (reference: deepspeed/runtime/zero/
+    # partition_parameters.py remote-device "cpu"/"nvme")
+    stream: Optional[Callable[[], Any]] = None
 
     def specs(self, param_shapes) -> Any:
         if self.partition_specs is None:

@@ -399,9 +399,13 @@ def _dropout(x, rate, rng, train, salt: int):
 # --------------------------------------------------------------------------- forward
 def forward(cfg: GPTConfig, params: Dict[str, Any], input_ids: jnp.ndarray,
             rngs: Optional[Dict[str, jax.Array]] = None, train: bool = True,
-            return_hidden: bool = False) -> jnp.ndarray:
+            return_hidden: bool = False, pld_theta=None) -> jnp.ndarray:
     """Return logits [B, T, V] (or the final-LN hidden states [B, T, D] with
-    ``return_hidden`` — the encoder surface CLIP-style text towers need)."""
+    ``return_hidden`` — the encoder surface CLIP-style text towers need).
+
+    ``pld_theta``: traced scalar keep-probability from the engine's Progressive
+    Layer Drop schedule (reference ``runtime/progressive_layer_drop.py:5``);
+    gates each block with the paper's depth-scaled probability."""
     B, T = input_ids.shape
     if T > cfg.max_seq_len:
         raise ValueError(
@@ -435,6 +439,10 @@ def forward(cfg: GPTConfig, params: Dict[str, Any], input_ids: jnp.ndarray,
         block_fn = jax.checkpoint(block_fn, policy=policy)
 
     sd = cfg.stochastic_depth if train else 0.0
+    if pld_theta is not None and (sd > 0.0 or not train):
+        raise ValueError(
+            "progressive_layer_drop is train-only and exclusive with "
+            "stochastic_depth (both gate whole blocks)")
     use_ltd = (train and cfg.random_ltd_keep is not None
                and cfg.random_ltd_keep < T and cfg.random_ltd_layer_ids)
     ltd_ids = jnp.asarray(cfg.random_ltd_layer_ids or (0,), jnp.int32)
@@ -459,7 +467,24 @@ def forward(cfg: GPTConfig, params: Dict[str, Any], input_ids: jnp.ndarray,
                                                  lrng, i), x)
         else:
             y = block_fn(x, layer_w, positions, lrng, i)
-        if sd > 0.0 and lrng is not None:
+        if pld_theta is not None:
+            # PLD depth scaling (arXiv:2010.13369): deeper layers drop first —
+            # layer i keeps with p_i = 1 - (i+1)/L * (1 - theta(t)); surviving
+            # deltas are rescaled so eval runs the full stack uncorrected
+            keep_p = (1.0 - (jnp.asarray(i + 1, jnp.float32) / cfg.n_layer)
+                      * (1.0 - pld_theta))
+            if lrng is None:
+                # a fixed fallback key would freeze the drop mask across steps
+                # (layers past their draw would never train again)
+                raise ValueError(
+                    "progressive_layer_drop needs a dropout rng: pass "
+                    "rngs={'dropout': key} to forward()")
+            keep = jax.random.bernoulli(
+                jax.random.fold_in(jax.random.fold_in(lrng, 0x91D), i), keep_p)
+            # max() keeps the untaken branch's gradient finite when keep_p -> 0
+            x = x + jnp.where(keep, (y - x) / jnp.maximum(keep_p, 1e-3),
+                              0.0).astype(x.dtype)
+        elif sd > 0.0 and lrng is not None:
             # stochastic depth: drop the whole block with prob sd; the
             # surviving delta is scaled so eval needs no correction
             keep = jax.random.bernoulli(jax.random.fold_in(lrng, 0x5D), 1.0 - sd)
@@ -526,12 +551,132 @@ def next_token_loss(forward_fn, max_seq_len: int, batch: Dict[str, jnp.ndarray]
 
 
 def loss_fn(cfg: GPTConfig, params, batch: Dict[str, jnp.ndarray],
-            rngs=None, train: bool = True) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+            rngs=None, train: bool = True, pld_theta=None
+            ) -> Tuple[jnp.ndarray, Dict[str, Any]]:
     """Next-token cross entropy. ``batch``: {"input_ids": [B,T]} (+ optional
     "labels"/"loss_mask")."""
     return next_token_loss(
-        lambda ids: forward(cfg, params, ids, rngs=rngs, train=train),
+        lambda ids: forward(cfg, params, ids, rngs=rngs, train=train,
+                            pld_theta=pld_theta),
         cfg.max_seq_len, batch)
+
+
+# ------------------------------------------------------- ZeRO-Infinity stream
+class GPTStream:
+    """ZeRO-Infinity unit decomposition of the GPT stack (``Module.stream``).
+
+    The model is exposed as ``embed`` / ``layer_0..L-1`` / ``final`` units so
+    the param-stream runner (:mod:`deepspeed_tpu.runtime.zero.infinity`) can
+    keep master weights in host RAM and stream ONE unit at a time through HBM —
+    the ``offload_param`` capability (reference: ``deepspeed/runtime/zero/
+    partition_parameters.py`` remote-device "cpu"/"nvme" + ``docs/_pages/
+    training.md:301`` 13B-on-one-V100). Host init is numpy — the full model is
+    never materialized on device — and every layer unit is shape-identical, so
+    the runner compiles exactly one fwd and one bwd program for all L layers.
+    """
+
+    def __init__(self, cfg: GPTConfig):
+        self.cfg = cfg
+        self.n_layer = cfg.n_layer
+        self.tied = cfg.tie_embeddings
+
+    def unit_names(self):
+        return (["embed"] + [f"layer_{i}" for i in range(self.n_layer)]
+                + ["final"])
+
+    # ---------------------------------------------------------- host init
+    def init_unit(self, name: str, seed: int) -> Dict[str, np.ndarray]:
+        cfg = self.cfg
+        d, f, v = cfg.d_model, cfg.ffn_dim, cfg.vocab_size
+        idx = self.unit_names().index(name)
+        rng = np.random.default_rng([int(seed) & 0x7FFFFFFF, idx])
+        std = 0.02
+        res_std = float(std / np.sqrt(2.0 * cfg.n_layer))
+
+        def normal(shape, s):
+            # float(s): a np.float64 scalar would NEP50-promote the product
+            return rng.standard_normal(shape, np.float32) * np.float32(s)
+
+        def ones(shape):
+            return np.ones(shape, np.float32)
+
+        def zeros(shape):
+            return np.zeros(shape, np.float32)
+
+        if name == "embed":
+            out = {"wte": normal((v, d), std)}
+            if not cfg.rotary and not cfg.alibi:
+                out["wpe"] = normal((cfg.max_seq_len + cfg.pos_offset, d), std)
+            if cfg.embed_layernorm:
+                out["emb_ln_scale"] = ones((d,))
+                out["emb_ln_bias"] = zeros((d,))
+            return out
+        if name == "final":
+            out = {"lnf_scale": ones((d,)), "lnf_bias": zeros((d,))}
+            if not cfg.tie_embeddings:
+                out["lm_head"] = normal((v, d), std)
+                if cfg.lm_head_bias:
+                    out["lm_head_b"] = zeros((v,))
+            return out
+        return {
+            "ln1_scale": ones((d,)), "ln1_bias": zeros((d,)),
+            "qkv_w": normal((d, 3 * d), std), "qkv_b": zeros((3 * d,)),
+            "attn_out_w": normal((d, d), res_std), "attn_out_b": zeros((d,)),
+            "ln2_scale": ones((d,)), "ln2_bias": zeros((d,)),
+            "mlp_up_w": normal((d, f), std), "mlp_up_b": zeros((f,)),
+            "mlp_down_w": normal((f, d), res_std), "mlp_down_b": zeros((d,)),
+        }
+
+    # ---------------------------------------------------------- device programs
+    def embed_fwd(self, emb: Dict[str, jnp.ndarray], input_ids: jnp.ndarray,
+                  compute_dtype) -> jnp.ndarray:
+        cfg = self.cfg
+        B, T = input_ids.shape
+        x = jnp.take(emb["wte"], input_ids, axis=0)
+        if "wpe" in emb:
+            positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+            x = x + jnp.take(emb["wpe"], positions + cfg.pos_offset, axis=0)
+        if cfg.embed_layernorm:
+            x = layer_norm(x, emb["emb_ln_scale"], emb["emb_ln_bias"],
+                           cfg.layer_norm_eps)
+        return x.astype(compute_dtype)
+
+    def layer_fwd(self, w: Dict[str, jnp.ndarray], x: jnp.ndarray,
+                  layer_idx, rng) -> jnp.ndarray:
+        cfg = self.cfg
+        B, T = x.shape[:2]
+        positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+        return _block(cfg, x, w, positions, rng, train=True,
+                      layer_idx=layer_idx)
+
+    def head_loss(self, final: Dict[str, jnp.ndarray], wte: jnp.ndarray,
+                  x: jnp.ndarray, input_ids: jnp.ndarray,
+                  labels: Optional[jnp.ndarray] = None,
+                  loss_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+        """Same semantics as :func:`next_token_loss`: explicit ``labels`` score
+        the full sequence; otherwise next-token targets are the shifted input
+        ids. ``loss_mask`` weights positions (shifted alongside the labels)."""
+        cfg = self.cfg
+        x = layer_norm(x, final["lnf_scale"], final["lnf_bias"],
+                       cfg.layer_norm_eps)
+        head = wte if cfg.tie_embeddings else final["lm_head"]
+        logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
+        if cfg.lm_head_bias and not cfg.tie_embeddings:
+            logits = logits + final["lm_head_b"].astype(logits.dtype)
+        if labels is None:
+            logits32 = logits[:, :-1].astype(jnp.float32)
+            labels = input_ids[:, 1:]
+            if loss_mask is not None:
+                loss_mask = loss_mask[:, 1:]
+        else:
+            logits32 = logits.astype(jnp.float32)
+        logz = jax.scipy.special.logsumexp(logits32, axis=-1)
+        gold = jnp.take_along_axis(logits32, labels[..., None], axis=-1)[..., 0]
+        nll = logz - gold
+        if loss_mask is not None:
+            mask = loss_mask.astype(jnp.float32)
+            return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        return jnp.mean(nll)
 
 
 # ------------------------------------------------------------- int8 weights
@@ -730,9 +875,11 @@ def build(cfg_or_name) -> Tuple[Module, GPTConfig]:
 
     return Module(
         init=functools.partial(init_params, cfg),
-        apply=lambda params, batch, rngs=None, train=True: loss_fn(
-            cfg, params, batch, rngs=rngs, train=train),
+        apply=lambda params, batch, rngs=None, train=True, pld_theta=None:
+            loss_fn(cfg, params, batch, rngs=rngs, train=train,
+                    pld_theta=pld_theta),
         partition_specs=functools.partial(partition_specs, cfg),
         to_pipeline=to_pipeline,
         with_ltd_keep=with_ltd_keep,
+        stream=lambda: GPTStream(cfg),
     ), cfg

@@ -180,6 +180,16 @@ class EigenvalueConfig(DeepSpeedConfigModel):
     layer_num: int = 0
 
 
+class ProgressiveLayerDropConfig(DeepSpeedConfigModel):
+    """Progressive Layer Drop (parity: ``runtime/progressive_layer_drop.py:5``;
+    PLD paper arXiv:2010.13369). ``theta`` is the asymptotic keep probability,
+    ``gamma`` the decay rate: theta(t) = (1-theta)*exp(-gamma*t) + theta."""
+
+    enabled: bool = False
+    theta: float = 0.5
+    gamma: float = 0.001
+
+
 class DeepSpeedConfig(DeepSpeedConfigModel):
     """Top-level config. Accepts a DeepSpeed JSON dict or file path via ``load``."""
 
@@ -220,6 +230,8 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     eigenvalue: EigenvalueConfig = Field(default_factory=EigenvalueConfig)
     pipeline: PipelineConfig = Field(default_factory=PipelineConfig)
     mesh: MeshTopologyConfig = Field(default_factory=MeshTopologyConfig)
+    progressive_layer_drop: ProgressiveLayerDropConfig = Field(
+        default_factory=ProgressiveLayerDropConfig)
 
     # data efficiency / curriculum (parity: runtime/data_pipeline) — parsed, consumed
     # by the data_pipeline module.

@@ -236,13 +236,49 @@ class DeepSpeedEngine:
                     "random_ltd_layer_id or a positive random_ltd_layer_num "
                     "(a silently inert schedule would still log transitions)")
 
+        # Progressive Layer Drop (parity: runtime/progressive_layer_drop.py:5):
+        # the authoritative theta(t) is computed in-program from the traced step
+        # counter (_loss_and_grads) — per-step schedule, zero recompiles; this
+        # host tracker mirrors it for get_state()/monitor parity
+        self.progressive_layer_drop = None
+        if config.progressive_layer_drop.enabled:
+            from .progressive_layer_drop import ProgressiveLayerDrop
+
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                config.progressive_layer_drop.theta,
+                config.progressive_layer_drop.gamma)
+
+        # ZeRO-Infinity param streaming: master weights live on host (RAM/NVMe)
+        # and are streamed unit-by-unit through HBM — models bigger than device
+        # memory on one chip (runtime/zero/infinity.py). Implies the host
+        # optimizer, so it supersedes the plain optimizer-offload runner.
+        self._param_stream = None
+        self._param_stream_requested = (
+            config.zero_optimization.offload_param_device in ("cpu", "nvme"))
         # ZeRO-Offload: optimizer state in host RAM, stepped by the native C++
         # SIMD optimizer (runtime/zero/offload.py); device keeps bf16 params only
         self._offload = None
         self._offload_requested = (
-            config.zero_optimization.offload_optimizer_device in ("cpu", "nvme"))
+            config.zero_optimization.offload_optimizer_device in ("cpu", "nvme")
+            and not self._param_stream_requested)
+        if self._param_stream_requested and self._onebit is not None:
+            raise ValueError("offload_param and 1-bit optimizers are exclusive")
+        if self._param_stream_requested and self._compression is not None:
+            raise ValueError(
+                "compression_training is not supported with offload_param "
+                "(the streamed per-unit programs bypass the QAT transform)")
+        if self._param_stream_requested and self._random_ltd is not None:
+            raise ValueError("random_ltd is not supported with offload_param")
         if self._offload_requested and self._onebit is not None:
             raise ValueError("offload_optimizer and 1-bit optimizers are exclusive")
+        if self.progressive_layer_drop is not None and (
+                self._onebit is not None or self._offload_requested
+                or self._param_stream_requested):
+            # those runners trace their gradient programs without the step
+            # input, which would silently freeze theta at 1.0
+            raise ValueError(
+                "progressive_layer_drop is not supported together with "
+                "ZeRO-Offload/Infinity or 1-bit optimizers")
         if self._compression is not None and (
                 self._offload_requested or self._onebit is not None):
             # their gradient programs bypass the QAT transform; failing loudly
@@ -331,6 +367,10 @@ class DeepSpeedEngine:
             from .zero.offload import HostOffloadRunner
 
             self._offload = HostOffloadRunner(self)
+        if self._param_stream_requested:
+            from .zero.infinity import ParamStreamRunner
+
+            self._param_stream = ParamStreamRunner(self)
         self._compile_steps()
         n_params = count_parameters(self.state["params"])
         log_dist(
@@ -343,6 +383,18 @@ class DeepSpeedEngine:
 
     # ------------------------------------------------------------------ state init
     def _init_state(self) -> Dict[str, Any]:
+        if self._param_stream_requested:
+            # ZeRO-Infinity param streaming: the model NEVER materializes on
+            # device — host init happens lazily in ParamStreamRunner (numpy,
+            # unit by unit); device state is bookkeeping scalars only
+            return {
+                "params": {},
+                "master": {},
+                "opt": {},
+                "step": jnp.zeros((), jnp.int32),
+                "micro": jnp.zeros((), jnp.int32),
+                "scaler": init_scaler_state(self.pc),
+            }
         pspecs = self.param_specs
 
         def init_fn(rng):
@@ -410,7 +462,24 @@ class DeepSpeedEngine:
                 # inside the loss so the straight-through fake-quant gradient
                 # reaches the unquantized master weights
                 p = self._compression.transform(p, step, curvature=curvature)
-            out = self.model.apply(p, batch, rngs=rngs, train=True)
+            kwargs = {}
+            if self.progressive_layer_drop is not None and step is not None:
+                # theta(t) from the traced step: per-step schedule without
+                # recompiles or host round-trips
+                pcfg = self.config.progressive_layer_drop
+                kwargs["pld_theta"] = (
+                    (1.0 - pcfg.theta)
+                    * jnp.exp(-pcfg.gamma * jnp.asarray(step, jnp.float32))
+                    + pcfg.theta)
+            try:
+                out = self.model.apply(p, batch, rngs=rngs, train=True, **kwargs)
+            except TypeError as e:
+                if "pld_theta" in str(e):
+                    raise ValueError(
+                        "progressive_layer_drop is enabled but this model's "
+                        "apply() takes no pld_theta (build_gpt models support "
+                        "it)") from e
+                raise
             loss, aux = out if isinstance(out, tuple) else (out, {})
             return loss.astype(jnp.float32) * eff_scale, (loss, aux)
 
@@ -606,10 +675,10 @@ class DeepSpeedEngine:
                 "1-bit optimizers use the fused train_batch() API (the compressed "
                 "stage is a single program; the split forward/backward/step surface "
                 "cannot express per-rank gradient exchange)")
-        if self._offload is not None:
+        if self._offload is not None or self._param_stream is not None:
             raise RuntimeError(
-                "ZeRO-Offload uses the fused train_batch() API (the host optimizer "
-                "step is driven once per global batch)")
+                "ZeRO-Offload/Infinity uses the fused train_batch() API (the host "
+                "optimizer step is driven once per global batch)")
         if self.wall_clock_breakdown():
             self.timers("forward").start()
         batch = self._apply_curriculum(batch)
@@ -694,7 +763,7 @@ class DeepSpeedEngine:
         if wcb:
             self.timers("batch_input").stop()
             self.timers("train_batch").start()
-        runner = self._onebit or self._offload
+        runner = self._onebit or self._offload or self._param_stream
         if runner is not None:
             self.state, metrics = runner.train_batch(batch, self._next_rng())
         else:
@@ -763,10 +832,17 @@ class DeepSpeedEngine:
     def _finish_step(self, metrics: Dict[str, Any]) -> None:
         self.global_steps += 1
         self._last_metrics = metrics
-        if self.pc.loss_scaling and bool(metrics.get("overflow", False)):
+        if self.progressive_layer_drop is not None:
+            # mirror the in-program schedule for get_state()/monitor readers
+            self.progressive_layer_drop.update_state(self.global_steps)
+        if bool(metrics.get("overflow", False)):
+            # not only under loss scaling: the offload/param-stream runners
+            # skip non-finite steps in bf16 too, and that must be visible
             self.skipped_steps += 1
-            log_dist(f"step {self.global_steps}: grad overflow, step skipped; "
-                     f"loss scale -> {float(self.state['scaler'].scale)}")
+            scale_note = (f"; loss scale -> {float(self.state['scaler'].scale)}"
+                          if self.pc.loss_scaling else "")
+            log_dist(f"step {self.global_steps}: non-finite grads, step "
+                     f"skipped{scale_note}")
         if self._monitor is not None and "loss" in metrics:
             # parity: the reference's gas-boundary event set
             # (engine.py:2183-2206: Train/Samples/{train_loss,lr,loss_scale})
@@ -779,6 +855,10 @@ class DeepSpeedEngine:
             if self.pc.loss_scaling:
                 events.append(("Train/loss_scale",
                                float(metrics.get("loss_scale", 1.0)),
+                               self.global_steps))
+            if self.progressive_layer_drop is not None:
+                events.append(("Train/pld_theta",
+                               self.progressive_layer_drop.get_theta(),
                                self.global_steps))
             sps = self.tput_timer.avg_samples_per_sec()
             if sps:

@@ -1,0 +1,39 @@
+"""Progressive Layer Drop (PLD) — host-side schedule tracker.
+
+API parity with the reference's ``ProgressiveLayerDrop``
+(``deepspeed/runtime/progressive_layer_drop.py:5``; paper arXiv:2010.13369):
+``theta(t) = (1 - theta) * exp(-gamma * t) + theta`` decays the global layer
+keep-probability from 1 toward ``theta``.
+
+TPU-native split of responsibilities: the *authoritative* theta used by
+training is computed IN-PROGRAM from the traced step counter (see
+``engine._loss_and_grads``) — it changes every step with zero host
+round-trips and zero recompiles. This class mirrors the same schedule on the
+host purely for the reference API surface (``get_state``/``get_theta``) and
+the monitor event stream.
+"""
+
+from __future__ import annotations
+
+import math
+
+from ..utils.logging import log_dist
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = float(theta)
+        self.gamma = float(gamma)
+        self.current_theta = 1.0
+        log_dist(f"Enabled progressive layer dropping (theta = {self.theta})")
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> None:
+        self.current_theta = ((1.0 - self.theta)
+                              * math.exp(-self.gamma * float(global_step))
+                              + self.theta)

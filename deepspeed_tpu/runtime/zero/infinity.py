@@ -1,0 +1,443 @@
+"""ZeRO-Infinity parameter streaming: models bigger than HBM on one chip.
+
+Capability parity with the reference's ``offload_param`` (ZeRO-Infinity,
+``deepspeed/runtime/zero/partition_parameters.py`` remote-device "cpu"/"nvme";
+``docs/_pages/training.md:301`` — 13B on a single V100): ALL master weights
+live in host RAM (or on NVMe via :class:`NVMeLeafStore`), and the device only
+ever holds
+
+- a small window of layer-unit parameters (double-buffered prefetch),
+- the per-layer residual-stream activations,
+- one transient unit's gradients.
+
+So HBM scales with ``layers_resident * layer_size + activations`` instead of
+model size — a 6.7B GPT trains on a 16 GB chip.
+
+TPU-native structure (vs the reference's per-tensor hook machinery):
+
+- The model exposes a *unit decomposition* (``Module.stream`` →
+  :class:`~deepspeed_tpu.models.gpt.GPTStream`): ``embed`` / L shape-identical
+  ``layer_i`` units / ``final``. Exactly four XLA programs are compiled —
+  embed fwd, layer fwd, layer bwd (recompute-in-bwd, i.e. full remat by
+  construction), head loss+bwd — and reused for every layer; the layer index
+  rides in as a traced scalar.
+- Transfers overlap compute through JAX async dispatch: the next unit's
+  ``device_put`` and the previous unit's gradient ``device_get`` are issued
+  while the current unit's program runs.
+- Gradients cross the wire in the compute dtype (bf16 — parity with the
+  reference's fp16 grad transfer) and per-unit squared norms are computed
+  ON DEVICE, so the host never makes an extra fp32 pass just for the global
+  norm.
+- The optimizer step is the native host SIMD Adam/Adagrad
+  (``csrc/cpu_adam.cpp``) with the bf16 device copy written back IN the same
+  pass (``bf16_out``), exactly the reference's overlapped fp16 copy-back
+  (``csrc/adam/cpu_adam.cpp:216``).
+
+Constraints (checked loudly): bf16/fp32 only (no dynamic loss scaling),
+gradient_accumulation_steps == 1, Adam/AdamW/Adagrad.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import tempfile
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+
+from ...ops.adam import DeepSpeedCPUAdam, DeepSpeedCPUAdagrad
+from ...utils.logging import log_dist
+from ..topology import mesh_context
+
+
+class ParamStreamRunner:
+    """Owns host master state + the per-unit streaming train step."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        cfg = engine.config
+        if engine.pc.loss_scaling:
+            raise ValueError(
+                "offload_param: use bf16 or fp32 (no dynamic loss scaling)")
+        if engine.gas != 1:
+            raise ValueError(
+                "offload_param streaming requires gradient_accumulation_steps=1 "
+                "(per-unit grads are consumed by the host optimizer as they "
+                "arrive; accumulate by raising train_micro_batch_size_per_gpu)")
+        if engine.model.stream is None:
+            raise ValueError(
+                "offload_param requires a model with a stream decomposition "
+                "hook (models.gpt.build provides one)")
+        self.stream = engine.model.stream()
+        opt_cfg = cfg.optimizer
+        opt_type = (opt_cfg.type if opt_cfg else "Adam").lower()
+        params = dict(opt_cfg.params) if opt_cfg else {}
+        self.base_lr = float(params.get("lr", 1e-3))
+        if opt_type in ("adam", "adamw", "fusedadam"):
+            self.cpu_opt = DeepSpeedCPUAdam(
+                lr=self.base_lr,
+                betas=tuple(params.get("betas", (0.9, 0.999))),
+                eps=params.get("eps", 1e-8),
+                weight_decay=params.get("weight_decay", 0.0),
+                adamw_mode=(opt_type != "adam") or params.get("adam_w_mode", True),
+                bias_correction=params.get("bias_correction", True))
+            self._kind = "adam"
+        elif opt_type == "adagrad":
+            self.cpu_opt = DeepSpeedCPUAdagrad(
+                lr=self.base_lr, eps=params.get("eps", 1e-10),
+                weight_decay=params.get("weight_decay", 0.0))
+            self._kind = "adagrad"
+        else:
+            raise ValueError(
+                f"offload_param supports Adam/AdamW/Adagrad on host (got {opt_type!r})")
+        self.cdtype = jnp.dtype(engine.pc.compute_dtype)
+        op = cfg.zero_optimization.offload_param
+        # device-resident tail window: the last `pin_memory? buffer_count` layer
+        # units from the forward pass are kept in HBM so the backward pass
+        # (which consumes them FIRST) skips their re-push (the reference's
+        # prefetch buffers, offload_param.buffer_count)
+        self.keep_layers = max(0, int(op.buffer_count)) if op else 2
+        self.count = 0
+        self.seed = int(cfg.seed)
+        # host state: leaf index -> (master, m, v) fp32 (RAM mode) or NVMe store
+        self._leaves: Optional[List[Tuple[str, str, tuple]]] = None  # (unit, name, shape)
+        self._unit_leaf_ids: Dict[str, List[int]] = {}
+        self._state: Optional[List] = None
+        self._push_bufs: Optional[List[np.ndarray]] = None  # uint16 bf16 (or fp32)
+        self.store = None
+        if op is not None and op.device.value == "nvme":
+            from ..swap_tensor import NVMeLeafStore
+
+            nvme_path = op.nvme_path or os.path.join(
+                tempfile.gettempdir(), "ds_tpu_nvme_swap")
+            self.store = NVMeLeafStore(
+                os.path.join(nvme_path, "param_stream"),
+                aio_threads=max(1, int(op.buffer_count or 4)))
+        self._programs = None
+        self._rep_sharding = jax.sharding.NamedSharding(
+            engine.mesh, jax.sharding.PartitionSpec())
+        self.last_stats: Dict[str, Any] = {}
+        log_dist(
+            f"ZeRO-Infinity param stream: {len(self.stream.unit_names())} units, "
+            f"host {opt_type} "
+            f"({'native SIMD' if self.cpu_opt.is_native else 'numpy fallback'}"
+            f"{', NVMe masters' if self.store is not None else ''}), "
+            f"keep_layers={self.keep_layers}")
+
+    # ------------------------------------------------------------------ host state
+    def init_host_state(self, for_load: bool = False) -> None:
+        """Materialize master/m/v on host, unit by unit (never the whole model
+        at once on device). ``for_load``: a checkpoint load follows — only the
+        index/shapes are needed."""
+        self._leaves = []
+        self._unit_leaf_ids = {}
+        self._push_bufs = []
+        state: List = []
+        zeros_cache: Dict[tuple, np.ndarray] = {}
+        if self.store is not None:
+            self.store.shapes = []
+        # one unit resident at a time: NVMe/RAM peak during init stays
+        # O(one unit of fp32), never the whole model
+        for unit in self.stream.unit_names():
+            init = self.stream.init_unit(unit, self.seed)
+            ids = []
+            for name in sorted(init):
+                i = len(self._leaves)
+                ids.append(i)
+                master = init[name]
+                self._leaves.append((unit, name, tuple(master.shape)))
+                self._push_bufs.append(None)
+                if for_load:
+                    if self.store is not None:
+                        self.store.shapes.append(tuple(master.shape))
+                    else:
+                        state.append(None)
+                    continue
+                self._refresh_push_buf(i, master)
+                if self.store is not None:
+                    self.store.shapes.append(tuple(master.shape))
+                    z = zeros_cache.setdefault(
+                        master.shape, np.zeros(master.shape, np.float32))
+                    self.store.writeback(i, np.ascontiguousarray(
+                        master, np.float32), z, z)
+                    self.store.drain()  # z is reused: writes must land first
+                else:
+                    state.append((master, np.zeros_like(master),
+                                  np.zeros_like(master)))
+            self._unit_leaf_ids[unit] = ids
+            del init
+        self._state = "nvme" if self.store is not None else state
+
+    def _refresh_push_buf(self, i: int, master: np.ndarray) -> None:
+        if self.cdtype == jnp.bfloat16:
+            if self._push_bufs[i] is None:
+                self._push_bufs[i] = np.empty(master.size, np.uint16)
+            self._push_bufs[i][:] = master.ravel().astype(
+                ml_dtypes.bfloat16).view(np.uint16)
+        else:
+            # fp32 compute (tests): push a copy — master mutates in-place while
+            # a previous step's transfer could still be in flight
+            self._push_bufs[i] = np.array(master, np.float32, copy=True)
+
+    def _push_unit(self, unit: str) -> Dict[str, jax.Array]:
+        out = {}
+        for i in self._unit_leaf_ids[unit]:
+            _, name, shape = self._leaves[i]
+            buf = self._push_bufs[i]
+            if self.cdtype == jnp.bfloat16:
+                arr = buf.view(ml_dtypes.bfloat16).reshape(shape)
+            else:
+                arr = buf.reshape(shape)
+            out[name] = jax.device_put(arr, self._rep_sharding)
+        return out
+
+    # ------------------------------------------------------------------ programs
+    def _build_programs(self) -> None:
+        s = self.stream
+        cd = self.cdtype
+
+        def cast_tree(t):
+            return jax.tree_util.tree_map(lambda g: g.astype(cd), t)
+
+        def gn2(t):
+            return sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                       for g in jax.tree_util.tree_leaves(t))
+
+        def efwd(emb, ids):
+            return s.embed_fwd(emb, ids, cd)
+
+        def lfwd(w, x, idx, rng):
+            return s.layer_fwd(w, x, idx, rng)
+
+        def lbwd(w, x, dy, idx, rng):
+            _, vjp = jax.vjp(lambda w_, x_: s.layer_fwd(w_, x_, idx, rng), w, x)
+            dw, dx = vjp(dy)
+            return dx.astype(cd), cast_tree(dw), gn2(dw)
+
+        def hbwd(final, wte, x, ids, labels, loss_mask):
+            loss, (df, dwte, dx) = jax.value_and_grad(
+                s.head_loss, argnums=(0, 1, 2))(final, wte, x, ids,
+                                                labels, loss_mask)
+            return (loss, cast_tree(df), dwte.astype(cd), dx.astype(cd),
+                    gn2(df))
+
+        def ebwd(emb, ids, dx):
+            _, vjp = jax.vjp(lambda e: s.embed_fwd(e, ids, cd), emb)
+            (demb,) = vjp(dx)
+            return cast_tree(demb)
+
+        self._programs = {
+            "embed_fwd": jax.jit(efwd),
+            "layer_fwd": jax.jit(lfwd),
+            "layer_bwd": jax.jit(lbwd),
+            "head_bwd": jax.jit(hbwd),
+            "embed_bwd": jax.jit(ebwd),
+        }
+
+    # ------------------------------------------------------------------ step
+    def train_batch(self, batch, rng):
+        engine = self.engine
+        if self._state is None:
+            self.init_host_state()
+        if self._programs is None:
+            self._build_programs()
+        P = self._programs
+        unknown = set(batch) - {"input_ids", "labels", "loss_mask"}
+        if unknown:
+            # silently dropping batch keys would train on the wrong objective
+            raise ValueError(
+                f"offload_param streaming understands batch keys input_ids/"
+                f"labels/loss_mask; got unknown {sorted(unknown)}")
+        ids = batch["input_ids"]
+        labels = batch.get("labels")
+        loss_mask = batch.get("loss_mask")
+        L = self.stream.n_layer
+        keep = min(self.keep_layers, L)
+        rngs = jax.random.split(rng, L)
+
+        with mesh_context(engine.mesh):
+            # ---------------- forward: stream layer units through HBM
+            emb_dev = self._push_unit("embed")
+            final_dev = self._push_unit("final")
+            x = P["embed_fwd"](emb_dev, ids)
+            acts: List[Any] = [x]
+            cache: Dict[int, Any] = {}
+            w = self._push_unit("layer_0") if L else None
+            for i in range(L):
+                w_next = (self._push_unit(f"layer_{i + 1}")
+                          if i + 1 < L else None)  # prefetch during compute
+                x = P["layer_fwd"](w, x, jnp.int32(i), rngs[i])
+                acts.append(x)
+                if i >= L - keep:
+                    cache[i] = w
+                w = w_next
+
+            # ---------------- head: loss + grads wrt (final, wte, x)
+            loss, df, dwte_head, dx, gn2_head = P["head_bwd"](
+                final_dev, emb_dev["wte"], acts[L], ids, labels, loss_mask)
+
+            # ---------------- backward: stream units in reverse, fetch grads
+            grads: Dict[str, Any] = {"final": df}
+            gn2_dev = [gn2_head]
+            fetch_q: List[Tuple[str, Any]] = []
+            prefetched: Dict[int, Any] = {}
+            for i in reversed(range(L)):
+                w = cache.pop(i, None)
+                if w is None:
+                    w = prefetched.pop(i, None)
+                if w is None:
+                    w = self._push_unit(f"layer_{i}")
+                dx, dw, g2 = P["layer_bwd"](
+                    w, acts[i], dx, jnp.int32(i), rngs[i])
+                acts[i + 1] = None  # free the consumed activation
+                j = i - 1
+                if j >= 0 and j not in cache:
+                    prefetched[j] = self._push_unit(f"layer_{j}")
+                gn2_dev.append(g2)
+                fetch_q.append((f"layer_{i}", dw))
+                if len(fetch_q) > 1:  # one-deep pipeline: fetch while computing
+                    unit, pend = fetch_q.pop(0)
+                    grads[unit] = jax.device_get(pend)
+            demb = P["embed_bwd"](emb_dev, ids, dx)
+            for unit, pend in fetch_q:
+                grads[unit] = jax.device_get(pend)
+            grads["embed"] = jax.device_get(demb)
+            dwte_head_h = np.asarray(jax.device_get(dwte_head), np.float32)
+            gn2_host = float(jax.device_get(sum(gn2_dev)))
+            loss = jax.device_get(loss)
+
+        # ---------------- host: global norm, clip, SIMD optimizer
+        # embed grads (incl. the head's tied-wte contribution) are summed and
+        # normed on host; everything else used the on-device squared norms
+        emb32 = {k: np.asarray(v, np.float32) for k, v in grads["embed"].items()}
+        emb32["wte"] = emb32["wte"] + dwte_head_h  # new array: device_get views are read-only
+        del dwte_head_h
+        grads["embed"] = emb32
+        gnorm2 = gn2_host + sum(float((g * g).sum()) for g in emb32.values())
+        gnorm = math.sqrt(max(gnorm2, 0.0))
+        finite = math.isfinite(gnorm)
+        clip = float(engine.config.gradient_clipping or 0.0)
+        scale = (clip / (gnorm + 1e-6)
+                 if (clip > 0.0 and gnorm > clip) else 1.0)
+        lr = float(engine.lr_fn(engine.state["step"]))
+        if finite:
+            self.count += 1
+            self._apply_host_optimizer(grads, scale, lr)
+        engine.state["step"] = engine.state["step"] + 1
+        self.last_stats = self._memory_stats()
+        metrics = {
+            "loss": jnp.asarray(loss),
+            "grad_norm": jnp.float32(gnorm),
+            "lr": jnp.float32(lr),
+            "loss_scale": jnp.float32(1.0),
+            "overflow": jnp.bool_(not finite),
+        }
+        return engine.state, metrics
+
+    def _apply_host_optimizer(self, grads: Dict[str, Any], scale: float,
+                              lr: float) -> None:
+        order = self.stream.unit_names()
+        if self.store is not None:
+            self.store.prefetch(0)
+        for unit in order:
+            unit_grads = grads[unit]
+            for i in self._unit_leaf_ids[unit]:
+                _, name, shape = self._leaves[i]
+                g32 = np.asarray(unit_grads[name], np.float32).ravel()
+                if not g32.flags.writeable or g32.base is not None:
+                    g32 = np.array(g32, np.float32)
+                if scale != 1.0:
+                    g32 *= scale
+                if self.store is not None:
+                    if i + 1 < len(self._leaves):
+                        self.store.prefetch(i + 1)
+                    mst, m, v = self.store.get(i)
+                else:
+                    mst, m, v = self._state[i]
+                bf16_out = (self._push_bufs[i]
+                            if self.cdtype == jnp.bfloat16 else None)
+                if self._kind == "adam":
+                    self.cpu_opt.step(mst.ravel(), m.ravel(), v.ravel(), g32,
+                                      self.count, lr=lr, bf16_out=bf16_out)
+                else:
+                    self.cpu_opt.step(mst.ravel(), v.ravel(), g32, lr=lr,
+                                      bf16_out=bf16_out)
+                if self.cdtype != jnp.bfloat16:
+                    self._refresh_push_buf(i, mst)
+                if self.store is not None:
+                    self.store.writeback(i, mst, m, v)
+            grads[unit] = None  # free as we go
+        if self.store is not None:
+            self.store.drain()
+
+    # ------------------------------------------------------------------ stats
+    def _memory_stats(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        try:
+            ms = jax.devices()[0].memory_stats() or {}
+            out["hbm_bytes_in_use"] = int(ms.get("bytes_in_use", 0))
+            out["hbm_peak_bytes"] = int(ms.get("peak_bytes_in_use", 0))
+        except Exception:  # backend without memory_stats
+            pass
+        try:
+            with open("/proc/self/statm") as f:
+                out["host_rss_bytes"] = int(f.read().split()[1]) * os.sysconf(
+                    "SC_PAGE_SIZE")
+        except OSError:
+            pass
+        def unit_size(u):
+            return sum(int(np.prod(self._leaves[i][2]))
+                       for i in self._unit_leaf_ids.get(u, ()))
+
+        n_params = sum(int(np.prod(s)) for (_, _, s) in (self._leaves or []))
+        L = self.stream.n_layer
+        repushed = sum(unit_size(f"layer_{i}")
+                       for i in range(max(0, L - self.keep_layers)))
+        out["n_params"] = n_params
+        # fwd pushes every unit once, bwd re-pushes the non-cached layer units,
+        # and every unit's grads come back once — all in the compute dtype
+        out["wire_bytes_per_step"] = (
+            (2 * n_params + repushed) * self.cdtype.itemsize)
+        return out
+
+    # ------------------------------------------------------------------ checkpoint
+    def host_state_dict(self) -> Dict[str, Any]:
+        out = {"count": np.int64(self.count)}
+        if self.store is not None:
+            out.update(self.store.read_all())
+            return out
+        for i, (mst, m, v) in enumerate(self._state):
+            out[f"master_{i}"] = mst
+            out[f"m_{i}"] = m
+            out[f"v_{i}"] = v
+        return out
+
+    def load_host_state_dict(self, d: Dict[str, Any]) -> None:
+        if self._state is None:
+            self.init_host_state(for_load=True)
+        self.count = int(d["count"])
+        n = len(self._leaves)
+        if self.store is not None:
+            self.store.write_all(d)
+            for i in range(n):
+                self._refresh_push_buf(
+                    i, np.ascontiguousarray(d[f"master_{i}"], np.float32))
+            return
+        self._state = [
+            (np.ascontiguousarray(d[f"master_{i}"], np.float32),
+             np.ascontiguousarray(d[f"m_{i}"], np.float32),
+             np.ascontiguousarray(d[f"v_{i}"], np.float32))
+            for i in range(n)]
+        for i in range(n):
+            self._refresh_push_buf(i, self._state[i][0])
+
+    # `master is None` drives the checkpoint layer's "initialized yet?" probe
+    # (same contract as HostOffloadRunner)
+    @property
+    def master(self):
+        return self._state

@@ -86,6 +86,17 @@ def main():
     results.append(run("int8-hbm", [
         sys.executable, os.path.join(REPO, "scripts", "int8_hbm.py")], 1500))
     save()
+    # ZeRO-Infinity param-stream rows last: longest, and must never cost the
+    # decode/SD/MFU evidence if the tunnel drops mid-run. Config dicts come
+    # from bench.py (single source of truth).
+    sys.path.insert(0, REPO)
+    from bench import INFINITY_CONFIGS
+
+    for spec in INFINITY_CONFIGS:
+        results.append(run(f"infinity:{spec['model']}", [
+            sys.executable, os.path.join(REPO, "bench.py"), "--worker",
+            json.dumps(spec)], spec.get("timeout", 3600)))
+        save()
     print(f"[chip_session] done -> {OUT}")
 
 

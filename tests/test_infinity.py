@@ -1,0 +1,189 @@
+"""ZeRO-Infinity parameter streaming (offload_param): host-resident masters
+streamed unit-by-unit through device memory.
+
+Mirrors the reference's offload_param coverage
+(tests/unit/runtime/zero/test_zero_offloadpp.py + the ZeRO-Infinity configs in
+tests/unit/runtime/zero/test_zero.py): correctness vs the in-HBM trajectory,
+bf16 training, checkpoint round-trip, and the NVMe master store.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import deepspeed_tpu
+
+
+def _engine(config_extra=None, vocab=128, tie=True):
+    from deepspeed_tpu.models import build_gpt
+    from deepspeed_tpu.models.gpt import GPTConfig
+
+    model, cfg = build_gpt(GPTConfig(
+        vocab_size=vocab, d_model=32, n_layer=3, n_head=2, max_seq_len=32,
+        tie_embeddings=tie))
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "steps_per_print": 0,
+    }
+    config.update(config_extra or {})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    return engine, cfg
+
+
+def _batch(cfg, seed=0, bs=16, seq=16):
+    r = np.random.default_rng(seed)
+    return {"input_ids": r.integers(0, cfg.vocab_size, size=(bs, seq),
+                                    dtype=np.int32)}
+
+
+STREAM_CFG = {"zero_optimization": {"offload_param": {"device": "cpu"}}}
+
+
+def _transplant(runner, device_params):
+    """Overwrite the stream runner's host masters with a device param tree."""
+    runner.init_host_state()
+    dp = {k: np.asarray(v, np.float32) for k, v in device_params.items()
+          if k != "blocks"}
+    blocks = {k: np.asarray(v, np.float32)
+              for k, v in device_params["blocks"].items()}
+    for i, (unit, name, shape) in enumerate(runner._leaves):
+        if unit == "embed" or unit == "final":
+            src = dp[name]
+        else:
+            layer = int(unit.split("_")[1])
+            src = blocks[name][layer]
+        assert src.shape == shape, (unit, name, src.shape, shape)
+        mst, m, v = runner._state[i]
+        mst[...] = src
+        runner._refresh_push_buf(i, mst)
+
+
+def test_stream_matches_in_hbm_trajectory():
+    """With identical initial weights, the streamed (per-unit recompute) step
+    must track the fused in-HBM program's loss and updated params."""
+    e_dev, cfg = _engine()
+    e_str, _ = _engine(STREAM_CFG)
+    assert e_str._param_stream is not None
+    _transplant(e_str._param_stream, e_dev.state["params"])
+
+    for i in range(3):
+        b = _batch(cfg, seed=i)
+        m_str = e_str.train_batch(b)
+        m_dev = e_dev.train_batch(b)
+        np.testing.assert_allclose(
+            float(m_str["loss"]), float(m_dev["loss"]), rtol=2e-4)
+        np.testing.assert_allclose(
+            float(m_str["grad_norm"]), float(m_dev["grad_norm"]), rtol=2e-3)
+
+    # compare one updated layer-leaf and the embedding against the device run
+    runner = e_str._param_stream
+    leaf_by_key = {(u, n): i for i, (u, n, _) in enumerate(runner._leaves)}
+    wte_stream = runner._state[leaf_by_key[("embed", "wte")]][0]
+    np.testing.assert_allclose(
+        wte_stream, np.asarray(e_dev.state["params"]["wte"], np.float32),
+        rtol=1e-3, atol=2e-5)
+    qkv_stream = runner._state[leaf_by_key[("layer_1", "qkv_w")]][0]
+    np.testing.assert_allclose(
+        qkv_stream, np.asarray(e_dev.state["params"]["blocks"]["qkv_w"][1],
+                               np.float32), rtol=1e-3, atol=2e-5)
+
+
+def test_stream_bf16_loss_falls():
+    e, cfg = _engine({**STREAM_CFG, "bf16": {"enabled": True},
+                      "gradient_clipping": 1.0})
+    b = _batch(cfg, seed=0)
+    losses = [float(e.train_batch(b)["loss"]) for _ in range(8)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]  # overfits the repeated batch
+    stats = e._param_stream.last_stats
+    assert stats["n_params"] > 0 and stats["wire_bytes_per_step"] > 0
+
+
+def test_stream_device_state_is_empty():
+    e, _ = _engine(STREAM_CFG)
+    assert e.state["params"] == {}
+    assert e.state["opt"] == {} and e.state["master"] == {}
+
+
+def test_stream_untied_head():
+    e, cfg = _engine({**STREAM_CFG}, tie=False)
+    b = _batch(cfg, seed=0)
+    losses = [float(e.train_batch(b)["loss"]) for _ in range(6)]
+    assert losses[-1] < losses[0]
+    keys = {(u, n) for u, n, _ in e._param_stream._leaves}
+    assert ("final", "lm_head") in keys
+
+
+def test_stream_checkpoint_roundtrip(tmp_path):
+    e, cfg = _engine(STREAM_CFG)
+    b = _batch(cfg, seed=0)
+    for _ in range(2):
+        e.train_batch(b)
+    e.save_checkpoint(str(tmp_path))
+    loss_ref = float(e.train_batch(_batch(cfg, seed=7))["loss"])
+    e2, _ = _engine(STREAM_CFG)
+    e2.load_checkpoint(str(tmp_path))
+    assert int(e2.state["step"]) == 2
+    assert e2._param_stream.count == 2
+    # replaying the same next batch from the restored state matches exactly
+    loss2 = float(e2.train_batch(_batch(cfg, seed=7))["loss"])
+    assert loss2 == pytest.approx(loss_ref, rel=1e-6)
+
+
+def test_stream_checkpoint_requires_optimizer_state(tmp_path):
+    e, cfg = _engine(STREAM_CFG)
+    e.train_batch(_batch(cfg))
+    e.save_checkpoint(str(tmp_path))
+    e2, _ = _engine(STREAM_CFG)
+    with pytest.raises(ValueError, match="host master"):
+        e2.load_checkpoint(str(tmp_path), load_optimizer_states=False)
+
+
+def test_stream_nvme_masters(tmp_path):
+    e, cfg = _engine({"zero_optimization": {"offload_param": {
+        "device": "nvme", "nvme_path": str(tmp_path), "buffer_count": 2}}})
+    assert e._param_stream.store is not None
+    b = _batch(cfg, seed=0)
+    losses = [float(e.train_batch(b)["loss"]) for _ in range(4)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+
+
+def test_stream_labels_and_loss_mask_match_engine():
+    """The stream head honors labels/loss_mask exactly like next_token_loss."""
+    e_dev, cfg = _engine()
+    e_str, _ = _engine(STREAM_CFG)
+    _transplant(e_str._param_stream, e_dev.state["params"])
+    r = np.random.default_rng(3)
+    b = _batch(cfg, seed=3)
+    b["loss_mask"] = (r.random(b["input_ids"].shape) > 0.3).astype(np.float32)
+    m_str = e_str.train_batch(b)
+    m_dev = e_dev.train_batch(b)
+    np.testing.assert_allclose(float(m_str["loss"]), float(m_dev["loss"]),
+                               rtol=2e-4)
+
+
+def test_stream_rejects_unknown_batch_keys():
+    e, cfg = _engine(STREAM_CFG)
+    b = _batch(cfg)
+    b["attention_mask"] = np.ones_like(b["input_ids"])
+    with pytest.raises(ValueError, match="unknown"):
+        e.train_batch(b)
+
+
+def test_stream_rejects_gas():
+    with pytest.raises(ValueError, match="gradient_accumulation_steps"):
+        _engine({**STREAM_CFG, "gradient_accumulation_steps": 2})
+
+
+def test_stream_supersedes_offload_optimizer():
+    """A full ZeRO-Infinity config (both offload blocks) routes to the param
+    stream runner, which owns the host optimizer itself."""
+    e, cfg = _engine({"zero_optimization": {
+        "offload_param": {"device": "cpu"},
+        "offload_optimizer": {"device": "cpu"}}})
+    assert e._param_stream is not None and e._offload is None
+    m = e.train_batch(_batch(cfg))
+    assert np.isfinite(float(m["loss"]))

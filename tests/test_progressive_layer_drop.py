@@ -1,0 +1,110 @@
+"""Progressive Layer Drop (parity: reference runtime/progressive_layer_drop.py
++ arXiv:2010.13369): theta schedule, in-scan layer gating, engine integration.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import build_gpt
+from deepspeed_tpu.models.gpt import GPTConfig, forward, init_params
+from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+
+
+def test_theta_schedule_matches_reference_formula():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.001)
+    assert pld.get_theta() == 1.0
+    for t in (1, 10, 1000, 100000):
+        pld.update_state(t)
+        expect = (1.0 - 0.5) * np.exp(-0.001 * t) + 0.5
+        assert pld.get_theta() == pytest.approx(expect, rel=1e-9)
+    assert pld.get_state()["progressive_layer_drop"] is True
+    # late in training theta approaches the configured floor
+    pld.update_state(10_000_000)
+    assert pld.get_theta() == pytest.approx(0.5, abs=1e-6)
+
+
+def _tiny(n_layer=2):
+    cfg = GPTConfig(vocab_size=64, d_model=32, n_layer=n_layer, n_head=2,
+                    max_seq_len=16)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 64, (2, 16)),
+                      jnp.int32)
+    return cfg, params, ids
+
+
+def test_pld_theta_one_is_identity():
+    """theta=1 keeps every layer with probability 1 — the baseline up to the
+    x + (y-x) residual-form rounding (a dropped layer would differ hugely)."""
+    cfg, params, ids = _tiny()
+    rngs = {"dropout": jax.random.PRNGKey(3)}
+    base = np.asarray(forward(cfg, params, ids, rngs=rngs, train=True),
+                      np.float32)
+    pld = np.asarray(forward(cfg, params, ids, rngs=rngs, train=True,
+                             pld_theta=jnp.float32(1.0)), np.float32)
+    np.testing.assert_allclose(base, pld, atol=1e-4, rtol=1e-4)
+
+
+def test_pld_theta_zero_drops_last_layer():
+    """With theta=0 the deepest layer's keep probability is exactly 0: poison
+    its weights — the output must match the clean model under the same rng."""
+    cfg, params, ids = _tiny(n_layer=2)
+    poisoned = jax.tree_util.tree_map(lambda x: x, params)
+    blocks = dict(poisoned["blocks"])
+    qkv = np.asarray(blocks["qkv_w"], np.float32).copy()
+    qkv[1] = 1e30  # layer index 1 == deepest layer
+    blocks["qkv_w"] = jnp.asarray(qkv)
+    poisoned["blocks"] = blocks
+    rngs = {"dropout": jax.random.PRNGKey(5)}
+    out_clean = forward(cfg, params, ids, rngs=rngs, train=True,
+                        pld_theta=jnp.float32(0.0))
+    out_poison = forward(cfg, poisoned, ids, rngs=rngs, train=True,
+                         pld_theta=jnp.float32(0.0))
+    assert np.isfinite(np.asarray(out_poison, np.float32)).all()
+    np.testing.assert_array_equal(np.asarray(out_clean),
+                                  np.asarray(out_poison))
+
+
+def test_pld_exclusive_with_stochastic_depth():
+    cfg, params, ids = _tiny()
+    cfg = cfg.__class__(**{**cfg.__dict__, "stochastic_depth": 0.1})
+    with pytest.raises(ValueError, match="stochastic_depth"):
+        forward(cfg, init_params(cfg, jax.random.PRNGKey(0)), ids,
+                rngs={"dropout": jax.random.PRNGKey(0)}, train=True,
+                pld_theta=jnp.float32(0.5))
+
+
+def _engine(extra=None):
+    model, cfg = build_gpt(GPTConfig(
+        vocab_size=128, d_model=32, n_layer=3, n_head=2, max_seq_len=32))
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "progressive_layer_drop": {"enabled": True, "theta": 0.5,
+                                   "gamma": 0.01},
+        "steps_per_print": 0,
+    }
+    config.update(extra or {})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    return engine, cfg
+
+
+def test_engine_pld_trains_and_tracks_theta():
+    e, cfg = _engine()
+    assert e.progressive_layer_drop is not None
+    r = np.random.default_rng(0)
+    b = {"input_ids": r.integers(0, cfg.vocab_size, (16, 16), dtype=np.int32)}
+    losses = [float(e.train_batch(b)["loss"]) for _ in range(6)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+    expect = (1.0 - 0.5) * np.exp(-0.01 * 6) + 0.5
+    assert e.progressive_layer_drop.get_theta() == pytest.approx(expect)
+
+
+def test_engine_pld_rejects_offload():
+    with pytest.raises(ValueError, match="progressive_layer_drop"):
+        _engine({"zero_optimization": {
+            "offload_optimizer": {"device": "cpu"}}})
