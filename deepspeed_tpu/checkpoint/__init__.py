@@ -191,4 +191,14 @@ def __getattr__(name):
         from . import reference_import
 
         return getattr(reference_import, name)
+    if name in ("save_reference_moe_checkpoint",
+                "load_reference_moe_checkpoint"):
+        from . import moe_interop
+
+        return getattr(moe_interop, name)
+    if name in ("save_reference_checkpoint", "export_engine_checkpoint",
+                "hf_config_for_export"):
+        from . import reference_export
+
+        return getattr(reference_export, name)
     raise AttributeError(name)

@@ -1,0 +1,215 @@
+"""MoE expert-sharded checkpoint interop with the reference layout.
+
+The reference saves MoE expert weights as ONE torch file per
+(moe-layer, global expert) — ``layer_{L}_expert_{E}_mp_rank_{MM}_model_states.pt``
+(``deepspeed/runtime/engine.py:3151`` ``_save_moe_checkpoint`` /
+``engine.py:2685`` ``_get_expert_ckpt_name``), with each file's keys shaped
+``<module path>.deepspeed_moe.experts.deepspeed_experts.{E}.<param>`` and the
+gate kept in the dense ``mp_rank_{MM}_model_states.pt`` under
+``...deepspeed_moe.gate.wg.weight`` (``engine.py:2660`` ``_get_non_moe_state_dict``).
+
+This module converts between that layout and the TPU-native stacked expert
+bank (``moe/experts.py``: ``{up_w [S,E,d,f], up_b [S,E,f], down_w [S,E,f,d],
+down_b [S,E,d]}`` + ``gate_w [S,d,E]``):
+
+- export: slice the bank per (super-layer, expert), transpose to torch
+  ``Linear`` [out,in] convention with Megatron-MoE names
+  (``dense_h_to_4h`` / ``dense_4h_to_h``), write one file per expert.
+- import: regex-match ``deepspeed_experts.{E}`` keys across expert files
+  (both the modern ``layer_{L}_expert_{E}`` and legacy ``expert_{E}``
+  namings), restack into the bank.
+
+The expert-parallel resharding the reference does at load
+(``engine.py:2560`` global->local expert renumbering across ``expp`` ranks) is
+a no-op here by construction: the logical bank holds every expert, and the
+``P("ep", ...)`` sharding places e-slices on the mesh at device_put time.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import jax
+
+from .reference_import import _np32, _torch_load, resolve_tag
+
+# torch Linear convention: weight [out, in]; Megatron-MoE expert param names
+_EXPERT_KEYS = {
+    "dense_h_to_4h.weight": ("up_w", True),
+    "dense_h_to_4h.bias": ("up_b", False),
+    "dense_4h_to_h.weight": ("down_w", True),
+    "dense_4h_to_h.bias": ("down_b", False),
+}
+_EXPERT_RE = re.compile(r".*deepspeed_moe\.experts\.deepspeed_experts\.(\d+)\.(.+)$")
+_GATE_RE = re.compile(r".*deepspeed_moe\.gate\.wg\.weight$")
+
+
+def _expert_file(tag_dir: str, layer_id: int, expert_id: int,
+                 mp_rank: int = 0) -> str:
+    return os.path.join(
+        tag_dir, f"layer_{layer_id}_expert_{expert_id}_mp_rank_"
+                 f"{mp_rank:02d}_model_states.pt")
+
+
+def save_reference_moe_checkpoint(
+        params: Dict[str, Any], save_dir: str, tag: str = "global_step0",
+        layer_prefix: str = "module.transformer.layers",
+        moe_freq: int = 1) -> List[str]:
+    """Write the stacked MoE bank in the reference's expert-file layout.
+
+    ``params`` is a ``models.gpt_moe`` param tree (or any tree with
+    ``moe_blocks.moe.{gate_w, experts.*}``). Returns the written file paths.
+    """
+    import torch
+
+    moe = params["moe_blocks"]["moe"]
+    experts = jax.tree_util.tree_map(lambda x: np.asarray(x, np.float32),
+                                     moe["experts"])
+    gate_w = np.asarray(moe["gate_w"], np.float32)       # [S, d, E]
+    S, E = experts["up_w"].shape[:2]
+    tag_dir = os.path.join(save_dir, tag)
+    os.makedirs(tag_dir, exist_ok=True)
+    written = []
+    for s in range(S):
+        # absolute transformer layer index of the s-th MoE layer (every
+        # moe_freq-th layer is MoE); the FILE id stays the sequential MoE
+        # counter exactly like the reference's moe_layer_id enumeration
+        abs_idx = (s + 1) * moe_freq - 1
+        mod = (f"{layer_prefix}.{abs_idx}.mlp.deepspeed_moe"
+               f".experts.deepspeed_experts")
+        for e in range(E):
+            sd = {}
+            for torch_name, (leaf, transpose) in _EXPERT_KEYS.items():
+                arr = experts[leaf][s, e]
+                if transpose:
+                    arr = arr.T
+                # copy=True: device_get/asarray views can be read-only, which
+                # torch.from_numpy rejects (undefined-behavior warning)
+                sd[f"{mod}.{e}.{torch_name}"] = torch.from_numpy(
+                    np.array(arr, np.float32, copy=True))
+            path = _expert_file(tag_dir, s, e)
+            torch.save(sd, path)
+            written.append(path)
+    # gate weights ride the dense states file (kept by the reference's
+    # _get_non_moe_state_dict), inside the reference's {'module': ...} wrapper;
+    # [E, d] torch Linear convention per layer. MERGE with any existing dense
+    # export (save_reference_checkpoint writes the same file) — clobbering
+    # would silently destroy every non-MoE weight.
+    gate_sd = {
+        (f"{layer_prefix}.{(s + 1) * moe_freq - 1}.mlp.deepspeed_moe"
+         f".gate.wg.weight"): torch.from_numpy(
+             np.array(gate_w[s].T, np.float32, copy=True))
+        for s in range(S)
+    }
+    gate_path = os.path.join(tag_dir, "mp_rank_00_model_states.pt")
+    if os.path.exists(gate_path):
+        existing = _torch_load(gate_path)
+        module = dict(existing.get("module", {}))
+        module.update(gate_sd)
+        existing["module"] = module
+        torch.save(existing, gate_path)
+    else:
+        torch.save({"module": gate_sd, "buffer_names": [],
+                    "ds_version": "0.8.1"}, gate_path)
+    written.append(gate_path)
+    with open(os.path.join(save_dir, "latest"), "w") as f:
+        f.write(tag)
+    return written
+
+
+def load_reference_moe_checkpoint(
+        params: Dict[str, Any], checkpoint_dir: str,
+        tag: Optional[str] = None) -> Dict[str, Any]:
+    """Return ``params`` with the MoE bank replaced from a reference-layout
+    expert-sharded checkpoint (modern ``layer_{L}_expert_{E}`` or legacy
+    ``expert_{E}`` file naming)."""
+    tag = resolve_tag(checkpoint_dir, tag)
+    tag_dir = os.path.join(checkpoint_dir, tag)
+    moe = params["moe_blocks"]["moe"]
+    experts = {k: np.array(_np32(v), copy=True)
+               for k, v in moe["experts"].items()}
+    gate_w = np.array(_np32(moe["gate_w"]), copy=True)   # [S, d, E]
+    S, E = experts["up_w"].shape[:2]
+
+    legacy = not os.path.exists(_expert_file(tag_dir, 0, 0))
+    for s in range(S):
+        for e in range(E):
+            if legacy:
+                if s > 0:
+                    raise FileNotFoundError(
+                        f"legacy expert files (expert_{{E}}) hold a single "
+                        f"MoE layer but the model has {S}")
+                path = os.path.join(
+                    tag_dir, f"expert_{e}_mp_rank_00_model_states.pt")
+            else:
+                path = _expert_file(tag_dir, s, e)
+            if not os.path.exists(path):
+                raise FileNotFoundError(f"missing expert file {path}")
+            sd = _torch_load(path)
+            found = 0
+            for key, val in sd.items():
+                m = _EXPERT_RE.match(key)
+                if not m:
+                    continue
+                if int(m.group(1)) != e:
+                    # the reference renames local->global ids at save; a
+                    # mismatched id means the file disagrees with its name
+                    raise ValueError(
+                        f"{path}: key {key} carries expert id {m.group(1)}")
+                leaf, transpose = _EXPERT_KEYS.get(m.group(2), (None, None))
+                if leaf is None:
+                    raise ValueError(
+                        f"{path}: unknown expert param {m.group(2)!r} "
+                        f"(supported: {sorted(_EXPERT_KEYS)})")
+                arr = _np32(val)
+                if transpose:
+                    arr = arr.T
+                if arr.shape != experts[leaf][s, e].shape:
+                    raise ValueError(
+                        f"{path}: {key} shape {arr.shape} != bank slot "
+                        f"{experts[leaf][s, e].shape}")
+                experts[leaf][s, e] = arr
+                found += 1
+            if found != len(_EXPERT_KEYS):
+                raise ValueError(
+                    f"{path}: found {found}/{len(_EXPERT_KEYS)} expert params")
+    # gate (optional in expert-only exports); real reference files nest the
+    # state dict under 'module' (engine _save_checkpoint layout) — accept both
+    dense_path = os.path.join(tag_dir, "mp_rank_00_model_states.pt")
+    if os.path.exists(dense_path):
+        dense_sd = _torch_load(dense_path)
+        dense_sd = dense_sd.get("module", dense_sd)
+        gates = [(k, v) for k, v in dense_sd.items()
+                 if _GATE_RE.match(k)]
+        if gates:
+            if len(gates) != S:
+                raise ValueError(
+                    f"{dense_path}: {len(gates)} gate tensors for {S} MoE "
+                    f"layers")
+            # sort by the layer index embedded in the module path
+            def _lidx(key: str) -> int:
+                nums = re.findall(r"\.(\d+)\.", key)
+                if not nums:
+                    raise ValueError(f"gate key {key!r} has no layer index")
+                return int(nums[-1])
+
+            for s, (k, v) in enumerate(sorted(gates, key=lambda kv: _lidx(kv[0]))):
+                arr = _np32(v).T  # [E,d] -> [d,E]
+                if arr.shape != gate_w[s].shape:
+                    raise ValueError(
+                        f"{dense_path}: gate {k} shape {arr.shape} != "
+                        f"{gate_w[s].shape}")
+                gate_w[s] = arr
+
+    out = dict(params)
+    out_moe_blocks = dict(params["moe_blocks"])
+    out_moe = dict(moe)
+    out_moe["experts"] = experts
+    out_moe["gate_w"] = gate_w
+    out_moe_blocks["moe"] = out_moe
+    out["moe_blocks"] = out_moe_blocks
+    return out

@@ -61,6 +61,9 @@ class Module:
     # layers train on `keep`-token subsets (the engine calls it when the
     # data_efficiency random_ltd schedule moves to a new compile bucket)
     with_ltd_keep: Optional[Callable[[int, Tuple[int, ...]], "Module"]] = None
+    # the GPTConfig this module was built from, when it is a build_gpt model —
+    # checkpoint exporters need it (checkpoint/reference_export.py)
+    gpt_config: Optional[Any] = None
     # optional ZeRO-Infinity decomposition: () -> StreamSpec (models/gpt.py
     # make_stream). Exposes the model as embed / repeated-layer / head units so
     # the param-stream runner (runtime/zero/infinity.py) can keep master

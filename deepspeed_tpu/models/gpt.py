@@ -63,9 +63,13 @@ class GPTConfig:
     use_flash: Optional[bool] = None  # None = auto dispatch
     flash_block_q: int = 256  # flash-attention tile sizes (autotunable)
     flash_block_k: int = 256
-    # stochastic-mode training (parity: the reference's StochasticTransformer,
-    # op_builder/stochastic_transformer.py): drop whole blocks with prob p at
-    # train time, survivor delta scaled by 1/(1-p)
+    # speed-over-bit-exactness kernel flag (parity: the reference's
+    # StochasticTransformer, op_builder/stochastic_transformer.py +
+    # csrc/transformer/ds_transformer_cuda.cpp:63 stochastic_mode): attention
+    # matmul operands ride the MXU's native bf16 pass, fp32 accumulation
+    stochastic_mode: bool = False
+    # stochastic-DEPTH training (Huang et al.): drop whole blocks with prob p
+    # at train time, survivor delta scaled by 1/(1-p)
     stochastic_depth: float = 0.0
     # GPT-Neo-style alternating local attention: every `period`-th layer
     # (1-indexed within the period; GPT-Neo = period 2, layers 1,3,... local)
@@ -324,7 +328,8 @@ def _attention_delta(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray],
                                    use_flash=cfg.use_flash,
                                    softmax_scale=cfg.attention_scale,
                                    block_q=cfg.flash_block_q,
-                                   block_k=cfg.flash_block_k)
+                                   block_k=cfg.flash_block_k,
+                                   stochastic_mode=cfg.stochastic_mode)
     attn = attn.reshape(B, T, D)
     return checkpoint_name(attn @ w["attn_out_w"] + w["attn_out_b"], "attn_out")
 
@@ -882,4 +887,5 @@ def build(cfg_or_name) -> Tuple[Module, GPTConfig]:
         to_pipeline=to_pipeline,
         with_ltd_keep=with_ltd_keep,
         stream=lambda: GPTStream(cfg),
+        gpt_config=cfg,
     ), cfg

@@ -59,9 +59,12 @@ def multihead_attention(
     softmax_scale: Optional[float] = None,
     block_q: int = 256,
     block_k: int = 256,
+    stochastic_mode: bool = False,
 ) -> jnp.ndarray:
     """Kernel dispatch: Pallas flash attention on TPU when eligible, XLA
-    otherwise. ``block_q``/``block_k`` tune the flash tiling (autotunable)."""
+    otherwise. ``block_q``/``block_k`` tune the flash tiling (autotunable);
+    ``stochastic_mode`` is the speed-over-bit-exactness kernel flag (bf16
+    MXU operands, fp32 accumulation — see ops/pallas/flash_attention.py)."""
     if use_flash is None:
         use_flash = _flash_eligible(q, k, bias)
     elif use_flash and bias is not None:
@@ -82,7 +85,8 @@ def multihead_attention(
         else:
             return flash_attention(q, k, v, causal=causal,
                                    softmax_scale=softmax_scale,
-                                   block_q=block_q, block_k=block_k)
+                                   block_q=block_q, block_k=block_k,
+                                   stochastic_mode=stochastic_mode)
     return dot_product_attention(q, k, v, causal=causal, bias=bias,
                                  softmax_scale=softmax_scale)
 

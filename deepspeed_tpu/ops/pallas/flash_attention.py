@@ -46,10 +46,16 @@ def _interpret() -> bool:
 # --------------------------------------------------------------------------- fwd
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
                 causal: bool, block_q: int, block_k: int, kv_len: int,
-                q_offset: int):
+                q_offset: int, stochastic_mode: bool):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * sm_scale  # [Bq, D]
     bq = q.shape[0]
+    # stochastic mode (parity: ds_transformer_cuda.cpp:63 stochastic_mode —
+    # speed over run-exactness): matmul operands stay in the input dtype so
+    # the MXU runs its native bf16 pass (fp32 upcast costs multiple passes);
+    # accumulation and the softmax state remain fp32
+    lo = q_ref.dtype if stochastic_mode else jnp.float32
+    q_lo = q.astype(lo)
 
     acc = jnp.zeros((bq, v_ref.shape[-1]), jnp.float32)
     m_i = jnp.full((bq, 1), NEG_INF, jnp.float32)
@@ -66,9 +72,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
 
     def body(ki, carry):
         acc, m_i, l_i = carry
-        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)  # [Bk, D]
-        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [Bq, Bk]
+        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(lo)  # [Bk, D]
+        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(lo)
+        s = jax.lax.dot_general(q_lo, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [Bq, Bk]
         if causal:
             k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
             s = jnp.where(k_pos <= q_pos, s, NEG_INF)
@@ -76,7 +83,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
         alpha = jnp.exp(m_i - m_new)
         p = jnp.exp(s - m_new)
         l_new = alpha * l_i + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jax.lax.dot(p, v)
+        acc = acc * alpha + jax.lax.dot(p.astype(lo), v,
+                                        preferred_element_type=jnp.float32)
         return acc, m_new, l_new
 
     acc, m_i, l_i = jax.lax.fori_loop(0, num_k_blocks, body, (acc, m_i, l_i))
@@ -86,13 +94,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
     lse_ref[0] = jnp.broadcast_to(lse, (bq, LANES))
 
 
-def _fwd(q, k, v, sm_scale: float, causal: bool, block_q: int, block_k: int):
+def _fwd(q, k, v, sm_scale: float, causal: bool, block_q: int, block_k: int,
+         stochastic_mode: bool = False):
     """q,k,v: [BH, T, D] -> (o [BH, T, D], lse [BH, T, LANES])."""
     BH, T, D = q.shape
     S = k.shape[1]
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, kv_len=S, q_offset=S - T)
+        block_q=block_q, block_k=block_k, kv_len=S, q_offset=S - T,
+        stochastic_mode=stochastic_mode)
     o, lse = pl.pallas_call(
         kernel,
         grid=(BH, T // block_q),
@@ -117,11 +127,13 @@ def _fwd(q, k, v, sm_scale: float, causal: bool, block_q: int, block_k: int):
 # --------------------------------------------------------------------------- bwd
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *,
                    sm_scale: float, causal: bool, block_q: int, block_k: int,
-                   kv_len: int, q_offset: int):
+                   kv_len: int, q_offset: int, stochastic_mode: bool):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
+    lo = q_ref.dtype if stochastic_mode else jnp.float32
+    q = q_ref[0].astype(lo)
     do = do_ref[0].astype(jnp.float32)
     o = o_ref[0].astype(jnp.float32)
+    do_lo = do.astype(lo)
     lse = lse_ref[0][:, :1]  # [Bq, 1]
     delta = jnp.sum(do * o, axis=-1, keepdims=True)  # [Bq, 1]
     bq = q.shape[0]
@@ -134,28 +146,34 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *,
         jnp.int32, (bq, block_k), 0)
 
     def body(ki, dq):
-        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * sm_scale
+        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(lo)
+        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(lo)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale
         if causal:
             k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
             s = jnp.where(k_pos <= q_pos, s, NEG_INF)
         p = jnp.exp(s - lse)  # [Bq, Bk]
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))  # [Bq, Bk]
+        dp = jax.lax.dot_general(do_lo, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)  # [Bq, Bk]
         ds = p * (dp - delta) * sm_scale
-        return dq + jax.lax.dot(ds, k)
+        return dq + jax.lax.dot(ds.astype(lo), k,
+                                preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(
-        0, num_k_blocks, body, jnp.zeros((bq, q.shape[-1]), jnp.float32))
+        0, num_k_blocks, body, jnp.zeros((bq, q_ref.shape[-1]), jnp.float32))
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
                     dk_ref, dv_ref, *, sm_scale: float, causal: bool,
-                    block_q: int, block_k: int, q_len: int, q_offset: int):
+                    block_q: int, block_k: int, q_len: int, q_offset: int,
+                    stochastic_mode: bool):
     ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)  # [Bk, D]
-    v = v_ref[0].astype(jnp.float32)
+    lo = k_ref.dtype if stochastic_mode else jnp.float32
+    k = k_ref[0].astype(lo)  # [Bk, D]
+    v = v_ref[0].astype(lo)
     bk = k.shape[0]
 
     # first q block whose absolute position can reach this k block
@@ -164,21 +182,29 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
     def body(qi, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(lo)
         do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
         o = o_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        do_lo = do.astype(lo)
         lse = lse_ref[0, pl.ds(qi * block_q, block_q), :1]  # [Bq, 1]
         delta = jnp.sum(do * o, axis=-1, keepdims=True)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * sm_scale  # [Bq, Bk]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [Bq, Bk]
         if causal:
             q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, bk), 0)
             s = jnp.where(k_pos <= q_pos, s, NEG_INF)
         p = jnp.exp(s - lse)
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())))  # [Bk, D]
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))  # [Bq, Bk]
+        dv = dv + jax.lax.dot_general(p.astype(lo), do_lo,
+                                      (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)  # [Bk, D]
+        dp = jax.lax.dot_general(do_lo, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)  # [Bq, Bk]
         ds = p * (dp - delta) * sm_scale
-        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())))  # [Bk, D]
+        dk = dk + jax.lax.dot_general(ds.astype(lo), q,
+                                      (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)  # [Bk, D]
         return dk, dv
 
     dk, dv = jax.lax.fori_loop(
@@ -189,7 +215,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(sm_scale, causal, block_q, block_k, res, do):
+def _bwd(sm_scale, causal, block_q, block_k, stochastic_mode, res, do):
     q, k, v, o, lse = res
     BH, T, D = q.shape
     S = k.shape[1]
@@ -197,7 +223,7 @@ def _bwd(sm_scale, causal, block_q, block_k, res, do):
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, kv_len=S,
-                          q_offset=S - T),
+                          q_offset=S - T, stochastic_mode=stochastic_mode),
         grid=(BH, T // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
@@ -215,7 +241,7 @@ def _bwd(sm_scale, causal, block_q, block_k, res, do):
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, q_len=T,
-                          q_offset=S - T),
+                          q_offset=S - T, stochastic_mode=stochastic_mode),
         grid=(BH, S // block_k),
         in_specs=[
             pl.BlockSpec((1, T, D), lambda bh, ki: (bh, 0, 0)),
@@ -239,19 +265,19 @@ def _bwd(sm_scale, causal, block_q, block_k, res, do):
 
 
 # --------------------------------------------------------------------------- api
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, sm_scale, causal, block_q, block_k):
-    o, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, sm_scale, causal, block_q, block_k, stochastic_mode):
+    o, _ = _fwd(q, k, v, sm_scale, causal, block_q, block_k, stochastic_mode)
     return o
 
 
-def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k):
-    o, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k)
+def _flash_fwd(q, k, v, sm_scale, causal, block_q, block_k, stochastic_mode):
+    o, lse = _fwd(q, k, v, sm_scale, causal, block_q, block_k, stochastic_mode)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(sm_scale, causal, block_q, block_k, res, do):
-    return _bwd(sm_scale, causal, block_q, block_k, res, do)
+def _flash_bwd(sm_scale, causal, block_q, block_k, stochastic_mode, res, do):
+    return _bwd(sm_scale, causal, block_q, block_k, stochastic_mode, res, do)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -265,8 +291,14 @@ def flash_attention(
     softmax_scale: Optional[float] = None,
     block_q: int = 256,
     block_k: int = 256,
+    stochastic_mode: bool = False,
 ) -> jnp.ndarray:
-    """Blockwise attention with online softmax; differentiable (custom VJP)."""
+    """Blockwise attention with online softmax; differentiable (custom VJP).
+
+    ``stochastic_mode`` trades bit-exactness for speed (parity:
+    ``csrc/transformer/ds_transformer_cuda.cpp:63``): matmul operands ride the
+    input dtype onto the MXU's native bf16 pass instead of being upcast to
+    fp32; accumulators and softmax state stay fp32. Off by default."""
     B, T, H, D = q.shape
     S = k.shape[1]
     block_q = min(block_q, T)
@@ -283,5 +315,6 @@ def flash_attention(
     qt = q.transpose(0, 2, 1, 3).reshape(B * H, T, D)
     kt = k.transpose(0, 2, 1, 3).reshape(B * H, S, D)
     vt = v.transpose(0, 2, 1, 3).reshape(B * H, S, D)
-    o = _flash(qt, kt, vt, scale, causal, block_q, block_k)
+    o = _flash(qt, kt, vt, scale, causal, block_q, block_k,
+               bool(stochastic_mode))
     return o.reshape(B, H, T, D).transpose(0, 2, 1, 3)

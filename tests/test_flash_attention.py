@@ -69,3 +69,32 @@ def test_cross_length_causal_offset():
     g_fl = jax.grad(loss_flash, argnums=(0, 1, 2))(q_short, k, v)
     for a, b in zip(g_ref, g_fl):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float32])
+def test_stochastic_mode_close_to_exact(dtype):
+    """stochastic_mode (parity: ds_transformer_cuda.cpp:63): bf16 MXU operands
+    with fp32 accumulation — close to, but not necessarily bitwise equal to,
+    the exact fp32-operand kernel; gradients flow through the same flag."""
+    q, k, v = make_qkv(T=256, dtype=dtype)
+    exact = flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
+    fast = flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
+                           stochastic_mode=True)
+    np.testing.assert_allclose(
+        np.asarray(exact, np.float32), np.asarray(fast, np.float32),
+        rtol=2e-2, atol=2e-2)
+
+    def loss(fn_kwargs):
+        def f(q_, k_, v_):
+            out = flash_attention(q_, k_, v_, causal=True, block_q=128,
+                                  block_k=128, **fn_kwargs)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+        return f
+
+    g_exact = jax.grad(loss({}), argnums=(0, 1, 2))(q, k, v)
+    g_fast = jax.grad(loss({"stochastic_mode": True}),
+                      argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_exact, g_fast):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=5e-2, atol=5e-2)

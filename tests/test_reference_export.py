@@ -1,0 +1,97 @@
+"""Export to the reference (torch-DeepSpeed) checkpoint layout.
+
+The reverse of test_reference_import: weights written here must (a) round-trip
+through our own reference importer bit-exactly, (b) load into the matching HF
+transformers model, and (c) come straight off a live engine — including the
+ZeRO-Infinity param-stream engine whose weights live in host masters.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint import (export_engine_checkpoint,
+                                      hf_config_for_export,
+                                      save_reference_checkpoint)
+from deepspeed_tpu.checkpoint.reference_import import (
+    get_fp32_state_dict_from_reference_checkpoint, load_reference_checkpoint)
+from deepspeed_tpu.models import build_gpt
+from deepspeed_tpu.models.gpt import GPTConfig, init_params
+
+
+def _cfg():
+    return GPTConfig(vocab_size=96, d_model=32, n_layer=2, n_head=2,
+                     max_seq_len=24)
+
+
+def test_roundtrip_through_own_importer(tmp_path):
+    cfg = _cfg()
+    params = jax.tree_util.tree_map(
+        lambda x: np.asarray(x, np.float32), init_params(cfg, jax.random.PRNGKey(0)))
+    path = save_reference_checkpoint(cfg, params, str(tmp_path), tag="global_step3")
+    assert path.endswith("global_step3/mp_rank_00_model_states.pt")
+
+    cfg2, params2 = load_reference_checkpoint(
+        str(tmp_path), hf_config_for_export(cfg), "GPT2LMHeadModel")
+    assert (cfg2.n_layer, cfg2.n_head, cfg2.d_model,
+            cfg2.vocab_size) == (2, 2, 32, 96)
+    assert cfg2.activation == cfg.activation
+    flat1 = jax.tree_util.tree_leaves_with_path(params)
+    flat2 = {jax.tree_util.keystr(k): v
+             for k, v in jax.tree_util.tree_leaves_with_path(params2)}
+    for k, v in flat1:
+        np.testing.assert_array_equal(
+            np.asarray(v, np.float32),
+            np.asarray(flat2[jax.tree_util.keystr(k)], np.float32),
+            err_msg=jax.tree_util.keystr(k))
+
+
+def test_export_loads_into_hf_transformers(tmp_path):
+    torch = pytest.importorskip("torch")
+    from transformers import GPT2Config, GPT2LMHeadModel
+
+    cfg = _cfg()
+    params = init_params(cfg, jax.random.PRNGKey(1))
+    path = save_reference_checkpoint(cfg, params, str(tmp_path))
+    sd = torch.load(path, map_location="cpu", weights_only=False)["module"]
+    hf = GPT2LMHeadModel(GPT2Config(
+        vocab_size=96, n_embd=32, n_layer=2, n_head=2, n_positions=24,
+        activation_function="gelu_new"))
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    # everything the HF module owns must be provided except attention biases
+    # (HF-internal causal-mask buffers, not weights)
+    assert not unexpected
+    assert all(".attn.bias" in m or ".attn.masked_bias" in m for m in missing), missing
+    got = hf.transformer.h[1].mlp.c_fc.weight.detach().numpy()
+    np.testing.assert_allclose(
+        got, np.asarray(params["blocks"]["mlp_up_w"][1], np.float32),
+        rtol=1e-6)
+
+
+def test_export_from_live_engines(tmp_path):
+    for extra, sub in [({}, "plain"),
+                       ({"zero_optimization": {
+                           "offload_param": {"device": "cpu"}}}, "stream")]:
+        model, cfg = build_gpt(_cfg())
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "steps_per_print": 0, **extra})
+        b = {"input_ids": np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (16, 16), dtype=np.int32)}
+        engine.train_batch(b)
+        path = export_engine_checkpoint(engine, str(tmp_path / sub))
+        sd = get_fp32_state_dict_from_reference_checkpoint(str(tmp_path / sub))
+        assert "transformer.h.1.attn.c_attn.weight" in sd
+        assert sd["transformer.wte.weight"].shape == (cfg.vocab_size,
+                                                      cfg.d_model)
+
+
+def test_export_rejects_non_gpt2_shapes(tmp_path):
+    cfg = GPTConfig(vocab_size=64, d_model=32, n_layer=2, n_head=2,
+                    max_seq_len=16, rotary=True)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="rotary"):
+        save_reference_checkpoint(cfg, params, str(tmp_path))
