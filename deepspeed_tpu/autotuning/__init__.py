@@ -1,1 +1,7 @@
 from .autotuner import Autotuner, TuningExperiment  # noqa: F401
+from .scheduler import (  # noqa: F401
+    Node,
+    ResourceManager,
+    ScheduledExperiment,
+    profile_model_info,
+)

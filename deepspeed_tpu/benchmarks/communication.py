@@ -88,6 +88,19 @@ def _collective_fn(op: str, mesh: Mesh):
     return jax.jit(fn)
 
 
+def _payload(mesh: Mesh, size_bytes: int, dtype) -> jnp.ndarray:
+    """The benched payload for a GLOBAL byte size: per-device shard sized and
+    128-lane-aligned so timings reflect steady-state transfers, not padding.
+    Single source of truth for the bench AND --verify paths — they must time
+    the identical payload for est-vs-measured to mean anything."""
+    n = mesh.devices.size
+    itemsize = jnp.dtype(dtype).itemsize
+    elems_per_dev = max(n, size_bytes // itemsize // n)
+    elems_per_dev = max(128, (elems_per_dev // 128) * 128)
+    return jax.device_put(jnp.ones((n, elems_per_dev), dtype),
+                          NamedSharding(mesh, P(AXIS)))
+
+
 def run_collective_bench(
     op: str,
     sizes_bytes: Sequence[int],
@@ -103,14 +116,10 @@ def run_collective_bench(
     mesh = Mesh(np.asarray(devices), (AXIS,))
     itemsize = jnp.dtype(dtype).itemsize
     fn = _collective_fn(op, mesh)
-    sharding = NamedSharding(mesh, P(AXIS))
     out = []
     for size in sizes_bytes:
-        elems_per_dev = max(n, size // itemsize // n)
-        # lane-align so timings reflect steady-state transfers, not padding
-        elems_per_dev = max(128, (elems_per_dev // 128) * 128)
-        x = jax.device_put(
-            jnp.ones((n, elems_per_dev), dtype), sharding)
+        x = _payload(mesh, size, dtype)
+        elems_per_dev = x.shape[1]
         for _ in range(warmups):
             r = fn(x)
         jax.block_until_ready(r)
@@ -129,6 +138,40 @@ def run_collective_bench(
             "busbw_GBps": round(algbw * _busbw_factor(op, n) / 1e9, 6),
         })
     return out
+
+
+def verify_collective(op: str, size_bytes: int, dtype=jnp.bfloat16,
+                      trials: int = 5, devices=None) -> Dict:
+    """Measured-vs-estimated for one collective (``ds_bench --verify``): the
+    wall-clock latency the bench reports vs the device-timeline collective
+    time a ``jax.profiler`` trace actually records (see
+    ``comm/runtime_accounting.py`` — the runtime analog of the reference's
+    ``utils/comms_logging.py:56`` per-op log). On the CPU backend shard_map
+    collectives execute as host rendezvous callbacks and leave no device
+    thunks — ``measured_ops`` fills in on TPU."""
+    from ..comm.runtime_accounting import profile_collectives
+
+    est = run_collective_bench(op, [size_bytes], dtype=dtype, trials=trials,
+                               devices=devices)[0]
+    devices = list(devices) if devices is not None else jax.devices()
+    n = len(devices)
+    mesh = Mesh(np.asarray(devices), (AXIS,))
+    fn = _collective_fn(op, mesh)
+    x = _payload(mesh, size_bytes, dtype)
+    jax.block_until_ready(fn(x))  # compile outside the trace
+    prof = profile_collectives(lambda: [fn(x) for _ in range(trials)],
+                               n_devices=n)
+    dev_us = sum(st.time_us for st in prof.ops.values())
+    counts = {k: st.count for k, st in sorted(prof.ops.items())}
+    return {
+        "op": op, "bytes": est["bytes"], "world": n, "trials": trials,
+        "est_latency_us": est["latency_us"],
+        # device collective time per trial per device: the transfer itself,
+        # minus dispatch/sync overhead the wall clock includes
+        "measured_device_us": round(dev_us / max(1, prof.n_devices)
+                                    / max(1, trials), 1),
+        "measured_ops": counts,
+    }
 
 
 def run_all(ops: Sequence[str] = OPS, min_bytes: int = 1 << 12,
@@ -160,11 +203,29 @@ def main(argv=None) -> int:
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--trials", type=int, default=20)
     p.add_argument("--json", action="store_true", help="emit one JSON line")
+    p.add_argument("--verify", action="store_true",
+                   help="profile each op and print measured device-timeline "
+                        "collective time vs the wall-clock estimate")
     args = p.parse_args(argv)
     ops = OPS if args.ops == "all" else tuple(args.ops.split(","))
     for op in ops:
         if op not in OPS:
             raise SystemExit(f"unknown op {op!r}; choose from {OPS}")
+    if args.verify:
+        rows = [verify_collective(op, args.maxsize,
+                                  dtype=jnp.dtype(args.dtype),
+                                  trials=min(args.trials, 5)) for op in ops]
+        if args.json:
+            print(json.dumps({"verify": rows}))
+        else:
+            hdr = (f"{'op':<16}{'bytes':>12}{'est wall(us)':>14}"
+                   f"{'measured dev(us)':>18}  collectives")
+            print(hdr)
+            print("-" * len(hdr))
+            for r in rows:
+                print(f"{r['op']:<16}{r['bytes']:>12}{r['est_latency_us']:>14}"
+                      f"{r['measured_device_us']:>18}  {r['measured_ops']}")
+        return 0
     results = run_all(ops, args.minsize, args.maxsize,
                       dtype=jnp.dtype(args.dtype), trials=args.trials)
     if args.json:

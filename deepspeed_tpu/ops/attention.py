@@ -83,12 +83,54 @@ def multihead_attention(
 
             warning_once("pallas flash attention unavailable; using XLA attention")
         else:
-            return flash_attention(q, k, v, causal=causal,
-                                   softmax_scale=softmax_scale,
-                                   block_q=block_q, block_k=block_k,
-                                   stochastic_mode=stochastic_mode)
+            fa = functools.partial(
+                flash_attention, causal=causal, softmax_scale=softmax_scale,
+                block_q=block_q, block_k=block_k,
+                stochastic_mode=stochastic_mode)
+            return _shard_mapped_kernel(fa, q, k, v)
     return dot_product_attention(q, k, v, causal=causal, bias=bias,
                                  softmax_scale=softmax_scale)
+
+
+def _bound_mesh():
+    """The mesh governing the current trace (None outside any mesh context)."""
+    m = jax.interpreters.pxla.thread_resources.env.physical_mesh
+    return None if m.empty else m
+
+
+def _shard_mapped_kernel(fa, q, k, v):
+    """Run a Pallas attention kernel under multi-device SPMD.
+
+    Mosaic custom calls cannot be auto-partitioned by GSPMD (XLA raises
+    "wrap the call in a shard_map") — a plain call inside a jit over a >1
+    device mesh would crash on real hardware. Attention is embarrassingly
+    parallel over batch and heads, so when a mesh is bound we shard_map over
+    the data-parallel batch axes and the tp head axis; each shard runs the
+    kernel on its local [B/dp, T, H/tp, D] block. Sequence stays unsharded
+    here — sp>1 routes to ring/Ulysses before kernel dispatch
+    (models/gpt._attention_delta)."""
+    mesh = _bound_mesh()
+    if mesh is None:
+        return fa(q, k, v)
+    names = set(mesh.axis_names)
+    batch_axes = tuple(a for a in ("dp", "ep") if a in names
+                       and mesh.shape[a] > 1)
+    head_axis = "tp" if "tp" in names and mesh.shape["tp"] > 1 else None
+    if not batch_axes and head_axis is None:
+        return fa(q, k, v)
+    bsz = int(np.prod([mesh.shape[a] for a in batch_axes])) if batch_axes else 1
+    hsz = mesh.shape[head_axis] if head_axis else 1
+    B, H = q.shape[0], q.shape[2]
+    if B % bsz or H % hsz:
+        raise ValueError(
+            f"flash attention under SPMD needs batch {B} divisible by "
+            f"{batch_axes}={bsz} and heads {H} by tp={hsz}")
+    from jax import shard_map
+
+    spec = jax.sharding.PartitionSpec(
+        batch_axes or None, None, head_axis, None)
+    return shard_map(fa, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)
 
 
 def _flash_eligible(q, k, bias) -> bool:

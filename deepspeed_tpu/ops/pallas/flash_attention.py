@@ -27,6 +27,7 @@ Design (TPU-first, per the Pallas TPU guide):
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import numpy as np
@@ -40,6 +41,13 @@ LANES = 128  # TPU lane count; lse residual is broadcast across it
 
 
 def _interpret() -> bool:
+    # DS_TPU_PALLAS_INTERPRET=0 forces real Mosaic lowering even when the
+    # process backend is CPU — the AOT compile-only flow (bench pipeline_aot)
+    # targets a TPU topology from a CPU host, and interpret-mode HLO would
+    # both misrepresent the real program and OOM the compiler
+    env = os.environ.get("DS_TPU_PALLAS_INTERPRET")
+    if env is not None:
+        return env not in ("0", "false", "False")
     return jax.default_backend() != "tpu"
 
 

@@ -928,6 +928,15 @@ class DeepSpeedEngine:
         trace-time accounting; see ``comm.CommsLogger``)."""
         return comm.comms_logger.log_summary(scale=max(1, self.global_steps))
 
+    def comms_verify(self, batch) -> str:
+        """MEASURED per-collective counts/time for one ``train_batch`` from a
+        ``jax.profiler`` device-timeline trace, printed next to the trace-time
+        estimate — the runtime analog of the reference's per-op comms log
+        (``utils/comms_logging.py:56``). See ``comm.runtime_accounting``."""
+        from ..comm.runtime_accounting import verify_comms
+
+        return verify_comms(self, batch)
+
     def train_micro_batch_size_per_gpu(self) -> int:
         return self.micro_batch_size
 

@@ -18,11 +18,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "chip_session_results.json")
 
 
-def run(tag, argv, timeout):
+def run(tag, argv, timeout, env=None):
     print(f"[chip_session] {tag}...", flush=True)
     try:
         p = subprocess.run(argv, capture_output=True, text=True,
-                           timeout=timeout, cwd=REPO)
+                           timeout=timeout, cwd=REPO, env=env)
         line = next((ln for ln in reversed(p.stdout.strip().splitlines())
                      if ln.strip().startswith("{")), None)
         rec = {"tag": tag, "rc": p.returncode,
@@ -52,6 +52,9 @@ def main():
         print("[chip_session] chip unusable; stopping")
         return
 
+    # AOT fit-checked against the v5e compiler (bench.py train_aot rows,
+    # 2026-07-30): selrm bs16 needs 16.85G and full-remat bs20/24 >17G — both
+    # OOM the 15.75G chip and were cut; selrm bs8/bs12 and full bs16 fit.
     sweep_grid = [
         {"model": "gpt2-350m", "micro_bs": 16, "seq": 1024, "remat": True,
          "policy": "dots_with_no_batch_dims_saveable", "tag": "350m-save-dots"},
@@ -59,10 +62,10 @@ def main():
          "policy": "save_attn_mlp_out", "tag": "350m-save-sublayer"},
         {"model": "gpt2-760m", "micro_bs": 16, "seq": 1024, "remat": True,
          "policy": "nothing_saveable", "tag": "760m-bs16"},
-        {"model": "gpt2-760m", "micro_bs": 16, "seq": 1024, "remat": True,
-         "policy": "save_attn_mlp_out", "tag": "760m-save-sublayer"},
-        {"model": "gpt2-760m", "micro_bs": 24, "seq": 1024, "remat": True,
-         "policy": "nothing_saveable", "tag": "760m-bs24"},
+        {"model": "gpt2-760m", "micro_bs": 12, "seq": 1024, "remat": True,
+         "policy": "save_attn_mlp_out", "tag": "760m-save-sublayer-bs12"},
+        {"model": "gpt2-760m", "micro_bs": 8, "seq": 1024, "remat": True,
+         "policy": "save_attn_mlp_out", "tag": "760m-save-sublayer-bs8"},
         {"model": "gpt2-760m", "micro_bs": 8, "seq": 1024, "remat": True,
          "policy": "dots_with_no_batch_dims_saveable", "tag": "760m-bs8-save-dots"},
     ]
@@ -90,12 +93,15 @@ def main():
     # decode/SD/MFU evidence if the tunnel drops mid-run. Config dicts come
     # from bench.py (single source of truth).
     sys.path.insert(0, REPO)
-    from bench import INFINITY_CONFIGS
+    from bench import INFINITY_CONFIGS, PIPELINE_CONFIGS
+    from __graft_entry__ import _force_cpu_env
 
-    for spec in INFINITY_CONFIGS:
-        results.append(run(f"infinity:{spec['model']}", [
+    for spec in PIPELINE_CONFIGS + INFINITY_CONFIGS:
+        # force_cpu rows (AOT compile) must not touch the axon backend
+        env = _force_cpu_env(1, os.environ) if spec.get("force_cpu") else None
+        results.append(run(f"{spec['kind']}:{spec['name']}", [
             sys.executable, os.path.join(REPO, "bench.py"), "--worker",
-            json.dumps(spec)], spec.get("timeout", 3600)))
+            json.dumps(spec)], spec.get("timeout", 3600), env=env))
         save()
     print(f"[chip_session] done -> {OUT}")
 

@@ -98,3 +98,26 @@ def test_stochastic_mode_close_to_exact(dtype):
         np.testing.assert_allclose(np.asarray(a, np.float32),
                                    np.asarray(b, np.float32),
                                    rtol=5e-2, atol=5e-2)
+
+
+def test_flash_shard_mapped_on_mesh():
+    """Mosaic kernels cannot be GSPMD-auto-partitioned: under a bound mesh the
+    dispatcher must shard_map over batch (dp) and heads (tp) — found by the
+    pipeline AOT compile row, where the bare call crashes XLA."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.ops.attention import multihead_attention
+    from deepspeed_tpu.runtime.topology import mesh_context
+
+    devs = np.array(jax.devices()).reshape(1, 4, 1, 1, 2)
+    mesh = Mesh(devs, ("pp", "dp", "ep", "sp", "tp"))
+    q, k, v = make_qkv(B=4, T=128, H=2, D=64)
+    ref = dot_product_attention(q, k, v, causal=True)
+
+    with mesh_context(mesh):
+        spec = NamedSharding(mesh, P(("dp", "ep"), None, "tp", None))
+        qs, ks, vs = (jax.device_put(t, spec) for t in (q, k, v))
+        out = jax.jit(lambda a, b, c: multihead_attention(
+            a, b, c, causal=True, use_flash=True))(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-2, atol=2e-2)
