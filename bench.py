@@ -280,6 +280,8 @@ def _worker_train(cfg: dict) -> dict:
         mcfg = dataclasses.replace(
             mcfg, remat=True,
             remat_policy=cfg.get("remat_policy", "nothing_saveable"))
+    if cfg.get("loss_chunk"):
+        mcfg = dataclasses.replace(mcfg, loss_chunk=int(cfg["loss_chunk"]))
     model, mcfg = build_gpt(mcfg)
     n_chips = len(jax.devices())
     micro_bs, seq, steps = cfg["micro_bs"], cfg["seq"], cfg["steps"]
@@ -453,6 +455,58 @@ def _worker_diffusion(cfg: dict) -> dict:
     }
 
 
+def _aot_fused_step(model, optimizer):
+    """The engine-shaped fused train step the AOT evidence rows compile:
+    loss+grads, fp32 cast, global-norm clip, AdamW on the fp32 master, bf16
+    copy-back. ONE definition — both AOT workers must compile the same
+    semantics or their rows silently diverge from each other and the engine."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.runtime.utils import clip_by_global_norm
+
+    tmap = jax.tree_util.tree_map
+
+    def step(params, master, opt, batch, rng):
+        def loss_fn(p):
+            loss, _ = model.apply(p, batch, rngs={"dropout": rng}, train=True)
+            return loss.astype(jnp.float32)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = tmap(lambda g: g.astype(jnp.float32), grads)
+        grads, gnorm = clip_by_global_norm(grads, 1.0)
+        new_master, new_opt = optimizer.update(
+            grads, opt, master, jnp.float32(3e-4))
+        new_params = tmap(lambda x: x.astype(jnp.bfloat16), new_master)
+        return new_params, new_master, new_opt, loss, gnorm
+
+    return step
+
+
+def _aot_report(compiled, compile_s: float) -> dict:
+    """memory/cost analysis fields shared by the AOT rows. cost_analysis
+    reports the PER-DEVICE partitioned program's flops (verified on a sharded
+    matmul) — the estimate divides by per-chip peak only."""
+    ma = compiled.memory_analysis()
+    ca = compiled.cost_analysis() or {}
+    flops = float(ca.get("flops", 0.0))
+    peak = peak_flops_per_chip("tpu")
+    return {
+        "compile_s": round(compile_s, 1),
+        "per_device_bytes": {
+            "arguments": int(ma.argument_size_in_bytes),
+            "outputs": int(ma.output_size_in_bytes),
+            "temp": int(ma.temp_size_in_bytes),
+            "peak": int(ma.peak_memory_in_bytes),
+            "code": int(ma.generated_code_size_in_bytes),
+        },
+        "fits_v5e_hbm": True,
+        "program_flops": flops,
+        "est_step_ms_at_0.44mfu": (round(flops / (peak * 0.44) * 1e3, 1)
+                                   if flops else None),
+    }
+
+
 def _worker_pipeline_aot(cfg: dict) -> dict:
     """AOT-compile the pp=2 SPMD pipeline training step against a REAL TPU
     (v5e) topology — the XLA TPU compiler runs on the host, no chips or tunnel
@@ -470,7 +524,6 @@ def _worker_pipeline_aot(cfg: dict) -> dict:
     from deepspeed_tpu.models import gpt as gpt_mod
     from deepspeed_tpu.ops.optimizers import get_optimizer
     from deepspeed_tpu.runtime.topology import MeshTopology, mesh_context
-    from deepspeed_tpu.runtime.utils import clip_by_global_norm
     from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
     from deepspeed_tpu.runtime.zero.policy import ZeroShardingPolicy
 
@@ -503,19 +556,7 @@ def _worker_pipeline_aot(cfg: dict) -> dict:
     sh = lambda spec: NamedSharding(topo.mesh, spec)  # noqa: E731
     optimizer = get_optimizer("AdamW", {"lr": 3e-4, "weight_decay": 0.1})
     opt_shapes = jax.eval_shape(optimizer.init, shapes)
-
-    def step(params, master, opt, batch, rng):
-        def loss_fn(p):
-            loss, _ = model.apply(p, batch, rngs={"dropout": rng}, train=True)
-            return loss.astype(jnp.float32)
-
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        grads = tmap(lambda g: g.astype(jnp.float32), grads)
-        grads, gnorm = clip_by_global_norm(grads, 1.0)
-        new_master, new_opt = optimizer.update(
-            grads, opt, master, jnp.float32(3e-4))
-        new_params = tmap(lambda x: x.astype(jnp.bfloat16), new_master)
-        return new_params, new_master, new_opt, loss, gnorm
+    step = _aot_fused_step(model, optimizer)
 
     def abstract(tree_shapes, spec_tree, dtype=None):
         return tmap(
@@ -550,31 +591,13 @@ def _worker_pipeline_aot(cfg: dict) -> dict:
                     "seq": seq, "model": cfg.get("model", "gpt2-350m"),
                     **_aot_oom_row(e)}
         compile_s = time.perf_counter() - t0
-    ma = compiled.memory_analysis()
-    ca = compiled.cost_analysis() or {}
-    # cost_analysis reports the PER-DEVICE partitioned program's flops
-    # (verified on a sharded matmul) — divide by per-chip peak only
-    flops = float(ca.get("flops", 0.0))
-    peak = peak_flops_per_chip("tpu")
-    # estimate at the best chip-measured MFU (docs/MFU_NOTES.md 760M: 0.44);
-    # the pipeline bubble M/(M+pp-1) is already in the program's schedule
-    est_step_ms = flops / (peak * 0.44) * 1e3 if flops else None
+    # note: the pipeline bubble M/(M+pp-1) is already in the program's schedule
     return {
         "config": cfg["name"], "kind": "pipeline_aot",
         "platform": "tpu-compile-only", "topology": topo_name,
         "pp": pp, "dp": dp, "num_micro": M, "micro_bs": micro_bs, "seq": seq,
         "model": cfg.get("model", "gpt2-350m"),
-        "compile_s": round(compile_s, 1),
-        "per_device_bytes": {
-            "arguments": int(ma.argument_size_in_bytes),
-            "outputs": int(ma.output_size_in_bytes),
-            "temp": int(ma.temp_size_in_bytes),
-            "peak": int(ma.peak_memory_in_bytes),
-            "code": int(ma.generated_code_size_in_bytes),
-        },
-        "program_flops": flops,
-        "est_step_ms_at_0.44mfu": (round(est_step_ms, 1)
-                                   if est_step_ms else None),
+        **_aot_report(compiled, compile_s),
     }
 
 
@@ -595,7 +618,6 @@ def _worker_train_aot(cfg: dict) -> dict:
     from deepspeed_tpu.models import gpt as gpt_mod
     from deepspeed_tpu.ops.optimizers import get_optimizer
     from deepspeed_tpu.runtime.topology import MeshTopology, mesh_context
-    from deepspeed_tpu.runtime.utils import clip_by_global_norm
 
     os.environ["DS_TPU_PALLAS_INTERPRET"] = "0"
     # v5e topologies come in 2x2 host granularity; the program targets ONE
@@ -606,7 +628,8 @@ def _worker_train_aot(cfg: dict) -> dict:
     mcfg = gpt_mod.PRESETS[cfg["model"]]
     mcfg = dataclasses.replace(
         mcfg, remat=True, use_flash=True,
-        remat_policy=cfg.get("remat_policy", "nothing_saveable"))
+        remat_policy=cfg.get("remat_policy", "nothing_saveable"),
+        loss_chunk=int(cfg.get("loss_chunk", 0)))
     model, mcfg = build_gpt(mcfg)
     micro_bs, seq = int(cfg.get("micro_bs", 16)), int(cfg.get("seq", 1024))
 
@@ -615,19 +638,7 @@ def _worker_train_aot(cfg: dict) -> dict:
     optimizer = get_optimizer("AdamW", {"lr": 3e-4, "weight_decay": 0.1})
     opt_shapes = jax.eval_shape(optimizer.init, shapes)
     rep = NamedSharding(topo.mesh, P())
-
-    def step(params, master, opt, batch, rng):
-        def loss_fn(p):
-            loss, _ = model.apply(p, batch, rngs={"dropout": rng}, train=True)
-            return loss.astype(jnp.float32)
-
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        grads = tmap(lambda g: g.astype(jnp.float32), grads)
-        grads, gnorm = clip_by_global_norm(grads, 1.0)
-        new_master, new_opt = optimizer.update(
-            grads, opt, master, jnp.float32(3e-4))
-        new_params = tmap(lambda x: x.astype(jnp.bfloat16), new_master)
-        return new_params, new_master, new_opt, loss, gnorm
+    step = _aot_fused_step(model, optimizer)
 
     def abstract(tree, dtype=None):
         return tmap(lambda s: jax.ShapeDtypeStruct(
@@ -655,23 +666,7 @@ def _worker_train_aot(cfg: dict) -> dict:
             out.update(_aot_oom_row(e))
             return out
         compile_s = time.perf_counter() - t0
-    ma = compiled.memory_analysis()
-    ca = compiled.cost_analysis() or {}
-    flops = float(ca.get("flops", 0.0))
-    out.update({
-        "compile_s": round(compile_s, 1),
-        "per_device_bytes": {
-            "arguments": int(ma.argument_size_in_bytes),
-            "outputs": int(ma.output_size_in_bytes),
-            "temp": int(ma.temp_size_in_bytes),
-            "peak": int(ma.peak_memory_in_bytes),
-        },
-        "fits_v5e_hbm": True,
-        "program_flops": flops,
-        "est_step_ms_at_0.44mfu": (
-            round(flops / (peak_flops_per_chip("tpu") * 0.44) * 1e3, 1)
-            if flops else None),
-    })
+    out.update(_aot_report(compiled, compile_s))
     return out
 
 
@@ -822,9 +817,15 @@ def main() -> None:
             {"kind": "train", "name": f"{big}-zero1-selrm12", "model": big,
              "micro_bs": 12, "seq": seq, "stage": 1, "steps": steps,
              "remat_policy": "save_attn_mlp_out"},
-            {"kind": "train", "name": f"{big}-zero1-selrm8", "model": big,
-             "micro_bs": 8, "seq": seq, "stage": 1, "steps": steps,
-             "remat_policy": "save_attn_mlp_out"},
+            # chunked loss drops the fp32 logits buffer — AOT-verified these
+            # fit where the unchunked variants OOM (docs/MFU_NOTES.md r4)
+            {"kind": "train", "name": f"{big}-zero1-selrm16-chunk",
+             "model": big, "micro_bs": 16, "seq": seq, "stage": 1,
+             "steps": steps, "remat_policy": "save_attn_mlp_out",
+             "loss_chunk": 128},
+            {"kind": "train", "name": f"{big}-zero1-bs24-chunk", "model": big,
+             "micro_bs": 24, "seq": seq, "stage": 1, "steps": steps,
+             "loss_chunk": 128},
         ] + [
             {"kind": "inference", "name": f"{model}-decode", "model": model,
              "batch": 1, "prompt": 128, "gen": 64},

@@ -101,6 +101,10 @@ class ResourceManager:
     # ------------------------------------------------------------------ queue
     def schedule_experiments(self, configs: List[Dict[str, Any]],
                              names: Optional[List[str]] = None) -> None:
+        if names is not None and len(names) != len(configs):
+            raise ValueError(
+                f"{len(names)} names for {len(configs)} configs — a partial "
+                "schedule would be indistinguishable from success")
         for i, cfg in enumerate(configs):
             name = (names[i] if names else None) or f"exp_{self.experiment_count}"
             exp_dir = os.path.join(self.results_dir, name)
@@ -145,7 +149,9 @@ class ResourceManager:
             try:
                 with open(metric_path) as f:
                     exp.metric_value = float(json.load(f)["metric_value"])
-            except (OSError, KeyError, ValueError) as e:
+            except (OSError, KeyError, ValueError, TypeError) as e:
+                # TypeError: float(None) from a {"metric_value": null} file —
+                # a bad job must not take the scheduler loop down
                 exp.error = f"bad metrics.json: {e}"
         else:
             tail = ""

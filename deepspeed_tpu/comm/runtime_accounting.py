@@ -25,6 +25,7 @@ import gzip
 import json
 import os
 import re
+import shutil
 import tempfile
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
@@ -111,11 +112,16 @@ def profile_collectives(fn: Callable[[], Any],
     results (the profiler only sees executed work). ``n_devices``: how many
     devices the profiled program actually spans (defaults to all local
     devices) — the per-device averages divide by this."""
+    own = trace_dir is None
     d = trace_dir or tempfile.mkdtemp(prefix="ds_tpu_comms_")
-    with jax.profiler.trace(d):
-        out = fn()
-        jax.block_until_ready(out)
-    return _parse_trace_dir(d, n_devices=n_devices)
+    try:
+        with jax.profiler.trace(d):
+            out = fn()
+            jax.block_until_ready(out)
+        return _parse_trace_dir(d, n_devices=n_devices)
+    finally:
+        if own:  # multi-MB chrome traces must not accumulate in /tmp
+            shutil.rmtree(d, ignore_errors=True)
 
 
 def verify_comms(engine, batch) -> str:

@@ -94,6 +94,12 @@ class GPTConfig:
     # collective-permute, "ulysses" all-to-alls heads<->sequence — the
     # long-context memory savers (parallel/{ring_attention,ulysses}.py)
     seq_parallel_impl: str = "dense"
+    # chunked cross-entropy: compute the LM-head logits + logsumexp over
+    # `loss_chunk`-token sequence slices in a rematted scan, so the fp32
+    # [B, T, V] logits tensor (3.07 GB at bs16/seq1024/50k vocab — the
+    # largest single buffer at the v5e fit boundary, see docs/MFU_NOTES.md)
+    # never materializes. 0 = off (whole-sequence loss).
+    loss_chunk: int = 0
 
     @property
     def ffn_dim(self) -> int:
@@ -336,17 +342,15 @@ def _attention_delta(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray],
 
 def _bound_mesh():
     """The mesh governing the CURRENT trace: the engine traces its programs
-    inside ``mesh_context(engine.mesh)``, so the thread-resources mesh is the
+    inside ``mesh_context(engine.mesh)``, so the trace-bound mesh is the
     right one even when several engines with different topologies coexist
     (a process-global would go stale). Falls back to the default topology for
     direct (non-engine) calls."""
-    from jax._src import mesh as mesh_lib
+    from ..runtime.topology import bound_mesh, get_topology
 
-    pm = mesh_lib.thread_resources.env.physical_mesh
-    if pm is not None and not pm.empty:
+    pm = bound_mesh()
+    if pm is not None:
         return pm
-    from ..runtime.topology import get_topology
-
     try:
         topo = get_topology()
     except Exception:
@@ -555,11 +559,115 @@ def next_token_loss(forward_fn, max_seq_len: int, batch: Dict[str, jnp.ndarray]
     return loss, {"num_tokens": nll.size}
 
 
+def _chunked_ce(hidden: jnp.ndarray, head: jnp.ndarray,
+                head_bias: Optional[jnp.ndarray], targets: jnp.ndarray,
+                mask: jnp.ndarray, chunk: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Masked cross entropy over `chunk`-token sequence slices.
+
+    Each scan step computes ONE chunk's logits (``[B, chunk, V]``) and its
+    fp32 logsumexp, and the step is rematted so backward recomputes the chunk
+    logits instead of keeping them — peak memory holds one chunk's logits,
+    not ``[B, T, V]``. Returns (sum of masked nll, sum of mask)."""
+    B, T, D = hidden.shape
+    if T % chunk:
+        raise ValueError(f"loss_chunk {chunk} must divide seq len {T}")
+    n = T // chunk
+    h = hidden.reshape(B, n, chunk, D).transpose(1, 0, 2, 3)
+    t = targets.reshape(B, n, chunk).transpose(1, 0, 2)
+    m = mask.reshape(B, n, chunk).transpose(1, 0, 2)
+
+    def body(carry, xs):
+        h_c, t_c, m_c = xs
+        logits = jnp.einsum("bcd,vd->bcv", h_c, head.astype(h_c.dtype))
+        if head_bias is not None:
+            logits = logits + head_bias.astype(logits.dtype)
+        logits32 = logits.astype(jnp.float32)
+        logz = jax.scipy.special.logsumexp(logits32, axis=-1)
+        gold = jnp.take_along_axis(logits32, t_c[..., None], axis=-1)[..., 0]
+        nll = (logz - gold) * m_c
+        s, c = carry
+        return (s + jnp.sum(nll), c + jnp.sum(m_c)), None
+
+    (s, c), _ = jax.lax.scan(jax.checkpoint(body),
+                             (jnp.float32(0.0), jnp.float32(0.0)), (h, t, m))
+    return s, c
+
+
+def _chunk_targets(cfg: GPTConfig, batch: Dict[str, jnp.ndarray]
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(input_ids_for_forward, targets [B,T], mask [B,T]) replicating
+    :func:`next_token_loss`'s label/mask/packing semantics on full-T tiles
+    (unmatched positions masked out)."""
+    input_ids = batch["input_ids"]
+    labels = batch.get("labels")
+    loss_mask = batch.get("loss_mask")
+    if labels is None and input_ids.shape[1] > cfg.max_seq_len:
+        # seq+1 token packing: inputs are the first max_seq_len tokens
+        ids_in = input_ids[:, :-1]
+        shift_targets = input_ids[:, 1:]
+    else:
+        ids_in = input_ids
+        shift_targets = None
+    B, T = ids_in.shape
+    if labels is not None:
+        targets = labels
+        mask = (loss_mask.astype(jnp.float32) if loss_mask is not None
+                else jnp.ones((B, T), jnp.float32))
+    elif shift_targets is not None:
+        targets = shift_targets
+        mask = (loss_mask[:, 1:].astype(jnp.float32)
+                if loss_mask is not None else jnp.ones((B, T), jnp.float32))
+    else:
+        # standard next-token shift: last position has no target — mask it
+        # (and pad targets with a dummy 0 there) so chunks tile the full T
+        targets = jnp.concatenate(
+            [input_ids[:, 1:], jnp.zeros((B, 1), input_ids.dtype)], axis=1)
+        mask = jnp.concatenate(
+            [jnp.ones((B, T - 1), jnp.float32),
+             jnp.zeros((B, 1), jnp.float32)], axis=1)
+        if loss_mask is not None:
+            shifted = jnp.concatenate(
+                [loss_mask[:, 1:], jnp.zeros((B, 1), loss_mask.dtype)], axis=1)
+            mask = mask * shifted.astype(jnp.float32)
+    return ids_in, targets, mask
+
+
+def chunked_head_loss(cfg: GPTConfig, params, hidden: jnp.ndarray,
+                      targets: jnp.ndarray, mask: jnp.ndarray
+                      ) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+    """Chunked LM head + masked cross entropy over post-LN ``hidden`` — shared
+    by the dense and pipelined models."""
+    head = params["wte"] if cfg.tie_embeddings else params["lm_head"]
+    head_b = (params.get("lm_head_b")
+              if (cfg.lm_head_bias and not cfg.tie_embeddings) else None)
+    s, c = _chunked_ce(hidden, head, head_b, targets, mask, cfg.loss_chunk)
+    # masked mean == next_token_loss semantics in every case: without a
+    # loss_mask the mask counts exactly the real target positions
+    return s / jnp.maximum(c, 1.0), {"num_tokens": int(targets.size)}
+
+
+def chunked_loss(cfg: GPTConfig, params, batch: Dict[str, jnp.ndarray],
+                 rngs=None, train: bool = True, pld_theta=None
+                 ) -> Tuple[jnp.ndarray, Dict[str, Any]]:
+    """:func:`loss_fn` semantics with the LM head + cross entropy evaluated in
+    ``cfg.loss_chunk``-token slices (see :func:`_chunked_ce`). Numerically the
+    same masked mean as :func:`next_token_loss`."""
+    ids_in, targets, mask = _chunk_targets(cfg, batch)
+    hidden = forward(cfg, params, ids_in, rngs=rngs, train=train,
+                     return_hidden=True, pld_theta=pld_theta)
+    return chunked_head_loss(cfg, params, hidden, targets, mask)
+
+
 def loss_fn(cfg: GPTConfig, params, batch: Dict[str, jnp.ndarray],
             rngs=None, train: bool = True, pld_theta=None
             ) -> Tuple[jnp.ndarray, Dict[str, Any]]:
     """Next-token cross entropy. ``batch``: {"input_ids": [B,T]} (+ optional
     "labels"/"loss_mask")."""
+    if cfg.loss_chunk:
+        if not cfg.has_lm_head:
+            raise ValueError("loss_chunk needs an LM head")
+        return chunked_loss(cfg, params, batch, rngs=rngs, train=train,
+                            pld_theta=pld_theta)
     return next_token_loss(
         lambda ids: forward(cfg, params, ids, rngs=rngs, train=train,
                             pld_theta=pld_theta),

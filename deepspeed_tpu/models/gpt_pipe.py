@@ -51,8 +51,10 @@ def partition_specs(cfg: G.GPTConfig, num_stages: int, param_shapes) -> Dict[str
 
 
 def forward(cfg: G.GPTConfig, num_stages: int, num_micro: int, params,
-            input_ids: jnp.ndarray, rngs=None, train: bool = True) -> jnp.ndarray:
-    """Logits [B, T, V] via pipelined blocks. B must divide by num_micro."""
+            input_ids: jnp.ndarray, rngs=None, train: bool = True,
+            return_hidden: bool = False) -> jnp.ndarray:
+    """Logits [B, T, V] via pipelined blocks (or the post-LN hidden states
+    with ``return_hidden``). B must divide by num_micro."""
     B, T = input_ids.shape
     if T > cfg.max_seq_len:
         raise ValueError(
@@ -93,12 +95,21 @@ def forward(cfg: G.GPTConfig, num_stages: int, num_micro: int, params,
     x = out.reshape(B, T, -1)
     x = maybe_shard(x, P(BATCH, None, None))
     x = G.layer_norm(x, params["lnf_scale"], params["lnf_bias"], cfg.layer_norm_eps)
+    if return_hidden:
+        return x
     head = params["wte"] if cfg.tie_embeddings else params["lm_head"]
     return jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
 
 
 def loss_fn(cfg: G.GPTConfig, num_stages: int, num_micro: int, params, batch,
             rngs=None, train: bool = True):
+    if cfg.loss_chunk:
+        # same chunked head as the dense model — the fp32 [B,T,V] logits
+        # never materialize (G.chunked_head_loss)
+        ids_in, targets, mask = G._chunk_targets(cfg, batch)
+        hidden = forward(cfg, num_stages, num_micro, params, ids_in,
+                         rngs=rngs, train=train, return_hidden=True)
+        return G.chunked_head_loss(cfg, params, hidden, targets, mask)
     return G.next_token_loss(
         lambda ids: forward(cfg, num_stages, num_micro, params, ids,
                             rngs=rngs, train=train),

@@ -94,8 +94,9 @@ def multihead_attention(
 
 def _bound_mesh():
     """The mesh governing the current trace (None outside any mesh context)."""
-    m = jax.interpreters.pxla.thread_resources.env.physical_mesh
-    return None if m.empty else m
+    from ..runtime.topology import bound_mesh
+
+    return bound_mesh()
 
 
 def _shard_mapped_kernel(fa, q, k, v):

@@ -64,8 +64,15 @@ def main():
          "policy": "nothing_saveable", "tag": "760m-bs16"},
         {"model": "gpt2-760m", "micro_bs": 12, "seq": 1024, "remat": True,
          "policy": "save_attn_mlp_out", "tag": "760m-save-sublayer-bs12"},
-        {"model": "gpt2-760m", "micro_bs": 8, "seq": 1024, "remat": True,
-         "policy": "save_attn_mlp_out", "tag": "760m-save-sublayer-bs8"},
+        # chunked loss (GPTConfig.loss_chunk) removes the fp32 logits buffer:
+        # AOT-verified to fit where the unchunked variants OOM — the two
+        # strongest 45%-MFU candidates
+        {"model": "gpt2-760m", "micro_bs": 16, "seq": 1024, "remat": True,
+         "policy": "save_attn_mlp_out", "loss_chunk": 128,
+         "tag": "760m-selrm16-chunkloss"},
+        {"model": "gpt2-760m", "micro_bs": 24, "seq": 1024, "remat": True,
+         "policy": "nothing_saveable", "loss_chunk": 128,
+         "tag": "760m-bs24-chunkloss"},
         {"model": "gpt2-760m", "micro_bs": 8, "seq": 1024, "remat": True,
          "policy": "dots_with_no_batch_dims_saveable", "tag": "760m-bs8-save-dots"},
     ]

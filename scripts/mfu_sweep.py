@@ -29,7 +29,8 @@ def run_one(spec: dict) -> dict:
     mcfg = gpt_mod.PRESETS[spec["model"]]
     mcfg = dataclasses.replace(
         mcfg, remat=spec["remat"], remat_policy=spec.get("policy", "nothing_saveable"),
-        max_seq_len=max(mcfg.max_seq_len, spec["seq"]))
+        max_seq_len=max(mcfg.max_seq_len, spec["seq"]),
+        loss_chunk=int(spec.get("loss_chunk", 0)))
     model, mcfg = build_gpt(mcfg)
     micro_bs, seq, steps = spec["micro_bs"], spec["seq"], spec.get("steps", 10)
     engine, _, _, _ = deepspeed_tpu.initialize(

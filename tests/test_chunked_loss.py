@@ -1,0 +1,120 @@
+"""Chunked cross entropy (GPTConfig.loss_chunk): the fp32 [B,T,V] logits
+never materialize; the loss and gradients must match the whole-sequence path.
+
+Motivated by the v5e AOT fit analysis (docs/MFU_NOTES.md round 4): the fp32
+logits are the largest single buffer at the HBM fit boundary.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.gpt import GPTConfig, init_params, loss_fn
+
+
+def _setup(chunk=0, **kw):
+    cfg = GPTConfig(vocab_size=97, d_model=32, n_layer=2, n_head=2,
+                    max_seq_len=32, loss_chunk=chunk, **kw)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _batch(cfg, bs=3, seq=32, with_mask=False, with_labels=False, seed=0):
+    r = np.random.default_rng(seed)
+    b = {"input_ids": jnp.asarray(
+        r.integers(0, cfg.vocab_size, (bs, seq)), jnp.int32)}
+    if with_labels:
+        b["labels"] = jnp.asarray(
+            r.integers(0, cfg.vocab_size, (bs, seq)), jnp.int32)
+    if with_mask:
+        b["loss_mask"] = jnp.asarray(
+            (r.random((bs, seq)) > 0.3).astype(np.float32))
+    return b
+
+
+@pytest.mark.parametrize("with_mask", [False, True])
+@pytest.mark.parametrize("with_labels", [False, True])
+def test_chunked_matches_whole_sequence(with_mask, with_labels):
+    cfg0, params = _setup(chunk=0)
+    cfg8 = dataclasses.replace(cfg0, loss_chunk=8)
+    b = _batch(cfg0, with_mask=with_mask, with_labels=with_labels)
+    l0, _ = loss_fn(cfg0, params, b, train=False)
+    l8, _ = loss_fn(cfg8, params, b, train=False)
+    np.testing.assert_allclose(float(l0), float(l8), rtol=1e-6)
+
+
+def test_chunked_gradients_match():
+    cfg0, params = _setup(chunk=0)
+    cfg8 = dataclasses.replace(cfg0, loss_chunk=8)
+    b = _batch(cfg0)
+
+    g0 = jax.grad(lambda p: loss_fn(cfg0, p, b, train=False)[0])(params)
+    g8 = jax.grad(lambda p: loss_fn(cfg8, p, b, train=False)[0])(params)
+    for (k, a), (_, c) in zip(
+            jax.tree_util.tree_leaves_with_path(g0),
+            jax.tree_util.tree_leaves_with_path(g8)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(c), rtol=1e-5, atol=1e-7,
+            err_msg=jax.tree_util.keystr(k))
+
+
+def test_chunked_untied_head_with_bias():
+    cfg, _ = _setup(chunk=0, tie_embeddings=False, lm_head_bias=True)
+    params = init_params(cfg, jax.random.PRNGKey(1))
+    cfg8 = dataclasses.replace(cfg, loss_chunk=16)
+    b = _batch(cfg)
+    l0, _ = loss_fn(cfg, params, b, train=False)
+    l8, _ = loss_fn(cfg8, params, b, train=False)
+    np.testing.assert_allclose(float(l0), float(l8), rtol=1e-6)
+
+
+def test_chunked_seq_plus_one_packing():
+    """seq+1 token packing (inputs longer than max_seq_len)."""
+    cfg0, params = _setup(chunk=0)
+    cfg8 = dataclasses.replace(cfg0, loss_chunk=8)
+    b = _batch(cfg0, seq=33)  # max_seq_len + 1
+    l0, _ = loss_fn(cfg0, params, b, train=False)
+    l8, _ = loss_fn(cfg8, params, b, train=False)
+    np.testing.assert_allclose(float(l0), float(l8), rtol=1e-6)
+
+
+def test_chunk_must_divide_seq():
+    cfg, params = _setup(chunk=7)
+    with pytest.raises(ValueError, match="divide"):
+        loss_fn(cfg, params, _batch(cfg, seq=32), train=False)
+
+
+def test_pipelined_model_honors_loss_chunk():
+    """gpt_pipe must route through the same chunked head (a silently dropped
+    loss_chunk would re-materialize the logits the knob exists to avoid)."""
+    from deepspeed_tpu.models import gpt_pipe
+
+    cfg0, params0 = _setup(chunk=0)
+    cfg8 = dataclasses.replace(cfg0, loss_chunk=8)
+    b = _batch(cfg0, bs=4, seq=32)
+    pipe_params = gpt_pipe.init_params(cfg8, 2, jax.random.PRNGKey(0))
+    l_chunk, _ = gpt_pipe.loss_fn(cfg8, 2, 2, pipe_params, b, train=False)
+    l_whole, _ = gpt_pipe.loss_fn(cfg0, 2, 2, pipe_params, b, train=False)
+    np.testing.assert_allclose(float(l_whole), float(l_chunk), rtol=1e-5)
+
+
+def test_engine_trains_with_chunked_loss():
+    import deepspeed_tpu
+    from deepspeed_tpu.models import build_gpt
+
+    model, cfg = build_gpt(GPTConfig(
+        vocab_size=128, d_model=32, n_layer=2, n_head=2, max_seq_len=32,
+        loss_chunk=8))
+    e, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1}, "steps_per_print": 0})
+    b = {"input_ids": np.random.default_rng(0).integers(
+        0, 128, (16, 32), dtype=np.int32)}
+    losses = [float(e.train_batch(b)["loss"]) for _ in range(5)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
