@@ -68,11 +68,15 @@ INFINITY_CONFIGS = [
 # the tunnel is dead (round-3 post-mortem: a down tunnel left the round with
 # no TPU-grounded numbers at all).
 AOT_TRAIN_CONFIGS = [
-    {"kind": "train_aot", "name": "gpt2-760m-selrm-aot", "model": "gpt2-760m",
-     "micro_bs": 16, "seq": 1024, "remat_policy": "save_attn_mlp_out",
+    {"kind": "kernels_aot", "name": "pallas-kernels-v5e-aot",
      "force_cpu": True, "timeout": 1500},
-    {"kind": "train_aot", "name": "gpt2-760m-bs24-aot", "model": "gpt2-760m",
-     "micro_bs": 24, "seq": 1024, "force_cpu": True, "timeout": 1500},
+    {"kind": "train_aot", "name": "gpt2-760m-selrm16-chunk-aot",
+     "model": "gpt2-760m", "micro_bs": 16, "seq": 1024,
+     "remat_policy": "save_attn_mlp_out", "loss_chunk": 128,
+     "force_cpu": True, "timeout": 1500},
+    {"kind": "train_aot", "name": "gpt2-760m-bs24-chunk-aot",
+     "model": "gpt2-760m", "micro_bs": 24, "seq": 1024, "loss_chunk": 128,
+     "force_cpu": True, "timeout": 1500},
 ]
 
 # Pipeline rows (VERDICT r3 next #4). The AOT row needs no chips at all — the
@@ -176,7 +180,8 @@ def _worker(cfg: dict) -> None:
           "kernels": _worker_kernels, "diffusion": _worker_diffusion,
           "pipeline_aot": _worker_pipeline_aot,
           "pipeline_mpmd": _worker_pipeline_mpmd,
-          "train_aot": _worker_train_aot}[cfg["kind"]]
+          "train_aot": _worker_train_aot,
+          "kernels_aot": _worker_kernels_aot}[cfg["kind"]]
     print(json.dumps(fn(cfg)))
 
 
@@ -453,6 +458,78 @@ def _worker_diffusion(cfg: dict) -> dict:
         "ddim_steps": steps, "batch": B,
         "image_px": int(img.shape[1]),
     }
+
+
+def _worker_kernels_aot(cfg: dict) -> dict:
+    """Mosaic-compile every Pallas kernel against the v5e TPU compiler on the
+    host — the chip-session 'kernel smoke' without a chip. A kernel that
+    fails HERE would fail on hardware (same compiler); green rows mean the
+    first tunnel-up window spends zero time on compile regressions."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import topologies
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.runtime.topology import MeshTopology, mesh_context
+
+    os.environ["DS_TPU_PALLAS_INTERPRET"] = "0"
+    td = topologies.get_topology_desc(
+        platform="tpu", topology_name=cfg.get("topology", "v5e:2x2"))
+    topo = MeshTopology.create(dp=1, devices=list(td.devices)[:1])
+    rep = NamedSharding(topo.mesh, P())
+
+    def a(shape, dtype=jnp.bfloat16):
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=rep)
+
+    B, H, S, Dh = 4, 16, 1024, 64
+    q4 = a((B, S, H, Dh))
+    results, failed = {}, []
+
+    def check(name, fn, *args):
+        try:
+            t0 = time.perf_counter()
+            with mesh_context(topo.mesh):
+                jax.jit(fn).lower(*args).compile()
+            results[name] = {"ok": True,
+                             "compile_s": round(time.perf_counter() - t0, 1)}
+        except Exception as e:
+            results[name] = {"ok": False, "error": str(e)[-300:]}
+            failed.append(name)
+
+    from deepspeed_tpu.ops.pallas.blocksparse_attention import (
+        blocksparse_attention)
+    from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+    from deepspeed_tpu.ops.sparse_attention import FixedSparsityConfig
+
+    check("flash_attention",
+          lambda q, k, v: flash_attention(q, k, v, causal=True), q4, q4, q4)
+    check("flash_attention_bwd",
+          jax.grad(lambda q, k, v: flash_attention(q, k, v, causal=True)
+                   .astype(jnp.float32).sum()), q4, q4, q4)
+    check("flash_attention_stochastic",
+          lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                          stochastic_mode=True), q4, q4, q4)
+    check("decode_attention",
+          lambda q, k, v, n: decode_attention(q, k, v, n),
+          a((B, 1, H, Dh)), a((B, H, S, Dh)), a((B, H, S, Dh)),
+          a((), jnp.int32))
+    layout = np.asarray(
+        FixedSparsityConfig(num_heads=H, block=128).make_layout(S))
+    check("blocksparse_attention",
+          lambda q, k, v: blocksparse_attention(q, k, v, layout=layout,
+                                                block=128), q4, q4, q4)
+    check("blocksparse_attention_bwd",
+          jax.grad(lambda q, k, v: blocksparse_attention(
+              q, k, v, layout=layout, block=128)
+              .astype(jnp.float32).sum()), q4, q4, q4)
+    out = {"config": cfg["name"], "kind": "kernels_aot",
+           "platform": "tpu-compile-only", "kernels": results}
+    if failed:
+        out["error"] = "Mosaic v5e compile failed: " + ", ".join(failed)
+    return out
 
 
 def _aot_fused_step(model, optimizer):

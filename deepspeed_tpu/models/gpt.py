@@ -594,10 +594,12 @@ def _chunked_ce(hidden: jnp.ndarray, head: jnp.ndarray,
 
 
 def _chunk_targets(cfg: GPTConfig, batch: Dict[str, jnp.ndarray]
-                   ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """(input_ids_for_forward, targets [B,T], mask [B,T]) replicating
-    :func:`next_token_loss`'s label/mask/packing semantics on full-T tiles
-    (unmatched positions masked out)."""
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, int]:
+    """(input_ids_for_forward, targets [B,T], mask [B,T], num_real_targets)
+    replicating :func:`next_token_loss`'s label/mask/packing semantics on
+    full-T tiles (unmatched positions masked out; ``num_real_targets`` is
+    the whole-sequence path's ``nll.size`` — the padded dummy position in
+    the standard shift case is excluded)."""
     input_ids = batch["input_ids"]
     labels = batch.get("labels")
     loss_mask = batch.get("loss_mask")
@@ -613,10 +615,12 @@ def _chunk_targets(cfg: GPTConfig, batch: Dict[str, jnp.ndarray]
         targets = labels
         mask = (loss_mask.astype(jnp.float32) if loss_mask is not None
                 else jnp.ones((B, T), jnp.float32))
+        return ids_in, targets, mask, int(targets.size)
     elif shift_targets is not None:
         targets = shift_targets
         mask = (loss_mask[:, 1:].astype(jnp.float32)
                 if loss_mask is not None else jnp.ones((B, T), jnp.float32))
+        return ids_in, targets, mask, int(targets.size)
     else:
         # standard next-token shift: last position has no target — mask it
         # (and pad targets with a dummy 0 there) so chunks tile the full T
@@ -629,11 +633,12 @@ def _chunk_targets(cfg: GPTConfig, batch: Dict[str, jnp.ndarray]
             shifted = jnp.concatenate(
                 [loss_mask[:, 1:], jnp.zeros((B, 1), loss_mask.dtype)], axis=1)
             mask = mask * shifted.astype(jnp.float32)
-    return ids_in, targets, mask
+    return ids_in, targets, mask, int(targets.size - B)  # dummy col excluded
 
 
 def chunked_head_loss(cfg: GPTConfig, params, hidden: jnp.ndarray,
-                      targets: jnp.ndarray, mask: jnp.ndarray
+                      targets: jnp.ndarray, mask: jnp.ndarray,
+                      num_tokens: Optional[int] = None
                       ) -> Tuple[jnp.ndarray, Dict[str, Any]]:
     """Chunked LM head + masked cross entropy over post-LN ``hidden`` — shared
     by the dense and pipelined models."""
@@ -643,7 +648,9 @@ def chunked_head_loss(cfg: GPTConfig, params, hidden: jnp.ndarray,
     s, c = _chunked_ce(hidden, head, head_b, targets, mask, cfg.loss_chunk)
     # masked mean == next_token_loss semantics in every case: without a
     # loss_mask the mask counts exactly the real target positions
-    return s / jnp.maximum(c, 1.0), {"num_tokens": int(targets.size)}
+    return s / jnp.maximum(c, 1.0), {
+        "num_tokens": int(num_tokens if num_tokens is not None
+                          else targets.size)}
 
 
 def chunked_loss(cfg: GPTConfig, params, batch: Dict[str, jnp.ndarray],
@@ -652,10 +659,11 @@ def chunked_loss(cfg: GPTConfig, params, batch: Dict[str, jnp.ndarray],
     """:func:`loss_fn` semantics with the LM head + cross entropy evaluated in
     ``cfg.loss_chunk``-token slices (see :func:`_chunked_ce`). Numerically the
     same masked mean as :func:`next_token_loss`."""
-    ids_in, targets, mask = _chunk_targets(cfg, batch)
+    ids_in, targets, mask, n_tok = _chunk_targets(cfg, batch)
     hidden = forward(cfg, params, ids_in, rngs=rngs, train=train,
                      return_hidden=True, pld_theta=pld_theta)
-    return chunked_head_loss(cfg, params, hidden, targets, mask)
+    return chunked_head_loss(cfg, params, hidden, targets, mask,
+                             num_tokens=n_tok)
 
 
 def loss_fn(cfg: GPTConfig, params, batch: Dict[str, jnp.ndarray],

@@ -160,8 +160,10 @@ def _moe_block(cfg: GPTMoEConfig, x, w, positions, rng, train, layer_idx=None):
 
 
 def forward(cfg: GPTMoEConfig, params, input_ids: jnp.ndarray,
-            rngs=None, train: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Returns (logits [B,T,V], aux_loss)."""
+            rngs=None, train: bool = True, return_hidden: bool = False
+            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (logits [B,T,V], aux_loss) — or (post-LN hidden, aux_loss)
+    with ``return_hidden`` (the chunked-loss path)."""
     b = cfg.base
     B, T = input_ids.shape
     if T > b.max_seq_len:
@@ -237,12 +239,27 @@ def forward(cfg: GPTMoEConfig, params, input_ids: jnp.ndarray,
         body, (x, jnp.int32(0), jnp.float32(0.0)), xs, gathered_spec=gathered)
 
     x = layer_norm(x, params["lnf_scale"], params["lnf_bias"], b.layer_norm_eps)
+    if return_hidden:
+        return x, aux_sum / cfg.n_super
     head = params["wte"] if b.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
     return logits, aux_sum / cfg.n_super
 
 
 def loss_fn(cfg: GPTMoEConfig, params, batch, rngs=None, train: bool = True):
+    if cfg.base.loss_chunk:
+        # same chunked head as the dense model (the fp32 [B,T,V] logits never
+        # materialize) — silently dropping the knob would re-create the exact
+        # OOM it exists to avoid
+        from .gpt import _chunk_targets, chunked_head_loss
+
+        ids_in, targets, mask, n_tok = _chunk_targets(cfg.base, batch)
+        hidden, aux = forward(cfg, params, ids_in, rngs=rngs, train=train,
+                              return_hidden=True)
+        lm_loss, _ = chunked_head_loss(cfg.base, params, hidden, targets,
+                                       mask, num_tokens=n_tok)
+        return (lm_loss + cfg.aux_loss_coef * aux,
+                {"lm_loss": lm_loss, "moe_aux_loss": aux})
     aux_box = []
 
     def fwd(ids):

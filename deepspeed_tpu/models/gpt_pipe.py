@@ -106,10 +106,11 @@ def loss_fn(cfg: G.GPTConfig, num_stages: int, num_micro: int, params, batch,
     if cfg.loss_chunk:
         # same chunked head as the dense model — the fp32 [B,T,V] logits
         # never materialize (G.chunked_head_loss)
-        ids_in, targets, mask = G._chunk_targets(cfg, batch)
+        ids_in, targets, mask, n_tok = G._chunk_targets(cfg, batch)
         hidden = forward(cfg, num_stages, num_micro, params, ids_in,
                          rngs=rngs, train=train, return_hidden=True)
-        return G.chunked_head_loss(cfg, params, hidden, targets, mask)
+        return G.chunked_head_loss(cfg, params, hidden, targets, mask,
+                                   num_tokens=n_tok)
     return G.next_token_loss(
         lambda ids: forward(cfg, num_stages, num_micro, params, ids,
                             rngs=rngs, train=train),

@@ -102,6 +102,34 @@ def test_pipelined_model_honors_loss_chunk():
     np.testing.assert_allclose(float(l_whole), float(l_chunk), rtol=1e-5)
 
 
+def test_moe_model_honors_loss_chunk():
+    from deepspeed_tpu.models.gpt_moe import (PRESETS, init_params as moe_init,
+                                              loss_fn as moe_loss)
+
+    cfg = PRESETS["tiny-moe"]
+    params = moe_init(cfg, jax.random.PRNGKey(0))
+    cfg8 = dataclasses.replace(cfg, base=dataclasses.replace(
+        cfg.base, loss_chunk=16))
+    b = {"input_ids": jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.base.vocab_size, (2, 32)), jnp.int32)}
+    l0, aux0 = moe_loss(cfg, params, b, train=False)
+    l8, aux8 = moe_loss(cfg8, params, b, train=False)
+    np.testing.assert_allclose(float(l0), float(l8), rtol=1e-5)
+    np.testing.assert_allclose(float(aux0["moe_aux_loss"]),
+                               float(aux8["moe_aux_loss"]), rtol=1e-6)
+
+
+def test_num_tokens_matches_whole_sequence_path():
+    from deepspeed_tpu.models.gpt import next_token_loss
+
+    cfg0, params = _setup(chunk=0)
+    cfg8 = dataclasses.replace(cfg0, loss_chunk=8)
+    b = _batch(cfg0)
+    _, aux0 = loss_fn(cfg0, params, b, train=False)
+    _, aux8 = loss_fn(cfg8, params, b, train=False)
+    assert aux8["num_tokens"] == aux0["num_tokens"]
+
+
 def test_engine_trains_with_chunked_loss():
     import deepspeed_tpu
     from deepspeed_tpu.models import build_gpt
