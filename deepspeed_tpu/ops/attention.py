@@ -75,18 +75,6 @@ def multihead_attention(
         warning_once("flash attention forced on but an attention bias is "
                      "present (ALiBi?); falling back to XLA attention")
         use_flash = False
-    if use_flash and k.shape[1] > 4096:
-        # the dense flash bwd keeps whole-sequence k/v (and q/do/o in the
-        # dk/dv pass) in VMEM; past ~4k the Mosaic scoped-VMEM limit (16M)
-        # trips (v5e-compiler-verified at 8192). The long-context paths are
-        # built for exactly this regime.
-        from ..utils.logging import warning_once
-
-        warning_once(
-            f"dense flash attention at seq {k.shape[1]} may exceed the "
-            "Mosaic scoped-VMEM limit in backward (ceiling ~4-8k); for long "
-            "sequences use seq_parallel_impl='ring'/'ulysses' (seq sharded "
-            "per chip) or sparse_attention (blocksparse streams by layout)")
     if use_flash:
         try:
             from .pallas.flash_attention import flash_attention

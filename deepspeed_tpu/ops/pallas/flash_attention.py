@@ -52,80 +52,100 @@ def _interpret() -> bool:
 
 
 # --------------------------------------------------------------------------- fwd
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale: float,
-                causal: bool, block_q: int, block_k: int, kv_len: int,
-                q_offset: int, stochastic_mode: bool):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, sm_scale: float, causal: bool, block_q: int, block_k: int,
+                num_k_blocks: int, q_offset: int, stochastic_mode: bool):
+    """One (q block, k block) tile of the online softmax. The k axis streams
+    through the innermost grid dimension (whole-sequence k/v in VMEM trips
+    the Mosaic scoped-VMEM limit past ~8k); the (acc, m, l) state lives in
+    VMEM scratch, persisting across the revisited output window."""
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * sm_scale  # [Bq, D]
-    bq = q.shape[0]
+    ki = pl.program_id(2)
+    bq = q_ref.shape[1]
     # stochastic mode (parity: ds_transformer_cuda.cpp:63 stochastic_mode —
     # speed over run-exactness): matmul operands stay in the input dtype so
     # the MXU runs its native bf16 pass (fp32 upcast costs multiple passes);
     # accumulation and the softmax state remain fp32
     lo = q_ref.dtype if stochastic_mode else jnp.float32
-    q_lo = q.astype(lo)
 
-    acc = jnp.zeros((bq, v_ref.shape[-1]), jnp.float32)
-    m_i = jnp.full((bq, 1), NEG_INF, jnp.float32)
-    l_i = jnp.zeros((bq, 1), jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
 
-    num_k_blocks = kv_len // block_k
-    if causal:
-        # only blocks intersecting the lower triangle of this q block; q rows sit
-        # at absolute positions q_offset + qi*Bq + i (q_offset = kv_len - q_len)
-        upper = (q_offset + qi * block_q + block_q + block_k - 1) // block_k
-        num_k_blocks = jnp.minimum(num_k_blocks, upper)
-    q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (bq, block_k), 0)
-
-    def body(ki, carry):
-        acc, m_i, l_i = carry
-        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(lo)  # [Bk, D]
-        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(lo)
-        s = jax.lax.dot_general(q_lo, k, (((1,), (1,)), ((), ())),
+    def _compute():
+        q = (q_ref[0].astype(jnp.float32) * sm_scale).astype(lo)  # [Bq, D]
+        k = k_ref[0].astype(lo)  # [Bk, D]
+        v = v_ref[0].astype(lo)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)  # [Bq, Bk]
         if causal:
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            # q rows sit at absolute positions q_offset + qi*Bq + i
+            q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
             s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        m_i = m_ref[:, :1]
+        l_i = l_ref[:, :1]
         m_new = jnp.maximum(m_i, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_i - m_new)
         p = jnp.exp(s - m_new)
         l_new = alpha * l_i + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jax.lax.dot(p.astype(lo), v,
-                                        preferred_element_type=jnp.float32)
-        return acc, m_new, l_new
+        acc_ref[...] = (acc_ref[...] * alpha
+                        + jax.lax.dot(p.astype(lo), v,
+                                      preferred_element_type=jnp.float32))
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    acc, m_i, l_i = jax.lax.fori_loop(0, num_k_blocks, body, (acc, m_i, l_i))
-    l_safe = jnp.where(l_i == 0.0, 1.0, l_i)
-    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    lse = m_i + jnp.log(l_safe)  # [Bq, 1]
-    lse_ref[0] = jnp.broadcast_to(lse, (bq, LANES))
+    if causal:
+        # only blocks intersecting the lower triangle of this q block
+        pl.when(ki * block_k
+                <= q_offset + qi * block_q + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == num_k_blocks - 1)
+    def _finalize():
+        l_i = l_ref[:, :1]
+        l_safe = jnp.where(l_i == 0.0, 1.0, l_i)
+        o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        lse = m_ref[:, :1] + jnp.log(l_safe)  # [Bq, 1]
+        lse_ref[0] = jnp.broadcast_to(lse, (bq, LANES))
 
 
 def _fwd(q, k, v, sm_scale: float, causal: bool, block_q: int, block_k: int,
          stochastic_mode: bool = False):
     """q,k,v: [BH, T, D] -> (o [BH, T, D], lse [BH, T, LANES])."""
+    from jax.experimental.pallas import tpu as pltpu
+
     BH, T, D = q.shape
     S = k.shape[1]
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal,
-        block_q=block_q, block_k=block_k, kv_len=S, q_offset=S - T,
-        stochastic_mode=stochastic_mode)
+        block_q=block_q, block_k=block_k, num_k_blocks=S // block_k,
+        q_offset=S - T, stochastic_mode=stochastic_mode)
     o, lse = pl.pallas_call(
         kernel,
-        grid=(BH, T // block_q),
+        grid=(BH, T // block_q, S // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda bh, qi, ki: (bh, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, T, D), q.dtype),
             jax.ShapeDtypeStruct((BH, T, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),      # acc
+            pltpu.VMEM((block_q, LANES), jnp.float32),  # running max
+            pltpu.VMEM((block_q, LANES), jnp.float32),  # running sum
         ],
         interpret=_interpret(),
     )(q, k, v)
@@ -133,94 +153,118 @@ def _fwd(q, k, v, sm_scale: float, causal: bool, block_q: int, block_k: int,
 
 
 # --------------------------------------------------------------------------- bwd
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *,
+# Backward kernels stream the CONTRACTED sequence axis through the grid
+# (3D grid, innermost axis revisits the same output window, accumulating)
+# instead of holding whole-sequence refs in VMEM — a [1, S, D] VMEM block
+# trips the Mosaic scoped-VMEM limit (16M, double-buffered) past seq ~4-8k.
+# Per grid step VMEM holds one (block_q, D) + one (block_k, D) tile set, so
+# the sequence ceiling is gone; causal skipping is a pl.when on whole blocks
+# (the out-of-triangle fetches still stream, the MXU work is skipped).
+
+
+def _bwd_delta_kernel(o_ref, do_ref, delta_ref):
+    """delta = rowsum(dO * O), computed ONCE per q block (it is k-invariant;
+    recomputing it per streamed k block would re-DMA the o tile S/block_k
+    times) and broadcast across lanes like the lse residual."""
+    delta = jnp.sum(do_ref[0].astype(jnp.float32)
+                    * o_ref[0].astype(jnp.float32), axis=-1, keepdims=True)
+    delta_ref[0] = jnp.broadcast_to(delta, delta_ref.shape[1:])
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                    sm_scale: float, causal: bool, block_q: int, block_k: int,
-                   kv_len: int, q_offset: int, stochastic_mode: bool):
+                   q_offset: int, stochastic_mode: bool):
     qi = pl.program_id(1)
+    ki = pl.program_id(2)
     lo = q_ref.dtype if stochastic_mode else jnp.float32
-    q = q_ref[0].astype(lo)
-    do = do_ref[0].astype(jnp.float32)
-    o = o_ref[0].astype(jnp.float32)
-    do_lo = do.astype(lo)
-    lse = lse_ref[0][:, :1]  # [Bq, 1]
-    delta = jnp.sum(do * o, axis=-1, keepdims=True)  # [Bq, 1]
-    bq = q.shape[0]
 
-    num_k_blocks = kv_len // block_k
-    if causal:
-        upper = (q_offset + qi * block_q + block_q + block_k - 1) // block_k
-        num_k_blocks = jnp.minimum(num_k_blocks, upper)
-    q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (bq, block_k), 0)
+    @pl.when(ki == 0)
+    def _init():
+        dq_ref[0] = jnp.zeros_like(dq_ref[0])
 
-    def body(ki, dq):
-        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(lo)
-        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(lo)
+    bq = q_ref.shape[1]
+
+    def _compute():
+        q = q_ref[0].astype(lo)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, :1]  # [Bq, 1]
+        delta = delta_ref[0][:, :1]  # [Bq, 1]
+        k = k_ref[0].astype(lo)
+        v = v_ref[0].astype(lo)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+            q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
             s = jnp.where(k_pos <= q_pos, s, NEG_INF)
         p = jnp.exp(s - lse)  # [Bq, Bk]
-        dp = jax.lax.dot_general(do_lo, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)  # [Bq, Bk]
+        dp = jax.lax.dot_general(do.astype(lo), v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
-        return dq + jax.lax.dot(ds.astype(lo), k,
-                                preferred_element_type=jnp.float32)
+        dq_ref[0] += jax.lax.dot(
+            ds.astype(lo), k,
+            preferred_element_type=jnp.float32).astype(dq_ref.dtype)
 
-    dq = jax.lax.fori_loop(
-        0, num_k_blocks, body, jnp.zeros((bq, q_ref.shape[-1]), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    if causal:
+        # any row of this q block can see the k block's first column?
+        pl.when(ki * block_k
+                <= q_offset + qi * block_q + block_q - 1)(_compute)
+    else:
+        _compute()
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, sm_scale: float, causal: bool,
-                    block_q: int, block_k: int, q_len: int, q_offset: int,
+                    block_q: int, block_k: int, q_offset: int,
                     stochastic_mode: bool):
     ki = pl.program_id(1)
+    qi = pl.program_id(2)
     lo = k_ref.dtype if stochastic_mode else jnp.float32
-    k = k_ref[0].astype(lo)  # [Bk, D]
-    v = v_ref[0].astype(lo)
-    bk = k.shape[0]
 
-    # first q block whose absolute position can reach this k block
-    first_q_block = jnp.maximum(0, ki * block_k - q_offset) // block_q if causal else 0
-    k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+    @pl.when(qi == 0)
+    def _init():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
 
-    def body(qi, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(lo)
-        do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        o = o_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        do_lo = do.astype(lo)
-        lse = lse_ref[0, pl.ds(qi * block_q, block_q), :1]  # [Bq, 1]
-        delta = jnp.sum(do * o, axis=-1, keepdims=True)
+    bk = k_ref.shape[1]
+
+    def _compute():
+        k = k_ref[0].astype(lo)  # [Bk, D]
+        v = v_ref[0].astype(lo)
+        q = q_ref[0].astype(lo)  # [Bq, D]
+        do_lo = do_ref[0].astype(lo)
+        lse = lse_ref[0][:, :1]  # [Bq, 1]
+        delta = delta_ref[0][:, :1]  # [Bq, 1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale  # [Bq, Bk]
         if causal:
+            bq = q.shape[0]
             q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, bk), 0)
+                jnp.int32, (bq, bk), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
             s = jnp.where(k_pos <= q_pos, s, NEG_INF)
         p = jnp.exp(s - lse)
-        dv = dv + jax.lax.dot_general(p.astype(lo), do_lo,
-                                      (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)  # [Bk, D]
+        dv_ref[0] += jax.lax.dot_general(
+            p.astype(lo), do_lo, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dv_ref.dtype)
         dp = jax.lax.dot_general(do_lo, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)  # [Bq, Bk]
+                                 preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
-        dk = dk + jax.lax.dot_general(ds.astype(lo), q,
-                                      (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)  # [Bk, D]
-        return dk, dv
+        dk_ref[0] += jax.lax.dot_general(
+            ds.astype(lo), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dk_ref.dtype)
 
-    dk, dv = jax.lax.fori_loop(
-        first_q_block, q_len // block_q, body,
-        (jnp.zeros((bk, k.shape[-1]), jnp.float32),
-         jnp.zeros((bk, v.shape[-1]), jnp.float32)))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    if causal:
+        # does the last row of this q block reach the k block at all?
+        pl.when(ki * block_k
+                <= q_offset + qi * block_q + block_q - 1)(_compute)
+    else:
+        _compute()
 
 
 def _bwd(sm_scale, causal, block_q, block_k, stochastic_mode, res, do):
@@ -228,48 +272,64 @@ def _bwd(sm_scale, causal, block_q, block_k, stochastic_mode, res, do):
     BH, T, D = q.shape
     S = k.shape[1]
 
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, kv_len=S,
-                          q_offset=S - T, stochastic_mode=stochastic_mode),
+    # prologue: delta = rowsum(dO*O) once per q row (k-invariant), in the
+    # same 128-lane broadcast layout as the lse residual
+    delta = pl.pallas_call(
+        _bwd_delta_kernel,
         grid=(BH, T // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        out_specs=pl.BlockSpec((1, block_q, LANES), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, T, LANES), jnp.float32),
         interpret=_interpret(),
-    )(q, k, v, o, do, lse)
+    )(o, do)
+
+    # accumulators are the (revisited) fp32 OUTPUT windows; cast at the end —
+    # accumulating in bf16 across S/block_k grid steps would lose precision
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          q_offset=S - T, stochastic_mode=stochastic_mode),
+        grid=(BH, T // block_q, S // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), jnp.float32),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, q_len=T,
+                          block_q=block_q, block_k=block_k,
                           q_offset=S - T, stochastic_mode=stochastic_mode),
-        grid=(BH, S // block_k),
+        grid=(BH, S // block_k, T // block_q),
         in_specs=[
-            pl.BlockSpec((1, T, D), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, T, D), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, T, D), lambda bh, ki: (bh, 0, 0)),
-            pl.BlockSpec((1, T, LANES), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_q, D), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda bh, ki, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda bh, ki, qi: (bh, qi, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, D), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bh, ki, qi: (bh, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, S, D), k.dtype),
-            jax.ShapeDtypeStruct((BH, S, D), v.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), jnp.float32),
+            jax.ShapeDtypeStruct((BH, S, D), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v, o, do, lse)
-    return dq, dk, dv
+    )(q, k, v, do, lse, delta)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 # --------------------------------------------------------------------------- api
