@@ -75,6 +75,11 @@ def main():
          "tag": "760m-bs24-chunkloss"},
         {"model": "gpt2-760m", "micro_bs": 8, "seq": 1024, "remat": True,
          "policy": "dots_with_no_batch_dims_saveable", "tag": "760m-bs8-save-dots"},
+        # long context on ONE chip: streamed flash kernels + chunked loss
+        # (AOT: 7.40 GB peak at seq 8192)
+        {"model": "gpt2-350m", "micro_bs": 2, "seq": 8192, "remat": True,
+         "policy": "nothing_saveable", "loss_chunk": 512,
+         "tag": "350m-seq8k-chunkloss"},
     ]
     for spec in sweep_grid:
         results.append(run(f"mfu:{spec['tag']}", [

@@ -121,3 +121,23 @@ def test_flash_shard_mapped_on_mesh():
             a, b, c, causal=True, use_flash=True))(qs, ks, vs)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
                                rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_streamed_multiblock_parity(causal):
+    """Many k blocks per q block (the 3D-grid streaming accumulation path):
+    fwd and grads must match the XLA reference across 8 streamed blocks."""
+    q, k, v = make_qkv(B=1, T=1024, H=1, D=64)
+    ref = dot_product_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-3, atol=2e-3)
+    g_ref = jax.grad(lambda a, b, c: jnp.sum(
+        dot_product_attention(a, b, c, causal=causal) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.grad(lambda a, b, c: jnp.sum(
+        flash_attention(a, b, c, causal=causal, block_q=128,
+                        block_k=128) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_fl):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-2, atol=2e-2)
