@@ -93,6 +93,11 @@ AOT_TRAIN_CONFIGS = [
     {"kind": "train_aot", "name": "gpt2-350m-seq8k-1chip",
      "model": "gpt2-350m", "micro_bs": 2, "seq": 8192, "loss_chunk": 512,
      "force_cpu": True, "timeout": 1500},
+    # expert parallelism (BASELINE config #4 shape): expert bank over ep=4,
+    # gating all-to-alls over ICI, ZeRO-1 over the (dp, ep) world
+    {"kind": "moe_aot", "name": "moe-125m-8e-ep4-aot",
+     "model": "moe-125m-8e", "ep": 4, "micro_bs": 4, "seq": 1024,
+     "force_cpu": True, "timeout": 1500},
 ]
 
 # Pipeline rows (VERDICT r3 next #4). The AOT row needs no chips at all — the
@@ -198,7 +203,8 @@ def _worker(cfg: dict) -> None:
           "pipeline_mpmd": _worker_pipeline_mpmd,
           "train_aot": _worker_train_aot,
           "kernels_aot": _worker_kernels_aot,
-          "infinity_aot": _worker_infinity_aot}[cfg["kind"]]
+          "infinity_aot": _worker_infinity_aot,
+          "moe_aot": _worker_moe_aot}[cfg["kind"]]
     print(json.dumps(fn(cfg)))
 
 
@@ -916,6 +922,72 @@ def _aot_oom_row(e: Exception) -> dict:
     return {"fits_v5e_hbm": False,
             "hbm_required_bytes": int(used) if used else None,
             "oom": msg.splitlines()[0][-300:]}
+
+
+def _worker_moe_aot(cfg: dict) -> dict:
+    """AOT-compile the MoE expert-parallel training step (ep over the v5e
+    mesh: expert bank sharded, gating all-to-alls over ICI) against the v5e
+    compiler — BASELINE config #4's program shape, no chips needed."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import topologies
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.models import build_gpt_moe
+    from deepspeed_tpu.ops.optimizers import get_optimizer
+    from deepspeed_tpu.runtime.topology import MeshTopology, mesh_context
+    from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+    from deepspeed_tpu.runtime.zero.policy import ZeroShardingPolicy
+
+    os.environ["DS_TPU_PALLAS_INTERPRET"] = "0"
+    td = topologies.get_topology_desc(
+        platform="tpu", topology_name=cfg.get("topology", "v5e:2x2"))
+    ep, dp = int(cfg.get("ep", 4)), int(cfg.get("dp", 1))
+    topo = MeshTopology.create(dp=dp, ep=ep, devices=list(td.devices)[:dp * ep])
+    model, mcfg = build_gpt_moe(cfg.get("model", "moe-125m-8e"))
+    micro_bs = int(cfg.get("micro_bs", 4))
+    seq = int(cfg.get("seq", 1024))
+    B = micro_bs * dp * ep  # batch rides the (dp, ep) axes
+
+    shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    base_specs = model.specs(shapes)
+    policy = ZeroShardingPolicy(topo, DeepSpeedZeroConfig(
+        stage=int(cfg.get("stage", 1))))
+    tmap = jax.tree_util.tree_map
+    sh = lambda spec: NamedSharding(topo.mesh, spec)  # noqa: E731
+    pspec = tmap(lambda s, b: policy.param_spec(s.shape, b), shapes, base_specs)
+    ospec = tmap(lambda s, b: policy.opt_spec(s.shape, b), shapes, base_specs)
+    optimizer = get_optimizer("AdamW", {"lr": 3e-4, "weight_decay": 0.1})
+    opt_shapes = jax.eval_shape(optimizer.init, shapes)
+    step = _aot_fused_step(model, optimizer)
+
+    def abstract(tree_shapes, spec_tree, dtype=None):
+        return tmap(lambda s, p: jax.ShapeDtypeStruct(
+            s.shape, dtype or s.dtype, sharding=sh(p)), tree_shapes, spec_tree)
+
+    opt_spec_tree = optimizer.state_spec(tmap(lambda p: sh(p), ospec), sh(P()))
+    a_opt = tmap(lambda s, shd: jax.ShapeDtypeStruct(
+        s.shape, s.dtype, sharding=shd), opt_shapes, opt_spec_tree)
+    a_batch = {"input_ids": jax.ShapeDtypeStruct(
+        (B, seq), jnp.int32, sharding=sh(topo.batch_spec(1)))}
+    a_rng = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=sh(P()))
+    out = {"config": cfg["name"], "kind": "moe_aot",
+           "platform": "tpu-compile-only",
+           "model": cfg.get("model", "moe-125m-8e"),
+           "ep": ep, "dp": dp, "micro_bs": micro_bs, "seq": seq}
+    with mesh_context(topo.mesh):
+        t0 = time.perf_counter()
+        try:
+            compiled = jax.jit(step, donate_argnums=(0, 1, 2)).lower(
+                abstract(shapes, pspec, jnp.bfloat16),
+                abstract(shapes, ospec, jnp.float32),
+                a_opt, a_batch, a_rng).compile()
+        except Exception as e:
+            out.update(_aot_oom_row(e))
+            return out
+        compile_s = time.perf_counter() - t0
+    out.update(_aot_report(compiled, compile_s))
+    return out
 
 
 def _worker_pipeline_mpmd(cfg: dict) -> dict:
