@@ -1,0 +1,76 @@
+"""The bench/chip-session config lists must be executable as-is: a malformed
+spec discovered at tunnel-up time would burn the measurement window (the
+round-3 post-mortem failure mode this guards against)."""
+
+import json
+
+import pytest
+
+
+def _bench():
+    import importlib
+    import sys
+
+    sys.path.insert(0, "/root/repo")
+    import bench
+
+    return importlib.reload(bench)
+
+
+def test_all_config_lists_have_registered_kinds_and_serialize():
+    bench = _bench()
+    kinds = {"train", "inference", "kernels", "diffusion", "pipeline_aot",
+             "pipeline_mpmd", "train_aot", "kernels_aot", "infinity_aot",
+             "moe_aot"}
+    for lst in (bench.INFINITY_CONFIGS, bench.PIPELINE_CONFIGS,
+                bench.AOT_TRAIN_CONFIGS):
+        assert lst, "config list emptied"
+        for cfg in lst:
+            assert cfg["kind"] in kinds, cfg
+            assert cfg["name"]
+            json.dumps(cfg)  # the worker boundary is a JSON argv
+
+
+def test_train_configs_reference_real_presets():
+    bench = _bench()
+    from deepspeed_tpu.models import gpt
+    from deepspeed_tpu.models.gpt_moe import PRESETS as MOE
+
+    for lst in (bench.INFINITY_CONFIGS, bench.PIPELINE_CONFIGS,
+                bench.AOT_TRAIN_CONFIGS):
+        for cfg in lst:
+            model = cfg.get("model")
+            if model:
+                assert model in gpt.PRESETS or model in MOE, cfg
+            if cfg.get("remat_policy") and cfg["remat_policy"] != \
+                    "save_attn_mlp_out":
+                assert hasattr(__import__("jax").checkpoint_policies,
+                               cfg["remat_policy"]), cfg
+
+
+def test_chip_session_grid_is_executable():
+    """Every chip-session sweep spec must parse against mfu_sweep's knobs."""
+    import ast
+    import os
+
+    src = open("/root/repo/scripts/chip_session.py").read()
+    tree = ast.parse(src)
+    # find the sweep_grid literal and evaluate it
+    grids = [node for node in ast.walk(tree)
+             if isinstance(node, ast.Assign)
+             and any(getattr(t, "id", None) == "sweep_grid"
+                     for t in node.targets)]
+    assert grids, "sweep_grid not found in chip_session.py"
+    grid = ast.literal_eval(grids[0].value)
+    assert len(grid) >= 5
+    import jax
+
+    from deepspeed_tpu.models import gpt
+
+    for spec in grid:
+        assert spec["model"] in gpt.PRESETS, spec
+        assert spec["seq"] % 128 == 0, spec
+        policy = spec.get("policy", "nothing_saveable")
+        assert (policy == "save_attn_mlp_out"
+                or hasattr(jax.checkpoint_policies, policy)), spec
+        json.dumps(spec)
