@@ -73,6 +73,15 @@ def main():
         {"model": "gpt2-760m", "micro_bs": 24, "seq": 1024, "remat": True,
          "policy": "nothing_saveable", "loss_chunk": 128,
          "tag": "760m-bs24-chunkloss"},
+        # mid-ladder hedges: the two rows above compile at 15.7 of 15.75 GB —
+        # if runtime fragmentation OOMs them on device, these (14.5 / 14.1 GB
+        # AOT) are the fallback measurements
+        {"model": "gpt2-760m", "micro_bs": 14, "seq": 1024, "remat": True,
+         "policy": "save_attn_mlp_out", "loss_chunk": 128,
+         "tag": "760m-selrm14-chunkloss"},
+        {"model": "gpt2-760m", "micro_bs": 20, "seq": 1024, "remat": True,
+         "policy": "nothing_saveable", "loss_chunk": 128,
+         "tag": "760m-bs20-chunkloss"},
         {"model": "gpt2-760m", "micro_bs": 8, "seq": 1024, "remat": True,
          "policy": "dots_with_no_batch_dims_saveable", "tag": "760m-bs8-save-dots"},
         # long context on ONE chip: streamed flash kernels + chunked loss
