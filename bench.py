@@ -64,6 +64,12 @@ INFINITY_CONFIGS = [
     {"kind": "train", "name": "gpt-neox-6.7b-infinity",
      "model": "gpt-neox-6.7b", "micro_bs": 16, "seq": 1024, "steps": 2,
      "offload": "param_stream", "keep_layers": 2, "timeout": 5400},
+    # ZeRO-Offload (optimizer-only) at billion scale: bf16 params resident
+    # (2.6 GB), fp32 grads (5.2 GB) + chunked loss ≈ 10 GB device; fp32
+    # master+moments (15.6 GB) live in host RAM, stepped by the C++ SIMD Adam
+    {"kind": "train", "name": "gpt2-1.3b-offload-opt", "model": "gpt2-1.3b",
+     "micro_bs": 8, "seq": 1024, "steps": 3, "offload": "optimizer",
+     "stage": 1, "loss_chunk": 128, "timeout": 3600},
 ]
 
 # Compile-only evidence rows: the XLA TPU compiler runs on the host, so these
