@@ -1166,7 +1166,15 @@ def main() -> None:
         if "error" in r:
             errors.append(f"{cfg['name']}: {r['error']}")
         print(f"[bench] {json.dumps(r)}", file=sys.stderr)
+        # refresh the stdout artifact after EVERY row: if the sweep is killed
+        # mid-run (driver budget, tunnel hang), the last complete line is
+        # still a valid summary of everything measured so far
+        print(json.dumps(_summarize(platform, sweep, errors)), flush=True)
 
+    print(json.dumps(_summarize(platform, sweep, errors)))
+
+
+def _summarize(platform: str, sweep: list, errors: list) -> dict:
     train_ok = [r for r in sweep if r.get("kind") == "train" and "error" not in r]
     infer_ok = [r for r in sweep if r.get("kind") == "inference" and "error" not in r]
     result = {"platform": platform, "sweep": sweep}
@@ -1205,7 +1213,7 @@ def main() -> None:
              "kernels_ok": (all(k.get("ok") for k in r["kernels"].values())
                             if "kernels" in r else None)}
             for r in aot_rows]
-    print(json.dumps(result))
+    return result
 
 
 if __name__ == "__main__":
