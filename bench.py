@@ -99,6 +99,16 @@ AOT_TRAIN_CONFIGS = [
     {"kind": "train_aot", "name": "gpt2-350m-seq8k-1chip",
      "model": "gpt2-350m", "micro_bs": 2, "seq": 8192, "loss_chunk": 512,
      "force_cpu": True, "timeout": 1500},
+    {"kind": "train_aot", "name": "gpt2-350m-seq8k-ulysses-sp4",
+     "model": "gpt2-350m", "micro_bs": 2, "seq": 8192, "sp": 4,
+     "seq_parallel_impl": "ulysses", "loss_chunk": 512,
+     "force_cpu": True, "timeout": 1500},
+    # tensor parallelism: Megatron specs + the shard_mapped flash kernel
+    # over tp=2 x dp=2 (the multi-chip config the GSPMD/Mosaic bug would
+    # have crashed before this round's fix)
+    {"kind": "train_aot", "name": "gpt2-350m-tp2-dp2",
+     "model": "gpt2-350m", "micro_bs": 8, "dp": 2, "tp": 2, "seq": 1024,
+     "loss_chunk": 128, "force_cpu": True, "timeout": 1500},
     # expert parallelism (BASELINE config #4 shape): expert bank over ep=4,
     # gating all-to-alls over ICI, ZeRO-1 over the (dp, ep) world
     {"kind": "moe_aot", "name": "moe-125m-8e-ep4-aot",
@@ -860,8 +870,9 @@ def _worker_train_aot(cfg: dict) -> dict:
     td = topologies.get_topology_desc(
         platform="tpu", topology_name=cfg.get("topology", "v5e:2x2"))
     dp, sp = int(cfg.get("dp", 1)), int(cfg.get("sp", 1))
-    topo = MeshTopology.create(dp=dp, sp=sp,
-                               devices=list(td.devices)[:dp * sp])
+    tp = int(cfg.get("tp", 1))
+    topo = MeshTopology.create(dp=dp, sp=sp, tp=tp,
+                               devices=list(td.devices)[:dp * sp * tp])
     replace = dict(
         remat=True, use_flash=True,
         remat_policy=cfg.get("remat_policy", "nothing_saveable"),
@@ -875,6 +886,9 @@ def _worker_train_aot(cfg: dict) -> dict:
     mcfg = dataclasses.replace(mcfg, **replace)
     model, mcfg = build_gpt(mcfg)
 
+    from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+    from deepspeed_tpu.runtime.zero.policy import ZeroShardingPolicy
+
     shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0))
     tmap = jax.tree_util.tree_map
     optimizer = get_optimizer("AdamW", {"lr": 3e-4, "weight_decay": 0.1})
@@ -882,10 +896,22 @@ def _worker_train_aot(cfg: dict) -> dict:
     rep = NamedSharding(topo.mesh, P())
     step = _aot_fused_step(model, optimizer)
 
-    def abstract(tree, dtype=None):
-        return tmap(lambda s: jax.ShapeDtypeStruct(
-            s.shape, dtype or s.dtype, sharding=rep), tree)
+    # real placement, exactly as the engine: model (Megatron tp) specs layered
+    # with the ZeRO policy — replicated-everything would misstate tp programs
+    base_specs = model.specs(shapes)
+    policy = ZeroShardingPolicy(topo, DeepSpeedZeroConfig(
+        stage=int(cfg.get("stage", 1))))
+    sh = lambda spec: NamedSharding(topo.mesh, spec)  # noqa: E731
+    pspec = tmap(lambda s, b: policy.param_spec(s.shape, b), shapes, base_specs)
+    ospec = tmap(lambda s, b: policy.opt_spec(s.shape, b), shapes, base_specs)
 
+    def abstract(tree, spec_tree, dtype=None):
+        return tmap(lambda s, p: jax.ShapeDtypeStruct(
+            s.shape, dtype or s.dtype, sharding=sh(p)), tree, spec_tree)
+
+    opt_spec_tree = optimizer.state_spec(tmap(lambda p: sh(p), ospec), rep)
+    a_opt = tmap(lambda s, shd: jax.ShapeDtypeStruct(
+        s.shape, s.dtype, sharding=shd), opt_shapes, opt_spec_tree)
     a_batch = {"input_ids": jax.ShapeDtypeStruct(
         (micro_bs * dp, seq), jnp.int32,
         sharding=NamedSharding(topo.mesh, topo.batch_spec(1)))}
@@ -893,7 +919,7 @@ def _worker_train_aot(cfg: dict) -> dict:
     out = {
         "config": cfg["name"], "kind": "train_aot",
         "platform": "tpu-compile-only", "model": cfg["model"],
-        "micro_bs": micro_bs, "seq": seq, "dp": dp, "sp": sp,
+        "micro_bs": micro_bs, "seq": seq, "dp": dp, "sp": sp, "tp": tp,
         "remat_policy": cfg.get("remat_policy", "nothing_saveable"),
     }
     with mesh_context(topo.mesh):
@@ -903,8 +929,9 @@ def _worker_train_aot(cfg: dict) -> dict:
             # (donate_argnums=(0,)): without aliasing, params+master+opt would
             # double-count and misreport the real program's peak
             compiled = jax.jit(step, donate_argnums=(0, 1, 2)).lower(
-                abstract(shapes, jnp.bfloat16), abstract(shapes, jnp.float32),
-                abstract(opt_shapes), a_batch, a_rng).compile()
+                abstract(shapes, pspec, jnp.bfloat16),
+                abstract(shapes, ospec, jnp.float32),
+                a_opt, a_batch, a_rng).compile()
         except Exception as e:  # compile-time OOM IS the evidence
             out.update(_aot_oom_row(e))
             return out
