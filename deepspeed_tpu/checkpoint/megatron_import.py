@@ -186,7 +186,9 @@ def import_megatron_checkpoint(ckpt_dir: str, n_head: int):
         vocab_size=int(wte.shape[0]), n_layer=len(layers), n_head=n_head,
         d_model=D, d_ff=ffn,
         max_seq_len=int(wpe.shape[0]) if wpe is not None else 2048,
-        rotary=wpe is None)
+        # Megatron-LM's default is erf gelu (F.gelu), not the tanh approx —
+        # keep both Megatron importers (this + module_inject/megatron.py) in sync
+        rotary=wpe is None, activation="gelu_exact")
     log_dist(
         f"imported Megatron-DeepSpeed checkpoint: {len(layers)} layers, "
         f"d_model {D}, tp_degree {ckpt.tp_degree} (merged)")

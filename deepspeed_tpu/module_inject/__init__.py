@@ -1,2 +1,4 @@
 from .auto_tp import auto_tp_specs  # noqa: F401
+from .megatron import (import_megatron_gpt,  # noqa: F401
+                       import_megatron_gpt_moe)
 from .replace_module import import_hf_model, HF_POLICIES  # noqa: F401

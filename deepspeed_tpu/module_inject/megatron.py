@@ -1,0 +1,322 @@
+"""Megatron-LM checkpoint import policies (dense + DeepSpeed-MoE).
+
+Capability parity with the reference's Megatron containers:
+``module_inject/containers/megatron_gpt.py`` (MegatronLayerPolicy — walks
+``ParallelTransformerLayer``: input_layernorm, [self_]attention.query_key_value
+/ .dense, post_attention_layernorm, mlp.dense_h_to_4h / dense_4h_to_h) and
+``module_inject/containers/megatron_gpt_moe.py`` (MegatronMoELayerPolicy —
+experts under ``mlp.deepspeed_moe.experts.deepspeed_experts.{e}``, PR-MoE
+residual under ``mlp.mlp`` + ``mlp.coefficient``).
+
+The reference injects fused CUDA modules into the torch module tree; here the
+same layer-walking knowledge maps a Megatron-LM *state dict* onto this
+framework's stacked scanned parameter trees (``models/gpt.py`` dense,
+``models/gpt_moe.py`` MoE), after which the jitted/Pallas decode path is the
+"injected kernel".
+
+Layout notes (mirrors ``containers/features/megatron.py`` transpose_qkv_alignment):
+``megatron_v2`` checkpoints store fused qkv rows per-head-interleaved
+``[H, 3, Dh]`` — permuted to this framework's ``q|k|v`` block order; version-0
+checkpoints are already block-ordered. Torch ``[out, in]`` weights are
+transposed to ``[in, out]``.
+
+Unlike the HF policies (which dispatch on a live ``transformers`` module
+class), Megatron models arrive as bare checkpoints, so the entry points take a
+state dict — matching how ``checkpoint/megatron_import.py`` handles the
+layer-file (pipeline) format. This module handles the monolithic
+(``model_optim_rng.pt``-style ``language_model.*``) format.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..models.gpt import GPTConfig
+from ..utils.logging import log_dist
+from .replace_module import _neox_qkv_permute, _np
+
+_LAYER_RE = re.compile(r"(?:transformer|encoder)\.layers\.(\d+)\.(.+)$")
+
+
+def _flatten(sd: Dict[str, Any], prefix: str = "") -> Dict[str, Any]:
+    """Megatron's ``model_optim_rng.pt`` nests state dicts (``language_model``
+    -> ``embedding``/``encoder`` sub-dicts with tensor leaves); flatten to
+    dotted keys so both the nested and already-flat forms are accepted."""
+    out: Dict[str, Any] = {}
+    for k, v in sd.items():
+        kk = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, kk + "."))
+        else:
+            out[kk] = v
+    return out
+
+
+def _normalize(sd: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Strip any ``model.``/``module.`` wrapping before ``language_model.`` and
+    return float32 numpy arrays keyed from ``language_model.`` down."""
+    out = {}
+    for k, v in _flatten(sd).items():
+        anchor = k.find("language_model.")
+        if anchor < 0:
+            continue  # optimizer/rng state in a full Megatron checkpoint
+        out[k[anchor + len("language_model."):]] = _np(v)
+    if not out:
+        raise ValueError(
+            "no 'language_model.*' keys found — not a monolithic Megatron-LM "
+            "state dict (for layer-file pipeline checkpoints use "
+            "checkpoint.megatron_import)")
+    return out
+
+
+def _split_layers(sd: Dict[str, np.ndarray]):
+    layers: Dict[int, Dict[str, np.ndarray]] = {}
+    rest: Dict[str, np.ndarray] = {}
+    for k, v in sd.items():
+        m = _LAYER_RE.search(k)
+        if m:
+            layers.setdefault(int(m.group(1)), {})[m.group(2)] = v
+        else:
+            rest[k] = v
+    if not layers:
+        raise ValueError("no '(transformer|encoder).layers.N.*' keys found")
+    idxs = sorted(layers)
+    if idxs != list(range(len(idxs))):
+        raise ValueError(f"non-contiguous layer indices {idxs}")
+    return [layers[i] for i in idxs], rest
+
+
+def _get(sd: Dict[str, np.ndarray], *names: str) -> np.ndarray:
+    for n in names:
+        if n in sd:
+            return sd[n]
+    raise KeyError(f"none of {names} present (have {sorted(sd)[:6]}...)")
+
+
+def _qkv(layer: Dict[str, np.ndarray], n_head: int, megatron_v2: bool):
+    w = _get(layer, "self_attention.query_key_value.weight",
+             "attention.query_key_value.weight")
+    b = _get(layer, "self_attention.query_key_value.bias",
+             "attention.query_key_value.bias")
+    if megatron_v2:
+        w, b = _neox_qkv_permute(w, b, n_head, w.shape[1] // n_head)
+    return w.T, b  # [in, out]
+
+
+def _attn_block(layer: Dict[str, np.ndarray], n_head: int, megatron_v2: bool):
+    qkv_w, qkv_b = _qkv(layer, n_head, megatron_v2)
+    return {
+        "ln1_scale": layer["input_layernorm.weight"],
+        "ln1_bias": layer["input_layernorm.bias"],
+        "qkv_w": qkv_w, "qkv_b": qkv_b,
+        "attn_out_w": _get(layer, "self_attention.dense.weight",
+                           "attention.dense.weight").T,
+        "attn_out_b": _get(layer, "self_attention.dense.bias",
+                           "attention.dense.bias"),
+        "ln2_scale": layer["post_attention_layernorm.weight"],
+        "ln2_bias": layer["post_attention_layernorm.bias"],
+    }
+
+
+def _dense_mlp(layer: Dict[str, np.ndarray], prefix: str = "mlp."):
+    return {
+        "mlp_up_w": layer[prefix + "dense_h_to_4h.weight"].T,
+        "mlp_up_b": layer[prefix + "dense_h_to_4h.bias"],
+        "mlp_down_w": layer[prefix + "dense_4h_to_h.weight"].T,
+        "mlp_down_b": layer[prefix + "dense_4h_to_h.bias"],
+    }
+
+
+_EXPERT_RE = re.compile(
+    r"^mlp\.(?:moe\.)?deepspeed_moe\.experts\.deepspeed_experts\.(\d+)\.")
+
+
+def _moe_layer_experts(layer: Dict[str, np.ndarray]) -> Optional[List[int]]:
+    es = sorted({int(m.group(1)) for k in layer
+                 if (m := _EXPERT_RE.match(k))})
+    return es or None
+
+
+def _stack_tree(dicts: List[Dict[str, np.ndarray]]) -> Dict[str, jnp.ndarray]:
+    return {k: jnp.asarray(np.stack([d[k] for d in dicts]))
+            for k in (dicts[0] if dicts else {})}
+
+
+def _base_config(sd, rest, layers, n_head, activation, layer_norm_eps):
+    wte = rest["embedding.word_embeddings.weight"]
+    wpe = rest.get("embedding.position_embeddings.weight")
+    d_model = int(wte.shape[1])
+    if d_model % n_head:
+        raise ValueError(f"d_model {d_model} not divisible by n_head {n_head}")
+    return wte, wpe, dict(
+        vocab_size=int(wte.shape[0]), n_layer=len(layers), n_head=n_head,
+        d_model=d_model,
+        max_seq_len=int(wpe.shape[0]) if wpe is not None else 2048,
+        rotary=wpe is None, tie_embeddings=True,
+        layer_norm_eps=layer_norm_eps, activation=activation)
+
+
+def _final_ln(rest: Dict[str, np.ndarray]):
+    return (_get(rest, "transformer.final_layernorm.weight",
+                 "encoder.final_layernorm.weight", "encoder.final_norm.weight"),
+            _get(rest, "transformer.final_layernorm.bias",
+                 "encoder.final_layernorm.bias", "encoder.final_norm.bias"))
+
+
+def import_megatron_gpt(
+    state_dict: Dict[str, Any], n_head: int, megatron_v2: bool = True,
+    activation: str = "gelu_exact", layer_norm_eps: float = 1e-5,
+) -> Tuple[GPTConfig, Dict[str, Any]]:
+    """Monolithic Megatron-LM GPT state dict -> (GPTConfig, params).
+
+    Parity: ``containers/megatron_gpt.py`` MegatronLayerPolicy (version 0 uses
+    ``attention.*``, newer uses ``self_attention.*`` — both accepted;
+    ``megatron_v2`` triggers the per-head qkv dealignment the reference does in
+    ``features/megatron.py:transpose_qkv_alignment``).
+    """
+    sd = _normalize(state_dict)
+    layers, rest = _split_layers(sd)
+    if any(_moe_layer_experts(l) for l in layers):
+        raise ValueError("MoE expert keys found — use import_megatron_gpt_moe")
+    wte, wpe, ckw = _base_config(sd, rest, layers, n_head, activation,
+                                 layer_norm_eps)
+    blocks = [dict(_attn_block(l, n_head, megatron_v2), **_dense_mlp(l))
+              for l in layers]
+    ffn = int(blocks[0]["mlp_up_w"].shape[1])
+    cfg = GPTConfig(d_ff=ffn, **ckw)
+    lnf_scale, lnf_bias = _final_ln(rest)
+    params: Dict[str, Any] = {
+        "wte": jnp.asarray(wte),
+        "blocks": _stack_tree(blocks),
+        "lnf_scale": jnp.asarray(lnf_scale),
+        "lnf_bias": jnp.asarray(lnf_bias),
+    }
+    if wpe is not None:
+        params["wpe"] = jnp.asarray(wpe)
+    log_dist(f"imported Megatron-LM GPT: {cfg.n_layer}L d{cfg.d_model} "
+             f"h{n_head} (megatron_v2={megatron_v2})")
+    return cfg, params
+
+
+def import_megatron_gpt_moe(
+    state_dict: Dict[str, Any], n_head: int, megatron_v2: bool = True,
+    k: int = 1, capacity_factor: float = 1.25,
+    activation: str = "gelu_exact", layer_norm_eps: float = 1e-5,
+):
+    """Monolithic Megatron-DeepSpeed MoE state dict -> (GPTMoEConfig, params).
+
+    Parity: ``containers/megatron_gpt_moe.py`` MegatronMoELayerPolicy —
+    'standard' experts under ``mlp.deepspeed_moe.experts.deepspeed_experts.{e}``
+    and PR-MoE ('residual') under ``mlp.moe.deepspeed_moe...`` with the shared
+    dense branch at ``mlp.mlp.*`` and mixing weights ``mlp.coefficient.weight``
+    (weight-only, exactly the tensors the reference policy extracts).
+
+    MoE layer placement must follow the reference's regular ``moe_freq``
+    pattern (every freq-th layer, dense layers first in each super-block) —
+    that is what the scanned super-block in ``models/gpt_moe.py`` executes.
+    """
+    from ..models.gpt_moe import GPTMoEConfig
+
+    sd = _normalize(state_dict)
+    layers, rest = _split_layers(sd)
+    expert_ids = [_moe_layer_experts(l) for l in layers]
+    moe_pos = [i for i, e in enumerate(expert_ids) if e]
+    if not moe_pos:
+        raise ValueError("no MoE expert keys — use import_megatron_gpt")
+    n_layer = len(layers)
+    freq = n_layer // len(moe_pos)
+    if moe_pos != [s * freq + (freq - 1) for s in range(len(moe_pos))]:
+        raise ValueError(
+            f"MoE layers at {moe_pos} do not form a regular every-{freq}th "
+            "pattern (dense-first); the scanned super-block model requires it")
+    n_experts = {len(e) for e in expert_ids if e}
+    if len(n_experts) != 1:
+        raise ValueError(f"inconsistent expert counts across layers: {n_experts}")
+    E = n_experts.pop()
+    residual = any(k.startswith("mlp.moe.") for k in layers[moe_pos[0]])
+    pre = "mlp.moe.deepspeed_moe." if residual else "mlp.deepspeed_moe."
+
+    wte, wpe, ckw = _base_config(sd, rest, layers, n_head, activation,
+                                 layer_norm_eps)
+
+    def moe_params(layer):
+        ex = {
+            "up_w": np.stack([layer[f"{pre}experts.deepspeed_experts.{e}."
+                                    "dense_h_to_4h.weight"].T
+                              for e in range(E)]),
+            "up_b": np.stack([layer[f"{pre}experts.deepspeed_experts.{e}."
+                                    "dense_h_to_4h.bias"] for e in range(E)]),
+            "down_w": np.stack([layer[f"{pre}experts.deepspeed_experts.{e}."
+                                      "dense_4h_to_h.weight"].T
+                                for e in range(E)]),
+            "down_b": np.stack([layer[f"{pre}experts.deepspeed_experts.{e}."
+                                      "dense_4h_to_h.bias"] for e in range(E)]),
+        }
+        moe = {"gate_w": layer[pre + "gate.wg.weight"].T, "experts": ex}
+        if residual:
+            moe["residual_mlp"] = {
+                "up_w": layer["mlp.mlp.dense_h_to_4h.weight"].T,
+                "up_b": layer["mlp.mlp.dense_h_to_4h.bias"],
+                "down_w": layer["mlp.mlp.dense_4h_to_h.weight"].T,
+                "down_b": layer["mlp.mlp.dense_4h_to_h.bias"],
+            }
+            moe["coefficient"] = layer["mlp.coefficient.weight"].T
+        return moe
+
+    moe_set = set(moe_pos)
+    dense_blocks = [dict(_attn_block(layers[i], n_head, megatron_v2),
+                         **_dense_mlp(layers[i]))
+                    for i in range(n_layer) if i not in moe_set]
+    moe_blocks = [dict(_attn_block(layers[i], n_head, megatron_v2),
+                       moe=moe_params(layers[i])) for i in moe_pos]
+
+    ffn = int(moe_blocks[0]["moe"]["experts"]["up_w"].shape[2])
+    base = GPTConfig(d_ff=ffn, **ckw)
+    cfg = GPTMoEConfig(base=base, num_experts=E, moe_freq=freq, k=k,
+                       capacity_factor=capacity_factor, use_residual=residual)
+
+    def stack_moe(blocks):
+        out = _stack_tree([{kk: vv for kk, vv in b.items() if kk != "moe"}
+                           for b in blocks])
+        moes = [b["moe"] for b in blocks]
+        out["moe"] = {
+            kk: ({k2: jnp.asarray(np.stack([m[kk][k2] for m in moes]))
+                  for k2 in moes[0][kk]}
+                 if isinstance(moes[0][kk], dict)
+                 else jnp.asarray(np.stack([m[kk] for m in moes])))
+            for kk in moes[0]
+        }
+        return out
+
+    if dense_blocks:
+        blocks = _stack_tree(dense_blocks)
+    else:
+        # all layers MoE (freq=1): zero-length stacked leaves, same tree shape
+        # as models/gpt_moe.init_params' dense_layers==0 branch
+        D = base.d_model
+        blocks = {
+            "ln1_scale": jnp.zeros((0, D)), "ln1_bias": jnp.zeros((0, D)),
+            "qkv_w": jnp.zeros((0, D, 3 * D)), "qkv_b": jnp.zeros((0, 3 * D)),
+            "attn_out_w": jnp.zeros((0, D, D)), "attn_out_b": jnp.zeros((0, D)),
+            "ln2_scale": jnp.zeros((0, D)), "ln2_bias": jnp.zeros((0, D)),
+            "mlp_up_w": jnp.zeros((0, D, ffn)), "mlp_up_b": jnp.zeros((0, ffn)),
+            "mlp_down_w": jnp.zeros((0, ffn, D)), "mlp_down_b": jnp.zeros((0, D)),
+        }
+    lnf_scale, lnf_bias = _final_ln(rest)
+    params: Dict[str, Any] = {
+        "wte": jnp.asarray(wte),
+        "blocks": blocks,
+        "moe_blocks": stack_moe(moe_blocks),
+        "lnf_scale": jnp.asarray(lnf_scale),
+        "lnf_bias": jnp.asarray(lnf_bias),
+    }
+    if wpe is not None:
+        params["wpe"] = jnp.asarray(wpe)
+    log_dist(f"imported Megatron-DeepSpeed MoE: {n_layer}L x{E} experts "
+             f"(freq={freq}, residual={residual})")
+    return cfg, params

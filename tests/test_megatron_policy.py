@@ -1,0 +1,269 @@
+"""Megatron-LM monolithic checkpoint policies (module_inject/megatron.py).
+
+Parity targets: ``module_inject/containers/megatron_gpt.py`` (MegatronLayerPolicy)
+and ``containers/megatron_gpt_moe.py`` (MegatronMoELayerPolicy). Tests build a
+synthetic Megatron-LM state dict by INVERSE-mapping native params (including the
+megatron_v2 per-head qkv interleave the reference undoes in
+``features/megatron.py:transpose_qkv_alignment``) and assert the import recovers
+the exact arrays and yields a runnable model.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models import gpt as gpt_mod
+from deepspeed_tpu.models import gpt_moe as moe_mod
+from deepspeed_tpu.module_inject import (import_megatron_gpt,
+                                         import_megatron_gpt_moe)
+
+H, DH = 2, 4
+D = H * DH
+L, F, V, S = 4, 16, 32, 16
+
+
+def _interleave_qkv(qkv_w, qkv_b):
+    """Native [D, 3D] block q|k|v -> Megatron-v2 [3D, D] per-head interleaved."""
+    block_w = np.asarray(qkv_w).T            # [3D out, D in], q|k|v row blocks
+    meg_w = (block_w.reshape(3, H, DH, D).transpose(1, 0, 2, 3)
+             .reshape(3 * D, D))
+    meg_b = (np.asarray(qkv_b).reshape(3, H, DH).transpose(1, 0, 2)
+             .reshape(3 * D))
+    return meg_w, meg_b
+
+
+def _attn_keys(pre, blk, i, attn="self_attention"):
+    meg_w, meg_b = _interleave_qkv(blk["qkv_w"][i], blk["qkv_b"][i])
+    return {
+        f"{pre}.input_layernorm.weight": np.asarray(blk["ln1_scale"][i]),
+        f"{pre}.input_layernorm.bias": np.asarray(blk["ln1_bias"][i]),
+        f"{pre}.{attn}.query_key_value.weight": meg_w,
+        f"{pre}.{attn}.query_key_value.bias": meg_b,
+        f"{pre}.{attn}.dense.weight": np.asarray(blk["attn_out_w"][i]).T,
+        f"{pre}.{attn}.dense.bias": np.asarray(blk["attn_out_b"][i]),
+        f"{pre}.post_attention_layernorm.weight": np.asarray(blk["ln2_scale"][i]),
+        f"{pre}.post_attention_layernorm.bias": np.asarray(blk["ln2_bias"][i]),
+    }
+
+
+def _mlp_keys(pre, blk, i, mlp="mlp"):
+    return {
+        f"{pre}.{mlp}.dense_h_to_4h.weight": np.asarray(blk["mlp_up_w"][i]).T,
+        f"{pre}.{mlp}.dense_h_to_4h.bias": np.asarray(blk["mlp_up_b"][i]),
+        f"{pre}.{mlp}.dense_4h_to_h.weight": np.asarray(blk["mlp_down_w"][i]).T,
+        f"{pre}.{mlp}.dense_4h_to_h.bias": np.asarray(blk["mlp_down_b"][i]),
+    }
+
+
+def _dense_cfg():
+    return gpt_mod.GPTConfig(vocab_size=V, n_layer=L, n_head=H, d_model=D,
+                             d_ff=F, max_seq_len=S, rotary=False,
+                             tie_embeddings=True)
+
+
+def _dense_megatron_sd(params, attn="self_attention", prefix="language_model."):
+    sd = {
+        prefix + "embedding.word_embeddings.weight": np.asarray(params["wte"]),
+        prefix + "embedding.position_embeddings.weight":
+            np.asarray(params["wpe"]),
+        prefix + "transformer.final_layernorm.weight":
+            np.asarray(params["lnf_scale"]),
+        prefix + "transformer.final_layernorm.bias":
+            np.asarray(params["lnf_bias"]),
+    }
+    for i in range(L):
+        pre = prefix + f"transformer.layers.{i}"
+        sd.update(_attn_keys(pre, params["blocks"], i, attn))
+        sd.update(_mlp_keys(pre, params["blocks"], i))
+    return sd
+
+
+def test_dense_roundtrip_exact():
+    cfg = _dense_cfg()
+    params = gpt_mod.init_params(cfg, jax.random.PRNGKey(0))
+    sd = _dense_megatron_sd(params)
+    icfg, iparams = import_megatron_gpt(sd, n_head=H)
+    assert (icfg.n_layer, icfg.d_model, icfg.n_head, icfg.d_ff) == (L, D, H, F)
+    assert not icfg.rotary and icfg.tie_embeddings
+    for k in ("qkv_w", "qkv_b", "attn_out_w", "mlp_up_w", "mlp_down_w"):
+        np.testing.assert_allclose(iparams["blocks"][k], params["blocks"][k],
+                                   rtol=0, atol=0, err_msg=k)
+    np.testing.assert_array_equal(iparams["wte"], params["wte"])
+    np.testing.assert_array_equal(iparams["wpe"], params["wpe"])
+    # imported model is directly runnable
+    ids = jnp.zeros((1, 8), jnp.int32)
+    logits = gpt_mod.forward(icfg, iparams, ids, train=False)
+    assert logits.shape == (1, 8, V)
+    ref = gpt_mod.forward(cfg, params, ids, train=False)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_dense_version0_attention_naming_and_model_prefix():
+    """version-0 checkpoints use ``attention.`` and often a ``model.`` wrap."""
+    cfg = _dense_cfg()
+    params = gpt_mod.init_params(cfg, jax.random.PRNGKey(1))
+    sd = _dense_megatron_sd(params, attn="attention",
+                            prefix="model.language_model.")
+    icfg, iparams = import_megatron_gpt(sd, n_head=H)
+    np.testing.assert_array_equal(iparams["blocks"]["qkv_w"],
+                                  params["blocks"]["qkv_w"])
+
+
+def test_dense_v1_no_interleave():
+    """megatron_v2=False: qkv rows already q|k|v block-ordered."""
+    cfg = _dense_cfg()
+    params = gpt_mod.init_params(cfg, jax.random.PRNGKey(2))
+    sd = _dense_megatron_sd(params)
+    for i in range(L):
+        pre = f"language_model.transformer.layers.{i}.self_attention"
+        sd[pre + ".query_key_value.weight"] = \
+            np.asarray(params["blocks"]["qkv_w"][i]).T
+        sd[pre + ".query_key_value.bias"] = \
+            np.asarray(params["blocks"]["qkv_b"][i])
+    icfg, iparams = import_megatron_gpt(sd, n_head=H, megatron_v2=False)
+    np.testing.assert_array_equal(iparams["blocks"]["qkv_w"],
+                                  params["blocks"]["qkv_w"])
+
+
+def _moe_cfg(use_residual=False):
+    return moe_mod.GPTMoEConfig(base=_dense_cfg(), num_experts=4, moe_freq=2,
+                                use_residual=use_residual)
+
+
+def _moe_megatron_sd(cfg, params):
+    """Scatter native MoE params into the reference's Megatron-MoE naming."""
+    prefix = "language_model."
+    base = cfg.base
+    sd = {
+        prefix + "embedding.word_embeddings.weight": np.asarray(params["wte"]),
+        prefix + "embedding.position_embeddings.weight":
+            np.asarray(params["wpe"]),
+        prefix + "transformer.final_layernorm.weight":
+            np.asarray(params["lnf_scale"]),
+        prefix + "transformer.final_layernorm.bias":
+            np.asarray(params["lnf_bias"]),
+    }
+    moe_pos = [s * cfg.moe_freq + cfg.moe_freq - 1 for s in range(cfg.n_super)]
+    dense_i = moe_i = 0
+    moe_pre = ("mlp.moe.deepspeed_moe." if cfg.use_residual
+               else "mlp.deepspeed_moe.")
+    for i in range(base.n_layer):
+        pre = prefix + f"transformer.layers.{i}"
+        if i in moe_pos:
+            blk = params["moe_blocks"]
+            sd.update(_attn_keys(pre, blk, moe_i))
+            moe = blk["moe"]
+            sd[f"{pre}.{moe_pre}gate.wg.weight"] = \
+                np.asarray(moe["gate_w"][moe_i]).T
+            ex = moe["experts"]
+            for e in range(cfg.num_experts):
+                epre = f"{pre}.{moe_pre}experts.deepspeed_experts.{e}"
+                sd[epre + ".dense_h_to_4h.weight"] = \
+                    np.asarray(ex["up_w"][moe_i, e]).T
+                sd[epre + ".dense_h_to_4h.bias"] = \
+                    np.asarray(ex["up_b"][moe_i, e])
+                sd[epre + ".dense_4h_to_h.weight"] = \
+                    np.asarray(ex["down_w"][moe_i, e]).T
+                sd[epre + ".dense_4h_to_h.bias"] = \
+                    np.asarray(ex["down_b"][moe_i, e])
+            if cfg.use_residual:
+                res = moe["residual_mlp"]
+                sd[f"{pre}.mlp.mlp.dense_h_to_4h.weight"] = \
+                    np.asarray(res["up_w"][moe_i]).T
+                sd[f"{pre}.mlp.mlp.dense_h_to_4h.bias"] = \
+                    np.asarray(res["up_b"][moe_i])
+                sd[f"{pre}.mlp.mlp.dense_4h_to_h.weight"] = \
+                    np.asarray(res["down_w"][moe_i]).T
+                sd[f"{pre}.mlp.mlp.dense_4h_to_h.bias"] = \
+                    np.asarray(res["down_b"][moe_i])
+                sd[f"{pre}.mlp.coefficient.weight"] = \
+                    np.asarray(moe["coefficient"][moe_i]).T
+            moe_i += 1
+        else:
+            blk = params["blocks"]
+            sd.update(_attn_keys(pre, blk, dense_i))
+            sd.update(_mlp_keys(pre, blk, dense_i))
+            dense_i += 1
+    return sd
+
+
+@pytest.mark.parametrize("use_residual", [False, True],
+                         ids=["standard", "pr-moe"])
+def test_moe_roundtrip(use_residual):
+    cfg = _moe_cfg(use_residual)
+    params = moe_mod.init_params(cfg, jax.random.PRNGKey(3))
+    sd = _moe_megatron_sd(cfg, params)
+    icfg, iparams = import_megatron_gpt_moe(sd, n_head=H)
+    assert icfg.num_experts == cfg.num_experts
+    assert icfg.moe_freq == cfg.moe_freq
+    assert icfg.use_residual == use_residual
+    ex, iex = params["moe_blocks"]["moe"]["experts"], \
+        iparams["moe_blocks"]["moe"]["experts"]
+    for k in ex:
+        np.testing.assert_allclose(iex[k], ex[k], rtol=0, atol=0, err_msg=k)
+    np.testing.assert_array_equal(iparams["moe_blocks"]["moe"]["gate_w"],
+                                  params["moe_blocks"]["moe"]["gate_w"])
+    np.testing.assert_array_equal(iparams["blocks"]["mlp_up_w"],
+                                  params["blocks"]["mlp_up_w"])
+    if use_residual:
+        np.testing.assert_array_equal(
+            iparams["moe_blocks"]["moe"]["coefficient"],
+            params["moe_blocks"]["moe"]["coefficient"])
+    ids = jnp.zeros((1, 8), jnp.int32)
+    logits, _ = moe_mod.forward(icfg, iparams, ids, train=False)
+    ref, _ = moe_mod.forward(cfg, params, ids, train=False)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_dense_import_rejects_moe_and_vice_versa():
+    cfg = _moe_cfg()
+    params = moe_mod.init_params(cfg, jax.random.PRNGKey(4))
+    sd = _moe_megatron_sd(cfg, params)
+    with pytest.raises(ValueError, match="import_megatron_gpt_moe"):
+        import_megatron_gpt(sd, n_head=H)
+    dcfg = _dense_cfg()
+    dparams = gpt_mod.init_params(dcfg, jax.random.PRNGKey(5))
+    with pytest.raises(ValueError, match="import_megatron_gpt"):
+        import_megatron_gpt_moe(_dense_megatron_sd(dparams), n_head=H)
+
+
+def test_moe_irregular_pattern_rejected():
+    cfg = _moe_cfg()
+    params = moe_mod.init_params(cfg, jax.random.PRNGKey(6))
+    sd = _moe_megatron_sd(cfg, params)
+    # rename layer-1's MoE keys to layer 0: dense-first ordering violated
+    moved = {(k.replace(".layers.1.", ".layers.0.")
+              if ".layers.1.mlp.deepspeed_moe." in k else k): v
+             for k, v in sd.items()}
+    with pytest.raises(ValueError, match="regular"):
+        import_megatron_gpt_moe(moved, n_head=H)
+
+
+def test_nested_model_optim_rng_structure():
+    """Real ``model_optim_rng.pt`` nests dicts: model -> language_model ->
+    embedding/encoder sub-dicts with tensor leaves (plus non-model state)."""
+    cfg = _dense_cfg()
+    params = gpt_mod.init_params(cfg, jax.random.PRNGKey(7))
+    flat = _dense_megatron_sd(params, prefix="")
+    nested: dict = {"checkpoint_version": np.float64(3.0)}
+    lm: dict = {}
+    for k, v in flat.items():
+        node, parts = lm, k.split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+    nested["model"] = {"language_model": lm}
+    icfg, iparams = import_megatron_gpt(nested, n_head=H)
+    np.testing.assert_array_equal(iparams["blocks"]["qkv_w"],
+                                  params["blocks"]["qkv_w"])
+    np.testing.assert_array_equal(iparams["wte"], params["wte"])
+
+
+def test_not_a_megatron_checkpoint():
+    with pytest.raises(ValueError, match="language_model"):
+        import_megatron_gpt({"transformer.h.0.attn.weight":
+                             np.zeros((4, 4))}, n_head=2)
