@@ -33,10 +33,15 @@ def run_one(spec: dict) -> dict:
         loss_chunk=int(spec.get("loss_chunk", 0)))
     model, mcfg = build_gpt(mcfg)
     micro_bs, seq, steps = spec["micro_bs"], spec["seq"], spec.get("steps", 10)
+    # gas>1 folds all micro-steps into ONE compiled program (the engine's
+    # fused accumulation scan) — amortizes per-dispatch tunnel latency, which
+    # the r4 chip session measured at ~350ms/step constant across models
+    gas = int(spec.get("gas", 1))
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model,
         config={
             "train_micro_batch_size_per_gpu": micro_bs,
+            "gradient_accumulation_steps": gas,
             "optimizer": {"type": "AdamW", "params": {"lr": 3e-4, "weight_decay": 0.1}},
             "bf16": {"enabled": True},
             "zero_optimization": {"stage": spec.get("stage", 1)},
@@ -44,10 +49,11 @@ def run_one(spec: dict) -> dict:
             "steps_per_print": 0,
         })
     rng = np.random.default_rng(0)
+    shape = (gas, micro_bs, seq) if gas > 1 else (micro_bs, seq)
 
     def make_batch():
         return {"input_ids": rng.integers(0, mcfg.vocab_size,
-                                          size=(micro_bs, seq), dtype=np.int32)}
+                                          size=shape, dtype=np.int32)}
 
     m = engine.train_batch(make_batch())
     float(m["loss"])
@@ -59,7 +65,7 @@ def run_one(spec: dict) -> dict:
 
     stats = jax.local_devices()[0].memory_stats() or {}
     peak_gb = stats.get("peak_bytes_in_use", 0) / 2**30
-    tok = steps * micro_bs * (seq - 1) / dt
+    tok = steps * gas * micro_bs * (seq - 1) / dt
     n_params = mcfg.num_params()
     fpt = 6 * n_params + 12 * mcfg.n_layer * mcfg.d_model * seq
     mfu = tok * fpt / (197e12 * jax.device_count())  # v5e bf16 peak per chip
