@@ -338,10 +338,15 @@ def _worker_train(cfg: dict) -> dict:
             "device": "cpu", "buffer_count": cfg.get("keep_layers", 2)}
     elif cfg.get("offload") == "optimizer":
         zero_cfg["offload_optimizer"] = {"device": "cpu"}
+    # gas>1 folds all micro-steps into one compiled program (engine's fused
+    # accumulation scan): amortizes per-dispatch tunnel RTT (~350ms constant,
+    # measured r4) exactly the way real accumulated training does
+    gas = int(cfg.get("gas", 1))
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model,
         config={
             "train_micro_batch_size_per_gpu": micro_bs,
+            "gradient_accumulation_steps": gas,
             "optimizer": {"type": "AdamW",
                           "params": {"lr": 3e-4, "weight_decay": 0.1}},
             "bf16": {"enabled": True},
@@ -351,24 +356,33 @@ def _worker_train(cfg: dict) -> dict:
         })
 
     rng = np.random.default_rng(0)
+    # k_steps>1: K complete optimizer steps per dispatch (engine.train_batches
+    # scan — no cross-step accumulator, peak HBM equals the k=1 program; the
+    # gas=8 variants AOT-OOM at the lead geometries)
+    k_steps = int(cfg.get("k_steps", 1))
+    shape = ((gas, micro_bs * n_chips, seq) if gas > 1
+             else (micro_bs * n_chips, seq))
+    if k_steps > 1:
+        shape = (k_steps,) + shape
 
     def make_batch():
         return {"input_ids": rng.integers(
-            0, mcfg.vocab_size, size=(micro_bs * n_chips, seq), dtype=np.int32)}
+            0, mcfg.vocab_size, size=shape, dtype=np.int32)}
 
-    m = engine.train_batch(make_batch())  # warmup/compile
+    step_fn = engine.train_batches if k_steps > 1 else engine.train_batch
+    m = step_fn(make_batch())  # warmup/compile
     float(m["loss"])
 
     t0 = time.perf_counter()
     for _ in range(steps):
-        m = engine.train_batch(make_batch())
+        m = step_fn(make_batch())
     # host transfer: device_get can't return until the whole chain executed
     # (block_until_ready is not trustworthy through remote-dispatch tunnels)
     float(m["loss"])
     _ = np.asarray(jax.device_get(m["grad_norm"]))
     dt = time.perf_counter() - t0
 
-    tokens = steps * micro_bs * n_chips * (seq - 1)
+    tokens = steps * k_steps * gas * micro_bs * n_chips * (seq - 1)
     tok_per_sec_chip = tokens / dt / n_chips
     n_params = mcfg.num_params()
     # 6*N FLOPs/token (fwd+bwd) + attention term 12*L*d*T per token
@@ -378,9 +392,10 @@ def _worker_train(cfg: dict) -> dict:
         "config": cfg["name"], "kind": "train", "platform": platform,
         "tokens_per_sec_chip": round(tok_per_sec_chip, 1),
         "mfu": round(mfu, 4), "chips": n_chips, "micro_bs": micro_bs,
-        "seq": seq, "stage": cfg.get("stage", 0),
+        "gas": gas, "k_steps": k_steps, "seq": seq,
+        "stage": cfg.get("stage", 0),
         "loss": round(float(m["loss"]), 4),
-        "step_ms": round(dt / steps * 1e3, 1),
+        "step_ms": round(dt / (steps * k_steps) * 1e3, 1),
     }
     if cfg.get("offload"):
         out["offload"] = cfg["offload"]
@@ -699,11 +714,15 @@ def _worker_infinity_aot(cfg: dict) -> dict:
     return out
 
 
-def _aot_fused_step(model, optimizer):
+def _aot_fused_step(model, optimizer, gas: int = 1, k_steps: int = 1):
     """The engine-shaped fused train step the AOT evidence rows compile:
     loss+grads, fp32 cast, global-norm clip, AdamW on the fp32 master, bf16
     copy-back. ONE definition — both AOT workers must compile the same
-    semantics or their rows silently diverge from each other and the engine."""
+    semantics or their rows silently diverge from each other and the engine.
+
+    ``gas>1`` mirrors the engine's fused accumulation scan (engine.py grad_acc
+    carry): batch gains a leading [gas] axis and a full fp32 grad accumulator
+    lives across the scan — the fit checks must price that buffer."""
     import jax
     import jax.numpy as jnp
 
@@ -712,19 +731,52 @@ def _aot_fused_step(model, optimizer):
     tmap = jax.tree_util.tree_map
 
     def step(params, master, opt, batch, rng):
-        def loss_fn(p):
-            loss, _ = model.apply(p, batch, rngs={"dropout": rng}, train=True)
+        def loss_fn(p, b, r):
+            loss, _ = model.apply(p, b, rngs={"dropout": r}, train=True)
             return loss.astype(jnp.float32)
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        grads = tmap(lambda g: g.astype(jnp.float32), grads)
+        if gas == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
+            grads = tmap(lambda g: g.astype(jnp.float32), grads)
+        else:
+            acc0 = tmap(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            rngs = jax.random.split(rng, gas)
+
+            def micro(carry, xs):
+                acc, loss_sum = carry
+                b, r = xs
+                loss, g = jax.value_and_grad(loss_fn)(params, b, r)
+                acc = tmap(lambda a, gg: a + gg.astype(jnp.float32) / gas,
+                           acc, g)
+                return (acc, loss_sum + loss), None
+
+            (grads, loss), _ = jax.lax.scan(
+                micro, (acc0, jnp.float32(0.0)), (batch, rngs))
+            loss = loss / gas
         grads, gnorm = clip_by_global_norm(grads, 1.0)
         new_master, new_opt = optimizer.update(
             grads, opt, master, jnp.float32(3e-4))
         new_params = tmap(lambda x: x.astype(jnp.bfloat16), new_master)
         return new_params, new_master, new_opt, loss, gnorm
 
-    return step
+    if k_steps == 1:
+        return step
+
+    def multi(params, master, opt, batch, rng):
+        # engine.train_batches shape: K complete steps scanned in-program
+        rngs = jax.random.split(rng, k_steps)
+
+        def body(carry, xs):
+            p, mst, o = carry
+            b, r = xs
+            p, mst, o, loss, gn = step(p, mst, o, b, r)
+            return (p, mst, o), (loss, gn)
+
+        (params, master, opt), (losses, gns) = jax.lax.scan(
+            body, (params, master, opt), (batch, rngs))
+        return params, master, opt, losses[-1], gns[-1]
+
+    return multi
 
 
 def _aot_report(compiled, compile_s: float) -> dict:
@@ -894,7 +946,8 @@ def _worker_train_aot(cfg: dict) -> dict:
     optimizer = get_optimizer("AdamW", {"lr": 3e-4, "weight_decay": 0.1})
     opt_shapes = jax.eval_shape(optimizer.init, shapes)
     rep = NamedSharding(topo.mesh, P())
-    step = _aot_fused_step(model, optimizer)
+    step = _aot_fused_step(model, optimizer, gas=int(cfg.get("gas", 1)),
+                           k_steps=int(cfg.get("k_steps", 1)))
 
     # real placement, exactly as the engine: model (Megatron tp) specs layered
     # with the ZeRO policy — replicated-everything would misstate tp programs
@@ -912,14 +965,23 @@ def _worker_train_aot(cfg: dict) -> dict:
     opt_spec_tree = optimizer.state_spec(tmap(lambda p: sh(p), ospec), rep)
     a_opt = tmap(lambda s, shd: jax.ShapeDtypeStruct(
         s.shape, s.dtype, sharding=shd), opt_shapes, opt_spec_tree)
+    gas = int(cfg.get("gas", 1))
+    k_steps = int(cfg.get("k_steps", 1))
+    bshape = ((gas, micro_bs * dp, seq) if gas > 1 else (micro_bs * dp, seq))
+    bspec = topo.batch_spec(1)
+    if gas > 1:
+        bspec = P(None, *tuple(bspec))
+    if k_steps > 1:
+        bshape = (k_steps,) + bshape
+        bspec = P(None, *tuple(bspec))
     a_batch = {"input_ids": jax.ShapeDtypeStruct(
-        (micro_bs * dp, seq), jnp.int32,
-        sharding=NamedSharding(topo.mesh, topo.batch_spec(1)))}
+        bshape, jnp.int32, sharding=NamedSharding(topo.mesh, bspec))}
     a_rng = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=rep)
     out = {
         "config": cfg["name"], "kind": "train_aot",
         "platform": "tpu-compile-only", "model": cfg["model"],
         "micro_bs": micro_bs, "seq": seq, "dp": dp, "sp": sp, "tp": tp,
+        "gas": gas, "k_steps": k_steps,
         "remat_policy": cfg.get("remat_policy", "nothing_saveable"),
     }
     with mesh_context(topo.mesh):
@@ -1130,20 +1192,28 @@ def main() -> None:
         model = os.environ.get("BENCH_MODEL", "gpt2-350m")
         bs = int(os.environ.get("BENCH_BS", "16"))
         seq = int(os.environ.get("BENCH_SEQ", "1024"))
-        steps = int(os.environ.get("BENCH_STEPS", "20"))
+        # k_steps=8 + fewer outer dispatches: same measured optimizer steps,
+        # 1/8th the dispatches — the per-dispatch tunnel RTT (~350ms, r4
+        # measured) otherwise reads as fake MFU loss. k_steps (full steps
+        # scanned in-program) not gas: the gas-8 fp32 accumulator AOT-OOMs
+        # the lead 760M geometries.
+        steps = int(os.environ.get("BENCH_STEPS", "5"))
+        kst = int(os.environ.get("BENCH_K_STEPS", "8"))
         big = os.environ.get("BENCH_BIG_MODEL", "gpt2-760m")
         big_bs = int(os.environ.get("BENCH_BIG_BS", "16"))
         configs = [
             {"kind": "kernels", "name": "pallas-kernel-smoke"},
         ] + [
             {"kind": "train", "name": f"{model}-zero{s}", "model": model,
-             "micro_bs": bs, "seq": seq, "stage": s, "steps": steps}
+             "micro_bs": bs, "seq": seq, "stage": s, "steps": steps,
+             "k_steps": kst}
             for s in (1, 2, 3)
         ] + [
             # bigger model: fatter matmuls lift MXU utilization (measured r3:
             # 350M 33% MFU vs 760M 44% at the same geometry)
             {"kind": "train", "name": f"{big}-zero{s}", "model": big,
-             "micro_bs": big_bs, "seq": seq, "stage": s, "steps": steps}
+             "micro_bs": big_bs, "seq": seq, "stage": s, "steps": steps,
+             "k_steps": kst}
             for s in (1, 3)
         ] + [
             # MFU hedges: selective remat (saves 2*d_model/token/layer, skips
@@ -1152,16 +1222,16 @@ def main() -> None:
             # are the largest selective-remat batches that compile
             {"kind": "train", "name": f"{big}-zero1-selrm12", "model": big,
              "micro_bs": 12, "seq": seq, "stage": 1, "steps": steps,
-             "remat_policy": "save_attn_mlp_out"},
+             "k_steps": kst, "remat_policy": "save_attn_mlp_out"},
             # chunked loss drops the fp32 logits buffer — AOT-verified these
             # fit where the unchunked variants OOM (docs/MFU_NOTES.md r4)
             {"kind": "train", "name": f"{big}-zero1-selrm16-chunk",
              "model": big, "micro_bs": 16, "seq": seq, "stage": 1,
-             "steps": steps, "remat_policy": "save_attn_mlp_out",
-             "loss_chunk": 128},
+             "steps": steps, "k_steps": kst,
+             "remat_policy": "save_attn_mlp_out", "loss_chunk": 128},
             {"kind": "train", "name": f"{big}-zero1-bs24-chunk", "model": big,
              "micro_bs": 24, "seq": seq, "stage": 1, "steps": steps,
-             "loss_chunk": 128},
+             "k_steps": kst, "loss_chunk": 128},
         ] + [
             {"kind": "inference", "name": f"{model}-decode", "model": model,
              "batch": 1, "prompt": 128, "gen": 64},

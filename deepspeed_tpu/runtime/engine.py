@@ -621,11 +621,40 @@ class DeepSpeedEngine:
             donate_argnums=(0,),
         )
 
+        def fused_multi(state, batches, rng):
+            # K COMPLETE steps (each: gas micro-batches + update) in one
+            # program. Unlike raising gas, this holds no cross-step grad
+            # accumulator — per-step grads are scan-transient, so peak HBM
+            # equals the single-step program's.
+            k = jax.tree_util.tree_leaves(batches)[0].shape[0]
+            rngs = jax.random.split(rng, k)
+
+            def body(st, xs):
+                mb, r = xs
+                st, metrics = fused(st, mb, r)
+                return st, metrics
+
+            return jax.lax.scan(body, state, (batches, rngs))
+
+        steps_batch_sharding = NamedSharding(
+            self.mesh, P(*((None,) * (2 if self.gas > 1 else 1)),
+                         *self.topo.batch_spec()))
+        self._train_batches_jit = jax.jit(
+            fused_multi,
+            in_shardings=(ss, steps_batch_sharding, None),
+            out_shardings=(ss, None),
+            donate_argnums=(0,),
+        )
+
     # ------------------------------------------------------------------ data placement
-    def _place_batch(self, batch, leading_gas: bool = False):
+    def _place_batch(self, batch, leading_gas: bool = False,
+                     leading_steps: bool = False):
         sharding = self.batch_sharding
-        if leading_gas and self.gas > 1:
-            sharding = NamedSharding(self.mesh, P(None, *self.topo.batch_spec()))
+        extra = (1 if (leading_gas and self.gas > 1) else 0) + \
+            (1 if leading_steps else 0)
+        if extra:
+            sharding = NamedSharding(
+                self.mesh, P(*((None,) * extra), *self.topo.batch_spec()))
         cast = (self.pc.compute_dtype
                 if (self.config.fp16.enabled and self.config.fp16.auto_cast)
                 else None)
@@ -785,6 +814,45 @@ class DeepSpeedEngine:
             log_dist(self.timers.log(["batch_input", "train_batch"]))
         self.tput_timer.stop(sync_on=metrics["loss"])
         return metrics
+
+    def train_batches(self, batch) -> Dict[str, Any]:
+        """K complete optimizer steps (each ``gas`` micro-batches) in ONE
+        compiled program — one host dispatch for the whole window. Batch
+        leaves: ``[k, gas, micro_bs, ...]`` when gas>1, else
+        ``[k, micro_bs, ...]``.
+
+        Amortizes per-dispatch host latency (remote-dispatch tunnels cost a
+        ~constant RTT per call) without the fp32 cross-step grad accumulator
+        that raising ``gas`` would add: per-step grads are scan-transient, so
+        peak HBM equals ``train_batch``'s. LR schedules, loss scaling, and
+        skip-on-overflow stay exact — they read the traced in-program step
+        counter. Schedulers/monitor observe every step afterwards from the
+        stacked metrics (one transfer).
+
+        The host-runner paths (1-bit, ZeRO-Offload, param-stream) interleave
+        host work per step and cannot fuse across steps — use ``train_batch``.
+        """
+        if self._onebit or self._offload or self._param_stream:
+            raise ValueError(
+                "train_batches requires the fully in-HBM fused path; the "
+                "1-bit/offload/param-stream runners interleave host work per "
+                "step — call train_batch per step instead")
+        k = int(jax.tree_util.tree_leaves(batch)[0].shape[0])
+        self._apply_random_ltd()
+        batch = self._apply_curriculum(batch)
+        batch = self._place_batch(batch, leading_gas=True, leading_steps=True)
+        with mesh_context(self.mesh):
+            self.state, stacked = self._train_batches_jit(
+                self.state, batch, self._next_rng())
+        self.micro_steps += self.gas * k
+        host = jax.device_get(stacked)  # one transfer for all K steps' metrics
+        for i in range(k):
+            mi = jax.tree_util.tree_map(lambda a, i=i: a[i], host)
+            self._last_loss = mi["loss"]
+            self._finish_step(mi)
+        last = jax.tree_util.tree_map(lambda a: a[-1], host)
+        last["mean_loss"] = float(np.mean(np.asarray(host["loss"])))
+        return last
 
     def _apply_random_ltd(self) -> None:
         """Move the model to the scheduled keep-token bucket when it changes

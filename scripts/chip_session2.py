@@ -7,9 +7,10 @@ of per-dispatch tunnel round-trip latency, not device-side regression. This
 session (run AFTER chip_session.py finishes):
 
   1. measures the raw dispatch RTT directly (tiny jitted op, per-call sync);
-  2. re-runs the leading MFU configs with gradient accumulation (gas=8):
-     one dispatch per 8 micro-steps, so the RTT amortizes 8x and the
-     measured MFU approaches the device-only number.
+  2. re-runs the leading MFU configs with k_steps=8 (engine.train_batches:
+     8 COMPLETE optimizer steps scanned in one program): one dispatch per
+     8 steps, RTT amortizes 8x, and peak HBM equals the k=1 program
+     (the gas=8 fp32 accumulator AOT-OOMs the lead geometries).
 
 Results append to chip_session2_results.json after every row.
 """
@@ -61,7 +62,7 @@ def rtt_probe() -> dict:
 
 
 def run_row(spec, timeout=1500):
-    tag = f"mfu-gas:{spec['tag']}"
+    tag = f"mfu-k8:{spec['tag']}"
     print(f"[chip2] {tag}...", flush=True)
     try:
         p = subprocess.run(
@@ -83,20 +84,20 @@ def run_row(spec, timeout=1500):
 GRID = [
     # leading candidates, one dispatch per 8 micro-steps
     {"model": "gpt2-760m", "micro_bs": 16, "seq": 1024, "remat": True,
-     "policy": "save_attn_mlp_out", "loss_chunk": 128, "gas": 8, "steps": 4,
-     "tag": "760m-selrm16-chunkloss-gas8"},
+     "policy": "save_attn_mlp_out", "loss_chunk": 128, "k_steps": 8, "steps": 4,
+     "tag": "760m-selrm16-chunkloss-k8"},
     {"model": "gpt2-760m", "micro_bs": 14, "seq": 1024, "remat": True,
-     "policy": "save_attn_mlp_out", "loss_chunk": 128, "gas": 8, "steps": 4,
-     "tag": "760m-selrm14-chunkloss-gas8"},
+     "policy": "save_attn_mlp_out", "loss_chunk": 128, "k_steps": 8, "steps": 4,
+     "tag": "760m-selrm14-chunkloss-k8"},
     {"model": "gpt2-350m", "micro_bs": 16, "seq": 1024, "remat": True,
-     "policy": "dots_with_no_batch_dims_saveable", "gas": 8, "steps": 4,
-     "tag": "350m-save-dots-gas8"},
+     "policy": "dots_with_no_batch_dims_saveable", "k_steps": 8, "steps": 4,
+     "tag": "350m-save-dots-k8"},
     {"model": "gpt2-760m", "micro_bs": 24, "seq": 1024, "remat": True,
-     "policy": "nothing_saveable", "loss_chunk": 128, "gas": 8, "steps": 4,
-     "tag": "760m-bs24-chunkloss-gas8"},
+     "policy": "nothing_saveable", "loss_chunk": 128, "k_steps": 8, "steps": 4,
+     "tag": "760m-bs24-chunkloss-k8"},
     {"model": "gpt2-350m", "micro_bs": 2, "seq": 8192, "remat": True,
-     "policy": "nothing_saveable", "loss_chunk": 512, "gas": 8, "steps": 4,
-     "tag": "350m-seq8k-chunkloss-gas8"},
+     "policy": "nothing_saveable", "loss_chunk": 512, "k_steps": 8, "steps": 4,
+     "tag": "350m-seq8k-chunkloss-k8"},
 ]
 
 

@@ -49,27 +49,34 @@ def run_one(spec: dict) -> dict:
             "steps_per_print": 0,
         })
     rng = np.random.default_rng(0)
+    # k_steps: K complete optimizer steps per dispatch (train_batches scan) —
+    # amortizes tunnel RTT with NO extra HBM (unlike gas, whose fp32
+    # accumulator AOT-OOMs the lead 760M rows)
+    k_steps = int(spec.get("k_steps", 1))
     shape = (gas, micro_bs, seq) if gas > 1 else (micro_bs, seq)
+    if k_steps > 1:
+        shape = (k_steps,) + shape
 
     def make_batch():
         return {"input_ids": rng.integers(0, mcfg.vocab_size,
                                           size=shape, dtype=np.int32)}
 
-    m = engine.train_batch(make_batch())
+    step_fn = engine.train_batches if k_steps > 1 else engine.train_batch
+    m = step_fn(make_batch())
     float(m["loss"])
     t0 = time.perf_counter()
     for _ in range(steps):
-        m = engine.train_batch(make_batch())
+        m = step_fn(make_batch())
     float(m["loss"])
     dt = time.perf_counter() - t0
 
     stats = jax.local_devices()[0].memory_stats() or {}
     peak_gb = stats.get("peak_bytes_in_use", 0) / 2**30
-    tok = steps * gas * micro_bs * (seq - 1) / dt
+    tok = steps * k_steps * gas * micro_bs * (seq - 1) / dt
     n_params = mcfg.num_params()
     fpt = 6 * n_params + 12 * mcfg.n_layer * mcfg.d_model * seq
     mfu = tok * fpt / (197e12 * jax.device_count())  # v5e bf16 peak per chip
-    return {**spec, "step_ms": round(dt / steps * 1e3, 1),
+    return {**spec, "step_ms": round(dt / (steps * k_steps) * 1e3, 1),
             "tok_s": round(tok, 1), "mfu": round(mfu, 4),
             "peak_hbm_gb": round(peak_gb, 2)}
 
