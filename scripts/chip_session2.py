@@ -23,6 +23,8 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "chip_session2_results.json")
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache"))
 
 
 def _rtt_probe_inner() -> dict:
@@ -115,8 +117,30 @@ def main():
         results.append({"tag": "rtt-probe", "error": str(e)[:200]})
     print(f"[chip2] {json.dumps(results[-1])}", flush=True)
     save()
+    # flash tile autotune first: its winner informs which flash_block_q/k to
+    # promote as defaults (dispatch-amortized in-program, ~2min per geom)
+    for geom in ("760m", "350m"):
+        tag = f"tile:{geom}"
+        print(f"[chip2] {tag}...", flush=True)
+        try:
+            p = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, "scripts", "flash_tile_tune.py"),
+                 json.dumps({"geom": geom, "iters": 8})],
+                capture_output=True, text=True, timeout=1800, cwd=REPO)
+            line = next((ln for ln in reversed(p.stdout.strip().splitlines())
+                         if ln.startswith("{")), None)
+            results.append(json.loads(line) if line else
+                           {"tag": tag, "rc": p.returncode,
+                            "stderr": p.stderr[-300:]})
+        except subprocess.TimeoutExpired:
+            results.append({"tag": tag, "error": "timeout 1800s"})
+        print(f"[chip2] {tag}: {json.dumps(results[-1])[:300]}", flush=True)
+        save()
     for spec in GRID:
-        results.append(run_row(spec))
+        # chunk-loss programs compile long (scanned loss); without a warm
+        # cache the 1500s default ate two first-pass rows
+        results.append(run_row(spec, timeout=2400))
         save()
     print(f"[chip2] done -> {OUT}")
 

@@ -15,6 +15,10 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+# persistent XLA compile cache: near-identical grid rows each paid a full
+# multi-minute compile (chunk-loss scans pushed rows past their timeouts)
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache"))
 
 
 def run_one(spec: dict) -> dict:
