@@ -88,6 +88,11 @@ GRID = [
     {"model": "gpt2-760m", "micro_bs": 16, "seq": 1024, "remat": True,
      "policy": "save_attn_mlp_out", "loss_chunk": 128, "k_steps": 8, "steps": 4,
      "tag": "760m-selrm16-chunkloss-k8"},
+    # chunk 512 = 4x fewer loss-scan iterations at identical AOT peak
+    # (14.74 GB): isolates the chunk-serialization cost
+    {"model": "gpt2-760m", "micro_bs": 16, "seq": 1024, "remat": True,
+     "policy": "save_attn_mlp_out", "loss_chunk": 512, "k_steps": 8, "steps": 4,
+     "tag": "760m-selrm16-chunk512-k8"},
     {"model": "gpt2-760m", "micro_bs": 14, "seq": 1024, "remat": True,
      "policy": "save_attn_mlp_out", "loss_chunk": 128, "k_steps": 8, "steps": 4,
      "tag": "760m-selrm14-chunkloss-k8"},
