@@ -29,6 +29,11 @@ import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
+# persistent XLA compile cache, inherited by worker subprocesses: chunk-loss
+# train programs compile in the ~20min range on the v5e — without the cache,
+# repeat configs (and the driver's end-of-round sweep) pay it every time
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache"))
 
 PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
 PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
@@ -1227,11 +1232,11 @@ def main() -> None:
             # fit where the unchunked variants OOM (docs/MFU_NOTES.md r4)
             {"kind": "train", "name": f"{big}-zero1-selrm16-chunk",
              "model": big, "micro_bs": 16, "seq": seq, "stage": 1,
-             "steps": steps, "k_steps": kst,
+             "steps": steps, "k_steps": kst, "timeout": 2700,
              "remat_policy": "save_attn_mlp_out", "loss_chunk": 128},
             {"kind": "train", "name": f"{big}-zero1-bs24-chunk", "model": big,
              "micro_bs": 24, "seq": seq, "stage": 1, "steps": steps,
-             "k_steps": kst, "loss_chunk": 128},
+             "k_steps": kst, "loss_chunk": 128, "timeout": 2700},
         ] + [
             {"kind": "inference", "name": f"{model}-decode", "model": model,
              "batch": 1, "prompt": 128, "gen": 64},
