@@ -84,24 +84,29 @@ def run_row(spec, timeout=1500):
 
 
 GRID = [
-    # leading candidates, one dispatch per 8 micro-steps
-    {"model": "gpt2-760m", "micro_bs": 16, "seq": 1024, "remat": True,
-     "policy": "save_attn_mlp_out", "loss_chunk": 128, "k_steps": 8, "steps": 4,
-     "tag": "760m-selrm16-chunkloss-k8"},
-    # chunk 512 = 4x fewer loss-scan iterations at identical AOT peak
-    # (14.74 GB): isolates the chunk-serialization cost
-    {"model": "gpt2-760m", "micro_bs": 16, "seq": 1024, "remat": True,
-     "policy": "save_attn_mlp_out", "loss_chunk": 512, "k_steps": 8, "steps": 4,
-     "tag": "760m-selrm16-chunk512-k8"},
-    {"model": "gpt2-760m", "micro_bs": 14, "seq": 1024, "remat": True,
-     "policy": "save_attn_mlp_out", "loss_chunk": 128, "k_steps": 8, "steps": 4,
-     "tag": "760m-selrm14-chunkloss-k8"},
+    # NO-CHUNK rows first: session-1 showed chunk-loss programs compile
+    # >25min (3 of 4 rows died on compile timeout) while plain rows finish in
+    # ~10-15min — bank the completable measurements before gambling on long
+    # compiles. bs12 selrm measured 33.4% WITH per-dispatch RTT; k8 shows the
+    # device-only number.
+    {"model": "gpt2-760m", "micro_bs": 12, "seq": 1024, "remat": True,
+     "policy": "save_attn_mlp_out", "k_steps": 8, "steps": 4,
+     "tag": "760m-selrm12-k8"},
     {"model": "gpt2-350m", "micro_bs": 16, "seq": 1024, "remat": True,
      "policy": "dots_with_no_batch_dims_saveable", "k_steps": 8, "steps": 4,
      "tag": "350m-save-dots-k8"},
-    {"model": "gpt2-760m", "micro_bs": 24, "seq": 1024, "remat": True,
-     "policy": "nothing_saveable", "loss_chunk": 128, "k_steps": 8, "steps": 4,
-     "tag": "760m-bs24-chunkloss-k8"},
+    {"model": "gpt2-760m", "micro_bs": 16, "seq": 1024, "remat": True,
+     "policy": "nothing_saveable", "k_steps": 8, "steps": 4,
+     "tag": "760m-full-bs16-k8"},
+    # chunk 512 = 4x fewer loss-scan iterations at identical AOT peak
+    # (14.74 GB): isolates the chunk-serialization cost; maybe also compiles
+    # faster than chunk-128
+    {"model": "gpt2-760m", "micro_bs": 16, "seq": 1024, "remat": True,
+     "policy": "save_attn_mlp_out", "loss_chunk": 512, "k_steps": 8, "steps": 4,
+     "tag": "760m-selrm16-chunk512-k8"},
+    {"model": "gpt2-760m", "micro_bs": 16, "seq": 1024, "remat": True,
+     "policy": "save_attn_mlp_out", "loss_chunk": 128, "k_steps": 8, "steps": 4,
+     "tag": "760m-selrm16-chunkloss-k8"},
     {"model": "gpt2-350m", "micro_bs": 2, "seq": 8192, "remat": True,
      "policy": "nothing_saveable", "loss_chunk": 512, "k_steps": 8, "steps": 4,
      "tag": "350m-seq8k-chunkloss-k8"},
