@@ -92,9 +92,11 @@ GRID = [
     {"model": "gpt2-760m", "micro_bs": 12, "seq": 1024, "remat": True,
      "policy": "save_attn_mlp_out", "k_steps": 8, "steps": 4,
      "tag": "760m-selrm12-k8"},
+    # save-dots policies OOM on chip (session 1: 350m rc1 OOM, 760m timeout)
+    # — selective-remat is the live 350m candidate
     {"model": "gpt2-350m", "micro_bs": 16, "seq": 1024, "remat": True,
-     "policy": "dots_with_no_batch_dims_saveable", "k_steps": 8, "steps": 4,
-     "tag": "350m-save-dots-k8"},
+     "policy": "save_attn_mlp_out", "k_steps": 8, "steps": 4,
+     "tag": "350m-save-sublayer-k8"},
     {"model": "gpt2-760m", "micro_bs": 16, "seq": 1024, "remat": True,
      "policy": "nothing_saveable", "k_steps": 8, "steps": 4,
      "tag": "760m-full-bs16-k8"},
