@@ -1206,49 +1206,56 @@ def main() -> None:
         kst = int(os.environ.get("BENCH_K_STEPS", "8"))
         big = os.environ.get("BENCH_BIG_MODEL", "gpt2-760m")
         big_bs = int(os.environ.get("BENCH_BIG_BS", "16"))
+        # Compiles on this setup run 10-25+ min per NEW program (r4 measured:
+        # 3 of 4 chunk-loss grid rows died on compile, not execution), so the
+        # DEFAULT sweep is the completable high-value core; BENCH_FULL=1
+        # restores the wide grid. Row order = evidence priority.
+        full = os.environ.get("BENCH_FULL", "0") == "1"
         configs = [
             {"kind": "kernels", "name": "pallas-kernel-smoke"},
-        ] + [
-            {"kind": "train", "name": f"{model}-zero{s}", "model": model,
-             "micro_bs": bs, "seq": seq, "stage": s, "steps": steps,
-             "k_steps": kst}
-            for s in (1, 2, 3)
-        ] + [
-            # bigger model: fatter matmuls lift MXU utilization (measured r3:
-            # 350M 33% MFU vs 760M 44% at the same geometry)
-            {"kind": "train", "name": f"{big}-zero{s}", "model": big,
-             "micro_bs": big_bs, "seq": seq, "stage": s, "steps": steps,
-             "k_steps": kst}
-            for s in (1, 3)
-        ] + [
-            # MFU hedges: selective remat (saves 2*d_model/token/layer, skips
-            # the output-projection recompute). AOT fit-checked: bs16 selrm
-            # and bs24 full-remat exceed v5e HBM (train_aot rows) — bs12/bs8
-            # are the largest selective-remat batches that compile
+            # the two strongest measured train rows (r4 chip grid), k8-fused
             {"kind": "train", "name": f"{big}-zero1-selrm12", "model": big,
              "micro_bs": 12, "seq": seq, "stage": 1, "steps": steps,
-             "k_steps": kst, "remat_policy": "save_attn_mlp_out"},
-            # chunked loss drops the fp32 logits buffer — AOT-verified these
-            # fit where the unchunked variants OOM (docs/MFU_NOTES.md r4)
+             "k_steps": kst, "timeout": 2700,
+             "remat_policy": "save_attn_mlp_out"},
+            {"kind": "train", "name": f"{model}-zero1", "model": model,
+             "micro_bs": bs, "seq": seq, "stage": 1,
+             "steps": steps, "k_steps": kst, "timeout": 2700,
+             "remat_policy": "save_attn_mlp_out"},
+            {"kind": "inference", "name": f"{model}-decode", "model": model,
+             "batch": 1, "prompt": 128, "gen": 64, "timeout": 2700},
+            # batched decode: amortized per-token throughput
+            {"kind": "inference", "name": f"{model}-decode-b8", "model": model,
+             "batch": 8, "prompt": 128, "gen": 64, "timeout": 2700},
+            {"kind": "diffusion", "name": "sd-ddim20", "latent": 32,
+             "ddim_steps": 20, "timeout": 2700},
+            # chunked loss drops the fp32 logits buffer — AOT-verified to fit
+            # where unchunked OOMs; longest compile, so last of the core rows
             {"kind": "train", "name": f"{big}-zero1-selrm16-chunk",
              "model": big, "micro_bs": 16, "seq": seq, "stage": 1,
              "steps": steps, "k_steps": kst, "timeout": 2700,
              "remat_policy": "save_attn_mlp_out", "loss_chunk": 128},
+        ] + (([
+            {"kind": "train", "name": f"{model}-zero{s}", "model": model,
+             "micro_bs": bs, "seq": seq, "stage": s, "steps": steps,
+             "k_steps": kst, "timeout": 2700}
+            for s in (2, 3)
+        ] + [
+            {"kind": "train", "name": f"{big}-zero{s}", "model": big,
+             "micro_bs": big_bs, "seq": seq, "stage": s, "steps": steps,
+             "k_steps": kst, "timeout": 2700}
+            for s in (1, 3)
+        ] + [
             {"kind": "train", "name": f"{big}-zero1-bs24-chunk", "model": big,
              "micro_bs": 24, "seq": seq, "stage": 1, "steps": steps,
              "k_steps": kst, "loss_chunk": 128, "timeout": 2700},
-        ] + [
-            {"kind": "inference", "name": f"{model}-decode", "model": model,
-             "batch": 1, "prompt": 128, "gen": 64},
-            # batched decode: amortized per-token throughput
-            {"kind": "inference", "name": f"{model}-decode-b8", "model": model,
-             "batch": 8, "prompt": 128, "gen": 64},
-            {"kind": "diffusion", "name": "sd-ddim20", "latent": 32,
-             "ddim_steps": 20},
-            # LAST in the sweep: these rows are long on a slow tunnel and must
-            # never cost the decode/SD evidence. The AOT rows are force_cpu
-            # (host-side v5e compiler) — chip-independent fit evidence.
-        ] + PIPELINE_CONFIGS + AOT_TRAIN_CONFIGS + INFINITY_CONFIGS
+        ]) if full else []) + (
+            # pipeline_aot + AOT rows are force_cpu (host-side v5e compiler):
+            # cheap chip-independent fit evidence; pipeline_mpmd is a short
+            # on-chip dispatch microbench. Infinity rows (long, host-streamed)
+            # only under BENCH_FULL.
+            PIPELINE_CONFIGS + AOT_TRAIN_CONFIGS
+            + (INFINITY_CONFIGS if full else []))
     else:
         # forced-CPU fallback: tiny shapes, still real measurements
         configs = [
