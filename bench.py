@@ -216,6 +216,10 @@ def run_worker(cfg: dict, platform: str, retries: int = 1):
 def _worker(cfg: dict) -> None:
     import jax
 
+    # explicit (not env): sitecustomize imports jax before env edits apply
+    # when a worker is exec'd without the var already in its environment
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
     fn = {"train": _worker_train, "inference": _worker_infer,

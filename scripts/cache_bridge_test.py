@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Does a local v5e-topology AOT compile warm the cache for the axon backend?
+
+Compiles the same jitted fn twice with JAX_COMPILATION_CACHE_DIR set:
+  --aot   : against topologies.get_topology_desc("tpu", "v5e:2x2") (local, no chip)
+  --axon  : against the live axon device, timing the compile
+
+If the second is near-instant after the first, every chip program can be
+pre-compiled host-side and tunnel windows become pure measurement.
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache"))
+# cache even "fast" compiles and log hits/misses
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
+
+def build(mode: str):
+    import jax
+
+    # sitecustomize imports jax before our env vars exist — set explicitly
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    import jax.numpy as jnp
+
+    if mode == "aot":
+        os.environ["DS_TPU_ACCELERATOR"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+
+    def f(x, w1, w2):
+        def body(carry, ws):
+            a, b = ws
+            h = jnp.tanh(carry @ a)
+            return h @ b, ()
+
+        y, _ = jax.lax.scan(body, x, (w1, w2))
+        return (y * jnp.float32(1.000123)).sum()
+
+    g = jax.grad(f, argnums=(1, 2))
+    import numpy as np
+    x = jnp.zeros((256, 512), jnp.bfloat16)
+    w1 = jnp.zeros((6, 512, 512), jnp.bfloat16)
+    w2 = jnp.zeros((6, 512, 512), jnp.bfloat16)
+    return jax.jit(g), (x, w1, w2)
+
+
+def main():
+    mode = sys.argv[1].lstrip("-")
+    import jax
+
+    jit, args = build(mode)
+    t0 = time.perf_counter()
+    if mode == "aot":
+        from jax.experimental import topologies
+
+        td = topologies.get_topology_desc(platform="tpu",
+                                          topology_name="v5e:2x2")
+        dev = list(td.devices)[:1]
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.sharding import Mesh
+
+        mesh = Mesh(dev, ("d",))
+        sh = NamedSharding(mesh, P())
+        abstract = [jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh)
+                    for a in args]
+        jit.lower(*abstract).compile()
+        print(json.dumps({"mode": mode,
+                          "compile_s": round(time.perf_counter() - t0, 2)}))
+    else:
+        c = jit.lower(*args).compile()
+        dt = time.perf_counter() - t0
+        print(json.dumps({"mode": mode, "compile_s": round(dt, 2),
+                          "platform": jax.devices()[0].platform}))
+
+
+if __name__ == "__main__":
+    main()

@@ -26,6 +26,10 @@ def run_one(spec: dict) -> dict:
 
     import jax
 
+    # explicit: sitecustomize imports jax before the module-top env edit
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+
     import deepspeed_tpu
     from deepspeed_tpu.models import build_gpt
     from deepspeed_tpu.models import gpt as gpt_mod
