@@ -1,25 +1,25 @@
 #!/bin/bash
-# Watch for the axon TPU tunnel to come up; run the chip session the moment it does.
-# Probes every 240s with a 60s timeout (tunnel-down hangs forever, never errors).
+# Watch for the axon TPU tunnel to come up; run the window orchestrator the
+# moment it does. Probes every 240s with a 90s timeout (tunnel-down hangs
+# forever, never errors).
 LOG=/root/repo/tunnel_watch.log
-DEADLINE=$(( $(date +%s) + 39600 ))   # give up after 11h
+DEADLINE=$(( $(date +%s) + ${WATCH_SECS:-30000} ))
 echo "[watch] start $(date -u +%FT%TZ)" >> "$LOG"
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if timeout 90 python -c "
 import sys
 import jax, jax.numpy as jnp
 d = jax.devices()
-# a CPU fallback must NOT count as the tunnel being up (bench.py's probe
-# makes the same platform check): chip_session on CPU would burn the window
+# a CPU fallback must NOT count as the tunnel being up
 if d[0].platform == 'cpu':
     print('probe found only CPU devices'); sys.exit(1)
 x = jnp.ones((256,256), jnp.bfloat16)
 (x@x).block_until_ready()
 print('up:', d[0])
 " >> "$LOG" 2>&1; then
-    echo "[watch] tunnel UP $(date -u +%FT%TZ); running chip_session" >> "$LOG"
-    python /root/repo/scripts/chip_session.py >> "$LOG" 2>&1
-    echo "[watch] chip_session done rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+    echo "[watch] tunnel UP $(date -u +%FT%TZ); running window_run" >> "$LOG"
+    python /root/repo/scripts/window_run.py >> "$LOG" 2>&1
+    echo "[watch] window_run done rc=$? $(date -u +%FT%TZ)" >> "$LOG"
     exit 0
   fi
   echo "[watch] down $(date -u +%FT%TZ)" >> "$LOG"

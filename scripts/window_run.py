@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Tunnel-window orchestrator: strict priority order, every row a subprocess.
+
+Learned from the 03:45-06:50Z window (r4): new XLA programs compile 10-25+
+min through this path, rows die on compile not execution, and the window can
+vanish at any minute. So: cheapest diagnostics first, then the MFU headline
+(k8 grid), then decode/SD (never yet measured on chip), then the long rows.
+The persistent compile cache (.jax_cache) makes any repeat instant.
+
+Results append to window_run_results.json after every row.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "window_run_results.json")
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache"))
+
+RESULTS = []
+
+
+def save():
+    with open(OUT, "w") as f:
+        json.dump(RESULTS, f, indent=1)
+
+
+def run(tag, argv, timeout):
+    print(f"[window] {tag}...", flush=True)
+    t0 = time.time()
+    try:
+        p = subprocess.run(argv, capture_output=True, text=True,
+                           timeout=timeout, cwd=REPO)
+        line = next((ln for ln in reversed(p.stdout.strip().splitlines())
+                     if ln.strip().startswith("{")), None)
+        rec = {"tag": tag, "rc": p.returncode, "wall_s": round(time.time() - t0),
+               "result": json.loads(line) if line else None}
+        if p.returncode != 0:
+            rec["stderr"] = p.stderr[-400:]
+    except subprocess.TimeoutExpired:
+        rec = {"tag": tag, "rc": -1, "wall_s": round(time.time() - t0),
+               "error": f"timeout {timeout}s"}
+    except Exception as e:  # noqa: BLE001
+        rec = {"tag": tag, "rc": -1, "error": str(e)[:200]}
+    RESULTS.append(rec)
+    save()
+    print(f"[window] {tag}: {json.dumps(rec)[:300]}", flush=True)
+    return rec
+
+
+def mfu(spec, timeout=2400):
+    return run(f"mfu:{spec['tag']}",
+               [sys.executable, os.path.join(REPO, "scripts", "mfu_sweep.py"),
+                "--one", json.dumps(spec)], timeout)
+
+
+def bench(spec, timeout=2700):
+    return run(f"{spec['kind']}:{spec['name']}",
+               [sys.executable, os.path.join(REPO, "bench.py"), "--worker",
+                json.dumps(spec)], timeout)
+
+
+def main():
+    # 1. diagnostics: RTT + does the cache bridge from AOT compiles work?
+    run("rtt-probe", [sys.executable,
+                      os.path.join(REPO, "scripts", "chip_session2.py"),
+                      "--rtt"], 600)
+    run("cache-bridge-axon", [sys.executable,
+                              os.path.join(REPO, "scripts",
+                                           "cache_bridge_test.py"),
+                              "--axon"], 1200)
+
+    # 2. MFU headline: k8 no-chunk rows first (fast compiles, known-runnable)
+    mfu({"model": "gpt2-760m", "micro_bs": 12, "seq": 1024, "remat": True,
+         "policy": "save_attn_mlp_out", "k_steps": 8, "steps": 4,
+         "tag": "760m-selrm12-k8"})
+    mfu({"model": "gpt2-350m", "micro_bs": 16, "seq": 1024, "remat": True,
+         "policy": "save_attn_mlp_out", "k_steps": 8, "steps": 4,
+         "tag": "350m-save-sublayer-k8"})
+
+    # 3. first-ever on-chip decode + SD (compile-heavy: 2700s each)
+    bench({"kind": "inference", "name": "gpt2-350m-decode", "model": "gpt2-350m",
+           "batch": 1, "prompt": 128, "gen": 64})
+    bench({"kind": "diffusion", "name": "sd-ddim20", "latent": 32,
+           "ddim_steps": 20})
+
+    # 4. tile autotune (informs flash_block_q/k defaults)
+    run("tile:760m", [sys.executable,
+                      os.path.join(REPO, "scripts", "flash_tile_tune.py"),
+                      json.dumps({"geom": "760m", "iters": 8})], 2400)
+
+    # 5. more k8 rows: full-remat bs16, then the chunk-loss ladder
+    mfu({"model": "gpt2-760m", "micro_bs": 16, "seq": 1024, "remat": True,
+         "policy": "nothing_saveable", "k_steps": 8, "steps": 4,
+         "tag": "760m-full-bs16-k8"})
+    mfu({"model": "gpt2-760m", "micro_bs": 16, "seq": 1024, "remat": True,
+         "policy": "save_attn_mlp_out", "loss_chunk": 512, "k_steps": 8,
+         "steps": 4, "tag": "760m-selrm16-chunk512-k8"}, timeout=2700)
+    mfu({"model": "gpt2-760m", "micro_bs": 16, "seq": 1024, "remat": True,
+         "policy": "save_attn_mlp_out", "loss_chunk": 128, "k_steps": 8,
+         "steps": 4, "tag": "760m-selrm16-chunkloss-k8"}, timeout=2700)
+
+    # 6. batched decode, int8 HBM evidence, MPMD dispatch microbench
+    bench({"kind": "inference", "name": "gpt2-350m-decode-b8",
+           "model": "gpt2-350m", "batch": 8, "prompt": 128, "gen": 64})
+    run("int8-hbm", [sys.executable,
+                     os.path.join(REPO, "scripts", "int8_hbm.py")], 2400)
+    bench({"kind": "pipeline_mpmd", "name": "pipeline-mpmd-dispatch"})
+
+    # 7. long rows: offload + infinity (big models, host streaming)
+    sys.path.insert(0, REPO)
+    from bench import INFINITY_CONFIGS
+
+    for spec in INFINITY_CONFIGS:
+        bench(spec, timeout=spec.get("timeout", 3600))
+
+    # 8. long-context k8 row last (compile gamble)
+    mfu({"model": "gpt2-350m", "micro_bs": 2, "seq": 8192, "remat": True,
+         "policy": "nothing_saveable", "loss_chunk": 512, "k_steps": 8,
+         "steps": 4, "tag": "350m-seq8k-chunk512-k8"}, timeout=2700)
+    print(f"[window] done -> {OUT}")
+
+
+if __name__ == "__main__":
+    main()
