@@ -74,3 +74,38 @@ def test_chip_session_grid_is_executable():
         assert (policy == "save_attn_mlp_out"
                 or hasattr(jax.checkpoint_policies, policy)), spec
         json.dumps(spec)
+
+
+def test_window_run_specs_are_executable():
+    """window_run.py inlines its mfu/bench specs as call arguments — every
+    dict literal passed to mfu()/bench() must parse against the same knobs."""
+    import ast
+
+    import jax
+
+    from deepspeed_tpu.models import gpt
+
+    src = open("/root/repo/scripts/window_run.py").read()
+    tree = ast.parse(src)
+    specs = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and getattr(node.func, "id", None) in ("mfu", "bench")
+                and node.args and isinstance(node.args[0], ast.Dict)):
+            specs.append((getattr(node.func, "id"),
+                          ast.literal_eval(node.args[0])))
+    assert len([s for f, s in specs if f == "mfu"]) >= 5
+    assert len([s for f, s in specs if f == "bench"]) >= 3
+    for fn, spec in specs:
+        json.dumps(spec)
+        model = spec.get("model")
+        if model:
+            assert model in gpt.PRESETS, spec
+        if fn == "mfu":
+            assert spec["seq"] % 128 == 0, spec
+            policy = spec.get("policy", "nothing_saveable")
+            assert (policy == "save_attn_mlp_out"
+                    or hasattr(jax.checkpoint_policies, policy)), spec
+        else:
+            assert spec.get("kind") in ("inference", "diffusion", "train",
+                                        "pipeline_mpmd"), spec
