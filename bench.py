@@ -724,92 +724,18 @@ def _worker_infinity_aot(cfg: dict) -> dict:
 
 
 def _aot_fused_step(model, optimizer, gas: int = 1, k_steps: int = 1):
-    """The engine-shaped fused train step the AOT evidence rows compile:
-    loss+grads, fp32 cast, global-norm clip, AdamW on the fp32 master, bf16
-    copy-back. ONE definition — both AOT workers must compile the same
-    semantics or their rows silently diverge from each other and the engine.
+    """Engine-shaped fused step; single definition lives in the package
+    (deepspeed_tpu.runtime.aot.fused_train_step) so every AOT producer —
+    these bench rows, bin/ds_aot, tests — compiles identical semantics."""
+    from deepspeed_tpu.runtime.aot import fused_train_step
 
-    ``gas>1`` mirrors the engine's fused accumulation scan (engine.py grad_acc
-    carry): batch gains a leading [gas] axis and a full fp32 grad accumulator
-    lives across the scan — the fit checks must price that buffer."""
-    import jax
-    import jax.numpy as jnp
-
-    from deepspeed_tpu.runtime.utils import clip_by_global_norm
-
-    tmap = jax.tree_util.tree_map
-
-    def step(params, master, opt, batch, rng):
-        def loss_fn(p, b, r):
-            loss, _ = model.apply(p, b, rngs={"dropout": r}, train=True)
-            return loss.astype(jnp.float32)
-
-        if gas == 1:
-            loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
-            grads = tmap(lambda g: g.astype(jnp.float32), grads)
-        else:
-            acc0 = tmap(lambda p: jnp.zeros(p.shape, jnp.float32), params)
-            rngs = jax.random.split(rng, gas)
-
-            def micro(carry, xs):
-                acc, loss_sum = carry
-                b, r = xs
-                loss, g = jax.value_and_grad(loss_fn)(params, b, r)
-                acc = tmap(lambda a, gg: a + gg.astype(jnp.float32) / gas,
-                           acc, g)
-                return (acc, loss_sum + loss), None
-
-            (grads, loss), _ = jax.lax.scan(
-                micro, (acc0, jnp.float32(0.0)), (batch, rngs))
-            loss = loss / gas
-        grads, gnorm = clip_by_global_norm(grads, 1.0)
-        new_master, new_opt = optimizer.update(
-            grads, opt, master, jnp.float32(3e-4))
-        new_params = tmap(lambda x: x.astype(jnp.bfloat16), new_master)
-        return new_params, new_master, new_opt, loss, gnorm
-
-    if k_steps == 1:
-        return step
-
-    def multi(params, master, opt, batch, rng):
-        # engine.train_batches shape: K complete steps scanned in-program
-        rngs = jax.random.split(rng, k_steps)
-
-        def body(carry, xs):
-            p, mst, o = carry
-            b, r = xs
-            p, mst, o, loss, gn = step(p, mst, o, b, r)
-            return (p, mst, o), (loss, gn)
-
-        (params, master, opt), (losses, gns) = jax.lax.scan(
-            body, (params, master, opt), (batch, rngs))
-        return params, master, opt, losses[-1], gns[-1]
-
-    return multi
+    return fused_train_step(model, optimizer, gas=gas, k_steps=k_steps)
 
 
 def _aot_report(compiled, compile_s: float) -> dict:
-    """memory/cost analysis fields shared by the AOT rows. cost_analysis
-    reports the PER-DEVICE partitioned program's flops (verified on a sharded
-    matmul) — the estimate divides by per-chip peak only."""
-    ma = compiled.memory_analysis()
-    ca = compiled.cost_analysis() or {}
-    flops = float(ca.get("flops", 0.0))
-    peak = peak_flops_per_chip("tpu")
-    return {
-        "compile_s": round(compile_s, 1),
-        "per_device_bytes": {
-            "arguments": int(ma.argument_size_in_bytes),
-            "outputs": int(ma.output_size_in_bytes),
-            "temp": int(ma.temp_size_in_bytes),
-            "peak": int(ma.peak_memory_in_bytes),
-            "code": int(ma.generated_code_size_in_bytes),
-        },
-        "fits_v5e_hbm": True,
-        "program_flops": flops,
-        "est_step_ms_at_0.44mfu": (round(flops / (peak * 0.44) * 1e3, 1)
-                                   if flops else None),
-    }
+    from deepspeed_tpu.runtime.aot import report_from_compiled
+
+    return report_from_compiled(compiled, compile_s)
 
 
 def _worker_pipeline_aot(cfg: dict) -> dict:
@@ -907,125 +833,30 @@ def _worker_pipeline_aot(cfg: dict) -> dict:
 
 
 def _worker_train_aot(cfg: dict) -> dict:
-    """AOT-compile a single-chip dense training config against the v5e
-    topology (no chips/tunnel needed — same machinery as the pipeline AOT
-    row): per-device HBM breakdown + program FLOPs for the flagship train
-    configs, so the round records real-TPU-compiler evidence for the MFU
-    sweep even when the chip is unreachable."""
-    import dataclasses
+    """AOT-compile a dense training config against the v5e topology (no
+    chips/tunnel needed): per-device HBM breakdown + program FLOPs, or a
+    structured compile-time OOM verdict. Core lives in
+    deepspeed_tpu.runtime.aot.train_program_report (also behind bin/ds_aot)."""
+    from deepspeed_tpu.runtime.aot import train_program_report
 
-    import jax
-    import jax.numpy as jnp
-    from jax.experimental import topologies
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    from deepspeed_tpu.models import build_gpt
-    from deepspeed_tpu.models import gpt as gpt_mod
-    from deepspeed_tpu.ops.optimizers import get_optimizer
-    from deepspeed_tpu.runtime.topology import MeshTopology, mesh_context
-
-    os.environ["DS_TPU_PALLAS_INTERPRET"] = "0"
-    # v5e topologies come in 2x2 host granularity; default targets ONE chip
-    # (dp=1 over devices[:1]); sp/dp > 1 build the multi-chip program (e.g.
-    # ring-attention sequence parallelism over 4 chips)
-    td = topologies.get_topology_desc(
-        platform="tpu", topology_name=cfg.get("topology", "v5e:2x2"))
-    dp, sp = int(cfg.get("dp", 1)), int(cfg.get("sp", 1))
-    tp = int(cfg.get("tp", 1))
-    topo = MeshTopology.create(dp=dp, sp=sp, tp=tp,
-                               devices=list(td.devices)[:dp * sp * tp])
-    replace = dict(
-        remat=True, use_flash=True,
-        remat_policy=cfg.get("remat_policy", "nothing_saveable"),
-        loss_chunk=int(cfg.get("loss_chunk", 0)))
-    if cfg.get("seq_parallel_impl"):
-        replace["seq_parallel_impl"] = cfg["seq_parallel_impl"]
-    mcfg = gpt_mod.PRESETS[cfg["model"]]
-    micro_bs, seq = int(cfg.get("micro_bs", 16)), int(cfg.get("seq", 1024))
-    if seq > mcfg.max_seq_len:
-        replace["max_seq_len"] = seq
-    mcfg = dataclasses.replace(mcfg, **replace)
-    model, mcfg = build_gpt(mcfg)
-
-    from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
-    from deepspeed_tpu.runtime.zero.policy import ZeroShardingPolicy
-
-    shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0))
-    tmap = jax.tree_util.tree_map
-    optimizer = get_optimizer("AdamW", {"lr": 3e-4, "weight_decay": 0.1})
-    opt_shapes = jax.eval_shape(optimizer.init, shapes)
-    rep = NamedSharding(topo.mesh, P())
-    step = _aot_fused_step(model, optimizer, gas=int(cfg.get("gas", 1)),
-                           k_steps=int(cfg.get("k_steps", 1)))
-
-    # real placement, exactly as the engine: model (Megatron tp) specs layered
-    # with the ZeRO policy — replicated-everything would misstate tp programs
-    base_specs = model.specs(shapes)
-    policy = ZeroShardingPolicy(topo, DeepSpeedZeroConfig(
-        stage=int(cfg.get("stage", 1))))
-    sh = lambda spec: NamedSharding(topo.mesh, spec)  # noqa: E731
-    pspec = tmap(lambda s, b: policy.param_spec(s.shape, b), shapes, base_specs)
-    ospec = tmap(lambda s, b: policy.opt_spec(s.shape, b), shapes, base_specs)
-
-    def abstract(tree, spec_tree, dtype=None):
-        return tmap(lambda s, p: jax.ShapeDtypeStruct(
-            s.shape, dtype or s.dtype, sharding=sh(p)), tree, spec_tree)
-
-    opt_spec_tree = optimizer.state_spec(tmap(lambda p: sh(p), ospec), rep)
-    a_opt = tmap(lambda s, shd: jax.ShapeDtypeStruct(
-        s.shape, s.dtype, sharding=shd), opt_shapes, opt_spec_tree)
-    gas = int(cfg.get("gas", 1))
-    k_steps = int(cfg.get("k_steps", 1))
-    bshape = ((gas, micro_bs * dp, seq) if gas > 1 else (micro_bs * dp, seq))
-    bspec = topo.batch_spec(1)
-    if gas > 1:
-        bspec = P(None, *tuple(bspec))
-    if k_steps > 1:
-        bshape = (k_steps,) + bshape
-        bspec = P(None, *tuple(bspec))
-    a_batch = {"input_ids": jax.ShapeDtypeStruct(
-        bshape, jnp.int32, sharding=NamedSharding(topo.mesh, bspec))}
-    a_rng = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=rep)
-    out = {
-        "config": cfg["name"], "kind": "train_aot",
-        "platform": "tpu-compile-only", "model": cfg["model"],
-        "micro_bs": micro_bs, "seq": seq, "dp": dp, "sp": sp, "tp": tp,
-        "gas": gas, "k_steps": k_steps,
-        "remat_policy": cfg.get("remat_policy", "nothing_saveable"),
-    }
-    with mesh_context(topo.mesh):
-        t0 = time.perf_counter()
-        try:
-            # donate the state exactly like the engine's fused step
-            # (donate_argnums=(0,)): without aliasing, params+master+opt would
-            # double-count and misreport the real program's peak
-            compiled = jax.jit(step, donate_argnums=(0, 1, 2)).lower(
-                abstract(shapes, pspec, jnp.bfloat16),
-                abstract(shapes, ospec, jnp.float32),
-                a_opt, a_batch, a_rng).compile()
-        except Exception as e:  # compile-time OOM IS the evidence
-            out.update(_aot_oom_row(e))
-            return out
-        compile_s = time.perf_counter() - t0
-    out.update(_aot_report(compiled, compile_s))
-    return out
+    rep = train_program_report(
+        cfg["model"],
+        topology=cfg.get("topology", "v5e:2x2"),
+        dp=int(cfg.get("dp", 1)), tp=int(cfg.get("tp", 1)),
+        sp=int(cfg.get("sp", 1)), stage=int(cfg.get("stage", 1)),
+        micro_bs=int(cfg.get("micro_bs", 16)), seq=int(cfg.get("seq", 1024)),
+        gas=int(cfg.get("gas", 1)), k_steps=int(cfg.get("k_steps", 1)),
+        remat_policy=cfg.get("remat_policy"),
+        loss_chunk=int(cfg.get("loss_chunk", 0)),
+        seq_parallel_impl=cfg.get("seq_parallel_impl"))
+    return {"config": cfg["name"], "kind": "train_aot",
+            "platform": "tpu-compile-only", **rep}
 
 
 def _aot_oom_row(e: Exception) -> dict:
-    """Structured fit/no-fit evidence from an XLA compile-time OOM: the whole
-    point of the compile-only rows is to learn this BEFORE chip time."""
-    import re as _re
+    from deepspeed_tpu.runtime.aot import oom_row
 
-    msg = str(e)
-    if "RESOURCE_EXHAUSTED" not in msg:
-        raise e
-    m = _re.search(r"Used ([\d.]+)([MG]) of", msg)
-    used = None
-    if m:
-        used = float(m.group(1)) * (2 ** 30 if m.group(2) == "G" else 2 ** 20)
-    return {"fits_v5e_hbm": False,
-            "hbm_required_bytes": int(used) if used else None,
-            "oom": msg.splitlines()[0][-300:]}
+    return oom_row(e)
 
 
 def _worker_moe_aot(cfg: dict) -> dict:

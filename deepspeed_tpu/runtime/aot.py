@@ -1,0 +1,265 @@
+"""Ahead-of-time program compilation against a TPU topology — no chips needed.
+
+The XLA TPU compiler runs on the host: ``jax.experimental.topologies`` gives a
+device-less v5e/v5p target, and lowering the engine-shaped fused train step
+against it yields real per-device HBM breakdowns, program FLOPs, and
+compile-time OOM verdicts BEFORE any accelerator time is spent. This module
+packages that workflow (proven as this repo's bench "compile-only evidence"
+rows) as a user API + the ``bin/ds_aot`` CLI.
+
+The reference has no equivalent — its capacity planning is runtime trial and
+error (``autotuning/`` experiment runs on live GPUs). On TPU the compiler IS
+the oracle, so fit-checking a config is a host-side build step: sweep
+micro-batch/remat/chunk ladders offline, spend device hours only on configs
+the compiler proved fit. With a persistent compilation cache
+(``jax.config.jax_compilation_cache_dir``) the compiled artifact is also a
+warm-start for the real run where the runtime's platform fingerprint matches.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["fused_train_step", "report_from_compiled", "oom_row",
+           "train_program_report", "peak_flops_per_chip"]
+
+
+def peak_flops_per_chip(platform: str = "tpu") -> float:
+    """bf16 peak for the local chip generation (nominal 1e12 on cpu)."""
+    import os
+
+    if platform == "cpu":
+        return 1e12
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+    table = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
+    for k, v in table.items():
+        if gen.startswith(k):
+            return v
+    return 197e12
+
+
+@contextlib.contextmanager
+def _env_override(key: str, value: str):
+    prev = os.environ.get(key)
+    os.environ[key] = value
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = prev
+
+
+def fused_train_step(model, optimizer, gas: int = 1, k_steps: int = 1):
+    """The engine-shaped fused train step: loss+grads, fp32 cast, global-norm
+    clip, AdamW on the fp32 master, bf16 copy-back — with the engine's
+    ``gas`` accumulation scan and/or ``train_batches``-style ``k_steps``
+    multi-step scan. ONE definition shared by every AOT evidence producer so
+    reports cannot silently diverge from each other."""
+    from ..runtime.utils import clip_by_global_norm
+
+    tmap = jax.tree_util.tree_map
+
+    def step(params, master, opt, batch, rng):
+        def loss_fn(p, b, r):
+            loss, _ = model.apply(p, b, rngs={"dropout": r}, train=True)
+            return loss.astype(jnp.float32)
+
+        def one(params, master, opt, batch, rng):
+            if gas == 1:
+                loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
+                grads = tmap(lambda g: g.astype(jnp.float32), grads)
+            else:
+                acc0 = tmap(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                rngs = jax.random.split(rng, gas)
+
+                def micro(carry, xs):
+                    acc, loss_sum = carry
+                    b, r = xs
+                    loss, g = jax.value_and_grad(loss_fn)(params, b, r)
+                    acc = tmap(lambda a, gg: a + gg.astype(jnp.float32) / gas,
+                               acc, g)
+                    return (acc, loss_sum + loss), None
+
+                (grads, loss), _ = jax.lax.scan(
+                    micro, (acc0, jnp.float32(0.0)), (batch, rngs))
+                loss = loss / gas
+            grads, gnorm = clip_by_global_norm(grads, 1.0)
+            new_master, new_opt = optimizer.update(
+                grads, opt, master, jnp.float32(3e-4))
+            new_params = tmap(lambda x: x.astype(jnp.bfloat16), new_master)
+            return new_params, new_master, new_opt, loss, gnorm
+
+        if k_steps == 1:
+            return one(params, master, opt, batch, rng)
+
+        rngs = jax.random.split(rng, k_steps)
+
+        def body(carry, xs):
+            p, mst, o = carry
+            b, r = xs
+            p, mst, o, loss, gn = one(p, mst, o, b, r)
+            return (p, mst, o), (loss, gn)
+
+        (params, master, opt), (losses, gns) = jax.lax.scan(
+            body, (params, master, opt), (batch, rngs))
+        return params, master, opt, losses[-1], gns[-1]
+
+    return step
+
+
+def report_from_compiled(compiled, compile_s: float) -> Dict[str, Any]:
+    """memory/cost analysis fields shared by every AOT report. cost_analysis
+    reports the PER-DEVICE partitioned program's flops (verified on a sharded
+    matmul). A successful compile IS the fit verdict — the TPU compiler
+    refuses over-HBM programs at compile time (see :func:`oom_row`)."""
+    ma = compiled.memory_analysis()
+    ca = compiled.cost_analysis() or {}
+    flops = float(ca.get("flops", 0.0))
+    peak = peak_flops_per_chip("tpu")
+    return {
+        "compile_s": round(compile_s, 1),
+        "per_device_bytes": {
+            "arguments": int(ma.argument_size_in_bytes),
+            "outputs": int(ma.output_size_in_bytes),
+            "temp": int(ma.temp_size_in_bytes),
+            "peak": int(ma.peak_memory_in_bytes),
+            "code": int(ma.generated_code_size_in_bytes),
+        },
+        "fits_v5e_hbm": True,
+        "program_flops": flops,
+        "est_step_ms_at_0.44mfu": (round(flops / (peak * 0.44) * 1e3, 1)
+                                   if flops else None),
+    }
+
+
+def oom_row(e: Exception) -> Dict[str, Any]:
+    """Structured fit/no-fit evidence from an XLA compile-time OOM — learning
+    this before chip time is the whole point. Re-raises non-OOM errors."""
+    import re
+
+    msg = str(e)
+    if "RESOURCE_EXHAUSTED" not in msg:
+        raise e
+    m = re.search(r"Used ([\d.]+)([MG]) of", msg)
+    used = None
+    if m:
+        used = float(m.group(1)) * (2 ** 30 if m.group(2) == "G" else 2 ** 20)
+    return {"fits_v5e_hbm": False,
+            "hbm_required_bytes": int(used) if used else None,
+            "oom": msg.splitlines()[0][-300:]}
+
+
+def train_program_report(
+    model: str,
+    *,
+    topology: str = "v5e:2x2",
+    dp: int = 1,
+    tp: int = 1,
+    sp: int = 1,
+    stage: int = 1,
+    micro_bs: int = 16,
+    seq: int = 1024,
+    gas: int = 1,
+    k_steps: int = 1,
+    remat_policy: Optional[str] = None,
+    loss_chunk: int = 0,
+    seq_parallel_impl: Optional[str] = None,
+    optimizer: Tuple[str, Dict[str, Any]] = ("AdamW",
+                                             {"lr": 3e-4,
+                                              "weight_decay": 0.1}),
+) -> Dict[str, Any]:
+    """Compile the dense-GPT training program for ``model`` (a
+    ``models.gpt.PRESETS`` name) against ``topology`` and report per-device
+    HBM, FLOPs, and the fits verdict. Parameters/optimizer state are placed
+    with the REAL engine rules (Megatron tp specs layered with the ZeRO
+    policy) — a replicated-everything report would misstate multi-chip
+    programs."""
+    import dataclasses
+
+    from jax.experimental import topologies
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..models import build_gpt
+    from ..models import gpt as gpt_mod
+    from ..ops.optimizers import get_optimizer
+    from ..runtime.topology import MeshTopology, mesh_context
+    from ..runtime.zero.config import DeepSpeedZeroConfig
+    from ..runtime.zero.policy import ZeroShardingPolicy
+
+    # compile the REAL Mosaic kernels, but restore the caller's
+    # interpret-mode setting afterwards (a library API must not poison the
+    # process env)
+    with _env_override("DS_TPU_PALLAS_INTERPRET", "0"):
+        td = topologies.get_topology_desc(platform="tpu",
+                                          topology_name=topology)
+        topo = MeshTopology.create(dp=dp, sp=sp, tp=tp,
+                                   devices=list(td.devices)[:dp * sp * tp])
+        replace: Dict[str, Any] = dict(remat=True, use_flash=True,
+                                       loss_chunk=int(loss_chunk))
+        if remat_policy:
+            replace["remat_policy"] = remat_policy
+        if seq_parallel_impl:
+            replace["seq_parallel_impl"] = seq_parallel_impl
+        mcfg = gpt_mod.PRESETS[model]
+        if seq > mcfg.max_seq_len:
+            replace["max_seq_len"] = seq
+        mcfg = dataclasses.replace(mcfg, **replace)
+        mdl, mcfg = build_gpt(mcfg)
+
+        tmap = jax.tree_util.tree_map
+        shapes = jax.eval_shape(mdl.init, jax.random.PRNGKey(0))
+        opt = get_optimizer(*optimizer)
+        opt_shapes = jax.eval_shape(opt.init, shapes)
+        step = fused_train_step(mdl, opt, gas=gas, k_steps=k_steps)
+
+        base_specs = mdl.specs(shapes)
+        policy = ZeroShardingPolicy(topo, DeepSpeedZeroConfig(stage=stage))
+        sh = lambda spec: NamedSharding(topo.mesh, spec)  # noqa: E731
+        pspec = tmap(lambda s, b: policy.param_spec(s.shape, b), shapes, base_specs)
+        ospec = tmap(lambda s, b: policy.opt_spec(s.shape, b), shapes, base_specs)
+
+        def abstract(tree, spec_tree, dtype=None):
+            return tmap(lambda s, p: jax.ShapeDtypeStruct(
+                s.shape, dtype or s.dtype, sharding=sh(p)), tree, spec_tree)
+
+        opt_spec_tree = opt.state_spec(tmap(lambda p: sh(p), ospec), sh(P()))
+        a_opt = tmap(lambda s, shd: jax.ShapeDtypeStruct(
+            s.shape, s.dtype, sharding=shd), opt_shapes, opt_spec_tree)
+        bshape: Tuple[int, ...] = (micro_bs * dp, seq)
+        bspec = topo.batch_spec(1)
+        if gas > 1:
+            bshape = (gas,) + bshape
+            bspec = P(None, *tuple(bspec))
+        if k_steps > 1:
+            bshape = (k_steps,) + bshape
+            bspec = P(None, *tuple(bspec))
+        a_batch = {"input_ids": jax.ShapeDtypeStruct(
+            bshape, jnp.int32, sharding=NamedSharding(topo.mesh, bspec))}
+        a_rng = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=sh(P()))
+
+        out: Dict[str, Any] = {
+            "model": model, "topology": topology, "micro_bs": micro_bs,
+            "seq": seq, "dp": dp, "tp": tp, "sp": sp, "stage": stage,
+            "gas": gas, "k_steps": k_steps, "loss_chunk": int(loss_chunk),
+            "remat_policy": remat_policy or mcfg.remat_policy,
+        }
+        with mesh_context(topo.mesh):
+            t0 = time.perf_counter()
+            try:
+                compiled = jax.jit(step, donate_argnums=(0, 1, 2)).lower(
+                    abstract(shapes, pspec, jnp.bfloat16),
+                    abstract(shapes, ospec, jnp.float32),
+                    a_opt, a_batch, a_rng).compile()
+            except Exception as e:  # compile-time OOM IS the evidence
+                out.update(oom_row(e))
+                return out
+        out.update(report_from_compiled(compiled, time.perf_counter() - t0))
+        return out

@@ -1,0 +1,66 @@
+"""runtime.aot: host-side TPU-topology compile reports (the bin/ds_aot core).
+
+These run the REAL XLA TPU compiler on the host (jax.experimental.topologies)
+— no accelerator needed — which is exactly the product claim being tested.
+"""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def tiny_report():
+    from deepspeed_tpu.runtime.aot import train_program_report
+
+    return train_program_report("gpt2-125m", micro_bs=2, seq=256, stage=1)
+
+
+def test_report_fields_and_fit(tiny_report):
+    r = tiny_report
+    assert r["fits_v5e_hbm"] is True
+    pd = r["per_device_bytes"]
+    assert pd["peak"] > 0 and pd["arguments"] > 0
+    # 125M params: bf16 params + fp32 master + 2x fp32 moments ~ 1.8 GB args
+    assert 0.5 * 2**30 < pd["arguments"] < 4 * 2**30
+    assert r["program_flops"] > 1e11  # ~6*N*tokens
+    assert r["topology"] == "v5e:2x2"
+    json.dumps(r)
+
+
+def test_k_steps_peak_matches_single_step(tiny_report):
+    """train_batches' scan must not grow peak HBM (no cross-step accumulator)
+    — the property that made k_steps the dispatch-amortization choice."""
+    from deepspeed_tpu.runtime.aot import train_program_report
+
+    r8 = train_program_report("gpt2-125m", micro_bs=2, seq=256, stage=1,
+                              k_steps=4)
+    assert r8["fits_v5e_hbm"]
+    # within 5%: scan bookkeeping only, no extra full-size buffer
+    assert r8["per_device_bytes"]["peak"] < \
+        tiny_report["per_device_bytes"]["peak"] * 1.05
+
+
+def test_gas_adds_accumulator(tiny_report):
+    """gas DOES add a full fp32 grad accumulator across the scan — the
+    documented reason bench rows use k_steps instead."""
+    from deepspeed_tpu.runtime.aot import train_program_report
+
+    rg = train_program_report("gpt2-125m", micro_bs=2, seq=256, stage=1,
+                              gas=4)
+    n_param_bytes = 125e6 * 4
+    grown = (rg["per_device_bytes"]["peak"]
+             - tiny_report["per_device_bytes"]["peak"])
+    assert grown > 0.5 * n_param_bytes
+
+
+def test_cli_ds_aot():
+    p = subprocess.run(
+        [sys.executable, "/root/repo/bin/ds_aot", "--model", "gpt2-125m",
+         "--micro-bs", "2", "--seq", "256"],
+        capture_output=True, text=True, timeout=900)
+    assert p.returncode == 0, p.stderr[-300:]
+    rep = json.loads(p.stdout.strip().splitlines()[-1])
+    assert rep["fits_v5e_hbm"] is True
