@@ -14,6 +14,9 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8")
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["DS_TPU_ACCELERATOR"] = "cpu"
+# AOT-report tests load libtpu for compile-only topology work, in-process AND
+# in CLI subprocesses — skip libtpu's single-process lockfile
+os.environ.setdefault("ALLOW_MULTIPLE_LIBTPU_LOAD", "true")
 
 import jax  # noqa: E402
 
