@@ -82,6 +82,10 @@ INFINITY_CONFIGS = [
 # the tunnel is dead (round-3 post-mortem: a down tunnel left the round with
 # no TPU-grounded numbers at all).
 AOT_TRAIN_CONFIGS = [
+    {"kind": "infer_aot", "name": "aot-350m-decode-b1", "model": "gpt2-350m",
+     "batch": 1, "prompt": 128, "gen": 64, "force_cpu": True},
+    {"kind": "infer_aot", "name": "aot-350m-decode-b8", "model": "gpt2-350m",
+     "batch": 8, "prompt": 128, "gen": 64, "force_cpu": True},
     {"kind": "kernels_aot", "name": "pallas-kernels-v5e-aot",
      "force_cpu": True, "timeout": 1500},
     {"kind": "train_aot", "name": "gpt2-760m-selrm16-chunk-aot",
@@ -227,6 +231,7 @@ def _worker(cfg: dict) -> None:
           "pipeline_aot": _worker_pipeline_aot,
           "pipeline_mpmd": _worker_pipeline_mpmd,
           "train_aot": _worker_train_aot,
+          "infer_aot": _worker_infer_aot,
           "kernels_aot": _worker_kernels_aot,
           "infinity_aot": _worker_infinity_aot,
           "moe_aot": _worker_moe_aot}[cfg["kind"]]
@@ -850,6 +855,22 @@ def _worker_train_aot(cfg: dict) -> dict:
         loss_chunk=int(cfg.get("loss_chunk", 0)),
         seq_parallel_impl=cfg.get("seq_parallel_impl"))
     return {"config": cfg["name"], "kind": "train_aot",
+            "platform": "tpu-compile-only", **rep}
+
+
+def _worker_infer_aot(cfg: dict) -> dict:
+    """AOT-compile the generate-shaped decode program against the v5e
+    topology: KV-cache-dominated HBM fit + per-token FLOPs evidence with no
+    chips (core: deepspeed_tpu.runtime.aot.decode_program_report)."""
+    from deepspeed_tpu.runtime.aot import decode_program_report
+
+    rep = decode_program_report(
+        cfg.get("model", "gpt2-350m"),
+        topology=cfg.get("topology", "v5e:2x2"),
+        batch=int(cfg.get("batch", 1)), prompt=int(cfg.get("prompt", 128)),
+        gen=int(cfg.get("gen", 64)),
+        cache_dtype=cfg.get("cache_dtype", "bfloat16"))
+    return {"config": cfg["name"], "kind": "infer_aot",
             "platform": "tpu-compile-only", **rep}
 
 

@@ -263,3 +263,83 @@ def train_program_report(
                 return out
         out.update(report_from_compiled(compiled, time.perf_counter() - t0))
         return out
+
+
+def decode_program_report(
+    model: str,
+    *,
+    topology: str = "v5e:2x2",
+    batch: int = 1,
+    prompt: int = 128,
+    gen: int = 64,
+    cache_dtype: str = "bfloat16",
+) -> Dict[str, Any]:
+    """Compile the generate-shaped program (prefill + a scan of single-token
+    cached decode steps with greedy selection) for ``model`` against
+    ``topology``. Reports per-device HBM (params + the [L,B,H,S,Dh] KV cache
+    the fit actually hinges on) and per-token decode FLOPs. Mirrors
+    InferenceEngine.generate's AOT structure (inference/engine.py) closely
+    enough that fit/FLOPs verdicts transfer."""
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ..models import gpt as gpt_mod
+
+    mcfg = gpt_mod.PRESETS[model]
+    total = prompt + gen + 8
+    dt = jnp.bfloat16 if cache_dtype == "bfloat16" else jnp.float32
+
+    with _env_override("DS_TPU_PALLAS_INTERPRET", "0"):
+        td = topologies.get_topology_desc(platform="tpu",
+                                          topology_name=topology)
+        mesh = Mesh(list(td.devices)[:1], ("d",))
+        rep = NamedSharding(mesh, P())
+
+        def fn(params, input_ids, key):
+            cache = gpt_mod.init_cache(mcfg, batch, total, dt)
+            params = jax.tree_util.tree_map(lambda x: x.astype(dt), params)
+            logits, cache = gpt_mod.forward_with_cache(
+                mcfg, params, input_ids, cache)
+            next_tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+
+            def body(carry, _):
+                cache, tok = carry
+                logits, cache = gpt_mod.forward_with_cache(
+                    mcfg, params, tok[:, None], cache)
+                nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+                return (cache, nxt), nxt
+
+            (_, _), toks = jax.lax.scan(
+                body, (cache, next_tok), None, length=gen - 1)
+            return jnp.concatenate(
+                [input_ids, next_tok[:, None], toks.T], axis=1)
+
+        shapes = jax.eval_shape(
+            lambda r: gpt_mod.init_params(mcfg, r),
+            jax.ShapeDtypeStruct((2,), jnp.uint32))
+        tmap = jax.tree_util.tree_map
+        a_params = tmap(lambda s: jax.ShapeDtypeStruct(
+            s.shape, s.dtype, sharding=rep), shapes)
+        a_ids = jax.ShapeDtypeStruct((batch, prompt), jnp.int32, sharding=rep)
+        a_key = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=rep)
+
+        out: Dict[str, Any] = {
+            "model": model, "topology": topology, "batch": batch,
+            "prompt": prompt, "gen": gen, "cache_dtype": cache_dtype,
+        }
+        t0 = time.perf_counter()
+        try:
+            compiled = jax.jit(fn).lower(a_params, a_ids, a_key).compile()
+        except Exception as e:
+            out.update(oom_row(e))
+            return out
+    rep_fields = report_from_compiled(compiled, time.perf_counter() - t0)
+    flops = rep_fields.get("program_flops") or 0.0
+    if flops:
+        # decode steps dominate; per generated token
+        rep_fields["flops_per_token"] = round(flops / max(gen, 1))
+    kv_bytes = (2 * mcfg.n_layer * batch * mcfg.n_head * total
+                * mcfg.head_dim * (2 if cache_dtype == "bfloat16" else 4))
+    rep_fields["kv_cache_bytes"] = kv_bytes
+    out.update(rep_fields)
+    return out

@@ -64,3 +64,16 @@ def test_cli_ds_aot():
     assert p.returncode == 0, p.stderr[-300:]
     rep = json.loads(p.stdout.strip().splitlines()[-1])
     assert rep["fits_v5e_hbm"] is True
+
+
+def test_decode_report():
+    from deepspeed_tpu.runtime.aot import decode_program_report
+
+    r = decode_program_report("gpt2-125m", batch=2, prompt=32, gen=8)
+    assert r["fits_v5e_hbm"] is True
+    # ~2*(non-embedding params) per decode token: 125M total - ~39M embedding
+    # tables -> ~172M; require the right order of magnitude
+    assert 1e8 < r["flops_per_token"] < 5e8
+    # KV bytes: 2 tensors * L * B * H * S * Dh * 2B
+    assert r["kv_cache_bytes"] == 2 * 12 * 2 * 12 * (32 + 8 + 8) * 64 * 2
+    json.dumps(r)
