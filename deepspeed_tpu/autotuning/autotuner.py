@@ -99,6 +99,9 @@ class Autotuner:
         at = dict(base_config.get("autotuning", {}))
         self.base_config = {k: v for k, v in base_config.items() if k != "autotuning"}
         self.metric = at.get("metric", metric)
+        # reference tuner algorithms (autotuning/tuner/): gridsearch (default),
+        # random, model_based (cost-model-guided; see tuner.py)
+        self.tuner_type = at.get("tuner_type", "gridsearch")
         self.early_stopping = int(at.get("tuner_early_stopping", early_stopping))
         self.results_dir = results_dir or at.get("results_dir", "autotuning_results")
         space = tuning_space or {}
@@ -128,10 +131,12 @@ class Autotuner:
     def generate_experiments(self) -> List[TuningExperiment]:
         keys = sorted(self.space)
         exps = []
+        self._combos = []
         for combo in itertools.product(*[self.space[k] for k in keys]):
             cfg = copy.deepcopy(self.base_config)
             for k, v in zip(keys, combo):
                 self._set(cfg, k, v)
+            self._combos.append(combo)
             exps.append(TuningExperiment(config=cfg))
         return exps
 
@@ -144,20 +149,36 @@ class Autotuner:
         better for latency). Failures are recorded, not fatal — the reference
         likewise treats OOM configs as pruned points.
         """
+        from .tuner import get_tuner, ordinal_features
+
         self.experiments = self.generate_experiments()
+        higher_better = self.metric != "latency"
+        # features only for the model-based tuner — grid/random never use them
+        feats = (ordinal_features(self.space, self._combos)
+                 if (self.experiments and self.tuner_type == "model_based")
+                 else None)
+        tuner = get_tuner(self.tuner_type, len(self.experiments), feats,
+                          higher_better)
         best: Optional[TuningExperiment] = None
         stale = 0
-        for i, exp in enumerate(self.experiments):
+        while True:
+            picked = tuner.next_indices(1)
+            if not picked:
+                break
+            i = picked[0]
+            exp = self.experiments[i]
             try:
                 v = float(trial_fn(exp.config))
                 exp.metric_value = v
             except Exception as e:  # pruned point
                 exp.error = f"{type(e).__name__}: {e}"
                 logger.info(f"autotuner: experiment {i} pruned ({exp.error})")
+                tuner.update(i, None)
                 continue
+            tuner.update(i, v)
             better = (best is None
-                      or (self.metric != "latency" and v > best.metric_value)
-                      or (self.metric == "latency" and v < best.metric_value))
+                      or (higher_better and v > best.metric_value)
+                      or (not higher_better and v < best.metric_value))
             if better:
                 best, stale = exp, 0
             else:

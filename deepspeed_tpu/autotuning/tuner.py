@@ -1,0 +1,166 @@
+"""Tuner algorithms: gridsearch, random, and model-based search.
+
+Capability parity with the reference's ``autotuning/tuner/`` package:
+``index_based_tuner.py`` (GridSearchTuner: sequential; RandomTuner: shuffled)
+and ``model_based_tuner.py`` (ModelBasedTuner: a cost model trained on
+measured trials ranks the unvisited configs; INIT_NUM random warmup trials;
+an exploration ratio keeps sampling off-model). The reference's cost model is
+XGBoost with a pairwise-rank objective (``tuner/cost_model.py``); xgboost is
+not in this image, so the model here is a ridge regression on ordinal
+config features — same role (rank unvisited configs from measured evidence),
+honest about being a linear surrogate. The selection loop, warmup, and
+exploration mechanics mirror the reference.
+
+Features: each tuning-space key contributes one ordinal feature — the value's
+index in that key's candidate list (works uniformly for numeric ladders and
+categorical lists like remat policies).
+"""
+
+from __future__ import annotations
+
+import random as _random
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.logging import logger
+
+TUNER_GRIDSEARCH = "gridsearch"
+TUNER_RANDOM = "random"
+TUNER_MODEL_BASED = "model_based"
+
+INIT_NUM = 2  # model-based warmup trials (reference: model_based_tuner.py)
+
+
+class BaseTuner:
+    """Selection strategy over an experiment list.
+
+    Protocol: ``next_indices(k)`` returns up to ``k`` unvisited experiment
+    indices; ``update(idx, metric_value)`` feeds a measured result back
+    (``None`` for a pruned/OOM trial). ``higher_better`` orients the model.
+    """
+
+    def __init__(self, n: int, features: Optional[np.ndarray] = None,
+                 higher_better: bool = True, seed: int = 0):
+        self.n = n
+        self.features = features
+        self.higher_better = higher_better
+        self.visited: set = set()
+        self.rng = _random.Random(seed)
+
+    def next_indices(self, k: int = 1) -> List[int]:
+        raise NotImplementedError
+
+    def update(self, idx: int, metric_value: Optional[float]) -> None:
+        self.visited.add(idx)
+
+    def _unvisited(self) -> List[int]:
+        return [i for i in range(self.n) if i not in self.visited]
+
+
+class GridSearchTuner(BaseTuner):
+    """Sequential order (reference GridSearchTuner)."""
+
+    def next_indices(self, k: int = 1) -> List[int]:
+        return self._unvisited()[:k]
+
+
+class RandomTuner(BaseTuner):
+    """Uniform random order without replacement (reference RandomTuner)."""
+
+    def next_indices(self, k: int = 1) -> List[int]:
+        u = self._unvisited()
+        return self.rng.sample(u, min(k, len(u)))
+
+
+class ModelBasedTuner(BaseTuner):
+    """Cost-model-guided search (reference ModelBasedTuner).
+
+    Warmup: INIT_NUM random trials. After each update the surrogate refits on
+    all measured (features, value) pairs and the next pick is the best
+    predicted unvisited config — except with probability
+    ``exploration_ratio`` (reference: 0.2) a random unvisited config is
+    taken instead, so the model cannot paint itself into a corner.
+    """
+
+    def __init__(self, n: int, features: np.ndarray, higher_better=True,
+                 seed: int = 0, exploration_ratio: float = 0.2,
+                 ridge_lambda: float = 1e-3):
+        super().__init__(n, features, higher_better, seed)
+        assert features is not None and len(features) == n
+        self.exploration_ratio = exploration_ratio
+        self.ridge_lambda = ridge_lambda
+        self.xs: List[np.ndarray] = []
+        self.ys: List[float] = []
+        self.failed: set = set()
+
+    def update(self, idx: int, metric_value: Optional[float]) -> None:
+        super().update(idx, metric_value)
+        if metric_value is None:
+            self.failed.add(idx)  # pruned (OOM): excluded from training
+            return
+        self.xs.append(self.features[idx])
+        self.ys.append(float(metric_value))
+
+    def _predict(self) -> Optional[np.ndarray]:
+        if len(self.xs) < 2:
+            return None
+        X = np.asarray(self.xs, np.float64)
+        y = np.asarray(self.ys, np.float64)
+        # standardize + bias column; ridge solve
+        mu, sd = X.mean(0), X.std(0) + 1e-9
+        Xs = (X - mu) / sd
+        A = np.concatenate([Xs, np.ones((len(Xs), 1))], axis=1)
+        lam = self.ridge_lambda * np.eye(A.shape[1])
+        lam[-1, -1] = 0.0  # don't penalize the bias
+        w = np.linalg.solve(A.T @ A + lam, A.T @ y)
+        Fs = (self.features - mu) / sd
+        return np.concatenate([Fs, np.ones((self.n, 1))], axis=1) @ w
+
+    def next_indices(self, k: int = 1) -> List[int]:
+        u = self._unvisited()
+        if not u:
+            return []
+        warmup_needed = len(self.visited) < min(INIT_NUM, self.n)
+        preds = None if warmup_needed else self._predict()
+        picks: List[int] = []
+        pool = list(u)
+        for _ in range(min(k, len(pool))):
+            if preds is None or self.rng.random() < self.exploration_ratio:
+                c = self.rng.choice(pool)
+            else:
+                key = (lambda i: -preds[i]) if self.higher_better \
+                    else (lambda i: preds[i])
+                c = min(pool, key=key)
+            picks.append(c)
+            pool.remove(c)
+        return picks
+
+
+def ordinal_features(space: Dict[str, Sequence[Any]],
+                     combos: List[Tuple[Any, ...]]) -> np.ndarray:
+    """Map each experiment's (key -> value) combo to ordinal indices.
+
+    Keyed by ``repr`` so list-valued candidates (e.g. optimizer betas) work."""
+    keys = sorted(space)
+    index = {k: {repr(v): i for i, v in enumerate(space[k])} for k in keys}
+    return np.asarray(
+        [[index[k].get(repr(v), 0) for k, v in zip(keys, combo)]
+         for combo in combos], np.float64)
+
+
+def get_tuner(tuner_type: str, n: int, features: Optional[np.ndarray],
+              higher_better: bool, seed: int = 0) -> BaseTuner:
+    if tuner_type == TUNER_MODEL_BASED:
+        if features is None:
+            logger.warning("model_based tuner needs features; "
+                           "falling back to gridsearch")
+            return GridSearchTuner(n, None, higher_better, seed)
+        return ModelBasedTuner(n, features, higher_better, seed)
+    if tuner_type == TUNER_RANDOM:
+        return RandomTuner(n, features, higher_better, seed)
+    if tuner_type == TUNER_GRIDSEARCH:
+        return GridSearchTuner(n, features, higher_better, seed)
+    raise ValueError(
+        f"unknown tuner_type {tuner_type!r}; expected "
+        f"{TUNER_GRIDSEARCH!r}, {TUNER_RANDOM!r} or {TUNER_MODEL_BASED!r}")

@@ -1,0 +1,81 @@
+"""Tuner algorithms (autotuning/tuner.py): gridsearch / random / model-based.
+
+Parity targets: reference ``autotuning/tuner/index_based_tuner.py`` and
+``model_based_tuner.py`` (cost-model selection with random warmup and an
+exploration ratio).
+"""
+
+import numpy as np
+
+from deepspeed_tpu.autotuning.autotuner import Autotuner
+from deepspeed_tpu.autotuning.tuner import (GridSearchTuner, ModelBasedTuner,
+                                            RandomTuner, get_tuner,
+                                            ordinal_features)
+
+
+def test_gridsearch_sequential_and_random_is_permutation():
+    g = GridSearchTuner(5)
+    order = []
+    while (p := g.next_indices(1)):
+        order.append(p[0])
+        g.update(p[0], 1.0)
+    assert order == [0, 1, 2, 3, 4]
+
+    r = RandomTuner(5, seed=3)
+    order = []
+    while (p := r.next_indices(1)):
+        order.append(p[0])
+        r.update(p[0], 1.0)
+    assert sorted(order) == [0, 1, 2, 3, 4]
+
+
+def test_model_based_converges_to_good_region():
+    """On a smooth landscape the surrogate must concentrate trials near the
+    optimum: after warmup, the model-based picks should reach the true best
+    config far sooner than its index position."""
+    n = 50
+    feats = np.arange(n, dtype=np.float64)[:, None]
+    true = -((feats[:, 0] - 40.0) ** 2)  # best at index 40
+    t = ModelBasedTuner(n, feats, higher_better=True, seed=0,
+                        exploration_ratio=0.0)
+    measured = []
+    for _ in range(10):
+        i = t.next_indices(1)[0]
+        measured.append(i)
+        t.update(i, float(true[i]))
+    # linear surrogate on a concave function still ranks the far end top;
+    # within 10 trials the best-measured index must be >= 35 (gridsearch
+    # would still be at index 9)
+    assert max(measured) >= 35, measured
+
+
+def test_model_based_survives_pruned_trials():
+    n = 10
+    feats = np.arange(n, dtype=np.float64)[:, None]
+    t = ModelBasedTuner(n, feats, higher_better=True, seed=1)
+    for _ in range(n):
+        i = t.next_indices(1)[0]
+        t.update(i, None if i % 2 else float(i))  # odd indices "OOM"
+    assert not t.next_indices(1)  # all visited, no crash
+
+
+def test_get_tuner_fallback_and_unknown():
+    import pytest
+
+    assert isinstance(get_tuner("model_based", 3, None, True),
+                      GridSearchTuner)  # no features -> fallback
+    with pytest.raises(ValueError):
+        get_tuner("bayesian", 3, None, True)
+
+
+def test_autotuner_integration_model_based():
+    """End-to-end through Autotuner.tune with a synthetic trial function."""
+    at = Autotuner(
+        {"autotuning": {"tuner_type": "model_based",
+                        "micro_batch_sizes": [1, 2, 4, 8, 16, 32],
+                        "zero_stages": [1]}},
+        results_dir="/tmp/at_results_test")
+    best = at.tune(lambda cfg: float(cfg["train_micro_batch_size_per_gpu"]))
+    assert best is not None
+    assert best.config["train_micro_batch_size_per_gpu"] == 32
+    assert ordinal_features(at.space, at._combos).shape == (6, 2)
