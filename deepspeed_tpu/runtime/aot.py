@@ -343,3 +343,37 @@ def decode_program_report(
     rep_fields["kv_cache_bytes"] = kv_bytes
     out.update(rep_fields)
     return out
+
+
+def find_max_batch(
+    model: str,
+    *,
+    lo: int = 1,
+    hi: int = 64,
+    **report_kwargs: Any,
+) -> Dict[str, Any]:
+    """Binary-search the largest ``micro_bs`` whose training program fits the
+    topology (compile-time verdicts only — no chips). Returns the last fitting
+    report plus the search trace. Automates the fit-ladder workflow the
+    compile-only evidence rows established (each probe is one
+    :func:`train_program_report` call; OOM verdicts are data, not errors)."""
+    trace: List[Dict[str, Any]] = []
+    best: Optional[Dict[str, Any]] = None
+    lo_f, hi_f = lo, hi  # invariant: lo_f fits (once proven), hi_f+1 unknown
+    # first make sure lo fits at all
+    r = train_program_report(model, micro_bs=lo, **report_kwargs)
+    trace.append({"micro_bs": lo, "fits": r["fits_v5e_hbm"]})
+    if not r["fits_v5e_hbm"]:
+        return {"model": model, "max_micro_bs": 0, "trace": trace,
+                "report": None}
+    best = r
+    while lo_f < hi_f:
+        mid = (lo_f + hi_f + 1) // 2
+        r = train_program_report(model, micro_bs=mid, **report_kwargs)
+        trace.append({"micro_bs": mid, "fits": r["fits_v5e_hbm"]})
+        if r["fits_v5e_hbm"]:
+            lo_f, best = mid, r
+        else:
+            hi_f = mid - 1
+    return {"model": model, "max_micro_bs": lo_f, "trace": trace,
+            "report": best}

@@ -77,3 +77,13 @@ def test_decode_report():
     # KV bytes: 2 tensors * L * B * H * S * Dh * 2B
     assert r["kv_cache_bytes"] == 2 * 12 * 2 * 12 * (32 + 8 + 8) * 64 * 2
     json.dumps(r)
+
+
+def test_find_max_batch_ladder():
+    from deepspeed_tpu.runtime.aot import find_max_batch
+
+    r = find_max_batch("gpt2-125m", lo=1, hi=4, seq=256, stage=1)
+    # tiny model at short seq: everything in [1,4] fits -> ladder tops out
+    assert r["max_micro_bs"] == 4
+    assert r["report"]["fits_v5e_hbm"] is True
+    assert r["trace"][0] == {"micro_bs": 1, "fits": True}
