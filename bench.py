@@ -82,6 +82,8 @@ INFINITY_CONFIGS = [
 # the tunnel is dead (round-3 post-mortem: a down tunnel left the round with
 # no TPU-grounded numbers at all).
 AOT_TRAIN_CONFIGS = [
+    {"kind": "sd_aot", "name": "aot-sd-ddim20", "latent": 32,
+     "ddim_steps": 20, "force_cpu": True},
     {"kind": "infer_aot", "name": "aot-350m-decode-b1", "model": "gpt2-350m",
      "batch": 1, "prompt": 128, "gen": 64, "force_cpu": True},
     {"kind": "infer_aot", "name": "aot-350m-decode-b8", "model": "gpt2-350m",
@@ -232,6 +234,7 @@ def _worker(cfg: dict) -> None:
           "pipeline_mpmd": _worker_pipeline_mpmd,
           "train_aot": _worker_train_aot,
           "infer_aot": _worker_infer_aot,
+          "sd_aot": _worker_sd_aot,
           "kernels_aot": _worker_kernels_aot,
           "infinity_aot": _worker_infinity_aot,
           "moe_aot": _worker_moe_aot}[cfg["kind"]]
@@ -871,6 +874,21 @@ def _worker_infer_aot(cfg: dict) -> dict:
         gen=int(cfg.get("gen", 64)),
         cache_dtype=cfg.get("cache_dtype", "bfloat16"))
     return {"config": cfg["name"], "kind": "infer_aot",
+            "platform": "tpu-compile-only", **rep}
+
+
+def _worker_sd_aot(cfg: dict) -> dict:
+    """AOT-compile the full SD inference program (DDIM scan + CFG UNet + VAE
+    decode) against the v5e topology (core: runtime.aot.sd_program_report)."""
+    from deepspeed_tpu.runtime.aot import sd_program_report
+
+    rep = sd_program_report(
+        topology=cfg.get("topology", "v5e:2x2"),
+        batch=int(cfg.get("batch", 1)), latent=int(cfg.get("latent", 32)),
+        ddim_steps=int(cfg.get("ddim_steps", 20)),
+        channels=tuple(cfg.get("channels", (128, 256, 512))),
+        text_dim=int(cfg.get("text_dim", 512)))
+    return {"config": cfg["name"], "kind": "sd_aot",
             "platform": "tpu-compile-only", **rep}
 
 

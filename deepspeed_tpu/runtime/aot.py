@@ -377,3 +377,81 @@ def find_max_batch(
             hi_f = mid - 1
     return {"model": model, "max_micro_bs": lo_f, "trace": trace,
             "report": best}
+
+
+def sd_program_report(
+    *,
+    topology: str = "v5e:2x2",
+    batch: int = 1,
+    latent: int = 32,
+    ddim_steps: int = 20,
+    channels: Tuple[int, ...] = (128, 256, 512),
+    text_dim: int = 512,
+) -> Dict[str, Any]:
+    """Compile the full Stable-Diffusion inference program (DDIM scan + CFG
+    UNet + VAE decode — exactly SDPipeline's jitted fn) against ``topology``.
+    BASELINE config #5's program shape as chip-free fit/FLOPs evidence."""
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ..models.diffusion import ddim_sample
+    from ..models.sd_unet import (SDUNetConfig, SDVAEDecoderConfig,
+                                  apply_sd_unet, apply_sd_vae_decoder,
+                                  init_sd_unet, init_sd_vae_decoder)
+
+    chans = tuple(channels)
+    groups = min(32, min(chans))
+    ucfg = SDUNetConfig(
+        block_out_channels=chans,
+        cross_attn=tuple(i < len(chans) - 1 for i in range(len(chans))),
+        cross_attention_dim=text_dim, n_head=8, norm_groups=groups)
+    vcfg = SDVAEDecoderConfig(
+        block_out_channels=tuple(max(c // 2, groups) for c in chans),
+        norm_groups=groups)
+
+    with _env_override("DS_TPU_PALLAS_INTERPRET", "0"):
+        td = topologies.get_topology_desc(platform="tpu",
+                                          topology_name=topology)
+        mesh = Mesh(list(td.devices)[:1], ("d",))
+        rep = NamedSharding(mesh, P())
+        tmap = jax.tree_util.tree_map
+
+        def fn(unet_params, vae_params, text, uncond, x, gs):
+            lat = ddim_sample(ucfg, unet_params, x, text, uncond,
+                              num_steps=ddim_steps, guidance_scale=gs,
+                              apply_fn=apply_sd_unet)
+            return apply_sd_vae_decoder(vcfg, vae_params, lat)
+
+        kdt = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        u_shapes = jax.eval_shape(lambda k: init_sd_unet(ucfg, k), kdt)
+        v_shapes = jax.eval_shape(lambda k: init_sd_vae_decoder(vcfg, k), kdt)
+
+        def ab(tree):
+            return tmap(lambda s: jax.ShapeDtypeStruct(
+                s.shape, s.dtype, sharding=rep), tree)
+
+        a_text = jax.ShapeDtypeStruct((batch, 77, text_dim), jnp.float32,
+                                      sharding=rep)
+        a_x = jax.ShapeDtypeStruct(
+            (batch, latent, latent, ucfg.in_channels), jnp.float32,
+            sharding=rep)
+        a_gs = jax.ShapeDtypeStruct((), jnp.float32, sharding=rep)
+
+        out: Dict[str, Any] = {
+            "topology": topology, "batch": batch, "latent": latent,
+            "ddim_steps": ddim_steps, "channels": list(chans),
+        }
+        t0 = time.perf_counter()
+        try:
+            compiled = jax.jit(fn).lower(
+                ab(u_shapes), ab(v_shapes), a_text, a_text, a_x,
+                a_gs).compile()
+        except Exception as e:
+            out.update(oom_row(e))
+            return out
+    rep_fields = report_from_compiled(compiled, time.perf_counter() - t0)
+    flops = rep_fields.get("program_flops") or 0.0
+    if flops:
+        rep_fields["flops_per_image"] = round(flops / max(batch, 1))
+    out.update(rep_fields)
+    return out

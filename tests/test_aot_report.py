@@ -87,3 +87,13 @@ def test_find_max_batch_ladder():
     assert r["max_micro_bs"] == 4
     assert r["report"]["fits_v5e_hbm"] is True
     assert r["trace"][0] == {"micro_bs": 1, "fits": True}
+
+
+def test_sd_report_tiny():
+    from deepspeed_tpu.runtime.aot import sd_program_report
+
+    r = sd_program_report(batch=1, latent=16, ddim_steps=2,
+                          channels=(32, 64), text_dim=64)
+    assert r["fits_v5e_hbm"] is True
+    assert r["flops_per_image"] > 0
+    json.dumps(r)
