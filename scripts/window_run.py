@@ -122,6 +122,12 @@ def main():
     mfu({"model": "gpt2-350m", "micro_bs": 2, "seq": 8192, "remat": True,
          "policy": "nothing_saveable", "loss_chunk": 512, "k_steps": 8,
          "steps": 4, "tag": "350m-seq8k-chunk512-k8"}, timeout=2700)
+
+    # 9. a full bench.py core sweep: its train rows are the SAME engine
+    # programs as the mfu rows above (now cache-warm), so this is cheap and
+    # leaves a driver-grade artifact + partial ledger from inside the window
+    run("bench-core-sweep",
+        [sys.executable, os.path.join(REPO, "bench.py")], 7200)
     print(f"[window] done -> {OUT}")
 
 
