@@ -88,6 +88,9 @@ AOT_TRAIN_CONFIGS = [
      "batch": 1, "prompt": 128, "gen": 64, "force_cpu": True},
     {"kind": "infer_aot", "name": "aot-350m-decode-b8", "model": "gpt2-350m",
      "batch": 8, "prompt": 128, "gen": 64, "force_cpu": True},
+    {"kind": "infer_aot", "name": "aot-350m-decode-b8-int8",
+     "model": "gpt2-350m", "batch": 8, "prompt": 128, "gen": 64,
+     "quantize_bits": 8, "force_cpu": True},
     {"kind": "kernels_aot", "name": "pallas-kernels-v5e-aot",
      "force_cpu": True, "timeout": 1500},
     {"kind": "train_aot", "name": "gpt2-760m-selrm16-chunk-aot",
@@ -875,7 +878,8 @@ def _worker_infer_aot(cfg: dict) -> dict:
         topology=cfg.get("topology", "v5e:2x2"),
         batch=int(cfg.get("batch", 1)), prompt=int(cfg.get("prompt", 128)),
         gen=int(cfg.get("gen", 64)),
-        cache_dtype=cfg.get("cache_dtype", "bfloat16"))
+        cache_dtype=cfg.get("cache_dtype", "bfloat16"),
+        quantize_bits=int(cfg.get("quantize_bits", 0)))
     return {"config": cfg["name"], "kind": "infer_aot",
             "platform": "tpu-compile-only", **rep}
 

@@ -273,6 +273,7 @@ def decode_program_report(
     prompt: int = 128,
     gen: int = 64,
     cache_dtype: str = "bfloat16",
+    quantize_bits: int = 0,
 ) -> Dict[str, Any]:
     """Compile the generate-shaped program (prefill + a scan of single-token
     cached decode steps with greedy selection) for ``model`` against
@@ -314,9 +315,17 @@ def decode_program_report(
             return jnp.concatenate(
                 [input_ids, next_tok[:, None], toks.T], axis=1)
 
-        shapes = jax.eval_shape(
-            lambda r: gpt_mod.init_params(mcfg, r),
-            jax.ShapeDtypeStruct((2,), jnp.uint32))
+        def build_params(r):
+            p = gpt_mod.init_params(mcfg, r)
+            if quantize_bits:
+                # int8 weight stack + per-group scales; the cached forward
+                # dequantizes one layer inside the scan (models/gpt.py)
+                p = gpt_mod.quantize_for_inference(mcfg, p,
+                                                   bits=quantize_bits)
+            return p
+
+        shapes = jax.eval_shape(build_params,
+                                jax.ShapeDtypeStruct((2,), jnp.uint32))
         tmap = jax.tree_util.tree_map
         a_params = tmap(lambda s: jax.ShapeDtypeStruct(
             s.shape, s.dtype, sharding=rep), shapes)
@@ -326,6 +335,7 @@ def decode_program_report(
         out: Dict[str, Any] = {
             "model": model, "topology": topology, "batch": batch,
             "prompt": prompt, "gen": gen, "cache_dtype": cache_dtype,
+            "quantize_bits": quantize_bits,
         }
         t0 = time.perf_counter()
         try:

@@ -97,3 +97,15 @@ def test_sd_report_tiny():
     assert r["fits_v5e_hbm"] is True
     assert r["flops_per_image"] > 0
     json.dumps(r)
+
+
+def test_decode_report_int8_shrinks_arguments():
+    from deepspeed_tpu.runtime.aot import decode_program_report
+
+    bf = decode_program_report("gpt2-125m", batch=1, prompt=32, gen=4)
+    q8 = decode_program_report("gpt2-125m", batch=1, prompt=32, gen=4,
+                               quantize_bits=8)
+    assert q8["fits_v5e_hbm"]
+    # int8 weight stack (+ scales) must be well under the bf16 arguments
+    assert q8["per_device_bytes"]["arguments"] < \
+        0.75 * bf["per_device_bytes"]["arguments"]
