@@ -38,11 +38,14 @@ def main():
 
     import jax
 
-    if spec.get("force_cpu"):
+    compile_only = bool(spec.get("compile_only"))
+    if spec.get("force_cpu") or compile_only:
         # env alone is too late (sitecustomize imports jax first), and the
         # axon plugin hangs at handshake while another process holds the chip
         os.environ["DS_TPU_ACCELERATOR"] = "cpu"
         jax.config.update("jax_platforms", "cpu")
+    if compile_only:
+        os.environ["DS_TPU_PALLAS_INTERPRET"] = "0"
     import jax.numpy as jnp
 
     from deepspeed_tpu.models.gpt import PRESETS  # noqa: F401 (repo path check)
@@ -77,6 +80,28 @@ def main():
         f = jax.jit(lambda q, k, v, body=body: jax.lax.fori_loop(
             0, iters, body, (q, k, v)))
         tag = f"{bq}x{bk}"
+        if compile_only:
+            # Mosaic-compile against the v5e topology (no chips): validates
+            # every tile variant BEFORE the tuner spends tunnel time on it
+            from jax.experimental import topologies
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+            td = topologies.get_topology_desc(platform="tpu",
+                                              topology_name="v5e:2x2")
+            mesh = Mesh(list(td.devices)[:1], ("d",))
+            rep = NamedSharding(mesh, P())
+            ab = lambda a: jax.ShapeDtypeStruct(  # noqa: E731
+                a.shape, a.dtype, sharding=rep)
+            try:
+                t0 = time.perf_counter()
+                f.lower(ab(q), ab(k), ab(v)).compile()
+                rows[tag] = {"compile_ok": True,
+                             "compile_s": round(time.perf_counter() - t0, 1)}
+            except Exception as e:  # noqa: BLE001
+                rows[tag] = {"compile_ok": False, "error": str(e)[:160]}
+            print(f"[tile] {geom} {tag}: {rows[tag]}", file=sys.stderr,
+                  flush=True)
+            continue
         try:
             r = f(q, k, v)
             jax.block_until_ready(r)  # compile + warm
