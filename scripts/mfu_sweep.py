@@ -29,6 +29,10 @@ def run_one(spec: dict) -> dict:
     # explicit: sitecustomize imports jax before the module-top env edit
     jax.config.update("jax_compilation_cache_dir",
                       os.environ["JAX_COMPILATION_CACHE_DIR"])
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # env alone is not enough once sitecustomize has imported jax: with
+        # the tunnel down, axon plugin discovery hangs the first device op
+        jax.config.update("jax_platforms", "cpu")
 
     import deepspeed_tpu
     from deepspeed_tpu.models import build_gpt
