@@ -100,6 +100,9 @@ AOT_TRAIN_CONFIGS = [
     {"kind": "train_aot", "name": "gpt2-760m-bs24-chunk-aot",
      "model": "gpt2-760m", "micro_bs": 24, "seq": 1024, "loss_chunk": 128,
      "force_cpu": True, "timeout": 1500},
+    {"kind": "infinity_aot", "name": "bloom-7b1-infinity-aot",
+     "model": "bloom-7b1", "micro_bs": 4, "seq": 1024, "keep_layers": 2,
+     "force_cpu": True},
     {"kind": "infinity_aot", "name": "gpt-neox-20b-infinity-aot",
      "model": "gpt-neox-20b", "micro_bs": 8, "seq": 1024, "keep_layers": 2,
      "force_cpu": True},

@@ -128,6 +128,12 @@ PRESETS: Dict[str, GPTConfig] = {
     "gpt-neox-20b": GPTConfig(
         vocab_size=50432, n_layer=44, n_head=64, d_model=6144, max_seq_len=2048,
         rotary=True, rotary_pct=0.25),
+    # BLOOM-7B1 (BASELINE.json config #3): ALiBi attention, embedding
+    # layernorm, tied head — bigscience/bloom-7b1 geometry
+    "bloom-7b1": GPTConfig(
+        vocab_size=250880, n_layer=30, n_head=32, d_model=4096,
+        max_seq_len=2048, alibi=True, embed_layernorm=True,
+        tie_embeddings=True),
     "tiny": GPTConfig(vocab_size=256, n_layer=2, n_head=4, d_model=64, max_seq_len=128),
 }
 
