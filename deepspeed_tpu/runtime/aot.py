@@ -298,7 +298,12 @@ def decode_program_report(
 
         def fn(params, input_ids, key):
             cache = gpt_mod.init_cache(mcfg, batch, total, dt)
-            params = jax.tree_util.tree_map(lambda x: x.astype(dt), params)
+            # cast FLOAT leaves to the compute dtype; int8 quantized stacks
+            # must stay int8 (the cached forward dequantizes per layer)
+            params = jax.tree_util.tree_map(
+                lambda x: (x.astype(dt)
+                           if jnp.issubdtype(x.dtype, jnp.floating) else x),
+                params)
             logits, cache = gpt_mod.forward_with_cache(
                 mcfg, params, input_ids, cache)
             next_tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
