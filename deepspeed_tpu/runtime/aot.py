@@ -274,6 +274,7 @@ def decode_program_report(
     gen: int = 64,
     cache_dtype: str = "bfloat16",
     quantize_bits: int = 0,
+    tp: int = 1,
 ) -> Dict[str, Any]:
     """Compile the generate-shaped program (prefill + a scan of single-token
     cached decode steps with greedy selection) for ``model`` against
@@ -293,7 +294,7 @@ def decode_program_report(
     with _env_override("DS_TPU_PALLAS_INTERPRET", "0"):
         td = topologies.get_topology_desc(platform="tpu",
                                           topology_name=topology)
-        mesh = Mesh(list(td.devices)[:1], ("d",))
+        mesh = Mesh(list(td.devices)[:tp], ("tp",))
         rep = NamedSharding(mesh, P())
 
         def fn(params, input_ids, key):
@@ -332,15 +333,25 @@ def decode_program_report(
         shapes = jax.eval_shape(build_params,
                                 jax.ShapeDtypeStruct((2,), jnp.uint32))
         tmap = jax.tree_util.tree_map
-        a_params = tmap(lambda s: jax.ShapeDtypeStruct(
-            s.shape, s.dtype, sharding=rep), shapes)
+        if tp > 1:
+            # Megatron TP placement, exactly as the inference engine lays
+            # params out (quantized {q,s} leaves expanded like the engine)
+            specs = gpt_mod.partition_specs(mcfg, shapes)
+            if quantize_bits:
+                specs = gpt_mod.quantized_partition_specs(shapes, specs)
+            a_params = tmap(lambda s, sp: jax.ShapeDtypeStruct(
+                s.shape, s.dtype, sharding=NamedSharding(mesh, sp)),
+                shapes, specs)
+        else:
+            a_params = tmap(lambda s: jax.ShapeDtypeStruct(
+                s.shape, s.dtype, sharding=rep), shapes)
         a_ids = jax.ShapeDtypeStruct((batch, prompt), jnp.int32, sharding=rep)
         a_key = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=rep)
 
         out: Dict[str, Any] = {
             "model": model, "topology": topology, "batch": batch,
             "prompt": prompt, "gen": gen, "cache_dtype": cache_dtype,
-            "quantize_bits": quantize_bits,
+            "quantize_bits": quantize_bits, "tp": tp,
         }
         t0 = time.perf_counter()
         try:
