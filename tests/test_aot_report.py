@@ -112,3 +112,18 @@ def test_decode_report_int8_shrinks_arguments():
     # int8 weight stack (+ scales) must be well under the bf16 arguments
     assert q8["per_device_bytes"]["arguments"] < \
         0.75 * bf["per_device_bytes"]["arguments"]
+
+
+def test_cli_batch_mode(tmp_path):
+    specs = tmp_path / "specs.jsonl"
+    specs.write_text(
+        '{"kind":"train","name":"t","model":"gpt2-125m","micro_bs":2,'
+        '"seq":256}\n')
+    out = tmp_path / "out.jsonl"
+    p = subprocess.run(
+        [sys.executable, "/root/repo/bin/ds_aot", "--batch", str(specs),
+         "--out", str(out)],
+        capture_output=True, text=True, timeout=900)
+    assert p.returncode == 0, p.stderr[-300:]
+    rows = [json.loads(l) for l in out.read_text().splitlines()]
+    assert rows and rows[0]["name"] == "t" and rows[0]["fits_v5e_hbm"]
