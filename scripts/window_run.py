@@ -82,11 +82,12 @@ def main():
          "policy": "save_attn_mlp_out", "k_steps": 8, "steps": 4,
          "tag": "350m-save-sublayer-k8"})
 
-    # 3. first-ever on-chip decode + SD (compile-heavy: 2700s each)
+    # 3. first-ever on-chip decode + SD (decode compiles TWO generate
+    # programs through the tunnel — budget accordingly)
     bench({"kind": "inference", "name": "gpt2-350m-decode", "model": "gpt2-350m",
-           "batch": 1, "prompt": 128, "gen": 64})
+           "batch": 1, "prompt": 128, "gen": 64}, timeout=3600)
     bench({"kind": "diffusion", "name": "sd-ddim20", "latent": 32,
-           "ddim_steps": 20})
+           "ddim_steps": 20}, timeout=3000)
 
     # 4. tile autotune (informs flash_block_q/k defaults)
     run("tile:760m", [sys.executable,
