@@ -109,3 +109,17 @@ def test_window_run_specs_are_executable():
         else:
             assert spec.get("kind") in ("inference", "diffusion", "train",
                                         "pipeline_mpmd"), spec
+
+
+def test_fallback_summary_carries_chip_window_evidence():
+    """A cpu-fallback sweep must still surface the round's chip-measured rows
+    (committed evidence) as the headline, clearly labeled."""
+    bench = _bench()
+    s = bench._summarize("cpu", [{"kind": "train", "config": "cpu-x",
+                                  "tokens_per_sec_chip": 27.0, "mfu": 0.02}],
+                         [])
+    ev = s.get("chip_window_evidence")
+    assert ev and ev["rows"] and ev["kernel_smoke_ok"]
+    assert "chip-measured" in s["metric"]
+    assert s["mfu"] == max(r["mfu"] for r in ev["rows"])
+    assert s["vs_baseline"] == round(s["mfu"] / 0.45, 3)
