@@ -91,6 +91,11 @@ AOT_TRAIN_CONFIGS = [
     {"kind": "infer_aot", "name": "aot-350m-decode-b8-int8",
      "model": "gpt2-350m", "batch": 8, "prompt": 128, "gen": 64,
      "quantize_bits": 8, "force_cpu": True},
+    # 13B weights chip-RESIDENT via the int8 Pallas matmul (the reference
+    # needs host offload at this size — ZeRO-Inference regime)
+    {"kind": "infer_aot", "name": "aot-opt13b-decode-b1-int8",
+     "model": "opt-13b", "batch": 1, "prompt": 128, "gen": 64,
+     "quantize_bits": 8, "force_cpu": True},
     {"kind": "kernels_aot", "name": "pallas-kernels-v5e-aot",
      "force_cpu": True, "timeout": 1500},
     {"kind": "train_aot", "name": "gpt2-760m-selrm16-chunk-aot",

@@ -36,7 +36,7 @@ class QuantizationConfig(DeepSpeedConfigModel):
     enabled: bool = False
     qkv: bool = True
     bits: int = 8
-    group_size: int = 64
+    group_size: int = 128
 
 
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):

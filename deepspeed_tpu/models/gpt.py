@@ -377,12 +377,32 @@ def _sp_active() -> bool:
     return mesh is not None and dict(mesh.shape).get("sp", 1) > 1
 
 
+def _wm(h: jnp.ndarray, leaf) -> jnp.ndarray:
+    """``h @ W`` where W is dense OR an int8 ``{"q","s"}`` quantized leaf.
+
+    Quantized leaves route through the Pallas int8-weight matmul
+    (ops/pallas/int8_matmul.py): s8 stays in HBM, dequantization happens per
+    VMEM tile — no bf16 weight buffer exists at any scope, and decode moves
+    half the weight bytes (the decode bottleneck)."""
+    if not _is_qleaf(leaf):
+        return h @ leaf
+    from ..ops.pallas.int8_matmul import int8_matmul
+
+    q, s = leaf["q"], leaf["s"]
+    shape = h.shape
+    group = q.size // s.size
+    out = int8_matmul(h.reshape(-1, shape[-1]), q, s.reshape(-1),
+                      group_size=group)
+    return out.reshape(*shape[:-1], q.shape[1])
+
+
 def _mlp_delta(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray]) -> jnp.ndarray:
     """MLP output (pre-residual): mlp(ln2(x))."""
     h = layer_norm(x, w["ln2_scale"], w["ln2_bias"], cfg.layer_norm_eps)
-    h = h @ w["mlp_up_w"] + w["mlp_up_b"]
+    h = _wm(h, w["mlp_up_w"]) + w["mlp_up_b"]
     h = _act(cfg, h)
-    return checkpoint_name(h @ w["mlp_down_w"] + w["mlp_down_b"], "mlp_out")
+    return checkpoint_name(_wm(h, w["mlp_down_w"]) + w["mlp_down_b"],
+                           "mlp_out")
 
 
 def attention_sublayer(cfg: GPTConfig, x: jnp.ndarray, w: Dict[str, jnp.ndarray],
@@ -814,14 +834,16 @@ class GPTStream:
 
 # ------------------------------------------------------------- int8 weights
 def quantize_for_inference(cfg: GPTConfig, params, bits: int = 8,
-                           group_size: int = 64):
+                           group_size: int = 128):
     """Replace the stacked block weight matrices with per-layer-grouped int8
-    ``{"q", "s"}`` leaves. The cached forward dequantizes ONE layer inside the
-    scan body, so peak HBM holds the int8 stack plus a single layer's
-    compute-dtype copy — never a full dequantized tree (parity goal: the
-    reference's int8 inference kernels consuming quantized weights directly,
-    ``csrc/transformer/inference/csrc/dequantize.cu`` + GroupQuantizer,
-    ``module_inject/replace_module.py:144``)."""
+    ``{"q", "s"}`` leaves. The cached forward feeds them to the Pallas
+    int8-weight matmul (``ops/pallas/int8_matmul.py``): s8 stays in HBM and
+    dequantization happens per VMEM tile, so no bf16 weight buffer exists at
+    any scope (parity: the reference's int8 inference kernels consuming
+    quantized weights directly, ``csrc/transformer/inference/csrc/
+    dequantize.cu`` + GroupQuantizer, ``module_inject/replace_module.py:144``).
+    ``group_size`` defaults to 128 — the kernel needs scale runs covering
+    whole lanes; smaller groups fall back to XLA dequant-then-matmul."""
     from ..ops.quantizer import quantize
 
     L = cfg.n_layer
@@ -841,15 +863,6 @@ def quantize_for_inference(cfg: GPTConfig, params, bits: int = 8,
 
 def _is_qleaf(v) -> bool:
     return isinstance(v, dict) and set(v.keys()) == {"q", "s"}
-
-
-def _dequant_layer(w, dtype):
-    """Dequantize one scan-sliced layer's quantized leaves to ``dtype``."""
-    from ..ops.quantizer import dequantize
-
-    return {k: (dequantize(v["q"], v["s"].reshape(-1), dtype=dtype)
-                if _is_qleaf(v) else v)
-            for k, v in w.items()}
 
 
 def quantized_partition_specs(params, specs):
@@ -889,7 +902,7 @@ def attn_with_cache(cfg: GPTConfig, x, w, k_cache, v_cache, pos, layer_idx=None)
     H, Dh = cfg.n_head, cfg.head_dim
     S = k_cache.shape[2]
     h = layer_norm(x, w["ln1_scale"], w["ln1_bias"], cfg.layer_norm_eps)
-    qkv = h @ w["qkv_w"] + w["qkv_b"]
+    qkv = _wm(h, w["qkv_w"]) + w["qkv_b"]
     q, k_, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(B, T, H, Dh)
     k_ = k_.reshape(B, T, H, Dh)
@@ -937,7 +950,7 @@ def attn_with_cache(cfg: GPTConfig, x, w, k_cache, v_cache, pos, layer_idx=None)
         probs = jax.nn.softmax(logits, axis=-1)
         attn = jnp.einsum("bhts,bhsd->bthd", probs.astype(v_cache.dtype), v_cache)
         attn = attn.reshape(B, T, D).astype(x.dtype)
-    attn = attn @ w["attn_out_w"] + w["attn_out_b"]
+    attn = _wm(attn, w["attn_out_w"]) + w["attn_out_b"]
     return x + attn, k_cache, v_cache
 
 
@@ -980,8 +993,8 @@ def forward_with_cache(cfg: GPTConfig, params, input_ids: jnp.ndarray, cache):
         # int8 decode carried 11.8 GB of s8 copies — the difference between
         # fitting a 13B model in 15.75 GB HBM and OOMing at 27 GB). A
         # dynamic_index_in_dim on the leading axis reads the argument buffer
-        # in place; the barrier keeps the slice→dequant order so the bf16
-        # tree never materializes outside the loop either.
+        # in place; the {q,s} leaves then flow into the Pallas int8-weight
+        # matmuls via _wm — no bf16 weight buffer exists at any scope.
         def body(carry, layer_in):
             x, i = carry
             k_c, v_c = layer_in
@@ -989,8 +1002,8 @@ def forward_with_cache(cfg: GPTConfig, params, input_ids: jnp.ndarray, cache):
                 lambda a: jax.lax.dynamic_index_in_dim(a, i, 0,
                                                        keepdims=False),
                 blocks)
-            layer_w, _ = jax.lax.optimization_barrier((layer_w, i))
-            layer_w = _dequant_layer(layer_w, compute_dtype)
+            # {q,s} leaves flow straight into the int8-weight Pallas matmuls
+            # (_wm); no bf16 weight buffer exists at any scope
             x, k_c, v_c = _block_with_cache(cfg, x, layer_w, k_c, v_c, pos,
                                             layer_idx=i)
             return (x, i + 1), (k_c, v_c)

@@ -1,0 +1,136 @@
+"""int8-weight matmul: dequantize per VMEM tile, never in HBM.
+
+Capability parity with the reference's int8 inference GEMMs, which consume
+quantized weights directly and dequantize inside the kernel
+(``csrc/transformer/inference/csrc/dequantize.cu`` + the GEMM bindings in
+``pt_binding.cpp``). On TPU this matters twice over for decode:
+
+1. HBM CAPACITY — XLA-level dequantize-then-matmul materializes bf16 weight
+   buffers (and, measured at 13B, layout-transposed copies of the s8 stacks);
+   the kernel reads s8 straight from HBM and widens only a (block_d, block_f)
+   tile in VMEM.
+2. HBM BANDWIDTH — single-token decode is weight-bandwidth-bound, so moving
+   s8 instead of bf16 halves the bytes per step: the same lever the
+   reference's dequant-fused GEMMs pull on V100.
+
+Quantization layout matches ``ops/quantizer/quantize`` as used by
+``models/gpt.quantize_for_inference``: a weight [D, F] is flattened row-major
+and split into contiguous ``group_size`` runs, so with ``F % group_size == 0``
+the scales reshape to [D, F // group_size] — each scale covers a run along F
+within one row.
+
+Grid = (F / block_f, D / block_d): the contraction (D) axis is innermost, so
+the f32 accumulator lives in VMEM scratch across its steps; x stays whole
+(decode M = B*T is tiny) with rows padded to the 8-sublane tile.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash_attention import _interpret
+
+_LANE = 128
+_SUBLANE = 8
+
+
+def _kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, n_d: int, group: int):
+    di = pl.program_id(1)
+
+    @pl.when(di == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    w = q_ref[...].astype(jnp.float32)  # [bd, bf] s8 -> f32
+    s = s_ref[0]  # [bd, bf // group] f32 (scales pre-tiled per f-block)
+    bd, bf = w.shape
+    w = (w.reshape(bd, bf // group, group) * s[:, :, None]).reshape(bd, bf)
+    x = x_ref[...].astype(jnp.float32)  # [M, bd]
+    acc_ref[...] += jax.lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(di == n_d - 1)
+    def _out():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+_MAX_M = 256  # beyond this (large prefill) x + the f32 accumulator overflow
+# VMEM — the XLA fallback is compute-bound there anyway
+
+
+def _eligible(M: int, D: int, F: int, group: int, block_d: int,
+              block_f: int) -> bool:
+    return (M <= _MAX_M
+            and F % group == 0 and group % _LANE == 0
+            and D % block_d == 0 and F % block_f == 0
+            and block_f % group == 0)
+
+
+@functools.partial(jax.jit, static_argnames=("group", "block_d", "block_f",
+                                             "out_dtype"))
+def _int8_matmul_kernel_call(x, q, s2d, group, block_d, block_f, out_dtype):
+    M, D = x.shape
+    F = q.shape[1]
+    Mp = max(_SUBLANE, ((M + _SUBLANE - 1) // _SUBLANE) * _SUBLANE)
+    if Mp != M:
+        x = jnp.pad(x, ((0, Mp - M), (0, 0)))
+    # scales pre-tiled [F/block_f, D, block_f/group]: Mosaic requires a
+    # block's last dim to be lane-divisible OR the full array dim — the
+    # per-f-block scale tile (block_f/group columns) is only legal as a
+    # full trailing dim
+    nf = block_f // group
+    s3 = s2d.reshape(D, F // block_f, nf).transpose(1, 0, 2)
+    out = pl.pallas_call(
+        functools.partial(_kernel, n_d=D // block_d, group=group),
+        grid=(F // block_f, D // block_d),
+        in_specs=[
+            pl.BlockSpec((Mp, block_d), lambda fi, di: (0, di)),
+            pl.BlockSpec((block_d, block_f), lambda fi, di: (di, fi)),
+            pl.BlockSpec((1, block_d, nf), lambda fi, di: (fi, di, 0)),
+        ],
+        out_specs=pl.BlockSpec((Mp, block_f), lambda fi, di: (0, fi)),
+        out_shape=jax.ShapeDtypeStruct((Mp, F), out_dtype),
+        scratch_shapes=[pltpu.VMEM((Mp, block_f), jnp.float32)],
+        interpret=_interpret(),
+    )(x, q, s3)
+    return out[:M]
+
+
+def int8_matmul(x: jnp.ndarray, q: jnp.ndarray, s: jnp.ndarray,
+                group_size: int = 64, block_d: int = 256,
+                block_f: int = 512) -> jnp.ndarray:
+    """``x @ dequantize(q, s)`` without materializing the bf16 weight.
+
+    x: [M, D] (float); q: [D, F] int8; s: flat scales for row-major
+    ``group_size`` runs (``models/gpt.quantize_for_inference`` layout).
+    Falls back to XLA dequantize-then-matmul off-TPU or for ineligible
+    shapes/groupings.
+    """
+    M, D = x.shape
+    Dq, F = q.shape
+    assert D == Dq, (x.shape, q.shape)
+    block_d = min(block_d, D)
+    block_f = min(block_f, F)
+    # kernel path when: on a TPU backend, in interpret mode (tests), OR when
+    # real Mosaic lowering is forced (DS_TPU_PALLAS_INTERPRET=0 — the AOT
+    # compile-only flow targets a TPU topology from a CPU host, where
+    # default_backend() says "cpu" but the program IS for TPU)
+    import os
+
+    on_tpu = (jax.default_backend() == "tpu" or _interpret()
+              or os.environ.get("DS_TPU_PALLAS_INTERPRET") == "0")
+    if not (on_tpu and _eligible(M, D, F, group_size, block_d, block_f)):
+        # flat-group dequant (handles F % group != 0 — groups are runs of the
+        # row-major flatten, the quantizer's only real invariant)
+        w = (q.astype(jnp.float32).reshape(-1, group_size)
+             * s.astype(jnp.float32)[:, None]).reshape(D, F).astype(x.dtype)
+        return x @ w
+    s2d = s.reshape(D, F // group_size).astype(jnp.float32)
+    return _int8_matmul_kernel_call(x, q, s2d, group_size, block_d, block_f,
+                                    x.dtype)

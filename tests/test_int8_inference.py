@@ -1,9 +1,9 @@
 """int8 inference that actually saves memory (VERDICT r2 'next' #5 / weak #5).
 
 The per-layer path stores the block stacks as int8 ``{"q","s"}`` leaves and
-dequantizes ONE layer inside the decode scan (models/gpt.py
-``quantize_for_inference`` + ``_dequant_layer``), so the compiled program never
-materializes a full dequantized weight tree. Parity: the reference's int8
+feeds them to the Pallas int8-weight matmul (``ops/pallas/int8_matmul.py``)
+inside the decode scan — dequantization happens per VMEM tile, so the compiled
+program never materializes a full dequantized weight tree. Parity: the reference's int8
 inference kernels consume quantized weights directly
 (``csrc/transformer/inference/csrc/dequantize.cu``).
 """

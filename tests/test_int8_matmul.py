@@ -1,0 +1,88 @@
+"""Pallas int8-weight matmul (ops/pallas/int8_matmul.py).
+
+Parity target: the reference's dequant-fused inference GEMMs
+(``csrc/transformer/inference/csrc/dequantize.cu`` + pt_binding GEMMs) —
+s8 weights consumed directly, dequantized per tile, never materialized.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.pallas.int8_matmul import int8_matmul
+from deepspeed_tpu.ops.quantizer import quantize
+
+
+def _ref(x, q, s, group):
+    D, F = q.shape
+    w = (np.asarray(q, np.float32).reshape(-1, group)
+         * np.asarray(s, np.float32)[:, None]).reshape(D, F)
+    return np.asarray(x, np.float32) @ w
+
+
+@pytest.mark.parametrize("M,D,F,group", [
+    (1, 256, 512, 128),     # decode-shaped GEMV
+    (8, 512, 1536, 128),    # b8 qkv-shaped
+    (5, 256, 512, 128),     # ragged M (sublane padding)
+    (2, 256, 512, 256),     # coarser groups
+])
+def test_matches_dequant_reference(M, D, F, group):
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    x = jax.random.normal(k1, (M, D), jnp.float32)
+    w = jax.random.normal(k2, (D, F), jnp.float32)
+    q, s = quantize(w, bits=8, num_groups=(D * F) // group)
+    out = int8_matmul(x, q, s, group_size=group)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               _ref(x, q, s, group), rtol=2e-2, atol=2e-2)
+
+
+def test_ineligible_group_falls_back():
+    # group 64 < lane width: must fall back to XLA dequant (still correct)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+    x = jax.random.normal(k1, (2, 128), jnp.float32)
+    w = jax.random.normal(k2, (128, 256), jnp.float32)
+    q, s = quantize(w, bits=8, num_groups=(128 * 256) // 64)
+    out = int8_matmul(x, q, s, group_size=64)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               _ref(x, q, s, 64), rtol=2e-2, atol=2e-2)
+
+
+def test_bf16_activation_dtype_out():
+    k1, k2 = jax.random.split(jax.random.PRNGKey(2))
+    x = jax.random.normal(k1, (8, 256), jnp.bfloat16)
+    w = jax.random.normal(k2, (256, 512), jnp.float32)
+    q, s = quantize(w, bits=8, num_groups=(256 * 512) // 128)
+    out = int8_matmul(x, q, s, group_size=128)
+    assert out.dtype == jnp.bfloat16 and out.shape == (8, 512)
+
+
+def test_ragged_F_group_flat_fallback():
+    # F % group != 0 (d_model=320-style): flat-group dequant must handle it
+    k1, k2 = jax.random.split(jax.random.PRNGKey(3))
+    D, F, group = 320, 960, 128
+    x = jax.random.normal(k1, (2, D), jnp.float32)
+    w = jax.random.normal(k2, (D, F), jnp.float32)
+    q, s = quantize(w, bits=8, num_groups=(D * F) // group)
+    out = int8_matmul(x, q, s, group_size=group)
+    ref = (np.asarray(q, np.float32).reshape(-1, group)
+           * np.asarray(s, np.float32)[:, None]).reshape(D, F)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(x, np.float32) @ ref,
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_large_M_falls_back():
+    # prefill-sized M must not route into the VMEM-resident kernel
+    from deepspeed_tpu.ops.pallas import int8_matmul as mod
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(4))
+    D, F, group, M = 256, 512, 128, 1024
+    assert M > mod._MAX_M
+    x = jax.random.normal(k1, (M, D), jnp.float32)
+    w = jax.random.normal(k2, (D, F), jnp.float32)
+    q, s = quantize(w, bits=8, num_groups=(D * F) // group)
+    out = int8_matmul(x, q, s, group_size=group)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               _ref(x, q, s, group), rtol=2e-2, atol=2e-2)
