@@ -38,6 +38,14 @@ os.environ.setdefault(
 PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
 PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
 WORKER_TIMEOUT = int(os.environ.get("BENCH_WORKER_TIMEOUT", "1200"))
+# mid-sweep tunnel-recovery probing (VERDICT r4 'next' #6): while the sweep is
+# running on the CPU fallback, re-probe the real backend between rows so a
+# tunnel that comes back MID-run is caught by the driver itself — no builder
+# orchestrator needed. Each probe is a watchdogged subprocess; a down tunnel
+# costs RECOVERY_PROBE_TIMEOUT once per RECOVERY_PROBE_EVERY seconds, capped.
+RECOVERY_PROBE_EVERY = int(os.environ.get("BENCH_RECOVERY_EVERY", "300"))
+RECOVERY_PROBE_TIMEOUT = int(os.environ.get("BENCH_RECOVERY_TIMEOUT", "90"))
+MAX_RECOVERY_PROBES = int(os.environ.get("BENCH_MAX_RECOVERY_PROBES", "8"))
 # partial-sweep ledger: every completed config row is appended here the moment
 # it finishes, so a mid-sweep tunnel drop can never zero a round's evidence
 # (round-3 post-mortem: the whole r3 sweep died with the tunnel and left no
@@ -204,6 +212,22 @@ def probe_backend() -> tuple:
     return "cpu", 1, errors
 
 
+def quick_probe(timeout: int = RECOVERY_PROBE_TIMEOUT) -> bool:
+    """One fast watchdogged matmul probe; True only if a non-CPU device
+    answered. Used between fallback rows to catch a mid-sweep tunnel
+    recovery (a down tunnel hangs rather than erroring, hence the timeout)."""
+    code = ("import jax, jax.numpy as jnp; d = jax.devices(); "
+            "x = jnp.ones((256,256), jnp.bfloat16); (x@x).block_until_ready(); "
+            "print('PLATFORM=%s' % d[0].platform)")
+    try:
+        p = subprocess.run([sys.executable, "-c", code], timeout=timeout,
+                           capture_output=True, text=True, cwd=REPO)
+        return (p.returncode == 0 and "PLATFORM=" in p.stdout
+                and p.stdout.split("PLATFORM=")[1].split()[0] != "cpu")
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def run_worker(cfg: dict, platform: str, retries: int = 1):
     """Run one benchmark config in a subprocess; returns parsed JSON or error dict."""
     if cfg.get("force_cpu"):
@@ -243,6 +267,7 @@ def _worker(cfg: dict) -> None:
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
     fn = {"train": _worker_train, "inference": _worker_infer,
+          "moe_train": _worker_moe_train,
           "kernels": _worker_kernels, "diffusion": _worker_diffusion,
           "pipeline_aot": _worker_pipeline_aot,
           "pipeline_mpmd": _worker_pipeline_mpmd,
@@ -438,6 +463,75 @@ def _worker_train(cfg: dict) -> dict:
                                        "n_params", "wire_bytes_per_step")
                              if k in runner.last_stats}
     return out
+
+
+def _worker_moe_train(cfg: dict) -> dict:
+    """Measured MoE training step (VERDICT r4 'next' #5): GShard top-k gating +
+    expert bank through the full engine step on the real device. Single-chip
+    ep=1 keeps the whole expert bank resident; the gating/dispatch einsums are
+    identical to the ep>1 program (moe/sharded_moe.py), so step time here is
+    the per-chip compute term of BASELINE config #4 (the reference measures
+    this path in ``DeepSpeed-MoE``, deepspeed/moe/sharded_moe.py)."""
+    import numpy as np
+
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import build_gpt_moe
+
+    platform = jax.devices()[0].platform
+    model, mcfg = build_gpt_moe(cfg.get("model", "moe-125m-8e"))
+    micro_bs, seq = int(cfg["micro_bs"]), int(cfg["seq"])
+    steps = int(cfg.get("steps", 5))
+    n_chips = len(jax.devices())
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        config={
+            "train_micro_batch_size_per_gpu": micro_bs,
+            "optimizer": {"type": "AdamW",
+                          "params": {"lr": 3e-4, "weight_decay": 0.1}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": cfg.get("stage", 1)},
+            "gradient_clipping": 1.0,
+            "steps_per_print": 0,
+        })
+    b = mcfg.base
+    rng = np.random.default_rng(0)
+
+    def make_batch():
+        # global batch rides the dp mesh axis, micro_bs per chip (as
+        # _worker_train does) so tokens/sec/chip stays per-chip truth
+        return {"input_ids": rng.integers(
+            0, b.vocab_size, size=(micro_bs * n_chips, seq), dtype=np.int32)}
+
+    m = engine.train_batch(make_batch())  # warmup/compile
+    float(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        m = engine.train_batch(make_batch())
+    float(m["loss"])
+    dt = time.perf_counter() - t0
+
+    # MFU over ACTIVE FLOPs/token: attention + dense MLPs + gate + the k
+    # routed expert FFNs (a dropped-token step does fewer — this is the upper
+    # bound the capacity factor allows, the standard MoE-MFU convention)
+    d, L, ff = b.d_model, b.n_layer, b.ffn_dim
+    n_super = mcfg.n_super
+    active = (L * 4 * d * d + (L - n_super) * 2 * d * ff
+              + n_super * (mcfg.k * 2 * d * ff + d * mcfg.num_experts)
+              + d * b.vocab_size)
+    flops_per_token = 6 * active + 12 * L * d * seq
+    tok = steps * micro_bs * n_chips * (seq - 1) / dt / n_chips
+    mfu = tok * flops_per_token / peak_flops_per_chip(platform)
+    return {
+        "config": cfg["name"], "kind": "moe_train", "platform": platform,
+        "model": cfg.get("model", "moe-125m-8e"),
+        "num_experts": mcfg.num_experts, "k": mcfg.k,
+        "micro_bs": micro_bs, "seq": seq, "chips": n_chips,
+        "tokens_per_sec_chip": round(tok, 1), "mfu": round(mfu, 4),
+        "step_ms": round(dt / steps * 1e3, 1),
+        "loss": round(float(m["loss"]), 4),
+    }
 
 
 def _worker_infer(cfg: dict) -> dict:
@@ -1073,6 +1167,94 @@ def _worker_pipeline_mpmd(cfg: dict) -> dict:
 
 # ---------------------------------------------------------------- parent side
 
+def tpu_core_configs() -> list:
+    """The measured TPU sweep (order = evidence priority) + AOT fit rows."""
+    model = os.environ.get("BENCH_MODEL", "gpt2-350m")
+    bs = int(os.environ.get("BENCH_BS", "16"))
+    seq = int(os.environ.get("BENCH_SEQ", "1024"))
+    # k_steps=8 + fewer outer dispatches: same measured optimizer steps,
+    # 1/8th the dispatches — the per-dispatch tunnel RTT (~350ms, r4
+    # measured) otherwise reads as fake MFU loss. k_steps (full steps
+    # scanned in-program) not gas: the gas-8 fp32 accumulator AOT-OOMs
+    # the lead 760M geometries.
+    steps = int(os.environ.get("BENCH_STEPS", "5"))
+    kst = int(os.environ.get("BENCH_K_STEPS", "8"))
+    big = os.environ.get("BENCH_BIG_MODEL", "gpt2-760m")
+    big_bs = int(os.environ.get("BENCH_BIG_BS", "16"))
+    # Compiles on this setup run 10-25+ min per NEW program (r4 measured:
+    # 3 of 4 chunk-loss grid rows died on compile, not execution), so the
+    # DEFAULT sweep is the completable high-value core; BENCH_FULL=1
+    # restores the wide grid. Row order = evidence priority.
+    full = os.environ.get("BENCH_FULL", "0") == "1"
+    return [
+        {"kind": "kernels", "name": "pallas-kernel-smoke"},
+        # the two strongest measured train rows (r4 chip grid), k8-fused
+        {"kind": "train", "name": f"{big}-zero1-selrm12", "model": big,
+         "micro_bs": 12, "seq": seq, "stage": 1, "steps": steps,
+         "k_steps": kst, "timeout": 2700,
+         "remat_policy": "save_attn_mlp_out"},
+        {"kind": "train", "name": f"{model}-zero1", "model": model,
+         "micro_bs": bs, "seq": seq, "stage": 1,
+         "steps": steps, "k_steps": kst, "timeout": 2700,
+         "remat_policy": "save_attn_mlp_out"},
+        {"kind": "inference", "name": f"{model}-decode", "model": model,
+         "batch": 1, "prompt": 128, "gen": 64, "timeout": 2700},
+        # batched decode: amortized per-token throughput
+        {"kind": "inference", "name": f"{model}-decode-b8", "model": model,
+         "batch": 8, "prompt": 128, "gen": 64, "timeout": 2700},
+        {"kind": "diffusion", "name": "sd-ddim20", "latent": 32,
+         "ddim_steps": 20, "timeout": 2700},
+        # measured MoE row (VERDICT r4 next #5): single-chip expert bank,
+        # same gating/dispatch program as ep>1
+        {"kind": "moe_train", "name": "moe-125m-8e-train",
+         "model": "moe-125m-8e", "micro_bs": 8, "seq": seq, "steps": steps,
+         "timeout": 2700},
+        # chunked loss drops the fp32 logits buffer — AOT-verified to fit
+        # where unchunked OOMs; longest compile, so last of the core rows
+        {"kind": "train", "name": f"{big}-zero1-selrm16-chunk",
+         "model": big, "micro_bs": 16, "seq": seq, "stage": 1,
+         "steps": steps, "k_steps": kst, "timeout": 2700,
+         "remat_policy": "save_attn_mlp_out", "loss_chunk": 128},
+    ] + (([
+        {"kind": "train", "name": f"{model}-zero{s}", "model": model,
+         "micro_bs": bs, "seq": seq, "stage": s, "steps": steps,
+         "k_steps": kst, "timeout": 2700}
+        for s in (2, 3)
+    ] + [
+        {"kind": "train", "name": f"{big}-zero{s}", "model": big,
+         "micro_bs": big_bs, "seq": seq, "stage": s, "steps": steps,
+         "k_steps": kst, "timeout": 2700}
+        for s in (1, 3)
+    ] + [
+        {"kind": "train", "name": f"{big}-zero1-bs24-chunk", "model": big,
+         "micro_bs": 24, "seq": seq, "stage": 1, "steps": steps,
+         "k_steps": kst, "loss_chunk": 128, "timeout": 2700},
+    ]) if full else []) + (
+        # pipeline_aot + AOT rows are force_cpu (host-side v5e compiler):
+        # cheap chip-independent fit evidence; pipeline_mpmd is a short
+        # on-chip dispatch microbench. Infinity rows (long, host-streamed)
+        # only under BENCH_FULL.
+        PIPELINE_CONFIGS + AOT_TRAIN_CONFIGS
+        + (INFINITY_CONFIGS if full else []))
+
+
+def cpu_fallback_configs() -> list:
+    """Forced-CPU fallback: tiny measured shapes + chip-independent AOT rows.
+
+    The measured rows carry force_cpu explicitly: they are forced-CPU
+    measurements BY DESIGN, so a mid-sweep tunnel recovery (which flips the
+    run's platform to tpu) cannot silently re-route a still-queued
+    'cpu-fallback-*' row onto the real backend and mislabel it as evidence."""
+    return [
+        {"kind": "train", "name": f"cpu-fallback-zero{s}", "model": "gpt2-125m",
+         "micro_bs": 2, "seq": 128, "stage": s, "steps": 3, "force_cpu": True}
+        for s in (1, 2)
+    ] + [{"kind": "inference", "name": "cpu-fallback-decode", "model": "gpt2-125m",
+          "batch": 1, "prompt": 32, "gen": 16, "reps": 3, "force_cpu": True},
+         # real-TPU-compiler evidence even when the tunnel is down
+         PIPELINE_CONFIGS[0]] + AOT_TRAIN_CONFIGS
+
+
 def main() -> None:
     platform, n_chips, probe_errors = probe_backend()
     for e in probe_errors:
@@ -1082,82 +1264,17 @@ def main() -> None:
     _persist_row({"run_start": True, "platform": platform, "argv": sys.argv[1:],
                   "probe_errors": probe_errors[-2:]})
 
-    if platform == "tpu":
-        model = os.environ.get("BENCH_MODEL", "gpt2-350m")
-        bs = int(os.environ.get("BENCH_BS", "16"))
-        seq = int(os.environ.get("BENCH_SEQ", "1024"))
-        # k_steps=8 + fewer outer dispatches: same measured optimizer steps,
-        # 1/8th the dispatches — the per-dispatch tunnel RTT (~350ms, r4
-        # measured) otherwise reads as fake MFU loss. k_steps (full steps
-        # scanned in-program) not gas: the gas-8 fp32 accumulator AOT-OOMs
-        # the lead 760M geometries.
-        steps = int(os.environ.get("BENCH_STEPS", "5"))
-        kst = int(os.environ.get("BENCH_K_STEPS", "8"))
-        big = os.environ.get("BENCH_BIG_MODEL", "gpt2-760m")
-        big_bs = int(os.environ.get("BENCH_BIG_BS", "16"))
-        # Compiles on this setup run 10-25+ min per NEW program (r4 measured:
-        # 3 of 4 chunk-loss grid rows died on compile, not execution), so the
-        # DEFAULT sweep is the completable high-value core; BENCH_FULL=1
-        # restores the wide grid. Row order = evidence priority.
-        full = os.environ.get("BENCH_FULL", "0") == "1"
-        configs = [
-            {"kind": "kernels", "name": "pallas-kernel-smoke"},
-            # the two strongest measured train rows (r4 chip grid), k8-fused
-            {"kind": "train", "name": f"{big}-zero1-selrm12", "model": big,
-             "micro_bs": 12, "seq": seq, "stage": 1, "steps": steps,
-             "k_steps": kst, "timeout": 2700,
-             "remat_policy": "save_attn_mlp_out"},
-            {"kind": "train", "name": f"{model}-zero1", "model": model,
-             "micro_bs": bs, "seq": seq, "stage": 1,
-             "steps": steps, "k_steps": kst, "timeout": 2700,
-             "remat_policy": "save_attn_mlp_out"},
-            {"kind": "inference", "name": f"{model}-decode", "model": model,
-             "batch": 1, "prompt": 128, "gen": 64, "timeout": 2700},
-            # batched decode: amortized per-token throughput
-            {"kind": "inference", "name": f"{model}-decode-b8", "model": model,
-             "batch": 8, "prompt": 128, "gen": 64, "timeout": 2700},
-            {"kind": "diffusion", "name": "sd-ddim20", "latent": 32,
-             "ddim_steps": 20, "timeout": 2700},
-            # chunked loss drops the fp32 logits buffer — AOT-verified to fit
-            # where unchunked OOMs; longest compile, so last of the core rows
-            {"kind": "train", "name": f"{big}-zero1-selrm16-chunk",
-             "model": big, "micro_bs": 16, "seq": seq, "stage": 1,
-             "steps": steps, "k_steps": kst, "timeout": 2700,
-             "remat_policy": "save_attn_mlp_out", "loss_chunk": 128},
-        ] + (([
-            {"kind": "train", "name": f"{model}-zero{s}", "model": model,
-             "micro_bs": bs, "seq": seq, "stage": s, "steps": steps,
-             "k_steps": kst, "timeout": 2700}
-            for s in (2, 3)
-        ] + [
-            {"kind": "train", "name": f"{big}-zero{s}", "model": big,
-             "micro_bs": big_bs, "seq": seq, "stage": s, "steps": steps,
-             "k_steps": kst, "timeout": 2700}
-            for s in (1, 3)
-        ] + [
-            {"kind": "train", "name": f"{big}-zero1-bs24-chunk", "model": big,
-             "micro_bs": 24, "seq": seq, "stage": 1, "steps": steps,
-             "k_steps": kst, "loss_chunk": 128, "timeout": 2700},
-        ]) if full else []) + (
-            # pipeline_aot + AOT rows are force_cpu (host-side v5e compiler):
-            # cheap chip-independent fit evidence; pipeline_mpmd is a short
-            # on-chip dispatch microbench. Infinity rows (long, host-streamed)
-            # only under BENCH_FULL.
-            PIPELINE_CONFIGS + AOT_TRAIN_CONFIGS
-            + (INFINITY_CONFIGS if full else []))
-    else:
-        # forced-CPU fallback: tiny shapes, still real measurements
-        configs = [
-            {"kind": "train", "name": f"cpu-fallback-zero{s}", "model": "gpt2-125m",
-             "micro_bs": 2, "seq": 128, "stage": s, "steps": 3}
-            for s in (1, 2)
-        ] + [{"kind": "inference", "name": "cpu-fallback-decode", "model": "gpt2-125m",
-              "batch": 1, "prompt": 32, "gen": 16, "reps": 3},
-             # real-TPU-compiler evidence even when the tunnel is down
-             PIPELINE_CONFIGS[0]] + AOT_TRAIN_CONFIGS
+    configs = list(tpu_core_configs() if platform == "tpu"
+                   else cpu_fallback_configs())
 
     sweep, errors = [], list(probe_errors)
-    for cfg in configs:
+    recovered = False
+    recovery_probes = 0
+    last_probe_t = time.time()
+    i = 0
+    while i < len(configs):
+        cfg = configs[i]
+        i += 1
         r = run_worker(cfg, platform)
         sweep.append(r)
         _persist_row(r)
@@ -1169,23 +1286,96 @@ def main() -> None:
         # still a valid summary of everything measured so far
         print(json.dumps(_summarize(platform, sweep, errors)), flush=True)
 
+        # VERDICT r4 'next' #6: a tunnel that comes back MID-sweep must be
+        # caught by the driver run itself. While on the fallback, re-probe
+        # between rows (rate-limited, watchdogged); on recovery, splice the
+        # cache-warmed measured TPU rows in RIGHT AFTER the current row so
+        # they run before the tunnel can flap again.
+        if (platform == "cpu" and not recovered
+                and recovery_probes < MAX_RECOVERY_PROBES
+                and time.time() - last_probe_t > RECOVERY_PROBE_EVERY):
+            recovery_probes += 1
+            last_probe_t = time.time()
+            if quick_probe():
+                recovered = True
+                platform = "tpu"
+                measured = [c for c in tpu_core_configs()
+                            if not c.get("force_cpu")]
+                configs[i:i] = measured
+                note = {"recovery": True, "after_rows": len(sweep),
+                        "spliced_rows": [c["name"] for c in measured]}
+                _persist_row(note)
+                print(f"[bench] tunnel recovered mid-sweep: {json.dumps(note)}",
+                      file=sys.stderr)
+
     print(json.dumps(_summarize(platform, sweep, errors)))
 
 
+# chip-evidence sources, newest first (module-level so tests can pin one)
+CHIP_EVIDENCE_SOURCES = [
+    (os.path.join(REPO, "window_run_results.json"),
+     "window_run_results.json (in-round tunnel-window orchestrator, "
+     "scripts/window_run.py)"),
+    (os.path.join(REPO, "docs", "CHIP_SESSION_r04_window1.json"),
+     "docs/CHIP_SESSION_r04_window1.json (tunnel window 2026-07-31 "
+     "03:45-06:50Z, 10 dispatches/row incl. ~350ms RTT each)"),
+]
+
+
+def _load_chip_evidence(sources=None):
+    """Newest chip-measured rows available on disk: this round's tunnel-watch
+    orchestrator ledger first (window_run_results.json), else the last
+    committed chip-session doc. Returns (rows, source_label, kernel_ok) or
+    (None, None, None); kernel_ok is None when the source carries no
+    kernel-smoke row (unknown, not failed)."""
+    for path, label in (sources or CHIP_EVIDENCE_SOURCES):
+        try:
+            with open(path) as f:
+                chip = json.load(f)
+        except (OSError, ValueError):
+            continue
+        rows = []
+        for c in chip:
+            res = c.get("result") or {}
+            if c.get("rc") != 0 or not isinstance(res, dict):
+                continue
+            if res.get("platform") == "cpu":
+                continue  # a fallback row is not chip evidence
+            keep = {k: res[k] for k in
+                    ("mfu", "step_ms", "tok_s", "tokens_per_sec_chip",
+                     "decode_p50_ms", "decode_p90_ms", "tokens_per_sec",
+                     "image_ms_p50")
+                    if k in res}
+            if any(k in keep for k in ("mfu", "decode_p50_ms",
+                                       "image_ms_p50")):
+                rows.append({"tag": c["tag"], **keep})
+        if rows:
+            kernel_rows = [c for c in chip
+                           if "kernel" in str(c.get("tag", ""))]
+            kernel_ok = (any(c.get("rc") == 0 for c in kernel_rows)
+                         if kernel_rows else None)
+            return rows, label, kernel_ok
+    return None, None, None
+
+
 def _summarize(platform: str, sweep: list, errors: list) -> dict:
-    train_ok = [r for r in sweep if r.get("kind") == "train" and "error" not in r]
+    train_ok = [r for r in sweep if r.get("kind") in ("train", "moe_train")
+                and "error" not in r]
     infer_ok = [r for r in sweep if r.get("kind") == "inference" and "error" not in r]
     result = {"platform": platform, "sweep": sweep}
     if errors:
         result["errors"] = errors[-4:]
     if train_ok:
         best = max(train_ok, key=lambda r: r.get("mfu", 0.0))
+        # vs_baseline from the ROW's platform, not the sweep's: a tunnel that
+        # recovered mid-sweep yields real TPU rows inside a "cpu" run
         result.update({
             "metric": f"{best['config']} bf16 training tokens/sec/chip",
             "value": best["tokens_per_sec_chip"],
             "unit": "tokens/sec/chip",
             "vs_baseline": (round(best["mfu"] / 0.45, 3)
-                            if platform == "tpu" else 0.0),
+                            if best.get("platform") not in (None, "cpu")
+                            else 0.0),
             "mfu": best["mfu"],
         })
     else:
@@ -1211,41 +1401,41 @@ def _summarize(platform: str, sweep: list, errors: list) -> dict:
              "kernels_ok": (all(k.get("ok") for k in r["kernels"].values())
                             if "kernels" in r else None)}
             for r in aot_rows]
-    if platform != "tpu":
-        # CPU fallback during a tunnel outage: attach the CHIP-measured rows
-        # this round's 03:45-06:50Z window banked (committed evidence,
-        # docs/CHIP_SESSION_r04_window1.json) so the round artifact still
-        # carries real-TPU numbers — clearly labeled with their source
-        try:
-            with open(os.path.join(
-                    REPO, "docs", "CHIP_SESSION_r04_window1.json")) as f:
-                chip = json.load(f)
-            rows = [dict(tag=c["tag"], **{k: c["result"][k] for k in
-                                          ("mfu", "step_ms", "tok_s")
-                                          if k in (c.get("result") or {})})
-                    for c in chip
-                    if c.get("rc") == 0 and (c.get("result") or {}).get("mfu")]
-            if rows:
-                best = max(rows, key=lambda r: r["mfu"])
-                result["chip_window_evidence"] = {
-                    "source": "docs/CHIP_SESSION_r04_window1.json "
-                              "(tunnel window 2026-07-31 03:45-06:50Z, "
-                              "10 dispatches/row incl. ~350ms RTT each)",
-                    "rows": rows,
-                    "kernel_smoke_ok": any(
-                        c["tag"] == "kernel-smoke" and c.get("rc") == 0
-                        for c in chip),
-                }
+    measured_tpu_train = any(r.get("platform") not in (None, "cpu")
+                             for r in train_ok)
+    if not measured_tpu_train:
+        # No driver-measured TPU train row this run (tunnel outage): attach
+        # the newest CHIP-measured rows on disk — this round's tunnel-watch
+        # orchestrator ledger if it ran, else the last committed window doc —
+        # clearly labeled with their source.
+        rows, src, kernel_ok = _load_chip_evidence()
+        if rows:
+            result["chip_window_evidence"] = {
+                "source": src, "rows": rows, "kernel_smoke_ok": kernel_ok}
+            train_rows = [r for r in rows if "mfu" in r
+                          and ("tok_s" in r or "tokens_per_sec_chip" in r)]
+            if train_rows:
+                best = max(train_rows, key=lambda r: r["mfu"])
+                sweep_note = ("sweep below ran on cpu fallback"
+                              if platform == "cpu"
+                              else "this tpu sweep's train rows failed")
                 result.update({
                     "metric": f"{best['tag']} bf16 training (chip-measured "
-                              "in-round window; sweep below ran on cpu "
-                              "fallback)",
-                    "value": best["tok_s"], "unit": "tokens/sec/chip",
+                              f"in-round window; {sweep_note})",
+                    "value": best.get("tok_s",
+                                      best.get("tokens_per_sec_chip")),
+                    "unit": "tokens/sec/chip",
                     "mfu": best["mfu"],
                     "vs_baseline": round(best["mfu"] / 0.45, 3),
                 })
-        except (OSError, ValueError, KeyError):
-            pass
+            dec = next((r for r in rows if "decode_p50_ms" in r), None)
+            if dec and "decode_p50_ms" not in result:
+                result["decode_p50_ms"] = dec["decode_p50_ms"]
+                result["decode_source"] = "chip_window"
+            sd = next((r for r in rows if "image_ms_p50" in r), None)
+            if sd and "sd_image_ms_p50" not in result:
+                result["sd_image_ms_p50"] = sd["image_ms_p50"]
+                result["sd_source"] = "chip_window"
     return result
 
 

@@ -105,9 +105,13 @@ def main():
          "policy": "save_attn_mlp_out", "loss_chunk": 128, "k_steps": 8,
          "steps": 4, "tag": "760m-selrm16-chunkloss-k8"}, timeout=2700)
 
-    # 6. batched decode, int8 HBM evidence, MPMD dispatch microbench
+    # 6. batched decode, measured MoE (VERDICT r4 next #5), int8 HBM
+    # evidence, MPMD dispatch microbench
     bench({"kind": "inference", "name": "gpt2-350m-decode-b8",
            "model": "gpt2-350m", "batch": 8, "prompt": 128, "gen": 64})
+    bench({"kind": "moe_train", "name": "moe-125m-8e-train",
+           "model": "moe-125m-8e", "micro_bs": 8, "seq": 1024, "steps": 5},
+          timeout=2700)
     run("int8-hbm", [sys.executable,
                      os.path.join(REPO, "scripts", "int8_hbm.py")], 2400)
     bench({"kind": "pipeline_mpmd", "name": "pipeline-mpmd-dispatch"})
