@@ -182,6 +182,14 @@ def _cpu_env(env: dict, n_devices: int = 1) -> dict:
     return _force_cpu_env(n_devices, env)
 
 
+# the one probe program: a real matmul, so 'initialized' means 'usable'
+# (shared by startup probing and mid-sweep recovery probing — keep in sync)
+_PROBE_CODE = (
+    "import jax, jax.numpy as jnp; d = jax.devices(); "
+    "x = jnp.ones((256,256), jnp.bfloat16); (x@x).block_until_ready(); "
+    "print('PLATFORM=%s NCHIPS=%d' % (d[0].platform, len(d)))")
+
+
 def probe_backend() -> tuple:
     """Return ("tpu", n_chips) if a real accelerator initializes, else ("cpu", 1).
 
@@ -189,9 +197,7 @@ def probe_backend() -> tuple:
     does one real matmul so 'initialized' means 'usable', not just 'registered'.
     A backend whose devices are CPU counts as the fallback, not the target.
     """
-    code = ("import jax, jax.numpy as jnp; d = jax.devices(); "
-            "x = jnp.ones((256,256), jnp.bfloat16); (x@x).block_until_ready(); "
-            "print('PLATFORM=%s NCHIPS=%d' % (d[0].platform, len(d)))")
+    code = _PROBE_CODE
     errors = []
     for attempt in range(PROBE_ATTEMPTS):
         try:
@@ -216,12 +222,10 @@ def quick_probe(timeout: int = RECOVERY_PROBE_TIMEOUT) -> bool:
     """One fast watchdogged matmul probe; True only if a non-CPU device
     answered. Used between fallback rows to catch a mid-sweep tunnel
     recovery (a down tunnel hangs rather than erroring, hence the timeout)."""
-    code = ("import jax, jax.numpy as jnp; d = jax.devices(); "
-            "x = jnp.ones((256,256), jnp.bfloat16); (x@x).block_until_ready(); "
-            "print('PLATFORM=%s' % d[0].platform)")
     try:
-        p = subprocess.run([sys.executable, "-c", code], timeout=timeout,
-                           capture_output=True, text=True, cwd=REPO)
+        p = subprocess.run([sys.executable, "-c", _PROBE_CODE],
+                           timeout=timeout, capture_output=True, text=True,
+                           cwd=REPO)
         return (p.returncode == 0 and "PLATFORM=" in p.stdout
                 and p.stdout.split("PLATFORM=")[1].split()[0] != "cpu")
     except subprocess.TimeoutExpired:
@@ -1329,32 +1333,38 @@ def _load_chip_evidence(sources=None):
     (None, None, None); kernel_ok is None when the source carries no
     kernel-smoke row (unknown, not failed)."""
     for path, label in (sources or CHIP_EVIDENCE_SOURCES):
+        # malformed evidence must degrade to "no evidence", never crash the
+        # sweep driver (this runs inside _summarize after EVERY row)
         try:
             with open(path) as f:
                 chip = json.load(f)
-        except (OSError, ValueError):
-            continue
-        rows = []
-        for c in chip:
-            res = c.get("result") or {}
-            if c.get("rc") != 0 or not isinstance(res, dict):
+            if not isinstance(chip, list):
                 continue
-            if res.get("platform") == "cpu":
-                continue  # a fallback row is not chip evidence
-            keep = {k: res[k] for k in
-                    ("mfu", "step_ms", "tok_s", "tokens_per_sec_chip",
-                     "decode_p50_ms", "decode_p90_ms", "tokens_per_sec",
-                     "image_ms_p50")
-                    if k in res}
-            if any(k in keep for k in ("mfu", "decode_p50_ms",
-                                       "image_ms_p50")):
-                rows.append({"tag": c["tag"], **keep})
-        if rows:
-            kernel_rows = [c for c in chip
-                           if "kernel" in str(c.get("tag", ""))]
-            kernel_ok = (any(c.get("rc") == 0 for c in kernel_rows)
-                         if kernel_rows else None)
-            return rows, label, kernel_ok
+            rows = []
+            for c in chip:
+                if not isinstance(c, dict):
+                    continue
+                res = c.get("result") or {}
+                if c.get("rc") != 0 or not isinstance(res, dict):
+                    continue
+                if res.get("platform") == "cpu":
+                    continue  # a fallback row is not chip evidence
+                keep = {k: res[k] for k in
+                        ("mfu", "step_ms", "tok_s", "tokens_per_sec_chip",
+                         "decode_p50_ms", "decode_p90_ms", "tokens_per_sec",
+                         "image_ms_p50")
+                        if k in res}
+                if any(k in keep for k in ("mfu", "decode_p50_ms",
+                                           "image_ms_p50")):
+                    rows.append({"tag": c.get("tag", "?"), **keep})
+            if rows:
+                kernel_rows = [c for c in chip if isinstance(c, dict)
+                               and "kernel" in str(c.get("tag", ""))]
+                kernel_ok = (any(c.get("rc") == 0 for c in kernel_rows)
+                             if kernel_rows else None)
+                return rows, label, kernel_ok
+        except (OSError, ValueError, KeyError, TypeError):
+            continue
     return None, None, None
 
 

@@ -88,7 +88,10 @@ def run_one(spec: dict) -> dict:
     n_params = mcfg.num_params()
     fpt = 6 * n_params + 12 * mcfg.n_layer * mcfg.d_model * seq
     mfu = tok * fpt / (197e12 * jax.device_count())  # v5e bf16 peak per chip
-    return {**spec, "step_ms": round(dt / (steps * k_steps) * 1e3, 1),
+    # platform lets evidence consumers (bench._load_chip_evidence) reject a
+    # CPU-run row as chip evidence
+    return {**spec, "platform": jax.devices()[0].platform,
+            "step_ms": round(dt / (steps * k_steps) * 1e3, 1),
             "tok_s": round(tok, 1), "mfu": round(mfu, 4),
             "peak_hbm_gb": round(peak_gb, 2)}
 
