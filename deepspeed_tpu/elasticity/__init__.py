@@ -1,6 +1,9 @@
 from .elasticity import (  # noqa: F401
+    ELASTICITY_CONFIG_ENV,
     ElasticityError,
     compute_elastic_config,
+    elasticity_enabled,
+    ensure_immutable_elastic_config,
     get_candidate_batch_sizes,
     get_valid_gpus,
 )

@@ -25,13 +25,16 @@ supervisor that
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
 import subprocess
 import sys
 import time
 from typing import Callable, List, Optional, Sequence
 
 from ..utils.logging import logger
-from .elasticity import ElasticityError, compute_elastic_config
+from .elasticity import (ELASTICITY_CONFIG_ENV, ElasticityError,
+                         compute_elastic_config)
 
 
 def probe_device_count(timeout: float = 120.0) -> int:
@@ -123,7 +126,13 @@ class DSElasticAgent:
                 f"elastic agent: launching worker (attempt {restarts + 1}): "
                 f"world={spec.world_size} micro={spec.micro_batch} "
                 f"gas={spec.gas} global_batch={spec.global_batch}")
-            proc = subprocess.Popen(argv)
+            # export the fingerprint the worker's runtime must match
+            # (ensure_immutable_elastic_config, elasticity.py) — the agent IS
+            # the resource scheduler here
+            env = dict(os.environ)
+            env[ELASTICITY_CONFIG_ENV] = json.dumps(
+                {"elasticity": dict(self.ds_config.get("elasticity", {}))})
+            proc = subprocess.Popen(argv, env=env)
             rc = self._watch(proc, launched_world=world)
             if rc == 0:
                 logger.info("elastic agent: worker SUCCEEDED")

@@ -17,14 +17,79 @@ Pure host math, portable as-is to TPU slices (world = chips or hosts).
 
 from __future__ import annotations
 
+import json
+import os
 from typing import Any, Dict, List, Optional, Tuple
 
 LATEST_ELASTICITY_VERSION = 0.2
 MINIMUM_DEEPSPEED_VERSION = "0.3.8"
 
+# the resource scheduler exports the elastic config it scaled the job by;
+# runtime must refuse to train with a different one (the reference's
+# DEEPSPEED_ELASTICITY_CONFIG, elasticity/elasticity.py:254). The reference
+# spelling is accepted too so imported launch scripts keep working.
+ELASTICITY_CONFIG_ENV = "DS_TPU_ELASTICITY_CONFIG"
+_ELASTICITY_CONFIG_ENV_COMPAT = "DEEPSPEED_ELASTICITY_CONFIG"
+
 
 class ElasticityError(Exception):
     """Parity: ``elasticity/elasticity.py`` error types (collapsed)."""
+
+
+def elasticity_enabled(ds_config: Dict[str, Any]) -> bool:
+    """Parity: ``elasticity.py:248``."""
+    return bool(ds_config.get("elasticity", {}).get("enabled", False))
+
+
+def _fingerprint(e: Dict[str, Any]) -> Dict[str, Any]:
+    """The convergence-relevant knobs: changing any of these mid-job changes
+    the effective batch schedule the scheduler planned resizes around."""
+    return {
+        "max_train_batch_size": int(e.get("max_train_batch_size", 2000)),
+        "micro_batch_sizes": sorted(
+            int(m) for m in e.get("micro_batch_sizes", [2, 4, 6])),
+        "version": float(e.get("version", LATEST_ELASTICITY_VERSION)),
+    }
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config: Dict[str, Any],
+                                    warn=None) -> bool:
+    """Refuse to run if the scheduler scaled this job with a DIFFERENT elastic
+    config than the runtime is using (parity:
+    ``ensure_immutable_elastic_config``, ``elasticity/elasticity.py:254``).
+
+    Returns True when the fingerprint was verified, False when no scheduler
+    config is present (warned — resizes are then unguaranteed)."""
+    raw = (os.environ.get(ELASTICITY_CONFIG_ENV)
+           or os.environ.get(_ELASTICITY_CONFIG_ENV_COMPAT))
+    if raw is None:
+        msg = (f"{ELASTICITY_CONFIG_ENV} not set: cannot guarantee the "
+               "resource scheduler will resize this job at compatible "
+               "worker counts")
+        if warn is not None:
+            warn(msg)
+        else:
+            import logging
+
+            from ..utils.logging import log_dist
+
+            log_dist(msg, level=logging.WARNING)
+        return False
+    try:
+        sched = json.loads(raw)
+    except ValueError as e:
+        raise ElasticityError(
+            f"{ELASTICITY_CONFIG_ENV} is not valid JSON: {e}") from e
+    sched_fp = _fingerprint(sched.get("elasticity", sched))
+    run_fp = _fingerprint(runtime_elastic_config)
+    for k in sched_fp:
+        if sched_fp[k] != run_fp[k]:
+            raise ElasticityError(
+                f"elastic config '{k}' seen by the resource scheduler "
+                f"({sched_fp[k]}) does not match the runtime config "
+                f"({run_fp[k]}) — the scheduler's resize plan would break "
+                "the effective batch invariant")
+    return True
 
 
 def get_candidate_batch_sizes(base_list: List[int],
@@ -76,6 +141,9 @@ def compute_elastic_config(ds_config: Dict[str, Any], world_size: int = 0
              else ds_config.elasticity or {})
     if not e.get("enabled", False):
         raise ElasticityError("elasticity block missing or disabled")
+    # fingerprint check against the scheduler's copy BEFORE resolving: a
+    # drifted config must fail loudly, not train at the wrong batch plan
+    ensure_immutable_elastic_config(e)
     max_batch = int(e.get("max_train_batch_size", 2000))
     micro_batches = [int(m) for m in e.get("micro_batch_sizes", [2, 4, 6])]
     min_gpus = int(e.get("min_gpus", 1))

@@ -12,7 +12,12 @@ coordinator. The reference's per-GPU process fork collapses into per-host ssh;
 ``num_gpus``/slots become hosts; ``MASTER_ADDR:PORT`` becomes the JAX
 coordinator address. A ``gcloud`` runner covers the managed TPU-VM path
 (``gcloud compute tpus tpu-vm ssh --worker=all``), the ssh runner covers
-bare-metal/pdsh-style fleets.
+bare-metal/pdsh-style fleets, the ``queued-resources`` runner provisions a
+slice through the Cloud TPU capacity queue before launching, and the ``gke``
+runner renders an Indexed-Job manifest (completion index = JAX process id)
+— together these fill the role of the reference's SLURM/MPI cluster runners
+(``launcher/multinode_runner.py:164,211``) for how TPU capacity is actually
+scheduled.
 """
 
 from __future__ import annotations
@@ -168,7 +173,164 @@ class GCloudRunner(MultiNodeRunner):
         ]]
 
 
-RUNNERS = {"ssh": SSHRunner, "gcloud": GCloudRunner}
+class QueuedResourcesRunner(GCloudRunner):
+    """Provision-then-launch via Cloud TPU Queued Resources — the way large
+    TPU slices are actually obtained (capacity queue, spot/reserved), filling
+    the role of the reference's cluster schedulers (SLURM/MPI runners,
+    ``launcher/multinode_runner.py:164,211``): the scheduler grants the
+    resources, then the same per-worker fan-out launches the job."""
+
+    name = "queued-resources"
+
+    def _scope(self) -> List[str]:
+        out = []
+        if getattr(self.args, "zone", None):
+            out += ["--zone", self.args.zone]
+        if getattr(self.args, "project", None):
+            out += ["--project", self.args.project]
+        return out
+
+    def provision_cmd(self) -> List[str]:
+        a = self.args
+        if not (a.tpu_name and a.accelerator_type):
+            raise ValueError(
+                "queued-resources provisioning needs --tpu_name and "
+                "--accelerator_type")
+        cmd = ["gcloud", "compute", "tpus", "queued-resources", "create",
+               a.tpu_name, "--node-id", a.tpu_name,
+               "--accelerator-type", a.accelerator_type,
+               "--runtime-version", a.runtime_version] + self._scope()
+        if getattr(a, "spot", False):
+            cmd.append("--spot")
+        return cmd
+
+    def describe_cmd(self) -> List[str]:
+        return (["gcloud", "compute", "tpus", "queued-resources", "describe",
+                 self.args.tpu_name, "--format=value(state.state)"]
+                + self._scope())
+
+    def wait_active(self, poll_s: float = 30.0, timeout_s: float = 86400.0,
+                    max_describe_failures: int = 5, run=subprocess.run) -> str:
+        """Poll the queue until the slice is ACTIVE (or terminally failed).
+        Persistent describe failures (auth expiry, resource deleted) raise
+        with gcloud's stderr instead of spinning as 'pending'."""
+        import time as _time
+
+        deadline = _time.time() + timeout_s
+        failures = 0
+        while True:
+            p = run(self.describe_cmd(), capture_output=True, text=True)
+            if getattr(p, "returncode", 0) != 0:
+                failures += 1
+                if failures >= max_describe_failures:
+                    raise RuntimeError(
+                        f"describe failed {failures}x for queued resource "
+                        f"{self.args.tpu_name}: "
+                        f"{(getattr(p, 'stderr', '') or '').strip()[-400:]}")
+                _time.sleep(poll_s)
+                continue
+            failures = 0
+            state = (p.stdout or "").strip().upper()
+            if state == "ACTIVE":
+                return state
+            if state in ("FAILED", "SUSPENDED"):
+                raise RuntimeError(
+                    f"queued resource {self.args.tpu_name} entered {state}")
+            if _time.time() >= deadline:
+                raise TimeoutError(
+                    f"queued resource {self.args.tpu_name} not ACTIVE after "
+                    f"{timeout_s}s (last state: {state or 'unknown'})")
+            logger.info(f"queued resource {self.args.tpu_name}: "
+                        f"{state or 'pending'}; waiting")
+            _time.sleep(poll_s)
+
+
+class GKERunner(MultiNodeRunner):
+    """Kubernetes (GKE) path: render an Indexed Job + headless Service and
+    ``kubectl apply`` it. Process id rides the job completion index; the
+    JAX coordinator is pod 0's stable DNS name — the same rendezvous contract
+    the ssh runner exports, expressed as a manifest."""
+
+    name = "gke"
+
+    def backend_exists(self) -> bool:
+        import shutil
+
+        return shutil.which("kubectl") is not None
+
+    def render_manifest(self, environment: Dict[str, str]) -> str:
+        a = self.args
+        n = len(self.resource_pool)
+        name = getattr(a, "tpu_name", None) or "deepspeed-tpu-job"
+        port = environment.get("DS_COORD_PORT", DEFAULT_COORDINATOR_PORT)
+        image = getattr(a, "gke_image", None)
+        if not image:
+            raise ValueError("gke launcher needs --gke_image")
+        exports = "".join(
+            f"export {k}={shlex.quote(str(v))}\n"
+            for k, v in sorted(environment.items()))
+        script = (f"{exports}"
+                  "export JAX_PROCESS_ID=$JOB_COMPLETION_INDEX\n"
+                  f"export JAX_NUM_PROCESSES={n}\n"
+                  f"export JAX_COORDINATOR_ADDRESS={name}-0.{name}:{port}\n"
+                  f"{a.launch_cmd}\n")
+        # block-scalar content must be indented DEEPER than its '- |' dash
+        # (12 cols) or the YAML fails to parse at kubectl apply time
+        indented = "".join(f"              {ln}\n"
+                           for ln in script.splitlines())
+        return f"""apiVersion: v1
+kind: Service
+metadata:
+  name: {name}
+  namespace: {a.gke_namespace}
+spec:
+  clusterIP: None
+  selector:
+    job-name: {name}
+---
+apiVersion: batch/v1
+kind: Job
+metadata:
+  name: {name}
+  namespace: {a.gke_namespace}
+spec:
+  completions: {n}
+  parallelism: {n}
+  completionMode: Indexed
+  backoffLimit: 0
+  template:
+    spec:
+      subdomain: {name}
+      restartPolicy: Never
+      nodeSelector:
+        cloud.google.com/gke-tpu-accelerator: {a.gke_tpu_accelerator}
+        cloud.google.com/gke-tpu-topology: {a.gke_topology}
+      containers:
+        - name: worker
+          image: {image}
+          command: ["bash", "-c"]
+          args:
+            - |
+{indented}          ports:
+            - containerPort: {port}
+          resources:
+            limits:
+              google.com/tpu: {a.gke_chips_per_host}
+"""
+
+    def get_cmd(self, environment, active_resources) -> List[List[str]]:
+        import tempfile
+
+        manifest = self.render_manifest(environment)
+        fd, path = tempfile.mkstemp(prefix="ds_tpu_gke_", suffix=".yaml")
+        with os.fdopen(fd, "w") as f:
+            f.write(manifest)
+        logger.info(f"gke manifest written to {path}")
+        return [["kubectl", "apply", "-f", path]]
+
+
+RUNNERS = {"ssh": SSHRunner, "gcloud": GCloudRunner,
+           "queued-resources": QueuedResourcesRunner, "gke": GKERunner}
 
 
 # --------------------------------------------------------------------- main
@@ -180,6 +342,25 @@ def parse_args(argv=None):
     p.add_argument("-e", "--exclude", default="")
     p.add_argument("--launcher", default="ssh", choices=sorted(RUNNERS))
     p.add_argument("--tpu_name", default=None)
+    # queued-resources provisioning (launcher=queued-resources)
+    p.add_argument("--provision", action="store_true",
+                   help="create the queued resource and wait for ACTIVE "
+                        "before launching")
+    p.add_argument("--accelerator_type", default=None,
+                   help="e.g. v5litepod-16 (queued-resources provisioning)")
+    p.add_argument("--runtime_version", default="tpu-ubuntu2204-base")
+    p.add_argument("--zone", default=None)
+    p.add_argument("--project", default=None)
+    p.add_argument("--spot", action="store_true")
+    # GKE (launcher=gke) manifest knobs
+    p.add_argument("--gke_image", default=None)
+    p.add_argument("--gke_namespace", default="default")
+    p.add_argument("--gke_tpu_accelerator", default="tpu-v5-lite-podslice")
+    p.add_argument("--gke_topology", default="2x4")
+    p.add_argument("--gke_chips_per_host", type=int, default=4)
+    p.add_argument("--num_hosts", type=int, default=0,
+                   help="worker count when there is no hostfile "
+                        "(gke/queued-resources slices name their own workers)")
     p.add_argument("--master_port", type=int, default=DEFAULT_COORDINATOR_PORT)
     p.add_argument("--no_ssh_check", action="store_true")
     p.add_argument("--elastic_training", action="store_true",
@@ -224,6 +405,10 @@ def main(argv=None) -> int:
                              args.user_script, *user_args])
     if os.path.exists(args.hostfile):
         hosts = parse_hostfile(args.hostfile)
+    elif args.num_hosts > 0:
+        # managed slices (gke/queued-resources) name their own workers; the
+        # launcher only needs the count
+        hosts = {f"worker-{i}": 1 for i in range(args.num_hosts)}
     else:
         logger.info("no hostfile: single-host launch")
         hosts = {"localhost": 1}
@@ -240,6 +425,12 @@ def main(argv=None) -> int:
     runner = RUNNERS[args.launcher](args, pool)
     if not args.no_ssh_check and not runner.backend_exists():
         raise RuntimeError(f"launcher backend {args.launcher!r} unavailable")
+    if args.provision:
+        if not isinstance(runner, QueuedResourcesRunner):
+            raise SystemExit("--provision requires --launcher "
+                             "queued-resources")
+        subprocess.run(runner.provision_cmd(), check=True)
+        runner.wait_active()
     env = build_environment(args, pool)
     procs = [subprocess.Popen(cmd) for cmd in runner.get_cmd(env, pool)]
     rc = 0

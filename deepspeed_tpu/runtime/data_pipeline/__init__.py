@@ -8,5 +8,8 @@ from .data_routing.random_ltd import (  # noqa: F401
 from .data_analyzer import (  # noqa: F401
     DataAnalyzer,
     IndexedMetricStore,
+    MMapIndexedDataset,
+    MMapIndexedDatasetBuilder,
+    build_metric_to_sample,
     seqlen_metric,
 )

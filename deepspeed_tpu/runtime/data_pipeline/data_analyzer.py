@@ -77,6 +77,143 @@ class IndexedMetricStore:
         which = np.digitize(vals, np.asarray(edges))
         return {b: np.nonzero(which == b)[0] for b in range(len(edges) + 1)}
 
+    def value_percentiles(self, metric: str,
+                          percentiles: Sequence[float] = (1, 5, 25, 50, 75,
+                                                          95, 99)
+                          ) -> Dict[float, float]:
+        """Metric value at each percentile (parity:
+        ``DataAnalyzer.get_metric_value_percentiles``,
+        ``data_sampling/data_analyzer.py:231``) — the summary the curriculum
+        schedule's min/max difficulty knobs are set from."""
+        vals = np.asarray(self.values(metric))
+        out = np.percentile(vals, list(percentiles))
+        return {float(p): float(v) for p, v in zip(percentiles, out)}
+
+    def metric_to_sample(self, metric: str) -> "MMapIndexedDataset":
+        """The inverted metric->sample-indices store (built at merge time)."""
+        prefix = os.path.join(self.path, f"{metric}_to_sample")
+        return MMapIndexedDataset(prefix)
+
+
+class MMapIndexedDatasetBuilder:
+    """Append-only builder for a variable-length-row memory-mapped store.
+
+    Parity: ``IndexedDatasetBuilder`` / ``MMapIndexedDataset._Writer``
+    (``data_sampling/indexed_dataset.py:275,465``) — the at-scale store the
+    reference's data-efficiency pipeline writes token sequences and
+    metric->sample maps into. TPU-native format: ``<prefix>.bin`` is the raw
+    concatenated payload, ``<prefix>.idx.npz`` holds dtype + per-row sizes +
+    exscan byte pointers (numpy's own container instead of custom binary
+    framing; the capability — O(1) random access to variable-length rows
+    without loading the file — is identical).
+    """
+
+    def __init__(self, prefix: str, dtype=np.int32):
+        self.prefix = prefix
+        self.dtype = np.dtype(dtype)
+        self._bin = open(f"{prefix}.bin", "wb")
+        self._sizes: list = []
+
+    def add_item(self, values) -> None:
+        arr = np.ascontiguousarray(np.asarray(values), dtype=self.dtype)
+        self._bin.write(arr.tobytes())
+        self._sizes.append(int(arr.size))
+
+    def merge_file_(self, other_prefix: str) -> None:
+        """Append another finalized store's rows (reference ``merge_file_``,
+        ``indexed_dataset.py:305``) — the multi-worker reduce path."""
+        other = MMapIndexedDataset(other_prefix)
+        if other.dtype != self.dtype:
+            # raw-byte append with a different itemsize would silently
+            # corrupt every merged row's pointer math
+            raise ValueError(
+                f"dtype mismatch: merging {other.dtype} store into "
+                f"{self.dtype} builder")
+        with open(f"{other_prefix}.bin", "rb") as f:
+            while True:
+                chunk = f.read(1 << 24)
+                if not chunk:
+                    break
+                self._bin.write(chunk)
+        self._sizes.extend(int(s) for s in other.sizes)
+
+    def finalize(self) -> "MMapIndexedDataset":
+        self._bin.close()
+        sizes = np.asarray(self._sizes, np.int64)
+        pointers = np.zeros_like(sizes)
+        if sizes.size:
+            np.cumsum(sizes[:-1] * self.dtype.itemsize, out=pointers[1:])
+        np.savez(f"{self.prefix}.idx.npz", dtype=str(self.dtype),
+                 sizes=sizes, pointers=pointers)
+        return MMapIndexedDataset(self.prefix)
+
+
+class MMapIndexedDataset:
+    """Random access to variable-length rows without loading the file.
+
+    Parity: ``MMapIndexedDataset`` (``data_sampling/indexed_dataset.py:381``).
+    Rows are numpy views into one ``np.memmap`` — zero-copy reads.
+    """
+
+    def __init__(self, prefix: str):
+        if not self.exists(prefix):
+            raise FileNotFoundError(f"no indexed dataset at {prefix}")
+        with np.load(f"{prefix}.idx.npz") as idx:
+            self.dtype = np.dtype(str(idx["dtype"]))
+            self.sizes = idx["sizes"]
+            self.pointers = idx["pointers"]
+        if os.path.getsize(f"{prefix}.bin") == 0:
+            # a store of zero rows / all-empty rows is valid; memmap refuses
+            # zero-byte files
+            self._data = np.empty(0, self.dtype)
+        else:
+            self._data = np.memmap(f"{prefix}.bin", dtype=self.dtype,
+                                   mode="r")
+
+    def __len__(self) -> int:
+        return int(self.sizes.shape[0])
+
+    def __getitem__(self, i: int) -> np.ndarray:
+        if not 0 <= i < len(self):
+            raise IndexError(i)
+        start = int(self.pointers[i]) // self.dtype.itemsize
+        return self._data[start:start + int(self.sizes[i])]
+
+    def size(self, i: int) -> int:
+        return int(self.sizes[i])
+
+    num_tokens = size  # reference API alias (indexed_dataset.py:207)
+
+    @staticmethod
+    def exists(prefix: str) -> bool:
+        return (os.path.exists(f"{prefix}.bin")
+                and os.path.exists(f"{prefix}.idx.npz"))
+
+
+def build_metric_to_sample(values, prefix: str) -> MMapIndexedDataset:
+    """Inverted index: row v = the sample indices whose (integer-quantized)
+    metric value is v. Parity: the reference's ``metric_to_sample`` merge
+    output (``data_analyzer.py:291`` merge_metric_to_sample), which curriculum
+    batching uses to draw all samples of a given difficulty without scanning.
+    """
+    vals = np.asarray(values)
+    iv = vals.astype(np.int64)
+    if not np.allclose(vals, iv):
+        raise ValueError(
+            "metric_to_sample needs integer-valued metrics (quantize first); "
+            f"got non-integral values, e.g. {vals[~np.isclose(vals, iv)][:3]}")
+    if iv.size and iv.min() < 0:
+        raise ValueError("metric values must be >= 0")
+    builder = MMapIndexedDatasetBuilder(prefix, dtype=np.int64)
+    order = np.argsort(iv, kind="stable")
+    sorted_vals = iv[order]
+    bounds = np.searchsorted(sorted_vals,
+                             np.arange((iv.max() + 1) if iv.size else 0))
+    bounds = np.append(bounds, iv.size)
+    for v in range(len(bounds) - 1):
+        builder.add_item(np.sort(order[bounds[v]:bounds[v + 1]]))
+    return builder.finalize()
+
 
 class DataAnalyzer:
     """Map metric functions over a dataset; write the indexed store.
@@ -118,8 +255,12 @@ class DataAnalyzer:
         return out
 
     @staticmethod
-    def merge(out_dir: str) -> IndexedMetricStore:
-        """Concatenate every worker's shard files into the final store."""
+    def merge(out_dir: str, build_inverted: bool = False) -> IndexedMetricStore:
+        """Concatenate every worker's shard files into the final store.
+
+        ``build_inverted`` additionally writes a ``<metric>_to_sample``
+        indexed store per integer-valued metric (the reference's
+        merge_metric_to_sample reduce output)."""
         shards = []
         for f in os.listdir(out_dir):
             if f.startswith("shard") and f.endswith(".json"):
@@ -158,6 +299,13 @@ class DataAnalyzer:
                     f"metric {m!r}: {full.shape[0]} values for {total} samples "
                     "— stale worker files from a different analysis?")
             np.save(os.path.join(out_dir, f"{m}.npy"), full)
+            if (build_inverted and np.allclose(full, full.astype(np.int64))
+                    and (full.size == 0 or full.min() >= 0)):
+                # mirror build_metric_to_sample's own preconditions: a metric
+                # that can't be inverted (negative sentinel values) is
+                # skipped, not a merge failure
+                build_metric_to_sample(
+                    full, os.path.join(out_dir, f"{m}_to_sample"))
         with open(os.path.join(out_dir, _MANIFEST), "w") as f:
             json.dump({"num_samples": total, "metrics": metrics}, f)
         return IndexedMetricStore(out_dir)

@@ -361,3 +361,108 @@ def test_random_ltd_refuses_inert_and_runner_configs():
         make(extra_rl={"random_ltd_layer_num": 1, "total_layer_num": 3},
              extra_cfg={"zero_optimization": {
                  "stage": 2, "offload_optimizer": {"device": "cpu"}}})
+
+
+def test_mmap_indexed_dataset_roundtrip(tmp_path, rng):
+    """Variable-length mmap store (parity: indexed_dataset.py:381): random
+    access without loading, zero rows allowed, builder merge."""
+    from deepspeed_tpu.runtime.data_pipeline import (
+        MMapIndexedDataset, MMapIndexedDatasetBuilder)
+
+    rows = [rng.integers(0, 1000, size=n).astype(np.int32)
+            for n in (5, 0, 3, 128, 1)]
+    b = MMapIndexedDatasetBuilder(str(tmp_path / "a"), dtype=np.int32)
+    for r in rows:
+        b.add_item(r)
+    ds = b.finalize()
+    assert len(ds) == len(rows)
+    for i, r in enumerate(rows):
+        assert ds.size(i) == r.size and ds.num_tokens(i) == r.size
+        np.testing.assert_array_equal(np.asarray(ds[i]), r)
+    with pytest.raises(IndexError):
+        ds[len(rows)]
+    # reopen from disk (a fresh process would do the same)
+    ds2 = MMapIndexedDataset(str(tmp_path / "a"))
+    np.testing.assert_array_equal(np.asarray(ds2[3]), rows[3])
+    # merge_file_: second store appended row-for-row
+    b2 = MMapIndexedDatasetBuilder(str(tmp_path / "b"), dtype=np.int32)
+    b2.add_item([7, 8])
+    b2.merge_file_(str(tmp_path / "a"))
+    merged = b2.finalize()
+    assert len(merged) == 1 + len(rows)
+    np.testing.assert_array_equal(np.asarray(merged[0]), [7, 8])
+    np.testing.assert_array_equal(np.asarray(merged[4]), rows[3])
+
+
+def test_metric_to_sample_inverted_index(tmp_path):
+    """Row v of the inverted store = sample ids with metric value v
+    (parity: data_analyzer.py:291 merge_metric_to_sample)."""
+    from deepspeed_tpu.runtime.data_pipeline import build_metric_to_sample
+
+    vals = np.asarray([3, 1, 3, 0, 1, 1], np.float32)
+    ds = build_metric_to_sample(vals, str(tmp_path / "m2s"))
+    assert len(ds) == 4  # values 0..3
+    np.testing.assert_array_equal(np.asarray(ds[0]), [3])
+    np.testing.assert_array_equal(np.asarray(ds[1]), [1, 4, 5])
+    np.testing.assert_array_equal(np.asarray(ds[2]), [])
+    np.testing.assert_array_equal(np.asarray(ds[3]), [0, 2])
+    with pytest.raises(ValueError, match="integer-valued"):
+        build_metric_to_sample(np.asarray([0.5]), str(tmp_path / "bad"))
+
+
+def test_analyzer_merge_builds_inverted_and_percentiles(tmp_path):
+    """merge(build_inverted=True) writes <metric>_to_sample; the store
+    exposes percentile summaries (parity: get_metric_value_percentiles)."""
+    from deepspeed_tpu.runtime.data_pipeline import DataAnalyzer
+
+    data = [{"input_ids": np.zeros(n, np.int32)}
+            for n in (4, 8, 4, 16, 8, 8, 2, 4)]
+    out = str(tmp_path / "store")
+    for w in range(2):
+        DataAnalyzer(worker_id=w, num_workers=2).run(data, out)
+    store = DataAnalyzer.merge(out, build_inverted=True)
+    pct = store.value_percentiles("seqlen", (0, 50, 100))
+    assert pct[0.0] == 2 and pct[100.0] == 16
+    inv = store.metric_to_sample("seqlen")
+    np.testing.assert_array_equal(np.asarray(inv[4]), [0, 2, 7])
+    np.testing.assert_array_equal(np.asarray(inv[8]), [1, 4, 5])
+    assert inv.size(16) == 1 and inv.size(3) == 0
+
+
+def test_mmap_indexed_dataset_edge_cases(tmp_path):
+    """Empty stores are valid; mixed-dtype merge is refused (the reference's
+    builder asserts dtype equality for the same pointer-math reason)."""
+    from deepspeed_tpu.runtime.data_pipeline import (
+        MMapIndexedDataset, MMapIndexedDatasetBuilder, build_metric_to_sample)
+
+    empty = build_metric_to_sample(np.asarray([]), str(tmp_path / "empty"))
+    assert len(empty) == 0
+    b = MMapIndexedDatasetBuilder(str(tmp_path / "allempty"), np.int32)
+    b.add_item([])
+    b.add_item([])
+    ds = b.finalize()
+    assert len(ds) == 2 and ds.size(0) == 0
+    np.testing.assert_array_equal(np.asarray(ds[1]), [])
+
+    b64 = MMapIndexedDatasetBuilder(str(tmp_path / "i64"), np.int64)
+    b64.add_item([1, 2, 3])
+    b64.finalize()
+    b32 = MMapIndexedDatasetBuilder(str(tmp_path / "i32"), np.int32)
+    with pytest.raises(ValueError, match="dtype mismatch"):
+        b32.merge_file_(str(tmp_path / "i64"))
+
+
+def test_merge_skips_uninvertible_metrics(tmp_path):
+    """A negative-sentinel integer metric must not abort the merge; it is
+    simply not inverted."""
+    from deepspeed_tpu.runtime.data_pipeline import (
+        DataAnalyzer, MMapIndexedDataset)
+
+    data = [{"input_ids": np.zeros(4, np.int32)} for _ in range(4)]
+    out = str(tmp_path / "neg")
+    DataAnalyzer({"score": lambda s: -1.0, "seqlen":
+                  lambda s: float(len(s["input_ids"]))}).run(data, out)
+    store = DataAnalyzer.merge(out, build_inverted=True)
+    assert not MMapIndexedDataset.exists(
+        str(tmp_path / "neg" / "score_to_sample"))
+    assert store.metric_to_sample("seqlen").size(4) == 4

@@ -302,3 +302,176 @@ def test_print_model_profile_includes_module_tree():
     assert "layers x1" in text and "(head)" in text
     # the module profile picked up the profiled batch geometry
     assert prof.profile["modules"]["seq"] == 16
+
+
+def test_elastic_config_fingerprint_immutability(monkeypatch):
+    """Parity: ensure_immutable_elastic_config (elasticity.py:254) — the
+    runtime refuses a config whose convergence-relevant knobs drifted from
+    what the scheduler scaled the job by."""
+    import json as _json
+
+    from deepspeed_tpu.elasticity import (
+        ELASTICITY_CONFIG_ENV, ElasticityError, elasticity_enabled,
+        ensure_immutable_elastic_config)
+    from deepspeed_tpu.elasticity import compute_elastic_config as cec
+
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 128,
+                          "micro_batch_sizes": [2, 4],
+                          "min_gpus": 1, "max_gpus": 8}}
+    monkeypatch.delenv(ELASTICITY_CONFIG_ENV, raising=False)
+    monkeypatch.delenv("DEEPSPEED_ELASTICITY_CONFIG", raising=False)
+    warned = []
+    assert not ensure_immutable_elastic_config(cfg["elasticity"],
+                                               warn=warned.append)
+    assert warned  # no scheduler config: warn, don't refuse
+    cec(cfg)  # planning proceeds
+
+    monkeypatch.setenv(ELASTICITY_CONFIG_ENV, _json.dumps(cfg))
+    assert ensure_immutable_elastic_config(cfg["elasticity"])
+    cec(cfg)
+
+    drifted = {"elasticity": dict(cfg["elasticity"],
+                                  max_train_batch_size=256)}
+    monkeypatch.setenv(ELASTICITY_CONFIG_ENV, _json.dumps(drifted))
+    with pytest.raises(ElasticityError, match="max_train_batch_size"):
+        cec(cfg)
+    # micro-batch drift refused too; ORDER of micro batches is not drift
+    reordered = {"elasticity": dict(cfg["elasticity"],
+                                    micro_batch_sizes=[4, 2])}
+    monkeypatch.setenv(ELASTICITY_CONFIG_ENV, _json.dumps(reordered))
+    assert ensure_immutable_elastic_config(cfg["elasticity"])
+    monkeypatch.setenv(ELASTICITY_CONFIG_ENV, _json.dumps(
+        {"elasticity": dict(cfg["elasticity"], micro_batch_sizes=[2, 8])}))
+    with pytest.raises(ElasticityError, match="micro_batch_sizes"):
+        ensure_immutable_elastic_config(cfg["elasticity"])
+
+    # the reference's env spelling is honored for imported launch scripts
+    monkeypatch.delenv(ELASTICITY_CONFIG_ENV)
+    monkeypatch.setenv("DEEPSPEED_ELASTICITY_CONFIG", _json.dumps(drifted))
+    with pytest.raises(ElasticityError):
+        ensure_immutable_elastic_config(cfg["elasticity"])
+
+    monkeypatch.setenv("DEEPSPEED_ELASTICITY_CONFIG", "not json{")
+    with pytest.raises(ElasticityError, match="valid JSON"):
+        ensure_immutable_elastic_config(cfg["elasticity"])
+    assert elasticity_enabled(cfg) and not elasticity_enabled({})
+
+
+def test_elastic_agent_exports_fingerprint_env(monkeypatch):
+    """The agent (acting as the scheduler) must hand its workers the
+    fingerprint env so their runtimes can verify immutability."""
+    import json as _json
+
+    from deepspeed_tpu.elasticity import ELASTICITY_CONFIG_ENV
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 64,
+                          "micro_batch_sizes": [2], "min_gpus": 1,
+                          "max_gpus": 4}}
+    monkeypatch.delenv(ELASTICITY_CONFIG_ENV, raising=False)
+    captured = {}
+
+    class FakeProc:
+        def poll(self):
+            return 0
+
+        def wait(self, timeout=None):
+            return 0
+
+    def fake_popen(argv, env=None, **kw):
+        captured["env"] = env
+        return FakeProc()
+
+    monkeypatch.setattr("subprocess.Popen", fake_popen)
+    agent = DSElasticAgent(lambda spec: ["true"], cfg,
+                         device_count_fn=lambda: 2, poll_interval=0.01)
+    res = agent.run()
+    assert res.state == "SUCCEEDED"
+    fp = _json.loads(captured["env"][ELASTICITY_CONFIG_ENV])
+    assert fp["elasticity"]["max_train_batch_size"] == 64
+
+
+def test_queued_resources_runner_commands():
+    """Provision/describe/launch command construction + ACTIVE polling
+    (fills the reference's cluster-scheduler runner role,
+    multinode_runner.py:164,211)."""
+    import argparse
+
+    from deepspeed_tpu.launcher.runner import QueuedResourcesRunner
+
+    args = argparse.Namespace(
+        tpu_name="slice1", accelerator_type="v5litepod-16",
+        runtime_version="tpu-ubuntu2204-base", zone="us-west4-a",
+        project="proj", spot=True, launch_cmd="python t.py")
+    r = QueuedResourcesRunner(args, {"worker-0": [0], "worker-1": [0]})
+    cmd = r.provision_cmd()
+    assert cmd[:6] == ["gcloud", "compute", "tpus", "queued-resources",
+                       "create", "slice1"]
+    assert "--accelerator-type" in cmd and "--spot" in cmd
+    assert "us-west4-a" in cmd and "proj" in cmd
+    assert "describe" in r.describe_cmd()
+
+    states = iter(["WAITING_FOR_RESOURCES", "PROVISIONING", "ACTIVE"])
+
+    class P:
+        def __init__(self, s):
+            self.stdout = s
+
+    assert r.wait_active(poll_s=0, run=lambda *a, **k: P(next(states))) == \
+        "ACTIVE"
+    with pytest.raises(RuntimeError, match="FAILED"):
+        r.wait_active(poll_s=0, run=lambda *a, **k: P("FAILED"))
+
+    class Err:
+        returncode = 1
+        stdout = ""
+        stderr = "ERROR: auth expired"
+
+    with pytest.raises(RuntimeError, match="auth expired"):
+        r.wait_active(poll_s=0, max_describe_failures=3,
+                      run=lambda *a, **k: Err())
+    # launch path is the gcloud worker fan-out against the provisioned node
+    launch = r.get_cmd({"DS_COORD_PORT": "8476"}, r.resource_pool)
+    assert launch[0][:6] == ["gcloud", "compute", "tpus", "tpu-vm", "ssh",
+                             "slice1"]
+
+
+def test_gke_runner_manifest(tmp_path):
+    """Indexed-Job manifest: completion index = JAX process id, pod-0 DNS =
+    coordinator, per-host TPU resource limit, headless service."""
+    import argparse
+
+    from deepspeed_tpu.launcher.runner import GKERunner
+
+    args = argparse.Namespace(
+        tpu_name="dsjob", gke_image="gcr.io/x/img:1", gke_namespace="ml",
+        gke_tpu_accelerator="tpu-v5-lite-podslice", gke_topology="2x4",
+        gke_chips_per_host=4, launch_cmd="python train.py --deepspeed")
+    r = GKERunner(args, {f"worker-{i}": [0] for i in range(4)})
+    m = r.render_manifest({"DS_COORD_PORT": "8476", "PYTHONPATH": "/app"})
+    assert "completions: 4" in m and "parallelism: 4" in m
+    assert "completionMode: Indexed" in m
+    assert "JAX_PROCESS_ID=$JOB_COMPLETION_INDEX" in m
+    assert "JAX_COORDINATOR_ADDRESS=dsjob-0.dsjob:8476" in m
+    assert "google.com/tpu: 4" in m
+    assert "clusterIP: None" in m and "namespace: ml" in m
+    assert "export PYTHONPATH=/app" in m
+    assert "python train.py --deepspeed" in m
+    # the manifest must actually PARSE (substring asserts missed a
+    # block-scalar indentation bug once)
+    import yaml as _yaml
+
+    docs = list(_yaml.safe_load_all(m))
+    assert [d["kind"] for d in docs] == ["Service", "Job"]
+    job = docs[1]["spec"]
+    assert job["completions"] == 4 and job["completionMode"] == "Indexed"
+    ctr = job["template"]["spec"]["containers"][0]
+    assert ctr["resources"]["limits"]["google.com/tpu"] == 4
+    assert "python train.py --deepspeed" in ctr["args"][0]
+    cmd = r.get_cmd({"DS_COORD_PORT": "8476", "PYTHONPATH": "/app"},
+                    r.resource_pool)
+    assert cmd[0][:3] == ["kubectl", "apply", "-f"]
+    assert open(cmd[0][3]).read() == m
+    import os as _os
+
+    _os.unlink(cmd[0][3])
