@@ -716,131 +716,21 @@ def _worker_kernels_aot(cfg: dict) -> dict:
 
 
 def _worker_infinity_aot(cfg: dict) -> dict:
-    """AOT-compile the ZeRO-Infinity streaming programs (embed fwd, shared
-    layer fwd/bwd, head loss+bwd, embed bwd — runtime/zero/infinity.py) for a
-    big model against the v5e compiler, and band the schedule's peak HBM:
-    resident window params + activation stack + the largest single program's
-    temp. De-risks the 6.7B chip row without chips."""
-    import dataclasses
+    """AOT evidence for the ZeRO-Infinity streaming schedule: the five
+    stream programs plus the schedule's two peak MOMENTS compiled whole
+    (all resident buffers as program arguments), so peak_bytes is the XLA
+    compiler's own accounting, with a fragmentation-margin verdict (core:
+    deepspeed_tpu.runtime.aot.infinity_program_report — closes the r4
+    'peak_bytes: null / est' gap, VERDICT r4 next #4)."""
+    from deepspeed_tpu.runtime.aot import infinity_program_report
 
-    import numpy as np
-
-    import jax
-    import jax.numpy as jnp
-    from jax.experimental import topologies
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    from deepspeed_tpu.models import gpt as gpt_mod
-    from deepspeed_tpu.models.gpt import GPTStream
-    from deepspeed_tpu.runtime.topology import MeshTopology, mesh_context
-
-    os.environ["DS_TPU_PALLAS_INTERPRET"] = "0"
-    td = topologies.get_topology_desc(
-        platform="tpu", topology_name=cfg.get("topology", "v5e:2x2"))
-    topo = MeshTopology.create(dp=1, devices=list(td.devices)[:1])
-    rep = NamedSharding(topo.mesh, P())
-    mcfg = gpt_mod.PRESETS[cfg.get("model", "gpt-neox-6.7b")]
-    mcfg = dataclasses.replace(mcfg, use_flash=True)
-    s = GPTStream(mcfg)
-    micro_bs, seq = int(cfg.get("micro_bs", 8)), int(cfg.get("seq", 1024))
-    keep = int(cfg.get("keep_layers", 2))
-    cd = jnp.bfloat16
-    d = mcfg.d_model
-
-    def a(shape, dtype=cd):
-        return jax.ShapeDtypeStruct(shape, dtype, sharding=rep)
-
-    def unit_abstract(unit):
-        return {k: a(v.shape) for k, v in s.init_unit(unit, 0).items()}
-
-    emb, layer, final = (unit_abstract(u) for u in ("embed", "layer_0",
-                                                    "final"))
-    ids = a((micro_bs, seq), jnp.int32)
-    x = a((micro_bs, seq, d))
-    rng = a((2,), jnp.uint32)
-    idx = a((), jnp.int32)
-
-    def cast_tree(t):
-        return jax.tree_util.tree_map(lambda g: g.astype(cd), t)
-
-    def gn2(t):
-        return sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
-                   for g in jax.tree_util.tree_leaves(t))
-
-    # the same five programs ParamStreamRunner builds (kept in sync by the
-    # shared GPTStream definitions)
-    def efwd(e, i):
-        return s.embed_fwd(e, i, cd)
-
-    def lfwd(w, x_, i, r):
-        return s.layer_fwd(w, x_, i, r)
-
-    def lbwd(w, x_, dy, i, r):
-        _, vjp = jax.vjp(lambda w2, x2: s.layer_fwd(w2, x2, i, r), w, x_)
-        dw, dx = vjp(dy)
-        return dx.astype(cd), cast_tree(dw), gn2(dw)
-
-    def hbwd(f, wte, x_, i):
-        loss, (df, dwte, dx) = jax.value_and_grad(
-            s.head_loss, argnums=(0, 1, 2))(f, wte, x_, i, None, None)
-        return loss, cast_tree(df), dwte.astype(cd), dx.astype(cd), gn2(df)
-
-    def ebwd(e, i, dx):
-        _, vjp = jax.vjp(lambda e2: s.embed_fwd(e2, i, cd), e)
-        (de,) = vjp(dx)
-        return cast_tree(de)
-
-    programs = {
-        "embed_fwd": (efwd, (emb, ids)),
-        "layer_fwd": (lfwd, (layer, x, idx, rng)),
-        "layer_bwd": (lbwd, (layer, x, x, idx, rng)),
-        "head_bwd": (hbwd, (final, emb["wte"], x, ids)),
-        "embed_bwd": (ebwd, (emb, ids, x)),
-    }
-    rows, failed = {}, []
-    with mesh_context(topo.mesh):
-        for name, (fn, args) in programs.items():
-            try:
-                t0 = time.perf_counter()
-                compiled = jax.jit(fn).lower(*args).compile()
-                ma = compiled.memory_analysis()
-                rows[name] = {
-                    "ok": True,
-                    "compile_s": round(time.perf_counter() - t0, 1),
-                    "arguments": int(ma.argument_size_in_bytes),
-                    "temp": int(ma.temp_size_in_bytes),
-                    "peak": int(ma.peak_memory_in_bytes),
-                }
-            except Exception as e:
-                rows[name] = {"ok": False, "error": str(e)[-300:]}
-                failed.append(name)
-    layer_bytes = sum(int(np.prod(v.shape)) * 2
-                      for v in s.init_unit("layer_0", 0).values())
-    acts_bytes = (mcfg.n_layer + 1) * micro_bs * seq * d * 2
-    emb_bytes = sum(int(np.prod(v.shape)) * 2
-                    for v in s.init_unit("embed", 0).values())
-    # schedule peak: resident layer window (keep + double-buffer) +
-    # activation stack + embeddings + the worst single program's temps
-    sched_peak = ((keep + 2) * layer_bytes + acts_bytes + emb_bytes
-                  + max((r.get("temp", 0) for r in rows.values()
-                         if r.get("ok")), default=0))
-    out = {
-        "config": cfg["name"], "kind": "infinity_aot",
-        "platform": "tpu-compile-only",
-        "model": cfg.get("model", "gpt-neox-6.7b"),
-        "micro_bs": micro_bs, "seq": seq, "keep_layers": keep,
-        "programs": rows,
-        "schedule_estimate_bytes": {
-            "layer_unit": layer_bytes,
-            "activation_stack": acts_bytes,
-            "embed_resident": emb_bytes,
-            "peak_estimate": int(sched_peak),
-        },
-        "fits_v5e_hbm": bool(not failed and sched_peak < 15.2e9),
-    }
-    if failed:
-        out["error"] = "programs failed: " + ", ".join(failed)
-    return out
+    rep = infinity_program_report(
+        cfg.get("model", "gpt-neox-6.7b"),
+        topology=cfg.get("topology", "v5e:2x2"),
+        micro_bs=int(cfg.get("micro_bs", 8)), seq=int(cfg.get("seq", 1024)),
+        keep_layers=int(cfg.get("keep_layers", 2)))
+    return {"config": cfg["name"], "kind": "infinity_aot",
+            "platform": "tpu-compile-only", **rep}
 
 
 def _aot_fused_step(model, optimizer, gas: int = 1, k_steps: int = 1):
@@ -1408,6 +1298,9 @@ def _summarize(platform: str, sweep: list, errors: list) -> dict:
             {"config": r["config"], "kind": r["kind"],
              "fits_v5e_hbm": r.get("fits_v5e_hbm"),
              "peak_bytes": (r.get("per_device_bytes") or {}).get("peak"),
+             # margin-aware: "marginal" = compiles but inside the
+             # fragmentation margin — a prediction needing runtime confirm
+             "fit_confidence": (r.get("fit") or {}).get("confidence"),
              "kernels_ok": (all(k.get("ok") for k in r["kernels"].values())
                             if "kernels" in r else None)}
             for r in aot_rows]

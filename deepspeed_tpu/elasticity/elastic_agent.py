@@ -94,6 +94,11 @@ class DSElasticAgent:
                  max_restarts: int = 10, poll_interval: float = 1.0):
         self.make_cmd = make_cmd
         self.ds_config = ds_config
+        # config may be a dict or an object with .elasticity (the pydantic
+        # DeepSpeedConfig) — normalize once for the fingerprint export
+        self._elastic_block = dict(
+            ds_config.get("elasticity", {}) if isinstance(ds_config, dict)
+            else getattr(ds_config, "elasticity", None) or {})
         self.device_count_fn = device_count_fn or probe_device_count
         self.max_restarts = int(max_restarts)
         self.poll_interval = float(poll_interval)
@@ -131,7 +136,7 @@ class DSElasticAgent:
             # the resource scheduler here
             env = dict(os.environ)
             env[ELASTICITY_CONFIG_ENV] = json.dumps(
-                {"elasticity": dict(self.ds_config.get("elasticity", {}))})
+                {"elasticity": self._elastic_block})
             proc = subprocess.Popen(argv, env=env)
             rc = self._watch(proc, launched_world=world)
             if rc == 0:

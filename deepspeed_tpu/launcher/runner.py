@@ -204,6 +204,12 @@ class QueuedResourcesRunner(GCloudRunner):
             cmd.append("--spot")
         return cmd
 
+    def get_cmd(self, environment, active_resources) -> List[List[str]]:
+        # the launch must target the same zone/project the slice was
+        # provisioned in, not the operator's gcloud defaults
+        return [cmd + self._scope()
+                for cmd in super().get_cmd(environment, active_resources)]
+
     def describe_cmd(self) -> List[str]:
         return (["gcloud", "compute", "tpus", "queued-resources", "describe",
                  self.args.tpu_name, "--format=value(state.state)"]
@@ -266,9 +272,12 @@ class GKERunner(MultiNodeRunner):
         image = getattr(a, "gke_image", None)
         if not image:
             raise ValueError("gke launcher needs --gke_image")
+        # host-machine paths are meaningless (and harmful) inside the
+        # container image — only rendezvous/config vars cross over
         exports = "".join(
             f"export {k}={shlex.quote(str(v))}\n"
-            for k, v in sorted(environment.items()))
+            for k, v in sorted(environment.items())
+            if k not in ("PATH", "PYTHONPATH", "LD_LIBRARY_PATH"))
         script = (f"{exports}"
                   "export JAX_PROCESS_ID=$JOB_COMPLETION_INDEX\n"
                   f"export JAX_NUM_PROCESSES={n}\n"
@@ -417,10 +426,20 @@ def main(argv=None) -> int:
         # the launcher-level flag reaches the worker on every path
         args.user_args = list(args.user_args) + [
             "--deepspeed_config", args.deepspeed_config]
+    # gke runs inside the container image, where the operator's interpreter
+    # path does not exist
+    interp = ("python3" if args.launcher == "gke"
+              else shlex.quote(sys.executable))
     args.launch_cmd = " ".join(
-        [shlex.quote(sys.executable), shlex.quote(args.user_script),
+        [interp, shlex.quote(args.user_script),
          *map(shlex.quote, args.user_args)])
     if list(pool) == ["localhost"]:
+        if args.launcher in ("gke", "queued-resources"):
+            # a silent local run instead of a provisioned slice is never
+            # what the operator meant
+            raise SystemExit(
+                f"--launcher {args.launcher} needs a hostfile or "
+                "--num_hosts (no workers resolved)")
         return subprocess.call([sys.executable, args.user_script, *args.user_args])
     runner = RUNNERS[args.launcher](args, pool)
     if not args.no_ssh_check and not runner.backend_exists():

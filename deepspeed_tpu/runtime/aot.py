@@ -27,7 +27,43 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["fused_train_step", "report_from_compiled", "oom_row",
-           "train_program_report", "peak_flops_per_chip"]
+           "train_program_report", "peak_flops_per_chip", "fit_verdict",
+           "infinity_program_report"]
+
+# usable HBM on the target chip (v5e: 16 GB - runtime reserved)
+HBM_BYTES = float(os.environ.get("DS_TPU_HBM_BYTES", 15.75e9))
+# Compile-time fit != runtime fit: the r4 760M case compiled at 15.6 GB and
+# OOMed at runtime on allocator fragmentation. Any "fits" verdict with less
+# than this much headroom is a PREDICTION that needs a runtime confirmation.
+FRAGMENTATION_MARGIN_BYTES = float(
+    os.environ.get("DS_TPU_FRAGMENTATION_MARGIN_BYTES", 1.0e9))
+
+
+def fit_verdict(peak_bytes: int, hbm_bytes: float = None,
+                margin_bytes: float = None) -> Dict[str, Any]:
+    """Margin-aware fit classification for a compiled program's peak HBM.
+
+    ``confidence`` is "fits" only with >= the fragmentation margin of
+    headroom; "marginal" compiles but sits inside the margin (the regime
+    where the r4 760M bs16 row OOMed at runtime despite a green compile);
+    "oom" did not compile."""
+    hbm = HBM_BYTES if hbm_bytes is None else float(hbm_bytes)
+    margin = (FRAGMENTATION_MARGIN_BYTES if margin_bytes is None
+              else float(margin_bytes))
+    headroom = hbm - float(peak_bytes)
+    if headroom < 0:
+        conf = "oom"
+    elif headroom < margin:
+        conf = "marginal"
+    else:
+        conf = "fits"
+    out = {"hbm_bytes": int(hbm), "headroom_bytes": int(headroom),
+           "fragmentation_margin_bytes": int(margin), "confidence": conf}
+    if conf == "marginal":
+        out["note"] = ("within the fragmentation margin of the HBM ceiling: "
+                       "compile-time fit is a prediction, not evidence — "
+                       "confirm with a runtime step")
+    return out
 
 
 def peak_flops_per_chip(platform: str = "tpu") -> float:
@@ -123,17 +159,23 @@ def report_from_compiled(compiled, compile_s: float) -> Dict[str, Any]:
     ma = compiled.memory_analysis()
     ca = compiled.cost_analysis() or {}
     flops = float(ca.get("flops", 0.0))
-    peak = peak_flops_per_chip("tpu")
+    peak_bytes = int(ma.peak_memory_in_bytes)
+    fit = fit_verdict(peak_bytes)
     return {
         "compile_s": round(compile_s, 1),
         "per_device_bytes": {
             "arguments": int(ma.argument_size_in_bytes),
             "outputs": int(ma.output_size_in_bytes),
             "temp": int(ma.temp_size_in_bytes),
-            "peak": int(ma.peak_memory_in_bytes),
+            "peak": peak_bytes,
             "code": int(ma.generated_code_size_in_bytes),
         },
-        "fits_v5e_hbm": True,
+        # margin-aware classification: a green compile inside the
+        # fragmentation margin is a prediction, not evidence (r4 760M lesson);
+        # fits_v5e_hbm must agree with the verdict (an 'oom' verdict with
+        # fits=True would schedule a run predicted to fail)
+        "fit": fit,
+        "fits_v5e_hbm": fit["confidence"] != "oom",
         # CAVEAT: XLA cost_analysis counts scan/while BODIES ONCE, so for a
         # scanned L-layer model this is ~L x below the true per-step flops —
         # use the analytic_flops fields the callers attach for estimates
@@ -379,6 +421,187 @@ def decode_program_report(
     rep_fields["kv_cache_bytes"] = kv_bytes
     out.update(rep_fields)
     return out
+
+
+def infinity_program_report(
+    model: str,
+    *,
+    topology: str = "v5e:2x2",
+    micro_bs: int = 8,
+    seq: int = 1024,
+    keep_layers: int = 2,
+) -> Dict[str, Any]:
+    """AOT evidence for the ZeRO-Infinity streaming schedule
+    (``runtime/zero/infinity.py``): compile the five stream programs AND the
+    schedule's two peak MOMENTS as whole programs — every buffer the runner
+    keeps resident at that moment (activation stack, layer-unit window,
+    embed/final units, in-flight grads) is an ARGUMENT of the compiled
+    program, so ``memory_analysis().peak_memory_in_bytes`` is the compiler's
+    own accounting of the whole-run peak, not an arithmetic sum (closes the
+    r4 "peak_bytes: null / est" gap). Verdicts carry the fragmentation
+    margin. Reference bar: 13B on one V100 (``docs/_pages/training.md:301``).
+    """
+    import dataclasses
+
+    import numpy as np
+
+    from jax.experimental import topologies
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..models import gpt as gpt_mod
+    from ..models.gpt import GPTStream
+    from ..runtime.topology import MeshTopology, mesh_context
+
+    tmap = jax.tree_util.tree_map
+    with _env_override("DS_TPU_PALLAS_INTERPRET", "0"):
+        td = topologies.get_topology_desc(platform="tpu",
+                                          topology_name=topology)
+        topo = MeshTopology.create(dp=1, devices=list(td.devices)[:1])
+        rep = NamedSharding(topo.mesh, P())
+        mcfg = gpt_mod.PRESETS[model]
+        mcfg = dataclasses.replace(mcfg, use_flash=True)
+        s = GPTStream(mcfg)
+        cd = jnp.bfloat16
+        d, L = mcfg.d_model, mcfg.n_layer
+        keep = min(int(keep_layers), L)
+
+        def a(shape, dtype=cd):
+            return jax.ShapeDtypeStruct(shape, dtype, sharding=rep)
+
+        def unit_abstract(unit, lead=()):
+            return {k: a(tuple(lead) + v.shape)
+                    for k, v in s.init_unit(unit, 0).items()}
+
+        emb = unit_abstract("embed")
+        layer = unit_abstract("layer_0")
+        final = unit_abstract("final")
+        ids = a((micro_bs, seq), jnp.int32)
+        x = a((micro_bs, seq, d))
+        rng = a((2,), jnp.uint32)
+        idx = a((), jnp.int32)
+
+        def cast_tree(t):
+            return tmap(lambda g: g.astype(cd), t)
+
+        def gn2(t):
+            return sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                       for g in jax.tree_util.tree_leaves(t))
+
+        # the same five programs ParamStreamRunner builds (kept in sync by
+        # the shared GPTStream definitions)
+        def efwd(e, i):
+            return s.embed_fwd(e, i, cd)
+
+        def lfwd(w, x_, i, r):
+            return s.layer_fwd(w, x_, i, r)
+
+        def lbwd(w, x_, dy, i, r):
+            _, vjp = jax.vjp(lambda w2, x2: s.layer_fwd(w2, x2, i, r), w, x_)
+            dw, dx = vjp(dy)
+            return dx.astype(cd), cast_tree(dw), gn2(dw)
+
+        def hbwd(f, wte, x_, i):
+            loss, (df, dwte, dx) = jax.value_and_grad(
+                s.head_loss, argnums=(0, 1, 2))(f, wte, x_, i, None, None)
+            return loss, cast_tree(df), dwte.astype(cd), dx.astype(cd), gn2(df)
+
+        def ebwd(e, i, dx):
+            _, vjp = jax.vjp(lambda e2: s.embed_fwd(e2, i, cd), e)
+            (de,) = vjp(dx)
+            return cast_tree(de)
+
+        programs = {
+            "embed_fwd": (efwd, (emb, ids)),
+            "layer_fwd": (lfwd, (layer, x, idx, rng)),
+            "layer_bwd": (lbwd, (layer, x, x, idx, rng)),
+            "head_bwd": (hbwd, (final, emb["wte"], x, ids)),
+            "embed_bwd": (ebwd, (emb, ids, x)),
+        }
+        rows: Dict[str, Any] = {}
+        failed = []
+        with mesh_context(topo.mesh):
+            for name, (fn, args) in programs.items():
+                try:
+                    t0 = time.perf_counter()
+                    compiled = jax.jit(fn).lower(*args).compile()
+                    ma = compiled.memory_analysis()
+                    rows[name] = {
+                        "ok": True,
+                        "compile_s": round(time.perf_counter() - t0, 1),
+                        "arguments": int(ma.argument_size_in_bytes),
+                        "temp": int(ma.temp_size_in_bytes),
+                        "peak": int(ma.peak_memory_in_bytes),
+                    }
+                except Exception as e:  # noqa: BLE001 — per-row evidence
+                    rows[name] = {"ok": False, "error": str(e)[-300:]}
+                    failed.append(name)
+
+            # ---- the schedule's two peak MOMENTS, compiled whole ----
+            # Residency model mirrors train_batch (runtime/zero/infinity.py):
+            # head moment: all L+1 activations + embed + final + the keep
+            # window of cached layer units alive while head_bwd runs.
+            acts = a((L + 1, micro_bs, seq, d))
+            win_head = unit_abstract("layer_0", lead=(max(keep, 1),))
+            # first-layer-bwd moment: acts still whole, window holds
+            # keep (+1 prefetch, +1 current) units, head's df grads pending
+            # fetch, dy in flight.
+            win_bwd = unit_abstract("layer_0", lead=(min(keep + 2, L),))
+            df_pending = unit_abstract("final")  # already cd-dtyped abstracts
+
+            def head_moment(f, e, acts_, i, win):
+                # win (the cached units) is resident but not consumed here —
+                # jit(keep_unused=True) keeps it in the program interface so
+                # the compiler accounts its bytes
+                return hbwd(f, e["wte"], acts_[L], i)
+
+            def layer_moment(win, acts_, dy, e, f, df_p, i, r):
+                w = tmap(lambda v: v[0], win)
+                return lbwd(w, acts_[L - 1], dy, i, r)
+
+            moments: Dict[str, Any] = {}
+            moment_defs = {
+                "head_moment": (head_moment,
+                                (final, emb, acts, ids, win_head)),
+                "layer_bwd_moment": (layer_moment,
+                                     (win_bwd, acts, x, emb, final,
+                                      df_pending, idx, rng)),
+            }
+            for name, (fn, args) in moment_defs.items():
+                try:
+                    t0 = time.perf_counter()
+                    compiled = jax.jit(fn, keep_unused=True).lower(
+                        *args).compile()
+                    ma = compiled.memory_analysis()
+                    moments[name] = {
+                        "ok": True,
+                        "compile_s": round(time.perf_counter() - t0, 1),
+                        "arguments": int(ma.argument_size_in_bytes),
+                        "temp": int(ma.temp_size_in_bytes),
+                        "peak": int(ma.peak_memory_in_bytes),
+                    }
+                except Exception as e:  # noqa: BLE001
+                    moments[name] = {"ok": False, "error": str(e)[-300:]}
+                    failed.append(name)
+
+        layer_bytes = sum(int(np.prod(v.shape)) * 2
+                          for v in s.init_unit("layer_0", 0).values())
+        whole_peaks = [m["peak"] for m in moments.values() if m.get("ok")]
+        out: Dict[str, Any] = {
+            "model": model, "topology": topology, "micro_bs": micro_bs,
+            "seq": seq, "keep_layers": keep,
+            "programs": rows, "moments": moments,
+            "layer_unit_bytes": layer_bytes,
+        }
+        if whole_peaks and not failed:
+            peak = max(whole_peaks)
+            out["per_device_bytes"] = {"peak": int(peak)}
+            out["whole_run_peak_bytes"] = int(peak)
+            out["fit"] = fit_verdict(peak)
+            out["fits_v5e_hbm"] = out["fit"]["confidence"] != "oom"
+        else:
+            out["fits_v5e_hbm"] = False
+            out["error"] = "programs failed: " + ", ".join(failed)
+        return out
 
 
 def find_max_batch(

@@ -255,12 +255,16 @@ class DataAnalyzer:
         return out
 
     @staticmethod
-    def merge(out_dir: str, build_inverted: bool = False) -> IndexedMetricStore:
+    def merge(out_dir: str, build_inverted: bool = False,
+              invert_max_rows: int = 1_000_000) -> IndexedMetricStore:
         """Concatenate every worker's shard files into the final store.
 
         ``build_inverted`` additionally writes a ``<metric>_to_sample``
         indexed store per integer-valued metric (the reference's
-        merge_metric_to_sample reduce output)."""
+        merge_metric_to_sample reduce output). The inverted store is dense
+        over [0, max_value]; metrics whose max exceeds ``invert_max_rows``
+        (id-like values) are skipped — call :func:`build_metric_to_sample`
+        on a quantized copy instead."""
         shards = []
         for f in os.listdir(out_dir):
             if f.startswith("shard") and f.endswith(".json"):
@@ -300,10 +304,13 @@ class DataAnalyzer:
                     "— stale worker files from a different analysis?")
             np.save(os.path.join(out_dir, f"{m}.npy"), full)
             if (build_inverted and np.allclose(full, full.astype(np.int64))
-                    and (full.size == 0 or full.min() >= 0)):
-                # mirror build_metric_to_sample's own preconditions: a metric
-                # that can't be inverted (negative sentinel values) is
-                # skipped, not a merge failure
+                    and (full.size == 0
+                         or (full.min() >= 0
+                             and full.max() < invert_max_rows))):
+                # mirror build_metric_to_sample's preconditions and cap the
+                # dense row count: a metric that can't (negatives) or
+                # shouldn't (id-like, max >= cap) be inverted is skipped,
+                # not a merge failure
                 build_metric_to_sample(
                     full, os.path.join(out_dir, f"{m}_to_sample"))
         with open(os.path.join(out_dir, _MANIFEST), "w") as f:

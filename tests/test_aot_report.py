@@ -127,3 +127,38 @@ def test_cli_batch_mode(tmp_path):
     assert p.returncode == 0, p.stderr[-300:]
     rows = [json.loads(l) for l in out.read_text().splitlines()]
     assert rows and rows[0]["name"] == "t" and rows[0]["fits_v5e_hbm"]
+
+
+def test_fit_verdict_margins():
+    """VERDICT r4 next #4: no 'fits' within the fragmentation margin of the
+    ceiling without an explicit marginal label."""
+    from deepspeed_tpu.runtime.aot import fit_verdict
+
+    v = fit_verdict(10e9, hbm_bytes=15.75e9, margin_bytes=1e9)
+    assert v["confidence"] == "fits" and "note" not in v
+    v = fit_verdict(15.2e9, hbm_bytes=15.75e9, margin_bytes=1e9)
+    assert v["confidence"] == "marginal"
+    assert "prediction" in v["note"]
+    assert v["headroom_bytes"] == int(15.75e9 - 15.2e9)
+    v = fit_verdict(16.5e9, hbm_bytes=15.75e9, margin_bytes=1e9)
+    assert v["confidence"] == "oom"
+
+
+def test_infinity_program_report_whole_moments():
+    """The streaming schedule's peak is compiler-accounted (residents are
+    program ARGUMENTS of the compiled moment), not an arithmetic sum."""
+    from deepspeed_tpu.runtime.aot import infinity_program_report
+
+    r = infinity_program_report("gpt2-125m", micro_bs=1, seq=128,
+                                keep_layers=2)
+    assert set(r["moments"]) == {"head_moment", "layer_bwd_moment"}
+    assert all(m["ok"] for m in r["moments"].values())
+    assert all(p["ok"] for p in r["programs"].values())
+    # the whole-moment peak must dominate every single-program peak, and its
+    # arguments must cover the resident activation stack + unit window
+    assert r["whole_run_peak_bytes"] >= max(
+        p["peak"] for p in r["programs"].values())
+    lm = r["moments"]["layer_bwd_moment"]
+    assert lm["arguments"] > 4 * r["layer_unit_bytes"]  # keep+2 window + acts
+    assert r["fit"]["confidence"] in ("fits", "marginal")
+    assert r["per_device_bytes"]["peak"] == r["whole_run_peak_bytes"]

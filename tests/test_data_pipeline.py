@@ -466,3 +466,20 @@ def test_merge_skips_uninvertible_metrics(tmp_path):
     assert not MMapIndexedDataset.exists(
         str(tmp_path / "neg" / "score_to_sample"))
     assert store.metric_to_sample("seqlen").size(4) == 4
+
+
+def test_merge_caps_idlike_metric_inversion(tmp_path):
+    """An id-like integer metric (huge max) must not explode the merge into
+    a dense O(max_value) inverted store."""
+    from deepspeed_tpu.runtime.data_pipeline import (
+        DataAnalyzer, MMapIndexedDataset)
+
+    data = [{"input_ids": np.zeros(4, np.int32)} for _ in range(3)]
+    out = str(tmp_path / "ids")
+    DataAnalyzer({"sample_id": lambda s: 1e8,
+                  "seqlen": lambda s: 4.0}).run(data, out)
+    DataAnalyzer.merge(out, build_inverted=True)
+    assert not MMapIndexedDataset.exists(
+        str(tmp_path / "ids" / "sample_id_to_sample"))
+    assert MMapIndexedDataset.exists(
+        str(tmp_path / "ids" / "seqlen_to_sample"))

@@ -430,10 +430,12 @@ def test_queued_resources_runner_commands():
     with pytest.raises(RuntimeError, match="auth expired"):
         r.wait_active(poll_s=0, max_describe_failures=3,
                       run=lambda *a, **k: Err())
-    # launch path is the gcloud worker fan-out against the provisioned node
+    # launch path is the gcloud worker fan-out against the provisioned node,
+    # scoped to the SAME zone/project as provisioning
     launch = r.get_cmd({"DS_COORD_PORT": "8476"}, r.resource_pool)
     assert launch[0][:6] == ["gcloud", "compute", "tpus", "tpu-vm", "ssh",
                              "slice1"]
+    assert "us-west4-a" in launch[0] and "proj" in launch[0]
 
 
 def test_gke_runner_manifest(tmp_path):
@@ -455,7 +457,8 @@ def test_gke_runner_manifest(tmp_path):
     assert "JAX_COORDINATOR_ADDRESS=dsjob-0.dsjob:8476" in m
     assert "google.com/tpu: 4" in m
     assert "clusterIP: None" in m and "namespace: ml" in m
-    assert "export PYTHONPATH=/app" in m
+    # host paths must NOT leak into the container (the image has its own)
+    assert "PYTHONPATH" not in m and "export DS_COORD_PORT=8476" in m
     assert "python train.py --deepspeed" in m
     # the manifest must actually PARSE (substring asserts missed a
     # block-scalar indentation bug once)
@@ -475,3 +478,34 @@ def test_gke_runner_manifest(tmp_path):
     import os as _os
 
     _os.unlink(cmd[0][3])
+
+
+def test_launcher_refuses_silent_local_run_for_managed_slices(tmp_path):
+    """gke/queued-resources with no resolved workers must refuse, not
+    silently run the script on the operator's machine."""
+    from deepspeed_tpu.launcher.runner import main as launcher_main
+
+    with pytest.raises(SystemExit, match="needs a hostfile or"):
+        launcher_main(["--launcher", "gke", "--hostfile",
+                       str(tmp_path / "missing"), "train.py"])
+
+
+def test_elastic_agent_accepts_object_config(monkeypatch):
+    """The agent must handle the pydantic-shaped config (an object with
+    .elasticity), not just dicts, through the fingerprint export."""
+    from deepspeed_tpu.elasticity import ELASTICITY_CONFIG_ENV
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+
+    class Cfg:
+        elasticity = {"enabled": True, "max_train_batch_size": 64,
+                      "micro_batch_sizes": [2], "min_gpus": 1, "max_gpus": 4}
+
+        def get(self, *a):  # pydantic models have no dict .get
+            raise AssertionError("dict path used on object config")
+
+    monkeypatch.delenv(ELASTICITY_CONFIG_ENV, raising=False)
+    agent = DSElasticAgent(lambda spec: ["true"], Cfg(),
+                           device_count_fn=lambda: 2, poll_interval=0.01)
+    assert agent._elastic_block["max_train_batch_size"] == 64
+    spec = agent.resolve(2)
+    assert spec.world_size == 2
