@@ -4,6 +4,7 @@
 # forever, never errors).
 LOG=/root/repo/tunnel_watch.log
 DEADLINE=$(( $(date +%s) + ${WATCH_SECS:-30000} ))
+WINDOWS_RUN=0
 echo "[watch] start $(date -u +%FT%TZ)" >> "$LOG"
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if timeout 90 python -c "
@@ -20,10 +21,18 @@ print('up:', d[0])
     echo "[watch] tunnel UP $(date -u +%FT%TZ); running window_run" >> "$LOG"
     python /root/repo/scripts/window_run.py >> "$LOG" 2>&1
     echo "[watch] window_run done rc=$? $(date -u +%FT%TZ)" >> "$LOG"
-    exit 0
+    WINDOWS_RUN=$(( WINDOWS_RUN + 1 ))
+    # keep watching: a SECOND window later in the session should bank more
+    # rows (window_run appends; repeat runs are cache-warm re-measurements)
+    sleep 600
+    continue
   fi
   echo "[watch] down $(date -u +%FT%TZ)" >> "$LOG"
   sleep 240
 done
+if [ "$WINDOWS_RUN" -gt 0 ]; then
+  echo "[watch] deadline reached after $WINDOWS_RUN window run(s)" >> "$LOG"
+  exit 0
+fi
 echo "[watch] deadline reached, tunnel never recovered" >> "$LOG"
 exit 1

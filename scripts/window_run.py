@@ -21,12 +21,28 @@ OUT = os.path.join(REPO, "window_run_results.json")
 os.environ.setdefault(
     "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache"))
 
+# append across windows: a second tunnel window later in the session must
+# add rows, not erase the first window's evidence
 RESULTS = []
+try:
+    with open(OUT) as _f:
+        _prev = json.load(_f)
+    if isinstance(_prev, list):
+        RESULTS = _prev
+except ValueError:
+    # a truncated/corrupt ledger is still evidence — keep it aside rather
+    # than overwriting it with a fresh file
+    os.replace(OUT, OUT + ".corrupt")
+except OSError:
+    pass
 
 
 def save():
-    with open(OUT, "w") as f:
+    # atomic: a kill mid-write must never truncate the banked rows
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(RESULTS, f, indent=1)
+    os.replace(tmp, OUT)
 
 
 def run(tag, argv, timeout):
