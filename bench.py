@@ -1246,7 +1246,10 @@ def _load_chip_evidence(sources=None):
                         if k in res}
                 if any(k in keep for k in ("mfu", "decode_p50_ms",
                                            "image_ms_p50")):
-                    rows.append({"tag": c.get("tag", "?"), **keep})
+                    row = {"tag": c.get("tag", "?"), **keep}
+                    if c.get("ts"):  # provenance in multi-window ledgers
+                        row["ts"] = c["ts"]
+                    rows.append(row)
             if rows:
                 kernel_rows = [c for c in chip if isinstance(c, dict)
                                and "kernel" in str(c.get("tag", ""))]
@@ -1285,6 +1288,19 @@ def _summarize(platform: str, sweep: list, errors: list) -> dict:
         })
     if infer_ok:
         result["decode_p50_ms"] = infer_ok[0]["decode_p50_ms"]
+        result["decode_tokens_per_sec"] = infer_ok[0].get("tokens_per_sec")
+        # the reference's published decode bar, embedded so the artifact is
+        # self-describing even when nobody writes the comparison up by hand
+        # — only for rows with hardware provenance (a cpu-fallback row must
+        # not be described as a chip decode)
+        if infer_ok[0].get("platform") not in (None, "cpu"):
+            result["decode_reference_bar"] = {
+                "zero_inference_opt30b_tok_s": 43,
+                "hardware": "1x V100-32GB, full CPU offload",
+                "source": "docs/_posts/2022-09-10-zero-inference.md:52",
+                "note": ("this row decodes a chip-RESIDENT model on one "
+                         "v5e; the reference bar is the host-offload "
+                         "regime — compare decode_tokens_per_sec directly")}
     diff_ok = [r for r in sweep if r.get("kind") == "diffusion"
                and "error" not in r]
     if diff_ok:

@@ -20,8 +20,10 @@ print('up:', d[0])
 " >> "$LOG" 2>&1; then
     echo "[watch] tunnel UP $(date -u +%FT%TZ); running window_run" >> "$LOG"
     python /root/repo/scripts/window_run.py >> "$LOG" 2>&1
-    echo "[watch] window_run done rc=$? $(date -u +%FT%TZ)" >> "$LOG"
-    WINDOWS_RUN=$(( WINDOWS_RUN + 1 ))
+    RC=$?
+    echo "[watch] window_run done rc=$RC $(date -u +%FT%TZ)" >> "$LOG"
+    # only a SUCCESSFUL run counts toward the exit-0 verdict
+    [ "$RC" -eq 0 ] && WINDOWS_RUN=$(( WINDOWS_RUN + 1 ))
     # keep watching: a SECOND window later in the session should bank more
     # rows (window_run appends; repeat runs are cache-warm re-measurements)
     sleep 600

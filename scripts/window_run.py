@@ -29,6 +29,10 @@ try:
         _prev = json.load(_f)
     if isinstance(_prev, list):
         RESULTS = _prev
+    else:
+        # valid-but-wrong-shape JSON is still evidence — set it aside
+        # rather than letting the first save() erase it
+        os.replace(OUT, OUT + ".corrupt")
 except ValueError:
     # a truncated/corrupt ledger is still evidence — keep it aside rather
     # than overwriting it with a fresh file
@@ -48,20 +52,24 @@ def save():
 def run(tag, argv, timeout):
     print(f"[window] {tag}...", flush=True)
     t0 = time.time()
+    # ts: the ledger now spans windows (and possibly sessions) — rows must
+    # carry their own provenance for consumers to tell fresh from stale
     try:
         p = subprocess.run(argv, capture_output=True, text=True,
                            timeout=timeout, cwd=REPO)
         line = next((ln for ln in reversed(p.stdout.strip().splitlines())
                      if ln.strip().startswith("{")), None)
-        rec = {"tag": tag, "rc": p.returncode, "wall_s": round(time.time() - t0),
+        rec = {"tag": tag, "ts": round(t0), "rc": p.returncode,
+               "wall_s": round(time.time() - t0),
                "result": json.loads(line) if line else None}
         if p.returncode != 0:
             rec["stderr"] = p.stderr[-400:]
     except subprocess.TimeoutExpired:
-        rec = {"tag": tag, "rc": -1, "wall_s": round(time.time() - t0),
+        rec = {"tag": tag, "ts": round(t0), "rc": -1,
+               "wall_s": round(time.time() - t0),
                "error": f"timeout {timeout}s"}
     except Exception as e:  # noqa: BLE001
-        rec = {"tag": tag, "rc": -1, "error": str(e)[:200]}
+        rec = {"tag": tag, "ts": round(t0), "rc": -1, "error": str(e)[:200]}
     RESULTS.append(rec)
     save()
     print(f"[window] {tag}: {json.dumps(rec)[:300]}", flush=True)
