@@ -104,6 +104,11 @@ AOT_TRAIN_CONFIGS = [
     {"kind": "infer_aot", "name": "aot-opt13b-decode-b1-int8",
      "model": "opt-13b", "batch": 1, "prompt": 128, "gen": 64,
      "quantize_bits": 8, "force_cpu": True},
+    # 20B chip-RESIDENT via the packed int4 Pallas matmul (13.8 GB peak,
+    # 1.9 GB headroom — outside the fragmentation margin)
+    {"kind": "infer_aot", "name": "aot-neox20b-decode-b1-int4",
+     "model": "gpt-neox-20b", "batch": 1, "prompt": 128, "gen": 64,
+     "quantize_bits": 4, "force_cpu": True, "timeout": 2700},
     {"kind": "kernels_aot", "name": "pallas-kernels-v5e-aot",
      "force_cpu": True, "timeout": 1500},
     {"kind": "train_aot", "name": "gpt2-760m-selrm16-chunk-aot",
@@ -550,10 +555,16 @@ def _worker_infer(cfg: dict) -> dict:
     platform = jax.devices()[0].platform
     mcfg = gpt_mod.PRESETS[cfg["model"]]
     params = gpt_mod.init_params(mcfg, jax.random.PRNGKey(0))
+    # quantize_bits: weight-only int8/int4 decode (Pallas dequant-per-tile
+    # matmuls) — measures the weight-bandwidth lever on the real chip
+    qbits = int(cfg.get("quantize_bits", 0))
     engine = InferenceEngine(
         for_gpt(mcfg, params),
-        DeepSpeedInferenceConfig(dtype="bfloat16",
-                                 max_out_tokens=cfg["prompt"] + cfg["gen"] + 8))
+        DeepSpeedInferenceConfig(
+            dtype="bfloat16",
+            max_out_tokens=cfg["prompt"] + cfg["gen"] + 8,
+            quant={"enabled": bool(qbits), "bits": qbits or 8,
+                   "group_size": 128}))
     ids = np.asarray(np.random.default_rng(0).integers(
         0, mcfg.vocab_size, (cfg["batch"], cfg["prompt"])), np.int32)
 
@@ -573,12 +584,15 @@ def _worker_infer(cfg: dict) -> dict:
     lat.sort()
     p50 = lat[len(lat) // 2]
     p90 = lat[min(len(lat) - 1, int(len(lat) * 0.9))]
-    return {
+    out = {
         "config": cfg["name"], "kind": "inference", "platform": platform,
         "decode_p50_ms": round(p50, 3), "decode_p90_ms": round(p90, 3),
         "tokens_per_sec": round(1e3 / max(p50, 1e-9) * cfg["batch"], 1),
         "batch": cfg["batch"], "prompt": cfg["prompt"],
     }
+    if qbits:
+        out["quantize_bits"] = qbits
+    return out
 
 
 def _worker_diffusion(cfg: dict) -> dict:

@@ -378,18 +378,28 @@ def _sp_active() -> bool:
 
 
 def _wm(h: jnp.ndarray, leaf) -> jnp.ndarray:
-    """``h @ W`` where W is dense OR an int8 ``{"q","s"}`` quantized leaf.
+    """``h @ W`` where W is dense OR a quantized leaf: int8 ``{"q","s"}`` or
+    packed int4 ``{"q4","s"}``.
 
-    Quantized leaves route through the Pallas int8-weight matmul
-    (ops/pallas/int8_matmul.py): s8 stays in HBM, dequantization happens per
-    VMEM tile — no bf16 weight buffer exists at any scope, and decode moves
-    half the weight bytes (the decode bottleneck)."""
+    Quantized leaves route through the Pallas quantized-weight matmuls
+    (ops/pallas/int8_matmul.py): the narrow weights stay in HBM,
+    dequantization happens per VMEM tile — no bf16 weight buffer exists at
+    any scope, and decode moves half (int8) or a quarter (int4) of the
+    weight bytes (the decode bottleneck)."""
     if not _is_qleaf(leaf):
         return h @ leaf
+    shape = h.shape
+    if "q4" in leaf:
+        from ..ops.pallas.int8_matmul import int4_matmul
+
+        q4, s = leaf["q4"], leaf["s"]
+        group = (2 * q4.size) // s.size
+        out = int4_matmul(h.reshape(-1, shape[-1]), q4, s.reshape(-1),
+                          group_size=group)
+        return out.reshape(*shape[:-1], 2 * q4.shape[1])
     from ..ops.pallas.int8_matmul import int8_matmul
 
     q, s = leaf["q"], leaf["s"]
-    shape = h.shape
     group = q.size // s.size
     out = int8_matmul(h.reshape(-1, shape[-1]), q, s.reshape(-1),
                       group_size=group)
@@ -853,7 +863,15 @@ def quantize_for_inference(cfg: GPTConfig, params, bits: int = 8,
         if v.ndim >= 3 and per_layer % group_size == 0 and not k.startswith("ln"):
             ng_l = max(1, per_layer // group_size)
             q, s = quantize(v, bits=bits, num_groups=L * ng_l)
-            blocks[k] = {"q": q, "s": s.reshape(L, ng_l)}
+            if bits == 4 and v.shape[-1] % 2 == 0:
+                # two nibbles per byte (pack_int4 half-split layout): the
+                # weight stack shrinks to a QUARTER of bf16 — 20B decode
+                # becomes chip-resident on one v5e
+                from ..ops.pallas.int8_matmul import pack_int4
+
+                blocks[k] = {"q4": pack_int4(q), "s": s.reshape(L, ng_l)}
+            else:
+                blocks[k] = {"q": q, "s": s.reshape(L, ng_l)}
         else:
             blocks[k] = v
     out = dict(params)
@@ -862,7 +880,7 @@ def quantize_for_inference(cfg: GPTConfig, params, bits: int = 8,
 
 
 def _is_qleaf(v) -> bool:
-    return isinstance(v, dict) and set(v.keys()) == {"q", "s"}
+    return isinstance(v, dict) and set(v.keys()) in ({"q", "s"}, {"q4", "s"})
 
 
 def quantized_partition_specs(params, specs):
@@ -872,7 +890,8 @@ def quantized_partition_specs(params, specs):
 
     def expand(leaf, spec):
         if _is_qleaf(leaf):
-            return {"q": spec, "s": P_(None, None)}
+            qk = "q4" if "q4" in leaf else "q"
+            return {qk: spec, "s": P_(None, None)}
         return spec
 
     return jax.tree_util.tree_map(

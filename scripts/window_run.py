@@ -136,6 +136,14 @@ def main():
     bench({"kind": "moe_train", "name": "moe-125m-8e-train",
            "model": "moe-125m-8e", "micro_bs": 8, "seq": 1024, "steps": 5},
           timeout=2700)
+    # quantized decode: the weight-bandwidth lever measured on chip (int8
+    # halves, packed int4 quarters the bytes/token)
+    bench({"kind": "inference", "name": "gpt2-350m-decode-b8-int8",
+           "model": "gpt2-350m", "batch": 8, "prompt": 128, "gen": 64,
+           "quantize_bits": 8})
+    bench({"kind": "inference", "name": "gpt2-350m-decode-b8-int4",
+           "model": "gpt2-350m", "batch": 8, "prompt": 128, "gen": 64,
+           "quantize_bits": 4})
     run("int8-hbm", [sys.executable,
                      os.path.join(REPO, "scripts", "int8_hbm.py")], 2400)
     bench({"kind": "pipeline_mpmd", "name": "pipeline-mpmd-dispatch"})

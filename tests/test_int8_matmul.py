@@ -86,3 +86,53 @@ def test_large_M_falls_back():
     out = int8_matmul(x, q, s, group_size=group)
     np.testing.assert_allclose(np.asarray(out, np.float32),
                                _ref(x, q, s, group), rtol=2e-2, atol=2e-2)
+
+
+# ------------------------------------------------------------------ int4
+def test_pack_unpack_int4_roundtrip():
+    from deepspeed_tpu.ops.pallas.int8_matmul import pack_int4, unpack_int4
+
+    rng = np.random.default_rng(0)
+    w = rng.integers(-8, 8, size=(4, 16, 256)).astype(np.int8)
+    packed = pack_int4(jnp.asarray(w))
+    assert packed.shape == (4, 16, 128) and packed.dtype == jnp.int8
+    np.testing.assert_array_equal(np.asarray(unpack_int4(packed)), w)
+
+
+def _ref4(x, q, s, group):
+    D, F = q.shape
+    w = (np.asarray(q, np.float32).reshape(-1, group)
+         * np.asarray(s, np.float32)[:, None]).reshape(D, F)
+    return np.asarray(x, np.float32) @ w
+
+
+@pytest.mark.parametrize("M,D,F,group", [
+    (1, 256, 1024, 128),    # decode-shaped GEMV
+    (8, 512, 3072, 128),    # b8 qkv-shaped (n_f odd at bf512 -> exercises
+                            # eligibility; 3072/512=6 even — kernel path)
+    (5, 256, 1024, 256),    # ragged M + coarser groups
+])
+def test_int4_matches_dequant_reference(M, D, F, group):
+    from deepspeed_tpu.ops.pallas.int8_matmul import int4_matmul, pack_int4
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    x = jax.random.normal(k1, (M, D), jnp.float32)
+    w = jax.random.normal(k2, (D, F), jnp.float32)
+    q, s = quantize(w, bits=4, num_groups=(D * F) // group)
+    out = int4_matmul(x, pack_int4(q), s, group_size=group)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               _ref4(x, q, s, group), rtol=2e-2, atol=2e-1)
+
+
+def test_int4_odd_f_block_count_falls_back():
+    """F=512 at block_f=512 -> a single f-block can't split into halves;
+    the XLA fallback must still be exact."""
+    from deepspeed_tpu.ops.pallas.int8_matmul import int4_matmul, pack_int4
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+    x = jax.random.normal(k1, (2, 256), jnp.float32)
+    w = jax.random.normal(k2, (256, 512), jnp.float32)
+    q, s = quantize(w, bits=4, num_groups=(256 * 512) // 128)
+    out = int4_matmul(x, pack_int4(q), s, group_size=128)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               _ref4(x, q, s, 128), rtol=2e-2, atol=2e-1)
