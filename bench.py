@@ -554,17 +554,25 @@ def _worker_infer(cfg: dict) -> dict:
 
     platform = jax.devices()[0].platform
     mcfg = gpt_mod.PRESETS[cfg["model"]]
-    params = gpt_mod.init_params(mcfg, jax.random.PRNGKey(0))
     # quantize_bits: weight-only int8/int4 decode (Pallas dequant-per-tile
     # matmuls) — measures the weight-bandwidth lever on the real chip
     qbits = int(cfg.get("quantize_bits", 0))
+    if cfg.get("stream_init"):
+        # big models (13B/20B): host-streamed quantized init — the fp32 tree
+        # never exists anywhere, the device gets only the narrow stacks
+        params = gpt_mod.init_quantized_decode_params(
+            mcfg, bits=qbits or 4, group_size=128)
+        quant = {"enabled": False}  # params arrive pre-quantized
+    else:
+        params = gpt_mod.init_params(mcfg, jax.random.PRNGKey(0))
+        quant = {"enabled": bool(qbits), "bits": qbits or 8,
+                 "group_size": 128}
     engine = InferenceEngine(
         for_gpt(mcfg, params),
         DeepSpeedInferenceConfig(
             dtype="bfloat16",
             max_out_tokens=cfg["prompt"] + cfg["gen"] + 8,
-            quant={"enabled": bool(qbits), "bits": qbits or 8,
-                   "group_size": 128}))
+            quant=quant))
     ids = np.asarray(np.random.default_rng(0).integers(
         0, mcfg.vocab_size, (cfg["batch"], cfg["prompt"])), np.int32)
 

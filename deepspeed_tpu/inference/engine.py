@@ -61,10 +61,25 @@ class InferenceEngine:
         self._profile_model_time = False
         self._model_times = []
 
-        # dtype conversion + TP placement (parity: engine init flow :38-150)
-        params = jax.tree_util.tree_map(
-            lambda x: x.astype(self.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
-            model.params)
+        # dtype conversion + TP placement (parity: engine init flow :38-150).
+        # Quantized {"q"/"q4","s"} leaves pass through whole: the int8/int4
+        # payload must not be float-cast and the scales stay fp32.
+        from ..models.gpt import _is_qleaf
+
+        def _cast(x):
+            if _is_qleaf(x):
+                return x
+            return (x.astype(self.dtype)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x)
+
+        params = jax.tree_util.tree_map(_cast, model.params,
+                                        is_leaf=_is_qleaf)
+        # params may arrive ALREADY quantized (the host-streamed big-model
+        # init — models/gpt.init_quantized_decode_params): treat exactly like
+        # the per-layer quant path, never re-quantize
+        pre_quantized = any(
+            isinstance(leaf, dict) and _is_qleaf(leaf)
+            for leaf in jax.tree_util.tree_leaves(params, is_leaf=_is_qleaf))
 
         # int8 weight-only quantization (parity: GroupQuantizer,
         # module_inject/replace_module.py:144). Preferred path: the model
@@ -74,7 +89,11 @@ class InferenceEngine:
         # whole-tree dequant inside the compiled fn (storage-only savings).
         self._quant_scales = None
         self._per_layer_quant = False
-        if self.config.quant.enabled and hasattr(model, "quantize_params"):
+        if pre_quantized:
+            self._per_layer_quant = True
+            log_dist("inference engine: pre-quantized layer-stack weights "
+                     "(host-streamed init), in-scan per-layer dequant")
+        elif self.config.quant.enabled and hasattr(model, "quantize_params"):
             params = model.quantize_params(
                 params, bits=self.config.quant.bits,
                 group_size=self.config.quant.group_size)

@@ -879,6 +879,74 @@ def quantize_for_inference(cfg: GPTConfig, params, bits: int = 8,
     return out
 
 
+def init_quantized_decode_params(cfg: GPTConfig, seed: int = 0,
+                                 bits: int = 4, group_size: int = 128,
+                                 compute_dtype=jnp.bfloat16):
+    """Build the quantized decode tree WITHOUT ever materializing the fp32
+    model: layer units are host-initialized one at a time (``GPTStream``
+    numpy init), quantized + nibble-packed in numpy, and only the narrow
+    stacks are pushed to the device. A 20B model's device footprint is the
+    ~10 GB int4 stacks + bf16 embeddings — the fp32 tree (80 GB) that
+    ``init_params`` -> ``quantize_for_inference`` would need never exists on
+    host OR device, which is what makes a MEASURED 20B-decode row possible
+    on one chip. Quantization math is bit-identical to
+    ``ops/quantizer.quantize`` (symmetric group-wise, round-half-even)."""
+    import ml_dtypes
+
+    s = GPTStream(cfg)
+    L = cfg.n_layer
+    qmax = 2.0 ** (bits - 1) - 1.0
+    cd_np = (ml_dtypes.bfloat16 if jnp.dtype(compute_dtype) == jnp.bfloat16
+             else np.float32)
+
+    def np_quantize(w, ng):
+        g = np.ascontiguousarray(w, np.float32).reshape(ng, -1)
+        absmax = np.max(np.abs(g), axis=1, keepdims=True)
+        scales = np.where(absmax > 0, absmax / qmax, 1.0).astype(np.float32)
+        q = np.clip(np.round(g / scales), -qmax - 1, qmax).astype(np.int8)
+        return q.reshape(w.shape), scales[:, 0]
+
+    def np_pack4(q):
+        F = q.shape[-1]
+        lo = q[..., : F // 2].astype(np.int32) & 0xF
+        hi = q[..., F // 2:].astype(np.int32)
+        return (lo | (hi << 4)).astype(np.int8)
+
+    acc_q: Dict[str, list] = {}
+    acc_s: Dict[str, list] = {}
+    acc_dense: Dict[str, list] = {}
+    packed_keys = set()
+    for i in range(L):
+        unit = s.init_unit(f"layer_{i}", seed)
+        for k, v in unit.items():
+            # same predicate as quantize_for_inference (there: stacked
+            # ndim >= 3 == per-layer ndim >= 2)
+            if (v.ndim >= 2 and v.size % group_size == 0
+                    and not k.startswith("ln")):
+                q, sc = np_quantize(v, v.size // group_size)
+                if bits == 4 and v.shape[-1] % 2 == 0:
+                    q = np_pack4(q)
+                    packed_keys.add(k)
+                acc_q.setdefault(k, []).append(q)
+                acc_s.setdefault(k, []).append(sc)
+            else:
+                acc_dense.setdefault(k, []).append(v.astype(cd_np))
+        del unit
+    blocks: Dict[str, Any] = {}
+    for k in acc_q:
+        qk = "q4" if k in packed_keys else "q"
+        blocks[k] = {qk: jnp.asarray(np.stack(acc_q[k])),
+                     "s": jnp.asarray(np.stack(acc_s[k]))}
+        acc_q[k] = None
+    for k in acc_dense:
+        blocks[k] = jnp.asarray(np.stack(acc_dense[k]))
+    params: Dict[str, Any] = {"blocks": blocks}
+    for unit in ("embed", "final"):
+        for k, v in s.init_unit(unit, seed).items():
+            params[k] = jnp.asarray(v.astype(cd_np))
+    return params
+
+
 def _is_qleaf(v) -> bool:
     return isinstance(v, dict) and set(v.keys()) in ({"q", "s"}, {"q4", "s"})
 

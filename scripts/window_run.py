@@ -155,6 +155,13 @@ def main():
     for spec in INFINITY_CONFIGS:
         bench(spec, timeout=spec.get("timeout", 3600))
 
+    # 7b. the big-decode gamble: 20B int4 chip-RESIDENT decode, host-streamed
+    # init (AOT says 13.8 GB peak, 1.95 GB headroom — outside the margin)
+    bench({"kind": "inference", "name": "neox20b-decode-b1-int4",
+           "model": "gpt-neox-20b", "batch": 1, "prompt": 128, "gen": 32,
+           "quantize_bits": 4, "stream_init": True, "reps": 3},
+          timeout=3600)
+
     # 8. long-context k8 row last (compile gamble)
     mfu({"model": "gpt2-350m", "micro_bs": 2, "seq": 8192, "remat": True,
          "policy": "nothing_saveable", "loss_chunk": 512, "k_steps": 8,
