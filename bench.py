@@ -359,11 +359,33 @@ def _worker_kernels(cfg: dict) -> dict:
             q, k, v, layout=layout, block=128).astype(jnp.float32).sum()))
         return f(q4, q4, q4)
 
+    def int8mm():
+        from deepspeed_tpu.ops.pallas.int8_matmul import int8_matmul
+
+        x8 = jnp.asarray(rng.standard_normal((8, 512)), jnp.bfloat16)
+        q8 = jnp.asarray(rng.integers(-127, 128, (512, 1536)), jnp.int8)
+        s8 = jnp.asarray(rng.uniform(0.01, 0.1, (512 * 1536 // 128,)),
+                         jnp.float32)
+        f = jax.jit(lambda x, q, s: int8_matmul(x, q, s, group_size=128))
+        return f(x8, q8, s8)
+
+    def int4mm():
+        from deepspeed_tpu.ops.pallas.int8_matmul import int4_matmul
+
+        x4 = jnp.asarray(rng.standard_normal((8, 512)), jnp.bfloat16)
+        q4 = jnp.asarray(rng.integers(-128, 128, (512, 1536)), jnp.int8)
+        s4 = jnp.asarray(rng.uniform(0.01, 0.1, (512 * 3072 // 128,)),
+                         jnp.float32)
+        f = jax.jit(lambda x, q, s: int4_matmul(x, q, s, group_size=128))
+        return f(x4, q4, s4)
+
     check("flash_attention", flash)
     check("flash_attention_bwd", flash_bwd)
     check("decode_attention", decode)
     check("blocksparse_attention", blocksparse)
     check("blocksparse_attention_bwd", blocksparse_bwd)
+    check("int8_matmul", int8mm)
+    check("int4_matmul", int4mm)
     out = {"config": cfg["name"], "kind": "kernels", "platform": platform,
            "kernels": results}
     if failed:
@@ -730,6 +752,16 @@ def _worker_kernels_aot(cfg: dict) -> dict:
           jax.grad(lambda q, k, v: blocksparse_attention(
               q, k, v, layout=layout, block=128)
               .astype(jnp.float32).sum()), q4, q4, q4)
+    from deepspeed_tpu.ops.pallas.int8_matmul import int4_matmul, int8_matmul
+
+    check("int8_matmul",
+          lambda x, qq, s: int8_matmul(x, qq, s, group_size=128),
+          a((8, 512)), a((512, 1536), jnp.int8),
+          a((512 * 1536 // 128,), jnp.float32))
+    check("int4_matmul",
+          lambda x, qq, s: int4_matmul(x, qq, s, group_size=128),
+          a((8, 512)), a((512, 1536), jnp.int8),
+          a((512 * 3072 // 128,), jnp.float32))
     out = {"config": cfg["name"], "kind": "kernels_aot",
            "platform": "tpu-compile-only", "kernels": results}
     if failed:
