@@ -1355,6 +1355,13 @@ def _summarize(platform: str, sweep: list, errors: list) -> dict:
                 "note": ("this row decodes a chip-RESIDENT model on one "
                          "v5e; the reference bar is the host-offload "
                          "regime — compare decode_tokens_per_sec directly")}
+    # a measured chip-RESIDENT big-model decode (13B int8 / 20B int4) is its
+    # own headline: the reference's answer at this size is host offload
+    big = [r for r in infer_ok if r.get("quantize_bits")
+           and r.get("platform") not in (None, "cpu")
+           and any(m in str(r.get("config", "")) for m in ("13b", "20b"))]
+    if big:
+        result["resident_big_decode"] = big[0]
     diff_ok = [r for r in sweep if r.get("kind") == "diffusion"
                and "error" not in r]
     if diff_ok:
