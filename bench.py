@@ -1269,6 +1269,9 @@ CHIP_EVIDENCE_SOURCES = [
     (os.path.join(REPO, "window_run_results.json"),
      "window_run_results.json (in-round tunnel-window orchestrator, "
      "scripts/window_run.py)"),
+    (os.path.join(REPO, "docs", "CHIP_SESSION_r05.json"),
+     "docs/CHIP_SESSION_r05.json (r5 tunnel-window results, "
+     "watcher-committed)"),
     (os.path.join(REPO, "docs", "CHIP_SESSION_r04_window1.json"),
      "docs/CHIP_SESSION_r04_window1.json (tunnel window 2026-07-31 "
      "03:45-06:50Z, 10 dispatches/row incl. ~350ms RTT each)"),

@@ -24,6 +24,23 @@ print('up:', d[0])
     echo "[watch] window_run done rc=$RC $(date -u +%FT%TZ)" >> "$LOG"
     # only a SUCCESSFUL run counts toward the exit-0 verdict
     [ "$RC" -eq 0 ] && WINDOWS_RUN=$(( WINDOWS_RUN + 1 ))
+    # bank whatever rows exist EVEN on a partial window (the ledger is
+    # append-per-row; bench's evidence loader filters per-row rc/platform).
+    # Pathspec'd commit: never sweep unrelated staged work, never leave the
+    # artifact staged on failure.
+    if cp /root/repo/window_run_results.json \
+          /root/repo/docs/CHIP_SESSION_r05.json 2>/dev/null; then
+      # add is needed for the first (untracked) copy; the pathspec'd commit
+      # still only ever commits this one file
+      if ! (cd /root/repo && git add -- docs/CHIP_SESSION_r05.json \
+            && git commit -q \
+               -m "chip session r5: tunnel-window results (auto-committed by watcher)" \
+               -- docs/CHIP_SESSION_r05.json) >> "$LOG" 2>&1; then
+        echo "[watch] evidence commit failed (see above)" >> "$LOG"
+        (cd /root/repo \
+         && git restore --staged docs/CHIP_SESSION_r05.json) >> "$LOG" 2>&1
+      fi
+    fi
     # keep watching: a SECOND window later in the session should bank more
     # rows (window_run appends; repeat runs are cache-warm re-measurements)
     sleep 600
