@@ -616,25 +616,51 @@ def find_max_batch(
     report plus the search trace. Automates the fit-ladder workflow the
     compile-only evidence rows established (each probe is one
     :func:`train_program_report` call; OOM verdicts are data, not errors)."""
-    trace: List[Dict[str, Any]] = []
-    best: Optional[Dict[str, Any]] = None
-    lo_f, hi_f = lo, hi  # invariant: lo_f fits (once proven), hi_f+1 unknown
-    # first make sure lo fits at all
-    r = train_program_report(model, micro_bs=lo, **report_kwargs)
-    trace.append({"micro_bs": lo, "fits": r["fits_v5e_hbm"]})
+    best_v, best, trace = _find_max(
+        lambda b: train_program_report(model, micro_bs=b, **report_kwargs),
+        "micro_bs", lo, hi)
+    return {"model": model, "max_micro_bs": best_v, "trace": trace,
+            "report": best}
+
+
+def _find_max(probe, param: str, lo: int, hi: int):
+    """Shared fit-ladder binary search: largest value in [lo, hi] for which
+    ``probe(value)`` reports ``fits_v5e_hbm`` (monotonic-fit assumption).
+    Returns (best_value_or_0, best_report_or_None, trace)."""
+    trace = []
+    r = probe(lo)
+    trace.append({param: lo, "fits": r["fits_v5e_hbm"]})
     if not r["fits_v5e_hbm"]:
-        return {"model": model, "max_micro_bs": 0, "trace": trace,
-                "report": None}
+        return 0, None, trace
     best = r
+    lo_f, hi_f = lo, hi
     while lo_f < hi_f:
         mid = (lo_f + hi_f + 1) // 2
-        r = train_program_report(model, micro_bs=mid, **report_kwargs)
-        trace.append({"micro_bs": mid, "fits": r["fits_v5e_hbm"]})
+        r = probe(mid)
+        trace.append({param: mid, "fits": r["fits_v5e_hbm"]})
         if r["fits_v5e_hbm"]:
             lo_f, best = mid, r
         else:
             hi_f = mid - 1
-    return {"model": model, "max_micro_bs": lo_f, "trace": trace,
+    return lo_f, best, trace
+
+
+def find_max_decode_batch(
+    model: str,
+    *,
+    lo: int = 1,
+    hi: int = 64,
+    **report_kwargs: Any,
+) -> Dict[str, Any]:
+    """Binary-search the largest decode ``batch`` whose generate program fits
+    the topology (compile-time verdicts only — the serving-capacity analog of
+    :func:`find_max_batch`; fit is KV-cache + weight bound). Marginal
+    verdicts count as fitting but are flagged in the returned report's
+    ``fit`` field."""
+    best_v, best, trace = _find_max(
+        lambda b: decode_program_report(model, batch=b, **report_kwargs),
+        "batch", lo, hi)
+    return {"model": model, "max_batch": best_v, "trace": trace,
             "report": best}
 
 

@@ -31,14 +31,18 @@ print('up:', d[0])
     if cp /root/repo/window_run_results.json \
           /root/repo/docs/CHIP_SESSION_r05.json 2>/dev/null; then
       # add is needed for the first (untracked) copy; the pathspec'd commit
-      # still only ever commits this one file
-      if ! (cd /root/repo && git add -- docs/CHIP_SESSION_r05.json \
-            && git commit -q \
-               -m "chip session r5: tunnel-window results (auto-committed by watcher)" \
-               -- docs/CHIP_SESSION_r05.json) >> "$LOG" 2>&1; then
-        echo "[watch] evidence commit failed (see above)" >> "$LOG"
-        (cd /root/repo \
-         && git restore --staged docs/CHIP_SESSION_r05.json) >> "$LOG" 2>&1
+      # still only ever commits this one file. A no-change repeat window is
+      # an expected no-op, not a failure.
+      if (cd /root/repo && git status --porcelain \
+            -- docs/CHIP_SESSION_r05.json | grep -q .); then
+        if ! (cd /root/repo && git add -- docs/CHIP_SESSION_r05.json \
+              && git commit -q \
+                 -m "chip session r5: tunnel-window results (auto-committed by watcher)" \
+                 -- docs/CHIP_SESSION_r05.json) >> "$LOG" 2>&1; then
+          echo "[watch] evidence commit failed (see above)" >> "$LOG"
+          (cd /root/repo \
+           && git restore --staged docs/CHIP_SESSION_r05.json) >> "$LOG" 2>&1
+        fi
       fi
     fi
     # keep watching: a SECOND window later in the session should bank more

@@ -162,3 +162,29 @@ def test_infinity_program_report_whole_moments():
     assert lm["arguments"] > 4 * r["layer_unit_bytes"]  # keep+2 window + acts
     assert r["fit"]["confidence"] in ("fits", "marginal")
     assert r["per_device_bytes"]["peak"] == r["whole_run_peak_bytes"]
+
+
+def test_find_max_decode_batch_ladder(monkeypatch):
+    """Binary search over decode batch with compile-time verdicts (the
+    serving-capacity analog of find_max_batch); probes are mocked so the
+    search logic is tested exactly."""
+    from deepspeed_tpu.runtime import aot
+
+    calls = []
+
+    def fake_report(model, *, batch, **kw):
+        calls.append(batch)
+        return {"fits_v5e_hbm": batch <= 11, "batch": batch}
+
+    monkeypatch.setattr(aot, "decode_program_report", fake_report)
+    r = aot.find_max_decode_batch("gpt2-125m", lo=1, hi=32)
+    assert r["max_batch"] == 11
+    assert r["report"]["batch"] == 11
+    assert all(t["fits"] == (t["batch"] <= 11) for t in r["trace"])
+
+    def never_fits(model, *, batch, **kw):
+        return {"fits_v5e_hbm": False}
+
+    monkeypatch.setattr(aot, "decode_program_report", never_fits)
+    r = aot.find_max_decode_batch("gpt2-125m", lo=1, hi=8)
+    assert r["max_batch"] == 0 and r["report"] is None
