@@ -201,3 +201,31 @@ def test_moe_train_row_counts_toward_headline():
     ], [])
     assert s["metric"].startswith("moe-row")
     assert s["vs_baseline"] == round(0.30 / 0.45, 3)
+
+
+def test_moe_train_worker_end_to_end():
+    """The window grid's measured-MoE row must be executable as-is: run the
+    actual bench worker subprocess on the tiny preset (a spec typo or engine
+    regression here would burn tunnel-window time)."""
+    import os
+    import subprocess
+    import sys
+
+    bench = _bench()
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, bench.__file__, "--worker",
+         json.dumps({"kind": "moe_train", "name": "tiny-moe-worker",
+                     "model": "tiny-moe", "micro_bs": 2, "seq": 32,
+                     "steps": 2})],
+        capture_output=True, text=True, timeout=420, env=env,
+        cwd=os.path.dirname(bench.__file__))
+    assert p.returncode == 0, p.stderr[-800:]
+    line = next(ln for ln in reversed(p.stdout.strip().splitlines())
+                if ln.startswith("{"))
+    r = json.loads(line)
+    assert r["kind"] == "moe_train" and r["num_experts"] == 4
+    assert r["tokens_per_sec_chip"] > 0 and r["mfu"] > 0
+    import numpy as np
+
+    assert np.isfinite(r["loss"])

@@ -509,3 +509,22 @@ def test_elastic_agent_accepts_object_config(monkeypatch):
     assert agent._elastic_block["max_train_batch_size"] == 64
     spec = agent.resolve(2)
     assert spec.world_size == 2
+
+
+def test_profile_modules_none_without_gpt_config():
+    """A model without a GPTConfig (e.g. MoE) yields no module tree; the
+    report must still print instead of raising."""
+    from deepspeed_tpu.models import build_gpt_moe
+    from deepspeed_tpu.profiling import FlopsProfiler
+
+    model, _ = build_gpt_moe("tiny-moe")
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config={"train_micro_batch_size_per_gpu": 1,
+                             "steps_per_print": 0})
+    prof = FlopsProfiler(engine)
+    r = np.random.default_rng(0)
+    prof.profile_train_batch(
+        {"input_ids": r.integers(0, 256, (8, 32), dtype=np.int32)})
+    assert prof.profile_modules() is None
+    text = prof.print_model_profile()
+    assert "Flops Profiler" in text and "layers x" not in text
