@@ -229,3 +229,49 @@ def test_moe_train_worker_end_to_end():
     import numpy as np
 
     assert np.isfinite(r["loss"])
+
+
+def test_main_recovery_splice(monkeypatch, capsys):
+    """End-to-end main() logic with a tunnel that comes back mid-sweep: the
+    measured TPU rows are spliced in right after the current row, fallback
+    rows keep their forced-CPU labels, and the final summary's vs_baseline
+    comes from the recovered row."""
+    bench = _bench()
+    monkeypatch.setattr(bench, "probe_backend",
+                        lambda: ("cpu", 1, ["probe hung (killed)"]))
+    monkeypatch.setattr(bench, "RECOVERY_PROBE_EVERY", 0)
+    monkeypatch.setattr(bench, "quick_probe", lambda timeout=0: True)
+    monkeypatch.setattr(bench, "_persist_row", lambda row: None)
+    monkeypatch.setattr(bench, "cpu_fallback_configs", lambda: [
+        {"kind": "train", "name": "cpu-fallback-zero1", "force_cpu": True},
+        {"kind": "train_aot", "name": "aot-row", "force_cpu": True},
+    ])
+    monkeypatch.setattr(bench, "tpu_core_configs", lambda: [
+        {"kind": "train", "name": "tpu-train"},
+        {"kind": "train_aot", "name": "tpu-aot", "force_cpu": True},
+    ])
+    ran = []
+
+    def fake_worker(cfg, platform, retries=1):
+        ran.append((cfg["name"], platform, bool(cfg.get("force_cpu"))))
+        if cfg["kind"] == "train":
+            plat = "cpu" if cfg.get("force_cpu") else platform
+            return {"kind": "train", "config": cfg["name"], "platform": plat,
+                    "tokens_per_sec_chip": 100.0 if plat == "cpu" else 9000.0,
+                    "mfu": 0.01 if plat == "cpu" else 0.41}
+        return {"kind": cfg["kind"], "config": cfg["name"],
+                "platform": "tpu-compile-only", "fits_v5e_hbm": True}
+
+    monkeypatch.setattr(bench, "run_worker", fake_worker)
+    bench.main()
+    # recovery fired after row 1: the measured TPU row (not the force_cpu
+    # AOT row, which already runs in the fallback) is spliced NEXT
+    assert [n for n, _, _ in ran] == [
+        "cpu-fallback-zero1", "tpu-train", "aot-row"]
+    # post-recovery, the still-queued fallback row ran under platform "tpu"
+    # but carries force_cpu (its env stays forced — label integrity)
+    assert ran[2] == ("aot-row", "tpu", True)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["metric"].startswith("tpu-train")
+    assert out["vs_baseline"] == round(0.41 / 0.45, 3)
+    assert "chip_window_evidence" not in out
