@@ -275,3 +275,30 @@ def test_main_recovery_splice(monkeypatch, capsys):
     assert out["metric"].startswith("tpu-train")
     assert out["vs_baseline"] == round(0.41 / 0.45, 3)
     assert "chip_window_evidence" not in out
+
+
+def test_quick_probe_rejects_cpu_backend(monkeypatch):
+    """The recovery probe must NOT claim the tunnel is back on a CPU
+    backend — a CPU 'success' would splice TPU rows into a chipless sweep.
+    The probe subprocess is faked so the platform guard (not a timeout) is
+    what's tested."""
+    import subprocess as sp
+
+    bench = _bench()
+
+    class Done:
+        returncode = 0
+
+        def __init__(self, platform):
+            self.stdout = f"PLATFORM={platform} NCHIPS=1\n"
+
+    monkeypatch.setattr(sp, "run", lambda *a, **k: Done("cpu"))
+    assert bench.quick_probe(timeout=5) is False
+    monkeypatch.setattr(sp, "run", lambda *a, **k: Done("TPU v5 lite"))
+    assert bench.quick_probe(timeout=5) is True
+
+    def hang(*a, **k):
+        raise sp.TimeoutExpired(cmd="probe", timeout=5)
+
+    monkeypatch.setattr(sp, "run", hang)
+    assert bench.quick_probe(timeout=5) is False
