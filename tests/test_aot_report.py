@@ -188,3 +188,61 @@ def test_find_max_decode_batch_ladder(monkeypatch):
     monkeypatch.setattr(aot, "decode_program_report", never_fits)
     r = aot.find_max_decode_batch("gpt2-125m", lo=1, hi=8)
     assert r["max_batch"] == 0 and r["report"] is None
+
+
+def test_fused_train_step_matches_engine_semantics():
+    """Every AOT report compiles runtime/aot.fused_train_step and presents
+    its memory/flops as THE engine program's. Pin the semantics: one step of
+    the fused function from the engine's own initial state must produce the
+    same loss and the same updated master as engine.train_batch."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import build_gpt
+    from deepspeed_tpu.models.gpt import GPTConfig
+    from deepspeed_tpu.ops.optimizers import get_optimizer
+    from deepspeed_tpu.runtime.aot import fused_train_step
+
+    from deepspeed_tpu.runtime.topology import MeshTopology
+
+    model, _ = build_gpt(GPTConfig(vocab_size=128, n_layer=2, n_head=2,
+                                   d_model=32, max_seq_len=32))
+    # dp=1: an 8-way grad psum reorders float sums, and first-step Adam
+    # amplifies that noise to full +/-lr on near-zero-grad leaves — the
+    # semantic pin needs bitwise-comparable reductions
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        topology=MeshTopology.create(dp=1, devices=jax.devices()[:1]),
+        config={"train_micro_batch_size_per_gpu": 8,
+                "optimizer": {"type": "AdamW",
+                              "params": {"lr": 3e-4, "weight_decay": 0.1}},
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 0},
+                "gradient_clipping": 1.0,
+                "steps_per_print": 0})
+    tmap = jax.tree_util.tree_map
+    state0 = {k: tmap(jnp.copy, engine.state[k])
+              for k in ("params", "master", "opt")}
+    batch = {"input_ids": np.random.default_rng(0).integers(
+        0, 128, (8, 32), dtype=np.int32)}
+
+    m = engine.train_batch(batch)
+    eng_loss = float(m["loss"])
+
+    step = fused_train_step(model, get_optimizer(
+        "AdamW", {"lr": 3e-4, "weight_decay": 0.1}))
+    _, new_master, _, loss, _ = jax.jit(step)(
+        state0["params"], state0["master"], state0["opt"],
+        {"input_ids": jnp.asarray(batch["input_ids"])},
+        jax.random.PRNGKey(0))
+    assert abs(float(loss) - eng_loss) < 1e-3, (float(loss), eng_loss)
+    assert (jax.tree_util.tree_structure(new_master)
+            == jax.tree_util.tree_structure(engine.state["master"]))
+    for a, b in zip(jax.tree_util.tree_leaves(new_master),
+                    jax.tree_util.tree_leaves(engine.state["master"]),
+                    strict=True):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
