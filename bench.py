@@ -85,6 +85,29 @@ INFINITY_CONFIGS = [
      "stage": 1, "loss_chunk": 128, "timeout": 3600},
 ]
 
+# Quantized ZeRO collectives (ZeRO++-style, comm/quantized.py): two
+# apples-to-apples pairs at identical geometry — stage-3 fp vs quantized
+# param gathers (the weight-wire lever), and stage-2 fp vs quantized grad
+# reduction (the gradient-wire lever; stage 2 because the quantized grad
+# program replicates params per device, which would negate the stage-3 row's
+# memory story). fp32 compute on purpose — the wire ratio is measured against
+# the logical dtype, and bf16 would halve the 4x-class reduction the knob is
+# sold on. Rows report the wire_ledger per-op dict next to step time.
+QUANTIZED_ZERO_CONFIGS = [
+    {"kind": "train", "name": "gpt2-125m-zero3-fp", "model": "gpt2-125m",
+     "micro_bs": 4, "seq": 512, "stage": 3, "steps": 3, "precision": "fp32",
+     "timeout": 1800},
+    {"kind": "train", "name": "gpt2-125m-zero3-qw8", "model": "gpt2-125m",
+     "micro_bs": 4, "seq": 512, "stage": 3, "steps": 3, "precision": "fp32",
+     "quantized_weights": True, "timeout": 1800},
+    {"kind": "train", "name": "gpt2-125m-zero2-fp", "model": "gpt2-125m",
+     "micro_bs": 4, "seq": 512, "stage": 2, "steps": 3, "precision": "fp32",
+     "timeout": 1800},
+    {"kind": "train", "name": "gpt2-125m-zero2-qg8", "model": "gpt2-125m",
+     "micro_bs": 4, "seq": 512, "stage": 2, "steps": 3, "precision": "fp32",
+     "quantized_gradients": True, "timeout": 1800},
+]
+
 # Compile-only evidence rows: the XLA TPU compiler runs on the host, so these
 # produce real-v5e HBM/FLOPs numbers for the flagship train configs even when
 # the tunnel is dead (round-3 post-mortem: a down tunnel left the round with
@@ -417,6 +440,14 @@ def _worker_train(cfg: dict) -> dict:
     n_chips = len(jax.devices())
     micro_bs, seq, steps = cfg["micro_bs"], cfg["seq"], cfg["steps"]
     zero_cfg = {"stage": cfg.get("stage", 0)}
+    # quantized collectives (QUANTIZED_ZERO_CONFIGS): block-int8 wire for the
+    # ZeRO-3 param gathers and/or the dp gradient reduction
+    if cfg.get("quantized_weights"):
+        zero_cfg["zero_quantized_weights"] = True
+    if cfg.get("quantized_gradients"):
+        zero_cfg["zero_quantized_gradients"] = True
+    if cfg.get("quantize_bits"):
+        zero_cfg["zero_quantize_bits"] = int(cfg["quantize_bits"])
     if cfg.get("offload") == "param_stream":
         # ZeRO-Infinity: host masters streamed unit-by-unit through HBM —
         # the bigger-than-HBM single-chip regime (reference: 13B on one V100,
@@ -436,7 +467,9 @@ def _worker_train(cfg: dict) -> dict:
             "gradient_accumulation_steps": gas,
             "optimizer": {"type": "AdamW",
                           "params": {"lr": 3e-4, "weight_decay": 0.1}},
-            "bf16": {"enabled": True},
+            # precision=fp32 (the quantized-zero rows): logical wire dtype is
+            # fp32 so the ledger ratio reflects the full int8 reduction
+            "bf16": {"enabled": cfg.get("precision", "bf16") != "fp32"},
             "zero_optimization": zero_cfg,
             "gradient_clipping": 1.0,
             "steps_per_print": 0,
@@ -484,6 +517,13 @@ def _worker_train(cfg: dict) -> dict:
         "loss": round(float(m["loss"]), 4),
         "step_ms": round(dt / (steps * k_steps) * 1e3, 1),
     }
+    if cfg.get("quantized_weights") or cfg.get("quantized_gradients"):
+        # logical-vs-wire bytes per quantized op (trace-time ledger): the
+        # compression evidence the QUANTIZED_ZERO_CONFIGS rows exist for
+        from deepspeed_tpu.comm.runtime_accounting import wire_ledger
+
+        out["wire"] = wire_ledger.summary_dict()
+        out["wire_ratio"] = round(wire_ledger.ratio(), 3)
     if cfg.get("offload"):
         out["offload"] = cfg["offload"]
         runner = getattr(engine, "_param_stream", None)
@@ -1188,6 +1228,7 @@ def tpu_core_configs() -> list:
         # on-chip dispatch microbench. Infinity rows (long, host-streamed)
         # only under BENCH_FULL.
         PIPELINE_CONFIGS + AOT_TRAIN_CONFIGS
+        + QUANTIZED_ZERO_CONFIGS
         + (INFINITY_CONFIGS if full else []))
 
 
@@ -1202,6 +1243,13 @@ def cpu_fallback_configs() -> list:
         {"kind": "train", "name": f"cpu-fallback-zero{s}", "model": "gpt2-125m",
          "micro_bs": 2, "seq": 128, "stage": s, "steps": 3, "force_cpu": True}
         for s in (1, 2)
+    ] + [
+        # quantized ZeRO-3 wire evidence is chip-independent (the ledger
+        # records at trace time), so the fallback sweep measures it too
+        {"kind": "train", "name": "cpu-fallback-zero3-qw8",
+         "model": "gpt2-125m", "micro_bs": 2, "seq": 128, "stage": 3,
+         "steps": 3, "precision": "fp32", "quantized_weights": True,
+         "force_cpu": True},
     ] + [{"kind": "inference", "name": "cpu-fallback-decode", "model": "gpt2-125m",
           "batch": 1, "prompt": 32, "gen": 16, "reps": 3, "force_cpu": True},
          # real-TPU-compiler evidence even when the tunnel is down

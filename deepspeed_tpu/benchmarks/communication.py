@@ -25,12 +25,12 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from jax import shard_map
+from ..utils.jax_compat import shard_map
 
 AXIS = "bench"
 
 OPS = ("all_reduce", "all_gather", "reduce_scatter", "all_to_all",
-       "broadcast", "pt2pt")
+       "broadcast", "pt2pt", "qall_gather", "qreduce_scatter")
 
 
 def _busbw_factor(op: str, n: int) -> float:
@@ -38,7 +38,8 @@ def _busbw_factor(op: str, n: int) -> float:
         return 1.0
     if op == "all_reduce":
         return 2.0 * (n - 1) / n
-    if op in ("all_gather", "reduce_scatter", "all_to_all"):
+    if op in ("all_gather", "reduce_scatter", "all_to_all",
+              "qall_gather", "qreduce_scatter"):
         return (n - 1) / n
     return 1.0  # broadcast / pt2pt
 
@@ -74,15 +75,29 @@ def _collective_fn(op: str, mesh: Mesh):
         perm = [(i, (i + 1) % n) for i in range(n)]
         return jax.lax.ppermute(x, AXIS, perm)
 
+    def qag(x):
+        # block-int8 wire (comm/quantized.py): algbw from LOGICAL bytes over
+        # measured time, so quantized rows report EFFECTIVE bandwidth — the
+        # apples-to-apples comparison against the full-precision row above
+        from ..comm.quantized import qall_gather
+
+        return qall_gather(x, AXIS, axis=0, tiled=True)
+
+    def qrs(x):
+        from ..comm.quantized import qreduce_scatter
+
+        return qreduce_scatter(x, AXIS, axis=0)
+
     inner = {"all_reduce": ar, "all_gather": ag, "reduce_scatter": rs,
-             "all_to_all": a2a, "broadcast": bc, "pt2pt": p2p}[op]
+             "all_to_all": a2a, "broadcast": bc, "pt2pt": p2p,
+             "qall_gather": qag, "qreduce_scatter": qrs}[op]
 
     def body(x):  # shard arrives as [1, elems]; collectives want flat payloads
         return inner(x.reshape(-1))
 
-    # all_gather's result is replicated (every device holds the full payload);
-    # everything else hands back a per-device payload on the bench axis
-    out_specs = P(None) if op == "all_gather" else P(AXIS)
+    # (q)all_gather's result is replicated (every device holds the full
+    # payload); everything else hands back a per-device payload on the axis
+    out_specs = P(None) if op in ("all_gather", "qall_gather") else P(AXIS)
     fn = shard_map(body, mesh=mesh, in_specs=spec, out_specs=out_specs,
                    check_vma=False)
     return jax.jit(fn)

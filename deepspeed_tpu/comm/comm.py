@@ -45,12 +45,17 @@ AxisName = Union[str, Sequence[str]]
 @dataclass
 class _OpRecord:
     count: int = 0
-    bytes: int = 0
+    bytes: int = 0       # logical bytes (full-precision payload)
+    wire_bytes: int = 0  # bytes actually on the wire (== bytes unless quantized)
 
 
 @dataclass
 class CommsLogger:
-    """Per-op count/byte accounting. Parity: ``utils/comms_logging.py:56``."""
+    """Per-op count/byte accounting. Parity: ``utils/comms_logging.py:56``.
+
+    Quantized collectives (``comm/quantized.py``) record both the logical
+    payload and the compressed wire bytes, so the summary shows the per-op
+    compression ratio next to the counts."""
 
     enabled: bool = False
     verbose: bool = False
@@ -58,7 +63,8 @@ class CommsLogger:
     prof_ops: list = field(default_factory=list)
     records: Dict[str, _OpRecord] = field(default_factory=dict)
 
-    def record(self, op_name: str, nbytes: int) -> None:
+    def record(self, op_name: str, nbytes: int,
+               wire_bytes: Optional[int] = None) -> None:
         if not self.enabled:
             return
         if not self.prof_all and self.prof_ops and not any(
@@ -67,8 +73,11 @@ class CommsLogger:
         rec = self.records.setdefault(op_name, _OpRecord())
         rec.count += 1
         rec.bytes += int(nbytes)
+        rec.wire_bytes += int(wire_bytes if wire_bytes is not None else nbytes)
         if self.verbose:
-            logger.info(f"comm: {op_name} {nbytes} bytes (trace-time)")
+            wire = (f" wire {wire_bytes}" if wire_bytes is not None
+                    and wire_bytes != nbytes else "")
+            logger.info(f"comm: {op_name} {nbytes} bytes{wire} (trace-time)")
 
     def log_summary(self, scale: int = 1) -> str:
         """Per-op summary. ``scale``: number of executions of the compiled
@@ -79,9 +88,12 @@ class CommsLogger:
                + (f" x {scale} executions)" if scale != 1 else ")") + ":")
         lines = [hdr]
         for name, rec in sorted(self.records.items()):
-            lines.append(
-                f"  {name:<24} count={rec.count * scale:<8} "
-                f"bytes={rec.bytes * scale}")
+            line = (f"  {name:<24} count={rec.count * scale:<8} "
+                    f"bytes={rec.bytes * scale}")
+            if rec.wire_bytes != rec.bytes:
+                ratio = rec.bytes / max(1, rec.wire_bytes)
+                line += f" wire={rec.wire_bytes * scale} ({ratio:.2f}x)"
+            lines.append(line)
         out = "\n".join(lines)
         log_dist(out)
         return out
@@ -235,7 +247,7 @@ def scatter(x, axis_name: AxisName, src_index: int = 0, axis: int = 0):
     src's array along ``axis``. Pytrees supported."""
     comms_logger.record(f"scatter[{axis_name}]", _nbytes(x))
     src = broadcast(x, axis_name, src_index)
-    n = lax.axis_size(axis_name)
+    n = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     return jax.tree_util.tree_map(
         lambda s: lax.dynamic_slice_in_dim(
@@ -268,7 +280,9 @@ def axis_index(axis_name: AxisName):
 
 
 def axis_size(axis_name: AxisName):
-    return lax.axis_size(axis_name)
+    # not lax.axis_size: that helper is missing from the older jax this image
+    # ships; psum of a literal folds to the same static extent on every version
+    return lax.psum(1, axis_name)
 
 
 # --------------------------------------------------------------------------- host-side

@@ -1,0 +1,467 @@
+"""Quantized collectives: block-int8/int4 wire formats for ZeRO traffic.
+
+The ZeRO++ result (PAPERS.md) is that the collectives dominating sharded-training
+step time — stage-3 parameter all-gathers and the dp gradient reduce-scatter —
+tolerate block-quantized wire formats with negligible quality loss, cutting comm
+volume ~4x; EQuARX shows the same block-quantized exchange is practical *inside*
+XLA. This module is that subsystem for the TPU-native stack:
+
+- **Primitives**: :func:`quantize_blockwise` / :func:`dequantize_blockwise` —
+  per-block affine (scale + zero-point) quantization over trailing-dimension
+  blocks, 8-bit or packed 4-bit payloads, optional stochastic rounding, and a
+  shared error-feedback residual step (:func:`error_feedback_step`) used by both
+  the int collectives here and the 1-bit compressed allreduce
+  (:mod:`deepspeed_tpu.runtime.comm.compressed`).
+- **Axis collectives** (call inside ``shard_map``, drop-in shaped like the
+  facade's :func:`~deepspeed_tpu.comm.comm.all_gather` /
+  :func:`~deepspeed_tpu.comm.comm.reduce_scatter` /
+  :func:`~deepspeed_tpu.comm.comm.all_to_all`): :func:`qall_gather`,
+  :func:`qreduce_scatter` (dequantize-then-reduce via all-to-all chunks — the
+  reduction itself stays fp32, only the wire is int), :func:`qall_to_all`.
+- **GSPMD helper** (call inside plain ``jit``): :func:`quantized_reshard` —
+  quantize, ``with_sharding_constraint`` the *int payload* to the target spec so
+  XLA's inserted collective moves int8/int4 bytes instead of fp32/bf16, then
+  dequantize. Straight-through backward (``custom_vjp`` identity), so parameter
+  gathers in the forward stay differentiable. This is how quantization composes
+  with the repo's declarative ZeRO (collectives are GSPMD-inserted, not called).
+
+Accounting: every op records logical bytes (what full precision would have put
+on the wire) and wire bytes (int payload + per-block scales/zero-points) at
+trace time, into both the facade's :class:`~deepspeed_tpu.comm.comm.CommsLogger`
+and the measured-side ledger
+(:data:`deepspeed_tpu.comm.runtime_accounting.wire_ledger`), so the compression
+ratio is observable per-op.
+
+Wire format per block of ``B`` elements: ``B`` bytes (int8) or ``B/2`` (int4)
+payload + 4-byte fp32 scale + 4-byte fp32 zero-point. At the default B=256 that
+is a 3.88x reduction vs fp32, 1.94x vs bf16.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .comm import comms_logger
+from .runtime_accounting import wire_ledger
+
+AxisName = Union[str, Sequence[str]]
+
+DEFAULT_BLOCK = 256
+SUPPORTED_BITS = (4, 8)
+
+
+# --------------------------------------------------------------------------- config
+@dataclasses.dataclass(frozen=True)
+class QuantizedCommConfig:
+    """Resolved quantized-collective knobs (from the ``zero_optimization`` block)."""
+
+    weights: bool = False    # zero_quantized_weights: fwd param gathers + MoE a2a
+    gradients: bool = False  # zero_quantized_gradients: dp grad reduce-scatter
+    bits: int = 8
+    block_size: int = DEFAULT_BLOCK
+    stochastic: bool = False
+    error_feedback: bool = False
+
+    def __post_init__(self):
+        if self.bits not in SUPPORTED_BITS:
+            raise ValueError(
+                f"zero_quantize_bits must be one of {SUPPORTED_BITS}, "
+                f"got {self.bits}")
+        if self.block_size < 8 or self.block_size % 2:
+            raise ValueError(
+                f"zero_quantize_block_size must be an even int >= 8, "
+                f"got {self.block_size}")
+
+    @property
+    def enabled(self) -> bool:
+        return self.weights or self.gradients
+
+    @classmethod
+    def from_zero_config(cls, zero_cfg: Any) -> "QuantizedCommConfig":
+        g = lambda k, d: getattr(zero_cfg, k, d)  # noqa: E731
+        return cls(
+            weights=bool(g("zero_quantized_weights", False)),
+            gradients=bool(g("zero_quantized_gradients", False)),
+            bits=int(g("zero_quantize_bits", 8)),
+            block_size=int(g("zero_quantize_block_size", DEFAULT_BLOCK)),
+            stochastic=bool(g("zero_quantize_stochastic", False)),
+            error_feedback=bool(g("zero_quantize_error_feedback", False)),
+        )
+
+
+def active_quantization() -> Optional[QuantizedCommConfig]:
+    """The quantization config bound for the current trace, or None.
+
+    The engine binds its ``zero_optimization`` block around tracing (the same
+    :func:`~deepspeed_tpu.runtime.zero.gather.gather_window` binding the stage-3
+    gather knobs ride); model-level call sites (MoE dispatch, layer scans) read
+    it here so quantization follows the engine config without plumbing."""
+    from ..runtime.zero.gather import _active_cfg
+
+    cfg = _active_cfg()
+    if cfg is None:
+        return None
+    q = QuantizedCommConfig.from_zero_config(cfg)
+    return q if q.enabled else None
+
+
+# --------------------------------------------------------------------------- accounting
+def _record(op_name: str, logical_bytes: int, wire_bytes: int) -> None:
+    comms_logger.record(op_name, logical_bytes, wire_bytes=wire_bytes)
+    wire_ledger.record(op_name, logical_bytes, wire_bytes)
+
+
+def _payload_bytes(*arrays) -> int:
+    return sum(int(np.prod(a.shape)) * a.dtype.itemsize for a in arrays)
+
+
+# --------------------------------------------------------------------------- primitives
+def effective_block(n_last: int, block_size: int) -> int:
+    """Block size actually used for a trailing dim of ``n_last``: the requested
+    size, shrunk for short rows so padding never dominates (a [.., 32] leaf
+    quantized with 256-blocks would pad 8x and INFLATE the wire). Kept even so
+    int4 packing stays byte-aligned."""
+    eff = min(int(block_size), int(n_last) + (int(n_last) % 2))
+    return max(eff, 2)
+
+
+def quantization_shrinks(n_last: int, bits: int, block_size: int,
+                         logical_itemsize: int) -> bool:
+    """Whether the quantized wire (payload + per-block scale/zero-point) is
+    actually smaller than the full-precision payload for this row length."""
+    eff = effective_block(n_last, block_size)
+    return bits / 8.0 + 8.0 / eff < float(logical_itemsize)
+
+
+def _pad_last(x: jnp.ndarray, multiple: int) -> jnp.ndarray:
+    n = x.shape[-1]
+    pad = (-n) % multiple
+    if pad == 0:
+        return x
+    cfg = [(0, 0)] * (x.ndim - 1) + [(0, pad)]
+    # edge padding keeps the tail block's [min, max] range tight (zero padding
+    # would widen it and inflate that block's quantization step)
+    return jnp.pad(x, cfg, mode="edge")
+
+
+def quantize_blockwise(
+    x: jnp.ndarray,
+    bits: int = 8,
+    block_size: int = DEFAULT_BLOCK,
+    stochastic: bool = False,
+    rng: Optional[jax.Array] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Per-block affine quantization over trailing-dimension blocks.
+
+    Returns ``(q, scale, zero_point)``: ``q`` uint8 ``[..., n_pad]`` (int8) or
+    ``[..., n_pad/2]`` (int4, two values per byte); ``scale``/``zero_point``
+    fp32 ``[..., n_blocks]``. ``x_hat = q * scale + zero_point`` per block.
+    ``stochastic=True`` rounds ``floor(v + u)``, ``u ~ U[0,1)`` (unbiased —
+    the right choice for gradients); requires ``rng``.
+    """
+    if bits not in SUPPORTED_BITS:
+        raise ValueError(f"bits must be one of {SUPPORTED_BITS}, got {bits}")
+    levels = (1 << bits) - 1
+    block_size = effective_block(x.shape[-1], block_size)
+    x32 = _pad_last(x.astype(jnp.float32), block_size)
+    lead = x32.shape[:-1]
+    nb = x32.shape[-1] // block_size
+    xb = x32.reshape(lead + (nb, block_size))
+    mn = jnp.min(xb, axis=-1)
+    mx = jnp.max(xb, axis=-1)
+    scale = jnp.maximum((mx - mn) / levels, jnp.float32(1e-12))
+    v = (xb - mn[..., None]) / scale[..., None]
+    if stochastic:
+        if rng is None:
+            raise ValueError("stochastic rounding requires an rng key")
+        v = jnp.floor(v + jax.random.uniform(rng, v.shape, jnp.float32))
+    else:
+        v = jnp.round(v)
+    q = jnp.clip(v, 0, levels).astype(jnp.uint8).reshape(lead + (nb * block_size,))
+    if bits == 4:
+        q = (q[..., 0::2] | (q[..., 1::2] << 4)).astype(jnp.uint8)
+    return q, scale, mn
+
+
+def dequantize_blockwise(
+    q: jnp.ndarray,
+    scale: jnp.ndarray,
+    zero_point: jnp.ndarray,
+    bits: int = 8,
+    block_size: int = DEFAULT_BLOCK,
+    orig_size: Optional[int] = None,
+) -> jnp.ndarray:
+    """Inverse of :func:`quantize_blockwise` (fp32 output, trailing padding
+    trimmed to ``orig_size`` when given). The block extent is derived from the
+    payload/scale shapes, so it stays consistent with whatever effective block
+    the quantizer picked; ``block_size`` is accepted for signature symmetry."""
+    del block_size
+    lead = q.shape[:-1]
+    if bits == 4:
+        lo = (q & 0xF).astype(jnp.uint8)
+        hi = (q >> 4).astype(jnp.uint8)
+        q = jnp.stack([lo, hi], axis=-1).reshape(lead + (q.shape[-1] * 2,))
+    nb = scale.shape[-1]
+    block = q.shape[-1] // nb
+    xb = q.reshape(lead + (nb, block)).astype(jnp.float32)
+    x = (xb * scale[..., None] + zero_point[..., None]).reshape(
+        lead + (nb * block,))
+    if orig_size is not None and orig_size != x.shape[-1]:
+        x = x[..., :orig_size]
+    return x
+
+
+# 1-bit (sign) quantizer — the wire format of the compressed allreduce; lives
+# here so the error-feedback machinery is shared with the int collectives.
+def pack_signs(x: jnp.ndarray) -> jnp.ndarray:
+    """[n] float -> [n/8] uint8 of sign bits (1 = non-negative). n % 8 == 0."""
+    bits = (x >= 0).astype(jnp.uint8)
+    return jnp.packbits(bits)
+
+
+def unpack_signs(packed: jnp.ndarray, n: int) -> jnp.ndarray:
+    """[n/8] uint8 -> [n] float32 of ±1."""
+    bits = jnp.unpackbits(packed)[:n]
+    return 2.0 * bits.astype(jnp.float32) - 1.0
+
+
+def quantize_1bit(buf: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """1-bit magnitude-preserving quantization: packed signs + one fp32 scale
+    ``||buf|| / sqrt(n)`` (the 1-bit Adam wire format)."""
+    n = buf.shape[-1]
+    scale = jnp.linalg.norm(buf) / np.sqrt(n)
+    return pack_signs(buf), scale
+
+
+def dequantize_1bit(packed: jnp.ndarray, scale: jnp.ndarray, n: int) -> jnp.ndarray:
+    return scale * unpack_signs(packed, n)
+
+
+def error_feedback_step(buf, quantize_fn, dequantize_fn):
+    """THE error-feedback residual update (single implementation for the 1-bit
+    allreduce and the int8/int4 reduce ops): compress ``buf``, keep what the
+    wire format lost. Caller folds the returned residual into the next step's
+    ``buf``. Returns ``(payload, new_residual)`` where ``payload`` is whatever
+    ``quantize_fn`` produced (passed to ``dequantize_fn`` verbatim)."""
+    payload = quantize_fn(buf)
+    new_residual = buf - dequantize_fn(payload)
+    return payload, new_residual
+
+
+# --------------------------------------------------------------------------- axis collectives
+def qall_gather(
+    x: jnp.ndarray,
+    axis_name: AxisName,
+    axis: int = 0,
+    tiled: bool = True,
+    bits: int = 8,
+    block_size: int = DEFAULT_BLOCK,
+    op_name: str = "qall_gather",
+):
+    """Quantized all-gather (inside ``shard_map``), drop-in shaped like
+    :func:`deepspeed_tpu.comm.comm.all_gather`: each rank's shard travels as
+    int8/int4 blocks + scales and is dequantized on arrival."""
+    q, s, z = quantize_blockwise(x, bits=bits, block_size=block_size)
+    _record(f"{op_name}[{axis_name}]", _payload_bytes(x), _payload_bytes(q, s, z))
+    Q = lax.all_gather(q, axis_name, axis=0, tiled=False)
+    S = lax.all_gather(s, axis_name, axis=0, tiled=False)
+    Z = lax.all_gather(z, axis_name, axis=0, tiled=False)
+    deq = dequantize_blockwise(Q, S, Z, bits=bits, block_size=block_size,
+                               orig_size=x.shape[-1]).astype(x.dtype)
+    # deq: [W, *x.shape]; lax.all_gather puts the world dim at ``axis``
+    # (tiled=False) or concatenates along it (tiled=True) — mirror both
+    stacked = jnp.moveaxis(deq, 0, axis)
+    if not tiled:
+        return stacked  # [..., W @ axis, ...]
+    W = deq.shape[0]
+    shape = list(x.shape)
+    shape[axis] = shape[axis] * W
+    return stacked.reshape(shape)
+
+
+def qreduce_scatter(
+    x: jnp.ndarray,
+    axis_name: AxisName,
+    axis: int = 0,
+    bits: int = 8,
+    block_size: int = DEFAULT_BLOCK,
+    stochastic: bool = False,
+    rng: Optional[jax.Array] = None,
+    residual: Optional[jnp.ndarray] = None,
+    mean: bool = False,
+    op_name: str = "qreduce_scatter",
+):
+    """Quantized reduce-scatter (inside ``shard_map``), drop-in shaped like
+    :func:`deepspeed_tpu.comm.comm.reduce_scatter`.
+
+    Mechanics (the ZeRO++ gradient exchange): split the local buffer into
+    ``W`` chunks along ``axis``, quantize each, all-to-all so rank ``i``
+    receives every rank's chunk ``i``, dequantize, and reduce in fp32 — only
+    the wire is int, the arithmetic is not. ``residual``: a same-shaped fp32
+    error-feedback buffer; when given, it is folded into ``x`` before
+    quantization and the call returns ``(result, new_residual)``.
+    ``mean=True`` divides by the axis extent (gradient averaging).
+    """
+    W = int(lax.psum(1, axis_name))  # axis extent (static under shard_map)
+    buf = x.astype(jnp.float32)
+    if residual is not None:
+        buf = buf + residual
+    xm = jnp.moveaxis(buf, axis, 0)
+    if xm.shape[0] % W:
+        raise ValueError(
+            f"qreduce_scatter: dim {axis} extent {xm.shape[0]} not divisible "
+            f"by axis size {W}")
+    chunks = xm.reshape((W, xm.shape[0] // W) + xm.shape[1:])
+    if stochastic and rng is not None:
+        rng = jax.random.fold_in(rng, lax.axis_index(axis_name))
+    q, s, z = quantize_blockwise(chunks, bits=bits, block_size=block_size,
+                                 stochastic=stochastic, rng=rng)
+    _record(f"{op_name}[{axis_name}]", _payload_bytes(x), _payload_bytes(q, s, z))
+    recv_q = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    recv_s = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    recv_z = lax.all_to_all(z, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    deq = dequantize_blockwise(recv_q, recv_s, recv_z, bits=bits,
+                               block_size=block_size,
+                               orig_size=chunks.shape[-1])
+    out = jnp.sum(deq, axis=0)
+    if mean:
+        out = out / W
+    out = jnp.moveaxis(out, 0, axis).astype(x.dtype)
+    if residual is None:
+        return out
+    sent = dequantize_blockwise(q, s, z, bits=bits, block_size=block_size,
+                                orig_size=chunks.shape[-1])
+    sent = jnp.moveaxis(sent.reshape(xm.shape), 0, axis)
+    return out, buf - sent
+
+
+def qall_to_all(
+    x: jnp.ndarray,
+    axis_name: AxisName,
+    split_axis: int = 0,
+    concat_axis: int = 0,
+    bits: int = 8,
+    block_size: int = DEFAULT_BLOCK,
+    op_name: str = "qall_to_all",
+):
+    """Quantized all-to-all (inside ``shard_map``), drop-in shaped like
+    :func:`deepspeed_tpu.comm.comm.all_to_all` — the MoE dispatch / Ulysses
+    exchange with an int wire. ``split_axis``/``concat_axis`` must not be the
+    trailing (feature) dimension: blocks live there and must not be split."""
+    last = x.ndim - 1
+    if split_axis % x.ndim == last or concat_axis % x.ndim == last:
+        raise ValueError(
+            "qall_to_all: split/concat over the trailing dimension would cut "
+            "quantization blocks; move features to the last axis")
+    q, s, z = quantize_blockwise(x, bits=bits, block_size=block_size)
+    _record(f"{op_name}[{axis_name}]", _payload_bytes(x), _payload_bytes(q, s, z))
+    Q = lax.all_to_all(q, axis_name, split_axis=split_axis,
+                       concat_axis=concat_axis, tiled=True)
+    S = lax.all_to_all(s, axis_name, split_axis=split_axis,
+                       concat_axis=concat_axis, tiled=True)
+    Z = lax.all_to_all(z, axis_name, split_axis=split_axis,
+                       concat_axis=concat_axis, tiled=True)
+    return dequantize_blockwise(Q, S, Z, bits=bits, block_size=block_size,
+                                orig_size=x.shape[-1]).astype(x.dtype)
+
+
+# --------------------------------------------------------------------------- GSPMD helper
+def _normalize_entries(spec, rank: int) -> Tuple:
+    entries = tuple(spec) if spec is not None else ()
+    entries = entries + (None,) * (rank - len(entries))
+    return entries[:rank]
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def quantized_reshard(x, spec: P, bits: int = 8,
+                      block_size: int = DEFAULT_BLOCK,
+                      op_name: str = "qreshard"):
+    """Reshard ``x`` to ``spec`` with an int wire (inside plain ``jit``).
+
+    Quantizes, constrains the *payload* to ``spec`` — XLA's inserted collective
+    (all-gather for a ZeRO-3 window entry, all-to-all for MoE dispatch) then
+    moves int8/int4 bytes — and dequantizes at the destination sharding.
+    Backward is straight-through identity: cotangents reshard at full
+    precision (gradient wire compression is ``zero_quantized_gradients``' job,
+    a different code path), and parameters gathered this way stay trainable.
+    """
+    return _qreshard_impl(x, spec, bits, block_size, op_name)
+
+
+def _qreshard_impl(x, spec, bits, block_size, op_name):
+    from ..models.api import maybe_shard
+
+    if x.ndim == 0 or not quantization_shrinks(
+            x.shape[-1], bits, block_size, x.dtype.itemsize):
+        # short rows (scalars, tiny biases, narrow bf16 leaves): the per-block
+        # scale/zero-point overhead would inflate the wire — ship full precision
+        entries = _normalize_entries(spec, x.ndim)
+        return maybe_shard(x, P(*entries))
+    q, s, z = quantize_blockwise(x, bits=bits, block_size=block_size)
+    _record(f"{op_name}{tuple(spec)}", _payload_bytes(x), _payload_bytes(q, s, z))
+    entries = _normalize_entries(spec, x.ndim)
+    q = maybe_shard(q, P(*entries))
+    # per-block scales: same leading placement, trailing (block) dim replicated
+    sspec = P(*entries[:-1], None) if x.ndim else P()
+    s = maybe_shard(s, sspec)
+    z = maybe_shard(z, sspec)
+    out = dequantize_blockwise(q, s, z, bits=bits, block_size=block_size,
+                               orig_size=x.shape[-1]).astype(x.dtype)
+    return maybe_shard(out, P(*entries))
+
+
+def _qreshard_fwd(x, spec, bits, block_size, op_name):
+    return _qreshard_impl(x, spec, bits, block_size, op_name), None
+
+
+def _qreshard_bwd(spec, bits, block_size, op_name, _res, g):
+    return (g,)
+
+
+quantized_reshard.defvjp(_qreshard_fwd, _qreshard_bwd)
+
+
+def quantized_reshard_tree(tree, specs, bits: int = 8,
+                           block_size: int = DEFAULT_BLOCK,
+                           op_name: str = "qreshard"):
+    """:func:`quantized_reshard` over a pytree of (array, PartitionSpec)."""
+    return jax.tree_util.tree_map(
+        lambda x, sp: quantized_reshard(x, sp, bits, block_size, op_name),
+        tree, specs,
+        is_leaf=lambda v: v is None)
+
+
+def wire_bytes_per_element(bits: int, block_size: int) -> float:
+    """Wire bytes per element (payload + amortized scale/zero-point) — the
+    denominator of the advertised compression ratio."""
+    return bits / 8.0 + 8.0 / block_size
+
+
+__all__ = [
+    "QuantizedCommConfig",
+    "active_quantization",
+    "quantize_blockwise",
+    "dequantize_blockwise",
+    "pack_signs",
+    "unpack_signs",
+    "quantize_1bit",
+    "dequantize_1bit",
+    "error_feedback_step",
+    "qall_gather",
+    "qreduce_scatter",
+    "qall_to_all",
+    "quantized_reshard",
+    "quantized_reshard_tree",
+    "wire_bytes_per_element",
+    "DEFAULT_BLOCK",
+    "SUPPORTED_BITS",
+]

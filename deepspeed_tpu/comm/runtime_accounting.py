@@ -44,6 +44,74 @@ _COLLECTIVE_RE = re.compile(
 
 
 @dataclass
+class WireRecord:
+    count: int = 0
+    logical_bytes: int = 0  # what full precision would have moved
+    wire_bytes: int = 0     # what the quantized format actually moves
+
+
+@dataclass
+class WireLedger:
+    """Logical-vs-wire byte ledger for quantized collectives.
+
+    The quantized ops (``comm/quantized.py``) report here at trace time: for
+    each op, the bytes the equivalent full-precision collective would have put
+    on the wire next to the int payload + scales actually sent. This is the
+    observable the ZeRO++-style config knobs are tuned against — per-op
+    compression ratios, independent of the facade's enable flag (compression
+    evidence must not vanish because comms logging is off).
+    """
+
+    records: Dict[str, WireRecord] = field(default_factory=dict)
+
+    def record(self, op_name: str, logical_bytes: int, wire_bytes: int) -> None:
+        rec = self.records.setdefault(op_name, WireRecord())
+        rec.count += 1
+        rec.logical_bytes += int(logical_bytes)
+        rec.wire_bytes += int(wire_bytes)
+
+    def ratio(self, prefix: Optional[str] = None) -> float:
+        """Aggregate logical/wire compression ratio over ops matching
+        ``prefix`` (all quantized ops when None); 1.0 when nothing matched."""
+        logical = wire = 0
+        for name, rec in self.records.items():
+            if prefix is None or name.startswith(prefix):
+                logical += rec.logical_bytes
+                wire += rec.wire_bytes
+        return logical / wire if wire else 1.0
+
+    def summary_dict(self) -> Dict[str, Dict[str, float]]:
+        out: Dict[str, Dict[str, float]] = {}
+        for name, rec in sorted(self.records.items()):
+            out[name] = {
+                "count": rec.count,
+                "logical_bytes": rec.logical_bytes,
+                "wire_bytes": rec.wire_bytes,
+                "ratio": round(rec.logical_bytes / max(1, rec.wire_bytes), 3),
+            }
+        return out
+
+    def summary(self) -> str:
+        lines = ["quantized wire accounting (trace-time):"]
+        for name, row in self.summary_dict().items():
+            lines.append(
+                f"  {name:<32} count={row['count']:<5} "
+                f"logical={row['logical_bytes']} wire={row['wire_bytes']} "
+                f"({row['ratio']}x)")
+        if not self.records:
+            lines.append("  (no quantized collectives traced)")
+        out = "\n".join(lines)
+        log_dist(out)
+        return out
+
+    def reset(self) -> None:
+        self.records.clear()
+
+
+wire_ledger = WireLedger()
+
+
+@dataclass
 class CollectiveStats:
     count: int = 0          # events summed across device lanes
     time_us: float = 0.0    # device time summed across lanes

@@ -22,14 +22,29 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 from jax.sharding import PartitionSpec as P
 
+from ..utils.jax_compat import manual_axis_names
+
 Params = Any
 Batch = Any
+
+
+def _spec_axes(spec) -> set:
+    axes = set()
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        axes.update(entry if isinstance(entry, (tuple, list)) else (entry,))
+    return axes
 
 
 def maybe_shard(x, spec: P):
     """``with_sharding_constraint`` that no-ops when no mesh is bound, so model code
     runs identically inside the engine (mesh context) and standalone (tests, single
-    device)."""
+    device). Also no-ops inside a ``shard_map`` body over any of the spec's axes:
+    there the data is already device-local and older jax rejects the constraint at
+    lowering time (newer jax silently ignores it)."""
+    if _spec_axes(spec) & manual_axis_names():
+        return x
     try:
         return jax.lax.with_sharding_constraint(x, spec)
     except (RuntimeError, ValueError):

@@ -133,8 +133,22 @@ def apply_moe(
     E, _, C, _ = dispatched.shape
     dispatched = dispatched.reshape(E, G * C, D)
     # land the routed tokens on the expert-parallel axis: XLA inserts the
-    # all-to-all here (and its transpose in backward)
-    dispatched = maybe_shard(dispatched, P("ep", None, None))
+    # all-to-all here (and its transpose in backward). Under
+    # zero_quantized_weights (the engine's fwd-wire knob, read from the
+    # trace-time config binding) the routed tokens travel as block-int8/int4:
+    # quantize, constrain the payload, dequantize on the expert side —
+    # straight-through backward, so the combine-transpose a2a stays fp.
+    from ..comm.quantized import active_quantization
+
+    qc = active_quantization()
+    if qc is not None and qc.weights:
+        from ..comm.quantized import quantized_reshard
+
+        dispatched = quantized_reshard(
+            dispatched, P("ep", None, None), qc.bits, qc.block_size,
+            "qall_to_all[moe_dispatch]")
+    else:
+        dispatched = maybe_shard(dispatched, P("ep", None, None))
 
     out = apply_experts(params["experts"], dispatched)
     out = out.reshape(E, G, C, D)

@@ -6,11 +6,15 @@ Capability parity with the reference's hand-written compressed collectives
 worker- and server-side error feedback that powers 1-bit Adam / 1-bit LAMB /
 0/1 Adam (``runtime/fp16/onebit/``).
 
-Algorithm (identical structure to the reference):
+The quantizer (packed signs + one fp32 scale) and the error-feedback residual
+update are the shared primitives in :mod:`deepspeed_tpu.comm.quantized`
+(``quantize_1bit`` / ``dequantize_1bit`` / ``error_feedback_step``) — the same
+machinery the block-int8/int4 ZeRO collectives use, so there is exactly ONE
+error-feedback implementation in the tree. This module owns only the exchange
+topology:
 
-1. worker: ``buf = x + worker_error``; one fp32 scale ``||buf||/sqrt(n)``;
-   signs packed to REAL 1-bit wire format (``jnp.packbits`` → uint8, 8 signs/byte);
-   ``worker_error = buf - scale * sign(buf)`` stays local.
+1. worker: ``buf = x + worker_error``; 1-bit quantize; the lost magnitude stays
+   local as ``worker_error`` (``error_feedback_step``).
 2. exchange: ``all_to_all`` of packed sign chunks over the compression axis — each
    rank is the "server" for its 1/world chunk (the reference's allgather+local-chunk
    reduction, ``nccl.py:84-118``); scales travel via a tiny ``all_gather``.
@@ -31,19 +35,16 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
-
-def pack_signs(x: jnp.ndarray) -> jnp.ndarray:
-    """[n] float -> [n/8] uint8 of sign bits (1 = non-negative). n % 8 == 0."""
-    bits = (x >= 0).astype(jnp.uint8)
-    return jnp.packbits(bits)
-
-
-def unpack_signs(packed: jnp.ndarray, n: int) -> jnp.ndarray:
-    """[n/8] uint8 -> [n] float32 of ±1."""
-    bits = jnp.unpackbits(packed)[:n]
-    return 2.0 * bits.astype(jnp.float32) - 1.0
+# re-exported for API stability: the wire-format primitives now live with the
+# rest of the quantized-collective machinery
+from ...comm.quantized import (  # noqa: F401
+    dequantize_1bit,
+    error_feedback_step,
+    pack_signs,
+    quantize_1bit,
+    unpack_signs,
+)
 
 
 def compression_error_shapes(n: int, world: int) -> Tuple[int, int]:
@@ -56,6 +57,17 @@ def compression_error_shapes(n: int, world: int) -> Tuple[int, int]:
     if n % (world * 8) != 0:
         raise ValueError(f"buffer size {n} must be a multiple of world*8={world * 8}")
     return n, n // world
+
+
+def _compress_1bit(buf: jnp.ndarray):
+    """1-bit error-feedback compression of a flat buffer: returns
+    ``((packed_signs, scale), new_residual)`` via the shared EF step."""
+    n = buf.shape[-1]
+    return error_feedback_step(
+        buf,
+        quantize_1bit,
+        lambda payload: dequantize_1bit(payload[0], payload[1], n),
+    )
 
 
 def compressed_allreduce(
@@ -79,14 +91,12 @@ def compressed_allreduce(
     n = x.shape[0]
     world = jax.lax.psum(1, axis_name)
 
-    # ---- worker compression (ref nccl.py:77-83)
+    # ---- worker compression (ref nccl.py:77-83; shared EF step)
     buf = x.astype(jnp.float32) + worker_error
-    scale_w = jnp.linalg.norm(buf) / np.sqrt(n)
-    signs = buf >= 0
-    new_worker_error = buf - scale_w * jnp.where(signs, 1.0, -1.0)
+    (packed, scale_w), new_worker_error = _compress_1bit(buf)
 
     # ---- exchange: chunk c of every rank's signs goes to rank c (ref :84-101)
-    packed = jnp.packbits(signs.astype(jnp.uint8)).reshape(world, -1)  # [W, n/8W]
+    packed = packed.reshape(world, -1)  # [W, n/8W]
     recv = jax.lax.all_to_all(packed, axis_name, split_axis=0, concat_axis=0,
                               tiled=False)  # [W, n/8W]: rank j's view of my chunk
     scales = jax.lax.all_gather(scale_w, axis_name)  # [W]
@@ -95,12 +105,9 @@ def compressed_allreduce(
     signs_per_rank = jax.vmap(lambda p: unpack_signs(p, chunk))(recv)  # [W, chunk]
     chunk_avg = jnp.mean(scales[:, None] * signs_per_rank, axis=0)  # [chunk]
 
-    # ---- server compression of the averaged chunk (ref :102-118)
+    # ---- server compression of the averaged chunk (ref :102-118; same EF step)
     sbuf = chunk_avg + server_error
-    scale_s = jnp.linalg.norm(sbuf) / np.sqrt(chunk)
-    s_signs = sbuf >= 0
-    new_server_error = sbuf - scale_s * jnp.where(s_signs, 1.0, -1.0)
-    s_packed = jnp.packbits(s_signs.astype(jnp.uint8))  # [chunk/8]
+    (s_packed, scale_s), new_server_error = _compress_1bit(sbuf)
 
     # ---- broadcast all server chunks to everyone
     all_packed = jax.lax.all_gather(s_packed, axis_name)  # [W, chunk/8]

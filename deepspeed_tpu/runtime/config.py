@@ -329,6 +329,33 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
             # The reference requires fp16 for ZeRO; on TPU bf16 is the norm. Pure
             # fp32 ZeRO is allowed but unusual — warn, don't fail.
             logger.warning("ZeRO enabled without fp16/bf16: running fp32 sharded training")
+        z = self.zero_optimization
+        if z.zero_quantized_weights and z.stage < ZeroStageEnum.weights:
+            # below stage 3 the stored params are replicated — there is no
+            # parameter gather to compress (MoE dispatch still quantizes, so
+            # this is a footgun warning rather than an error)
+            logger.warning(
+                "zero_quantized_weights is set but ZeRO stage < 3: no parameter "
+                "all-gathers exist to quantize (only the MoE dispatch "
+                "all-to-all, if any, is compressed)")
+        if z.zero_quantized_gradients and self.prescale_gradients:
+            # predivided cotangents shrink every block's [min, max] range, then
+            # the post-exchange multiply amplifies quantization noise by the
+            # same factor — the two knobs work against each other
+            raise ValueError(
+                "zero_quantized_gradients and prescale_gradients are mutually "
+                "exclusive (prescaling amplifies block-quantization noise)")
+        if z.zero_quantize_stochastic and not z.quantized_comm_enabled:
+            logger.warning(
+                "zero_quantize_stochastic set without zero_quantized_weights/"
+                "gradients: no quantized collectives are enabled")
+        if z.zero_quantize_error_feedback and not z.zero_quantized_gradients:
+            # the residual only exists in the quantized gradient program;
+            # weight gathers are straight-through (no reduction to feed back)
+            logger.warning(
+                "zero_quantize_error_feedback set without "
+                "zero_quantized_gradients: the error-feedback residual only "
+                "applies to the quantized gradient exchange and is ignored")
 
     # ------------------------------------------------------------------ helpers
     @property

@@ -84,12 +84,35 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     ignore_unused_parameters: bool = True
     round_robin_gradients: bool = False
 
+    # ---- quantized collectives (ZeRO++-style; comm/quantized.py) ----
+    # zero_quantized_weights: forward-path wire compression — ZeRO-3 parameter
+    # gathers (and the MoE dispatch all-to-all) move block-int8/int4 payloads.
+    # zero_quantized_gradients: the dp gradient reduction runs as a quantized
+    # reduce-scatter + all-gather instead of a full-precision psum.
+    zero_quantized_weights: bool = False
+    zero_quantized_gradients: bool = False
+    zero_quantize_bits: int = Field(8, ge=4, le=8)       # 8 or 4 (int4 packed)
+    zero_quantize_block_size: int = Field(256, ge=8)     # elements per scale/zp
+    zero_quantize_stochastic: bool = False               # unbiased rounding
+    zero_quantize_error_feedback: bool = False           # persistent grad residual
+
     def model_post_init(self, __context) -> None:
         # legacy cpu_offload=true means offload_optimizer={"device": "cpu"}
         if self.cpu_offload and self.offload_optimizer is None:
             object.__setattr__(
                 self, "offload_optimizer",
                 DeepSpeedZeroOffloadOptimizerConfig(device=OffloadDeviceEnum.cpu))
+        if self.zero_quantize_bits not in (4, 8):
+            raise ValueError(
+                f"zero_quantize_bits must be 4 or 8, got {self.zero_quantize_bits}")
+        if self.zero_quantize_block_size % 2:
+            raise ValueError(
+                "zero_quantize_block_size must be even (int4 packs two values "
+                f"per byte), got {self.zero_quantize_block_size}")
+
+    @property
+    def quantized_comm_enabled(self) -> bool:
+        return self.zero_quantized_weights or self.zero_quantized_gradients
 
     @property
     def offload_optimizer_device(self) -> str:

@@ -105,6 +105,47 @@ def window_size(blocks, L: int) -> int:
     return k
 
 
+def _quantization():
+    """The active quantized-weights config for ZeRO-3 gathers, or None."""
+    cfg = _active_cfg()
+    if cfg is None or int(getattr(cfg, "stage", 0)) < 3:
+        return None
+    if not getattr(cfg, "zero_quantized_weights", False):
+        return None
+    from ...comm.quantized import QuantizedCommConfig
+
+    return QuantizedCommConfig.from_zero_config(cfg)
+
+
+def _gather_layer(tree, gathered_spec, qc, lead_none: bool = False,
+                  op_name: str = "qgather[zero3]"):
+    """Constrain ``tree`` to its gathered (non-dp) spec — explicitly through
+    the quantized wire when ``qc`` is set, otherwise the plain full-precision
+    sharding constraint. ``lead_none``: specs get a leading None entry (the
+    window/layer axis of a chunked stack)."""
+    import jax.sharding as jsh
+
+    from ...models.api import maybe_shard
+
+    def full_spec(s):
+        entries = tuple(s)
+        return jsh.PartitionSpec(None, *entries) if lead_none else \
+            jsh.PartitionSpec(*entries)
+
+    if qc is None:
+        return jax.tree_util.tree_map(
+            lambda x, s: maybe_shard(x, full_spec(s)), tree, gathered_spec,
+            is_leaf=lambda v: v is None)
+
+    from ...comm.quantized import quantized_reshard
+
+    return jax.tree_util.tree_map(
+        lambda x, s: quantized_reshard(x, full_spec(s), qc.bits,
+                                       qc.block_size, op_name),
+        tree, gathered_spec,
+        is_leaf=lambda v: v is None)
+
+
 def zero3_layer_scan(body: Callable, carry: Any, blocks: Any,
                      gathered_spec: Optional[Any] = None):
     """``lax.scan(body, carry, blocks)`` with ZeRO-3 gather windowing.
@@ -114,17 +155,34 @@ def zero3_layer_scan(body: Callable, carry: Any, blocks: Any,
     one layer's params WITHOUT the leading layer axis — the model-parallel-only
     placement a gathered window is constrained to (i.e. dp removed); None
     leaves the gather implicit. Returns the final carry.
+
+    When the bound config sets ``zero_quantized_weights`` (and provides
+    ``gathered_spec``), the per-layer/window gather goes through
+    :func:`~deepspeed_tpu.comm.quantized.quantized_reshard`: the weights are
+    block-quantized shard-locally, XLA's inserted all-gather moves the
+    int8/int4 payload, and the layer computes on the dequantized values —
+    ZeRO++'s qwZ with a straight-through backward (the reverse-path gradient
+    reduction stays full precision unless ``zero_quantized_gradients``).
     """
     leaves = jax.tree_util.tree_leaves(blocks)
     if not leaves:
         return carry
     L = leaves[0].shape[0]
     k = window_size(blocks, L)
+    qc = _quantization() if gathered_spec is not None else None
     if k <= 1:
-        carry, _ = jax.lax.scan(body, carry, blocks)
-        return carry
+        if qc is None:
+            carry, _ = jax.lax.scan(body, carry, blocks)
+            return carry
 
-    from ...models.api import maybe_shard
+        def qbody(c, layer):
+            # per-layer explicit quantized gather (minimal-residency schedule,
+            # int wire): constrain the dequantized value the body consumes
+            layer = _gather_layer(layer, gathered_spec, qc)
+            return body(c, layer)
+
+        carry, _ = jax.lax.scan(qbody, carry, blocks)
+        return carry
 
     chunked = jax.tree_util.tree_map(
         lambda x: x.reshape((L // k, k) + x.shape[1:]), blocks)
@@ -134,10 +192,7 @@ def zero3_layer_scan(body: Callable, carry: Any, blocks: Any,
         # non-dp spec forces one batched all-gather whose issue point XLA can
         # hoist ahead of the previous window's tail compute (prefetch).
         if gathered_spec is not None:
-            chunk = jax.tree_util.tree_map(
-                lambda x, s: maybe_shard(x, jax.sharding.PartitionSpec(
-                    None, *tuple(s))),
-                chunk, gathered_spec)
+            chunk = _gather_layer(chunk, gathered_spec, qc, lead_none=True)
         c, _ = jax.lax.scan(body, c, chunk)
         return c, None
 

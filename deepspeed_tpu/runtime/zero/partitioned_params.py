@@ -23,6 +23,7 @@ TPU-native mapping:
 from __future__ import annotations
 
 import contextlib
+import functools
 from typing import Any, Dict, Iterable, Optional
 
 import numpy as np
@@ -30,6 +31,16 @@ import numpy as np
 import jax
 
 from ...utils.logging import log_dist
+
+
+@functools.lru_cache(maxsize=None)
+def _quantize_jit(bits: int, block: int):
+    """One jitted quantizer per (bits, block): a fresh ``jax.jit(lambda ...)``
+    per leaf would defeat the jit cache and recompile on every fetch."""
+    from ...comm.quantized import quantize_blockwise
+
+    return jax.jit(functools.partial(
+        quantize_blockwise, bits=bits, block_size=block))
 
 
 @contextlib.contextmanager
@@ -54,14 +65,59 @@ class GatheredParameters:
     ``engine.state["params"]``; None = every leaf. ``modify``: write leaves
     back on exit, preserving each leaf's original sharding and dtype. Keeping
     the fp32 master (if any) consistent is handled too.
+
+    ``quantized``: EXPLICIT opt-in to fetch float leaves over the
+    block-int8/int4 wire (``comm/quantized.py``) — quantize on device, move
+    the int payload + per-block scales to host, dequantize in numpy. ~4x less
+    device->host traffic for inspection reads, at up to half a quantization
+    step of error per block — never the default (gathers must stay exact for
+    export/comparison callers, whatever the training wire does), and
+    incompatible with ``modify`` (writing dequantized values back would
+    inject quantization noise into leaves the caller never touched).
     """
 
     def __init__(self, engine, paths: Optional[Iterable[str]] = None,
-                 modify: bool = False):
+                 modify: bool = False, quantized: bool = False):
+        if quantized and modify:
+            raise ValueError(
+                "GatheredParameters: quantized=True with modify=True would "
+                "write quantization noise back into untouched leaves; gather "
+                "full precision when mutating")
         self.engine = engine
         self.paths = list(paths) if paths is not None else None
         self.modify = modify
+        self.quantized = bool(quantized)
         self._gathered: Dict[str, np.ndarray] = {}
+
+    def _fetch(self, leaf) -> np.ndarray:
+        import jax.numpy as jnp
+
+        from ...comm.quantized import quantization_shrinks
+        from ...comm.runtime_accounting import wire_ledger
+
+        block = int(getattr(self.engine.config.zero_optimization,
+                            "zero_quantize_block_size", 256))
+        bits = int(getattr(self.engine.config.zero_optimization,
+                           "zero_quantize_bits", 8))
+        if (not self.quantized or not jnp.issubdtype(leaf.dtype, jnp.floating)
+                or leaf.ndim == 0
+                or not quantization_shrinks(leaf.shape[-1], bits, block,
+                                            leaf.dtype.itemsize)):
+            # short trailing rows (scalars, (N, 2)-shaped leaves, narrow bf16):
+            # per-block scale/zero-point overhead would INFLATE the transfer
+            return np.array(jax.device_get(leaf))
+        q, s, z = _quantize_jit(bits, block)(leaf)
+        wire_ledger.record("qgather[host]", int(leaf.nbytes),
+                           int(q.nbytes + s.nbytes + z.nbytes))
+        qh, sh, zh = (np.asarray(a) for a in jax.device_get((q, s, z)))
+        lead = qh.shape[:-1]
+        if bits == 4:
+            qh = np.stack([qh & 0xF, qh >> 4], axis=-1).reshape(lead + (-1,))
+        nb = sh.shape[-1]
+        eff = qh.shape[-1] // nb  # the quantizer's effective block, from shapes
+        xb = qh.reshape(lead + (nb, eff)).astype(np.float32)
+        x = (xb * sh[..., None] + zh[..., None]).reshape(lead + (nb * eff,))
+        return np.ascontiguousarray(x[..., :leaf.shape[-1]])
 
     def _leaf(self, tree, dotted: str):
         node = tree
@@ -96,8 +152,9 @@ class GatheredParameters:
                 expanded.append(p)
         for p in expanded:
             leaf = self._leaf(params, p)
-            # device_get returns read-only views; users mutate these in place
-            self._gathered[p] = np.array(jax.device_get(leaf))
+            # _fetch copies to writable host numpy (over the quantized wire
+            # when enabled); users mutate these in place
+            self._gathered[p] = self._fetch(leaf)
         return self._gathered
 
     def __exit__(self, exc_type, exc, tb):

@@ -1,0 +1,51 @@
+"""Version-tolerant imports for JAX APIs that moved between releases.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to a top-level
+``jax.shard_map`` export (and renamed its ``check_rep`` kwarg to
+``check_vma``). The repo targets both eras: import ``shard_map`` from here,
+never from ``jax`` directly — a bare ``from jax import shard_map`` kills
+module import (and pytest collection) on the older runtime this image ships.
+"""
+
+from __future__ import annotations
+
+try:  # newer jax: top-level export, kwarg named check_vma
+    from jax import shard_map as shard_map  # type: ignore[attr-defined]
+except ImportError:  # older jax: experimental module, kwarg named check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map_legacy
+
+    def shard_map(f=None, *, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, **kwargs):
+        if check_vma is not None and "check_rep" not in kwargs:
+            kwargs["check_rep"] = check_vma
+        if f is None:  # decorator-style usage
+            return lambda g: _shard_map_legacy(
+                g, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
+        return _shard_map_legacy(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
+
+
+try:  # probe resolved once: manual_axis_names runs on the hot tracing path
+    from jax._src.core import unsafe_get_axis_names as _get_axis_names
+except Exception:  # newer jax dropped the API (and no-ops the constraint)
+    _get_axis_names = None
+
+_EMPTY = frozenset()
+
+
+def manual_axis_names() -> frozenset:
+    """Mesh axis names currently bound manually (i.e. we are tracing inside a
+    ``shard_map``/``pmap`` body). Older jax rejects ``with_sharding_constraint``
+    over such axes at lowering time — callers use this to skip the constraint.
+    Newer jax treats those constraints as no-ops and also dropped the probe API,
+    so an empty set is the correct degradation."""
+    if _get_axis_names is None:
+        return _EMPTY
+    try:
+        names = _get_axis_names()
+        return frozenset(names) if names else _EMPTY
+    except Exception:
+        return _EMPTY
+
+
+__all__ = ["shard_map", "manual_axis_names"]

@@ -23,7 +23,7 @@ def test_all_config_lists_have_registered_kinds_and_serialize():
              "pipeline_mpmd", "train_aot", "kernels_aot", "infinity_aot",
              "moe_aot", "infer_aot", "sd_aot"}
     for lst in (bench.INFINITY_CONFIGS, bench.PIPELINE_CONFIGS,
-                bench.AOT_TRAIN_CONFIGS):
+                bench.AOT_TRAIN_CONFIGS, bench.QUANTIZED_ZERO_CONFIGS):
         assert lst, "config list emptied"
         for cfg in lst:
             assert cfg["kind"] in kinds, cfg
@@ -37,7 +37,7 @@ def test_train_configs_reference_real_presets():
     from deepspeed_tpu.models.gpt_moe import PRESETS as MOE
 
     for lst in (bench.INFINITY_CONFIGS, bench.PIPELINE_CONFIGS,
-                bench.AOT_TRAIN_CONFIGS):
+                bench.AOT_TRAIN_CONFIGS, bench.QUANTIZED_ZERO_CONFIGS):
         for cfg in lst:
             model = cfg.get("model")
             if model:
