@@ -1,0 +1,231 @@
+"""``python -m deepspeed_tpu.analysis`` — dslint over bench.py configs.
+
+Builds the engine a bench row describes (same config mapping as bench.py's
+``_worker_train``), captures its fused train program WITHOUT executing a step,
+and runs the rule families. For models too large to materialize on the local
+host, falls back to the abstract AOT path (``runtime/aot.py``'s
+``fused_train_step`` over ``ShapeDtypeStruct`` state — nothing allocated).
+
+Exit status: 0 clean (or warnings only), 2 when ERROR-severity findings exist
+(``--fail-on never`` disables), 1 on usage errors. CI gates on this
+(``scripts/verify_tier1.sh``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import importlib.util
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+# the default bench row: the quantized ZeRO-3 config the wire-compression
+# evidence ships on (bench.py QUANTIZED_ZERO_CONFIGS)
+DEFAULT_BENCH = "gpt2-125m-zero3-qw8"
+
+# above this many params the real engine (materialized state) is replaced by
+# the abstract AOT capture — the analyzer must never OOM the host it guards
+ABSTRACT_PARAM_FLOOR = int(4e8)
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def load_bench_rows() -> List[Dict[str, Any]]:
+    """The train-kind config rows from the repo's bench.py."""
+    path = os.path.join(_repo_root(), "bench.py")
+    if not os.path.exists(path):
+        return []
+    spec = importlib.util.spec_from_file_location("_ds_bench", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rows: List[Dict[str, Any]] = []
+    for attr in ("QUANTIZED_ZERO_CONFIGS", "PIPELINE_CONFIGS",
+                 "INFINITY_CONFIGS"):
+        for row in getattr(mod, attr, []):
+            if row.get("kind") == "train" and "model" in row:
+                rows.append(row)
+    return rows
+
+
+def _row_to_ds_config(row: Dict[str, Any]) -> Dict[str, Any]:
+    """bench row -> DeepSpeed config dict (the _worker_train mapping)."""
+    zero_cfg: Dict[str, Any] = {"stage": row.get("stage", 0)}
+    if row.get("quantized_weights"):
+        zero_cfg["zero_quantized_weights"] = True
+    if row.get("quantized_gradients"):
+        zero_cfg["zero_quantized_gradients"] = True
+    if row.get("quantize_bits"):
+        zero_cfg["zero_quantize_bits"] = int(row["quantize_bits"])
+    if row.get("offload") == "param_stream":
+        zero_cfg["offload_param"] = {
+            "device": "cpu", "buffer_count": row.get("keep_layers", 2)}
+    elif row.get("offload") == "optimizer":
+        zero_cfg["offload_optimizer"] = {"device": "cpu"}
+    return {
+        "train_micro_batch_size_per_gpu": row["micro_bs"],
+        "gradient_accumulation_steps": int(row.get("gas", 1)),
+        "optimizer": {"type": "AdamW",
+                      "params": {"lr": 3e-4, "weight_decay": 0.1}},
+        "bf16": {"enabled": row.get("precision", "bf16") != "fp32"},
+        "zero_optimization": zero_cfg,
+        "gradient_clipping": 1.0,
+        "steps_per_print": 0,
+    }
+
+
+def _build_model(row: Dict[str, Any]):
+    from ..models import build_gpt
+    from ..models import gpt as gpt_mod
+
+    mcfg = gpt_mod.PRESETS[row["model"]]
+    if row.get("remat", True):
+        mcfg = dataclasses.replace(
+            mcfg, remat=True,
+            remat_policy=row.get("remat_policy", "nothing_saveable"))
+    if row.get("loss_chunk"):
+        mcfg = dataclasses.replace(mcfg, loss_chunk=int(row["loss_chunk"]))
+    return build_gpt(mcfg)
+
+
+def analyze_row(row: Dict[str, Any], compile: bool = False,
+                seq: Optional[int] = None):
+    """Analyze one bench train row. Returns a Report."""
+    from . import analyze_engine
+    from ..models import gpt as gpt_mod
+
+    mcfg = gpt_mod.PRESETS[row["model"]]
+    if mcfg.num_params() > ABSTRACT_PARAM_FLOOR or row.get("offload"):
+        return _analyze_row_abstract(row, compile=compile, seq=seq)
+
+    import deepspeed_tpu
+
+    model, _ = _build_model(row)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config=_row_to_ds_config(row))
+    return analyze_engine(engine, compile=compile,
+                          seq=seq or row.get("seq"))
+
+
+def _analyze_row_abstract(row: Dict[str, Any], compile: bool = False,
+                          seq: Optional[int] = None):
+    """Big-model path: the engine-shaped AOT step over abstract state —
+    program rules only, nothing materialized (``runtime/aot.py`` pattern)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from . import Analyzer, AnalysisContext, capture
+    from ..runtime.aot import fused_train_step
+    from ..runtime.config import DeepSpeedConfig
+    from ..runtime.topology import MeshTopology, mesh_context
+    from ..runtime.zero.gather import gather_window
+    from ..runtime.zero.policy import ZeroShardingPolicy
+    from ..ops.optimizers import get_optimizer
+
+    model, mcfg = _build_model(row)
+    ds_config = DeepSpeedConfig.load(_row_to_ds_config(row),
+                                     world_size=jax.device_count())
+    topo = MeshTopology.create(dp=-1)
+    policy = ZeroShardingPolicy(topo, ds_config.zero_optimization)
+    tmap = jax.tree_util.tree_map
+    shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    opt = get_optimizer("AdamW", {"lr": 3e-4, "weight_decay": 0.1})
+    opt_shapes = jax.eval_shape(opt.init, shapes)
+    step = fused_train_step(model, opt, gas=int(row.get("gas", 1)))
+
+    base_specs = model.specs(shapes)
+    sh = lambda spec: NamedSharding(topo.mesh, spec)  # noqa: E731
+    pspec = tmap(lambda s, b: policy.param_spec(s.shape, b), shapes, base_specs)
+    ospec = tmap(lambda s, b: policy.opt_spec(s.shape, b), shapes, base_specs)
+
+    def abstract(tree, spec_tree, dtype=None):
+        return tmap(lambda s, p: jax.ShapeDtypeStruct(
+            s.shape, dtype or s.dtype, sharding=sh(p)), tree, spec_tree)
+
+    opt_spec_tree = opt.state_spec(tmap(lambda p: sh(p), ospec), sh(P()))
+    a_opt = tmap(lambda s, shd: jax.ShapeDtypeStruct(
+        s.shape, s.dtype, sharding=shd), opt_shapes, opt_spec_tree)
+    seq = int(seq or row.get("seq", 512))
+    bshape = (row["micro_bs"] * topo.data_parallel_size, seq)
+    gas = int(row.get("gas", 1))
+    bspec = topo.batch_spec(1)
+    if gas > 1:
+        bshape = (gas,) + bshape
+        bspec = P(None, *tuple(bspec))
+    a_batch = {"input_ids": jax.ShapeDtypeStruct(
+        bshape, jnp.int32, sharding=NamedSharding(topo.mesh, bspec))}
+    a_rng = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    compute = jnp.bfloat16 if ds_config.bf16.enabled else jnp.float32
+
+    with mesh_context(topo.mesh), gather_window(ds_config.zero_optimization):
+        prog = capture(
+            jax.jit(step, donate_argnums=(0, 1, 2)),
+            abstract(shapes, pspec, compute),
+            abstract(shapes, ospec, jnp.float32),
+            a_opt, a_batch, a_rng,
+            name=f"aot:{row['name']}", compile=compile)
+    ctx = AnalysisContext(config=ds_config, mesh=topo.mesh)
+    return Analyzer().run([prog], ctx)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.analysis",
+        description="dslint: static analysis of engine/pjit programs "
+                    "(sharding, precision, host-sync, collective-order, "
+                    "config rules)")
+    parser.add_argument(
+        "target", nargs="?", default=DEFAULT_BENCH,
+        help=f"bench.py train-config name (default: {DEFAULT_BENCH})")
+    parser.add_argument("--list", action="store_true",
+                        help="list analyzable bench configs and exit")
+    parser.add_argument("--all", action="store_true",
+                        help="sweep every bench train config")
+    parser.add_argument("--compile", action="store_true",
+                        help="also run XLA to get post-GSPMD HLO (enables "
+                             "the wire-traffic rules; slower)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit the report as JSON")
+    parser.add_argument("--seq", type=int, default=None,
+                        help="override the analyzed sequence length")
+    parser.add_argument("--fail-on", choices=("error", "never"),
+                        default="error",
+                        help="exit 2 on ERROR findings (default) or never")
+    args = parser.parse_args(argv)
+
+    rows = load_bench_rows()
+    by_name = {r["name"]: r for r in rows}
+    if args.list:
+        for r in rows:
+            print(f"{r['name']:<32} model={r['model']} "
+                  f"stage={r.get('stage', 0)} micro_bs={r['micro_bs']}")
+        return 0
+
+    targets = rows if args.all else [by_name.get(args.target)]
+    if targets == [None]:
+        print(f"unknown bench config {args.target!r}; --list shows options",
+              file=sys.stderr)
+        return 1
+
+    had_error = False
+    reports = []
+    for row in targets:
+        report = analyze_row(row, compile=args.compile, seq=args.seq)
+        had_error |= bool(report.errors())
+        if args.as_json:
+            reports.append({"config": row["name"], **report.to_dict()})
+        else:
+            print(f"== {row['name']}")
+            print(report.render())
+    if args.as_json:
+        print(json.dumps(reports if args.all else reports[0], indent=2))
+    return 2 if (had_error and args.fail_on == "error") else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,242 @@
+"""Rule framework for the jaxpr/HLO static analyzer (``dslint``).
+
+GSPMD is silent: a wrong ``PartitionSpec`` replicates a multi-GB parameter, a
+stray fp32 literal upcasts a bf16 matmul path, and a mismatched collective
+order inside a manual ``shard_map`` body deadlocks a multihost run — all
+without an error. This package walks the *program the compiler actually sees*
+(jaxpr at trace level, optimized HLO after GSPMD partitioning) and reports
+findings before any accelerator time is spent.
+
+Vocabulary:
+
+- :class:`Severity` — INFO < WARNING < ERROR. ERROR findings are the "this
+  will burn a TPU-hour" class (deadlocks, silent replication of huge buffers,
+  config knobs the compiled program contradicts); CI gates on them.
+- :class:`Finding` — one diagnostic: ``(severity, rule_id, location, message,
+  suggestion)``.
+- :class:`Rule` — a check. ``check_program(prog, ctx)`` runs per captured
+  program (:class:`~deepspeed_tpu.analysis.ir.ProgramIR`);
+  ``check_context(ctx)`` runs once per analysis (engine/config-level checks).
+- :class:`Analyzer` — runs a rule set over programs + context, returns a
+  :class:`Report` with text/JSON renderings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+from ..utils.logging import logger
+
+
+class Severity(enum.IntEnum):
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    def __str__(self) -> str:  # render as the bare name in reports
+        return self.name
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic emitted by a rule."""
+
+    rule_id: str
+    severity: Severity
+    location: str       # program name + jaxpr path or HLO op, best effort
+    message: str
+    suggestion: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "rule_id": self.rule_id,
+            "severity": self.severity.name,
+            "location": self.location,
+            "message": self.message,
+            "suggestion": self.suggestion,
+        }
+
+    def render(self) -> str:
+        out = f"[{self.severity.name:<7}] {self.rule_id}: {self.message}"
+        if self.location:
+            out += f"\n    at: {self.location}"
+        if self.suggestion:
+            out += f"\n    fix: {self.suggestion}"
+        return out
+
+
+class Rule:
+    """Base class for analyzer rules.
+
+    Subclasses set ``rule_id`` (``family/name``) and ``default_severity`` and
+    override one or both hooks. Rules must be *pure observers*: they read the
+    captured IR and context, never mutate them, and never execute device code.
+    """
+
+    rule_id: str = "base/unnamed"
+    default_severity: Severity = Severity.WARNING
+    description: str = ""
+
+    def check_program(self, prog: "ProgramIR", ctx: "AnalysisContext"  # noqa: F821
+                      ) -> Iterable[Finding]:
+        return ()
+
+    def check_context(self, ctx: "AnalysisContext") -> Iterable[Finding]:
+        return ()
+
+    def finding(self, message: str, location: str = "",
+                severity: Optional[Severity] = None,
+                suggestion: str = "") -> Finding:
+        return Finding(
+            rule_id=self.rule_id,
+            severity=self.default_severity if severity is None else severity,
+            location=location,
+            message=message,
+            suggestion=suggestion,
+        )
+
+
+@dataclasses.dataclass
+class AnalysisOptions:
+    """Thresholds and switches, resolvable from the ``analysis`` config block.
+
+    ``replicated_bytes``: floor for the replicated-large-array rule (per-leaf
+    logical bytes). ``donation_bytes``: floor for the donation-miss rule.
+    ``matmul_min_elems``: smallest operand treated as a "real" matmul by the
+    fp32-leak rule. ``reduction_min_elems``: floor for the low-precision
+    accumulation rule. ``wire_check_bytes``: floor for flagging full-precision
+    collectives while quantized collectives are configured.
+    """
+
+    replicated_bytes: int = 16 << 20
+    donation_bytes: int = 1 << 20
+    matmul_min_elems: int = 4096
+    # floor chosen above the per-layer cotangent sums a normal bf16 backward
+    # emits (those accumulate fp32 on the MXU anyway); what's left is the
+    # batch-sized loss/logit reductions where bf16 genuinely drops the tail
+    reduction_min_elems: int = 1 << 20
+    wire_check_bytes: int = 1 << 20
+    include: Sequence[str] = ()   # rule_id prefixes to keep (empty = all)
+    exclude: Sequence[str] = ()   # rule_id prefixes to drop
+
+    def rule_enabled(self, rule_id: str) -> bool:
+        if any(rule_id.startswith(p) for p in self.exclude):
+            return False
+        if self.include:
+            return any(rule_id.startswith(p) for p in self.include)
+        return True
+
+
+@dataclasses.dataclass
+class AnalysisContext:
+    """What the rules may consult besides the IR itself."""
+
+    engine: Any = None              # DeepSpeedEngine, when analyzing one
+    config: Any = None              # DeepSpeedConfig (or None)
+    mesh: Any = None                # jax.sharding.Mesh (or None)
+    options: AnalysisOptions = dataclasses.field(default_factory=AnalysisOptions)
+
+    @property
+    def n_devices(self) -> int:
+        return int(self.mesh.devices.size) if self.mesh is not None else 1
+
+    @property
+    def quantization(self):
+        """The resolved QuantizedCommConfig from the bound config, or None."""
+        zero = getattr(self.config, "zero_optimization", None)
+        if zero is None:
+            return None
+        from ..comm.quantized import QuantizedCommConfig
+
+        qc = QuantizedCommConfig.from_zero_config(zero)
+        return qc if qc.enabled else None
+
+
+class AnalysisError(RuntimeError):
+    """Raised when ``analysis.fail_on_error`` is set and ERROR findings exist."""
+
+    def __init__(self, report: "Report"):
+        self.report = report
+        super().__init__(
+            f"static analysis found {len(report.errors())} ERROR finding(s):\n"
+            + "\n".join(f.render() for f in report.errors()))
+
+
+@dataclasses.dataclass
+class Report:
+    """Findings from one analysis run, plus reporters."""
+
+    findings: List[Finding] = dataclasses.field(default_factory=list)
+    programs: List[str] = dataclasses.field(default_factory=list)
+
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity >= Severity.ERROR]
+
+    def warnings(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == Severity.WARNING]
+
+    def by_rule(self, rule_id: str) -> List[Finding]:
+        return [f for f in self.findings if f.rule_id == rule_id]
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors()
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "programs": list(self.programs),
+            "n_findings": len(self.findings),
+            "n_errors": len(self.errors()),
+            "n_warnings": len(self.warnings()),
+            "findings": [f.to_dict() for f in self.findings],
+        }
+
+    def to_json(self, indent: Optional[int] = None) -> str:
+        return json.dumps(self.to_dict(), indent=indent)
+
+    def render(self) -> str:
+        head = (f"dslint: analyzed {len(self.programs)} program(s) "
+                f"[{', '.join(self.programs)}] — "
+                f"{len(self.errors())} error(s), "
+                f"{len(self.warnings())} warning(s), "
+                f"{len(self.findings)} finding(s) total")
+        if not self.findings:
+            return head + "\n  (clean)"
+        body = "\n".join(
+            f.render() for f in sorted(
+                self.findings, key=lambda f: (-int(f.severity), f.rule_id)))
+        return head + "\n" + body
+
+
+class Analyzer:
+    """Run a rule set over captured programs + context."""
+
+    def __init__(self, rules: Optional[Sequence[Rule]] = None,
+                 options: Optional[AnalysisOptions] = None):
+        if rules is None:
+            from . import default_rules
+
+            rules = default_rules()
+        self.options = options or AnalysisOptions()
+        self.rules = [r for r in rules if self.options.rule_enabled(r.rule_id)]
+
+    def run(self, programs: Sequence["ProgramIR"],  # noqa: F821
+            ctx: Optional[AnalysisContext] = None) -> Report:
+        ctx = ctx or AnalysisContext()
+        ctx.options = self.options
+        report = Report(programs=[p.name for p in programs])
+        for rule in self.rules:
+            try:
+                report.findings.extend(rule.check_context(ctx))
+            except Exception as e:  # a broken rule must not kill the analysis
+                logger.warning(f"dslint rule {rule.rule_id} failed on context: {e}")
+            for prog in programs:
+                try:
+                    report.findings.extend(rule.check_program(prog, ctx))
+                except Exception as e:
+                    logger.warning(
+                        f"dslint rule {rule.rule_id} failed on {prog.name}: {e}")
+        return report

@@ -1,0 +1,131 @@
+"""Collective-order rules: the SPMD deadlock class, caught statically.
+
+Inside a manual ``shard_map`` body every rank executes the same program, so
+every rank must issue the *same collectives in the same order*. A ``cond``
+whose branches disagree about their collective sequence means rank A (taking
+branch 0) can sit in an all-gather while rank B (branch 1) sits in a psum —
+a silent multihost hang, the failure mode ``runtime/pipe/mpmd.py`` avoids by
+construction (its send/recv schedule is validated for pairing) and
+``comm/quantized.py`` avoids by keeping its q-collectives unconditional.
+
+Note the subtlety: *uniform* branch predicates (same value on every rank, e.g.
+the engine's grads-finite scalar) make divergence impossible at runtime, but
+the jaxpr does not prove uniformity — so a collective imbalance between
+branches is reported even then: XLA itself refuses to partition such programs
+in manual mode, and under ``shard_map`` the hang is real.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from .core import AnalysisContext, Finding, Rule, Severity
+from .ir import ProgramIR, collective_signature, iter_eqns, source_line, sub_jaxprs
+
+
+def _fmt(sig) -> str:
+    if not sig:
+        return "(no collectives)"
+    return " -> ".join(f"{name}[{','.join(axes)}]" for name, axes in sig)
+
+
+class DivergentBranchCollectivesRule(Rule):
+    """``cond`` branches with different collective sequences."""
+
+    rule_id = "collective/divergent-branch-order"
+    default_severity = Severity.ERROR
+    description = "cond branches disagree on their collective sequence"
+
+    def check_program(self, prog: ProgramIR,
+                      ctx: AnalysisContext) -> Iterable[Finding]:
+        for eqn, path in iter_eqns(prog.jaxpr):
+            if eqn.primitive.name != "cond":
+                continue
+            branches = eqn.params.get("branches", ())
+            sigs = [collective_signature(b.jaxpr) for b in branches]
+            if len(sigs) < 2 or all(s == sigs[0] for s in sigs[1:]):
+                continue
+            if not any(sigs):
+                continue
+            src = source_line(eqn)
+            detail = "; ".join(
+                f"branch {i}: {_fmt(s)}" for i, s in enumerate(sigs))
+            yield self.finding(
+                f"cond branches issue different collective sequences "
+                f"({detail}) — ranks taking different branches deadlock "
+                f"inside shard_map / multihost SPMD",
+                location=(f"{prog.name}:{path}"
+                          + (f" ({src})" if src else "")),
+                suggestion="make the collective set identical across "
+                           "branches (issue the collective outside the cond, "
+                           "or add the matching collective on dummy data in "
+                           "the other branch)",
+            )
+
+
+class CollectiveInWhilePredicateRule(Rule):
+    """Collectives inside a ``while_loop`` predicate: the loop's trip count
+    then depends on a cross-rank exchange evaluated anew each iteration —
+    one rank exiting early orphans the others mid-collective."""
+
+    rule_id = "collective/collective-in-while-predicate"
+    default_severity = Severity.ERROR
+    description = "while_loop cond function contains collectives"
+
+    def check_program(self, prog: ProgramIR,
+                      ctx: AnalysisContext) -> Iterable[Finding]:
+        for eqn, path in iter_eqns(prog.jaxpr):
+            if eqn.primitive.name != "while":
+                continue
+            cond_jaxpr = eqn.params.get("cond_jaxpr")
+            if cond_jaxpr is None:
+                continue
+            sig = collective_signature(cond_jaxpr.jaxpr)
+            if not sig:
+                continue
+            src = source_line(eqn)
+            yield self.finding(
+                f"while_loop predicate issues collectives ({_fmt(sig)}) — "
+                f"if any rank's local data lets it exit a different "
+                f"iteration, the remaining ranks hang in the predicate's "
+                f"collective",
+                location=(f"{prog.name}:{path}"
+                          + (f" ({src})" if src else "")),
+                suggestion="reduce the loop-exit quantity ONCE per iteration "
+                           "in the body and branch on the replicated scalar",
+            )
+
+
+class ShardMapBranchlessGuardRule(Rule):
+    """Informational inventory: per-``shard_map`` collective signature.
+
+    Not a bug by itself — surfacing the manual-mode collective order is what
+    lets a human (or a diff in CI) notice when an edit reorders the exchange
+    that ``runtime/engine.py:_qdp_grads`` or the 1-bit runner relies on."""
+
+    rule_id = "collective/shard-map-signature"
+    default_severity = Severity.INFO
+    description = "inventory of manual-mode collective sequences"
+
+    def check_program(self, prog: ProgramIR,
+                      ctx: AnalysisContext) -> Iterable[Finding]:
+        for eqn, path in iter_eqns(prog.jaxpr):
+            if eqn.primitive.name != "shard_map":
+                continue
+            for _, sub in sub_jaxprs(eqn):
+                sig = collective_signature(sub)
+                if sig:
+                    yield self.finding(
+                        f"shard_map body collective order: {_fmt(sig)}",
+                        location=f"{prog.name}:{path}",
+                    )
+                break  # one body per shard_map eqn
+
+
+def collective_rules() -> List[Rule]:
+    return [DivergentBranchCollectivesRule(), CollectiveInWhilePredicateRule(),
+            ShardMapBranchlessGuardRule()]
+
+
+__all__ = ["DivergentBranchCollectivesRule", "CollectiveInWhilePredicateRule",
+           "ShardMapBranchlessGuardRule", "collective_rules"]

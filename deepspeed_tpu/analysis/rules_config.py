@@ -1,0 +1,129 @@
+"""Config rules: knobs the compiled program contradicts.
+
+A config block is a *promise* about the program ("the gradient wire is int8",
+"loss scaling protects the fp16 backward"). Pydantic validation
+(``runtime/config.py`` / ``zero/config.py``) catches knob combinations that
+are wrong on paper; these rules catch the ones that are wrong *in the traced
+program* — set but inert, or structurally impossible to honor.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+import jax.numpy as jnp
+
+from .core import AnalysisContext, Finding, Rule, Severity
+from .ir import COLLECTIVE_PRIMS, ProgramIR, iter_eqns
+
+_INT_WIRE_DTYPES = (jnp.uint8, jnp.int8)
+_WIRE_PRIMS = COLLECTIVE_PRIMS | {"sharding_constraint"}
+
+
+def _has_int_wire(prog: ProgramIR) -> bool:
+    """Whether the trace moved any int payload: a quantized collective inside
+    a shard_map body (uint8 all_gather/all_to_all) or a GSPMD constraint on a
+    uint8 payload (``quantized_reshard``)."""
+    for eqn, _ in iter_eqns(prog.jaxpr):
+        if eqn.primitive.name not in _WIRE_PRIMS:
+            continue
+        for v in list(eqn.invars) + list(eqn.outvars):
+            dt = getattr(getattr(v, "aval", None), "dtype", None)
+            if dt is not None and any(dt == d for d in _INT_WIRE_DTYPES):
+                return True
+    return False
+
+
+class QuantizedWireMissingRule(Rule):
+    """``zero_quantized_weights``/``zero_quantized_gradients`` set, but the
+    traced step program carries no int payload at all — the knob is paying
+    quantize/dequantize noise for zero wire savings (e.g. every leaf's row is
+    below the break-even length, so ``quantization_shrinks`` vetoed the int
+    format everywhere)."""
+
+    rule_id = "config/quantized-wire-missing"
+    default_severity = Severity.ERROR
+    description = "quantized-collective knobs set but no int payload traced"
+
+    def check_program(self, prog: ProgramIR,
+                      ctx: AnalysisContext) -> Iterable[Finding]:
+        qc = ctx.quantization
+        if qc is None:
+            return
+        if prog.wire_records or _has_int_wire(prog):
+            return
+        knobs = [k for k, on in (("zero_quantized_weights", qc.weights),
+                                 ("zero_quantized_gradients", qc.gradients))
+                 if on]
+        yield self.finding(
+            f"{' + '.join(knobs)} configured but the traced step moves no "
+            f"int8/int4 payload — the quantized wire never engaged "
+            f"(all rows below the break-even length, or the quantized path "
+            f"is bypassed by this engine mode)",
+            location=f"{prog.name}",
+            suggestion="drop the knob, or check why the quantized path is "
+                       "inert (stage < 3 without MoE for weights; a runner "
+                       "that owns the gradient program; leaves whose trailing "
+                       "dim is too short for the configured block size)",
+        )
+
+
+class QuantizedWeightsBelowStage3Rule(Rule):
+    """``zero_quantized_weights`` below ZeRO-3: stored params are replicated,
+    so there is no parameter gather to compress (only a MoE dispatch, if
+    any). The config loader warns at parse time; this keeps the fact visible
+    in the analysis report next to the wire evidence."""
+
+    rule_id = "config/quantized-weights-below-stage3"
+    default_severity = Severity.WARNING
+    description = "zero_quantized_weights without stage-3 parameter gathers"
+
+    def check_context(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        zero = getattr(ctx.config, "zero_optimization", None)
+        if zero is None:
+            return
+        if getattr(zero, "zero_quantized_weights", False) and \
+                int(getattr(zero, "stage", 0)) < 3:
+            yield self.finding(
+                f"zero_quantized_weights with ZeRO stage "
+                f"{int(getattr(zero, 'stage', 0))}: no parameter all-gathers "
+                f"exist to quantize",
+                location="config.zero_optimization",
+                suggestion="raise to stage 3 (where parameter gathers are "
+                           "the wire) or drop the knob",
+            )
+
+
+class LossScaleDtypeRule(Rule):
+    """Loss-scale bookkeeping must be fp32: a scaler held in low precision
+    quantizes the scale steps and can silently pin the scale at 0/inf."""
+
+    rule_id = "config/loss-scale-dtype"
+    default_severity = Severity.ERROR
+    description = "loss-scale state stored in low precision"
+
+    def check_context(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        eng = ctx.engine
+        if eng is None or not getattr(eng.pc, "loss_scaling", False):
+            return
+        scaler = eng.state.get("scaler")
+        scale = getattr(scaler, "scale", None)
+        if scale is None:
+            return
+        if scale.dtype != jnp.float32:
+            yield self.finding(
+                f"loss-scale state is {scale.dtype} — dynamic scale updates "
+                f"(x2 / /2 with hysteresis) need fp32 range and exactness",
+                location="engine.state.scaler",
+                suggestion="keep ScalerState leaves fp32 regardless of the "
+                           "compute dtype",
+            )
+
+
+def config_rules() -> List[Rule]:
+    return [QuantizedWireMissingRule(), QuantizedWeightsBelowStage3Rule(),
+            LossScaleDtypeRule()]
+
+
+__all__ = ["QuantizedWireMissingRule", "QuantizedWeightsBelowStage3Rule",
+           "LossScaleDtypeRule", "config_rules"]

@@ -1,0 +1,238 @@
+"""Precision rules: dtype propagation through the jaxpr.
+
+The failure mode: one stray fp32 literal (or an ``astype`` someone added while
+debugging) silently upcasts a whole bf16 matmul path — on TPU that halves MXU
+throughput and doubles the activation footprint, with zero errors. The dual
+failure is accumulating a *large* reduction in bf16, where the mantissa runs
+out long before the sum finishes.
+
+Taint propagation: every jaxpr var gets a state in {CLEAN, LOW, UPCAST} —
+LOW means "derived from a bf16/fp16 value", UPCAST means "a LOW value that was
+converted to fp32/fp64 and is still wide". A flop-heavy op (dot_general, conv)
+consuming an UPCAST operand is the leak. Sub-jaxprs (scan bodies, cond
+branches, pjit calls, shard_map bodies) are entered with their operand taints
+so leaks inside a scanned layer body are found where they happen.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .core import AnalysisContext, Finding, Rule, Severity
+from .ir import ProgramIR, source_line, sub_jaxprs
+
+CLEAN, LOW, UPCAST = 0, 1, 2
+
+_LOW_DTYPES = (jnp.bfloat16, jnp.float16)
+_WIDE_DTYPES = (jnp.float32, jnp.float64)
+
+_HEAVY_PRIMS = frozenset({"dot_general", "conv_general_dilated"})
+
+
+def _dtype_of(v):
+    return getattr(getattr(v, "aval", None), "dtype", None)
+
+
+def _size_of(v) -> int:
+    shape = getattr(getattr(v, "aval", None), "shape", None)
+    if shape is None:
+        return 0
+    return int(np.prod(shape)) if shape else 1
+
+
+def _is_low(dt) -> bool:
+    return dt is not None and any(dt == d for d in _LOW_DTYPES)
+
+
+def _is_wide(dt) -> bool:
+    return dt is not None and any(dt == d for d in _WIDE_DTYPES)
+
+
+class _TaintWalker:
+    """One pass over a jaxpr tree; collects findings, bounded dedup."""
+
+    def __init__(self, rule: Rule, prog: ProgramIR, ctx: AnalysisContext):
+        self.rule = rule
+        self.prog = prog
+        self.min_elems = ctx.options.matmul_min_elems
+        self.findings: List[Finding] = []
+        self._seen: set = set()
+
+    def walk(self, jaxpr, taint_in: List[int], path: str) -> List[int]:
+        env: Dict[int, int] = {}
+
+        def read(v) -> int:
+            if not hasattr(v, "count"):  # Literal
+                return CLEAN
+            return env.get(id(v), CLEAN)
+
+        def write(v, t: int) -> None:
+            env[id(v)] = t
+
+        for v, t in zip(jaxpr.invars, taint_in):
+            write(v, t)
+        for v in jaxpr.constvars:
+            write(v, LOW if _is_low(_dtype_of(v)) else CLEAN)
+
+        for i, eqn in enumerate(jaxpr.eqns):
+            name = eqn.primitive.name
+            in_taints = [read(v) for v in eqn.invars]
+            agg = max(in_taints, default=CLEAN)
+            here = f"{path}/{name}[{i}]"
+
+            subs = sub_jaxprs(eqn)
+            if subs:
+                out_taint = CLEAN
+                for tag, sub in subs:
+                    ops = eqn.invars
+                    if name == "cond":  # first invar is the predicate
+                        ops = eqn.invars[1:]
+                    tin = [read(v) for v in ops]
+                    n = len(sub.invars)
+                    if len(tin) != n:  # consts/extras: conservative pad/trim
+                        fill = agg if tin else CLEAN
+                        tin = (tin + [fill] * n)[:n]
+                    tout = self.walk(sub, tin, f"{here}.{tag}")
+                    out_taint = max([out_taint, *tout], default=out_taint)
+                for v in eqn.outvars:
+                    write(v, out_taint)
+                continue
+
+            if name == "convert_element_type":
+                src = _dtype_of(eqn.invars[0])
+                dst = eqn.params.get("new_dtype")
+                if _is_wide(dst) and (in_taints[0] >= LOW or _is_low(src)):
+                    write(eqn.outvars[0], UPCAST)
+                elif _is_low(dst):
+                    write(eqn.outvars[0], LOW)
+                else:
+                    write(eqn.outvars[0], agg)
+                continue
+
+            if name in _HEAVY_PRIMS:
+                for v, t in zip(eqn.invars, in_taints):
+                    if (t == UPCAST and _is_wide(_dtype_of(v))
+                            and _size_of(v) >= self.min_elems):
+                        src = source_line(eqn)
+                        key = (name, src or here)
+                        if key not in self._seen:
+                            self._seen.add(key)
+                            self.findings.append(self.rule.finding(
+                                f"{name} runs in "
+                                f"{np.dtype(_dtype_of(v)).name} on an operand "
+                                f"upcast from bf16/fp16 "
+                                f"({_size_of(v)} elements) — the low-"
+                                f"precision compute path leaks to full "
+                                f"precision here",
+                                location=(f"{self.prog.name}:{here}"
+                                          + (f" ({src})" if src else "")),
+                                suggestion="drop the fp32 astype/literal on "
+                                           "this path (or cast back to the "
+                                           "compute dtype before the matmul); "
+                                           "keep fp32 for reductions and the "
+                                           "optimizer, not for MXU ops",
+                            ))
+                        break
+            for v in eqn.outvars:
+                write(v, agg)
+
+        return [read(v) for v in jaxpr.outvars]
+
+
+class F32LeakRule(Rule):
+    """fp32/fp64 matmuls reachable from bf16/fp16 inputs via upcasts."""
+
+    rule_id = "precision/fp32-leak"
+    default_severity = Severity.WARNING
+    description = "flop-heavy ops silently upcast out of the bf16 path"
+
+    def check_program(self, prog: ProgramIR,
+                      ctx: AnalysisContext) -> Iterable[Finding]:
+        jaxpr = prog.jaxpr
+        taint_in = [LOW if _is_low(_dtype_of(v)) else CLEAN
+                    for v in jaxpr.invars]
+        if LOW not in taint_in:
+            # no low-precision inputs: nothing to leak from (pure-fp32
+            # programs are allowed to be pure fp32)
+            return []
+        w = _TaintWalker(self, prog, ctx)
+        w.walk(jaxpr, taint_in, "")
+        return w.findings
+
+
+class F64PresenceRule(Rule):
+    """float64 anywhere in the program — software-emulated (or rejected) on
+    TPU; almost always an accidental ``jax_enable_x64`` interaction."""
+
+    rule_id = "precision/f64-present"
+    default_severity = Severity.ERROR
+    description = "float64 values in a TPU-bound program"
+
+    def check_program(self, prog: ProgramIR,
+                      ctx: AnalysisContext) -> Iterable[Finding]:
+        from .ir import iter_eqns
+
+        for eqn, path in iter_eqns(prog.jaxpr):
+            for v in list(eqn.outvars):
+                dt = _dtype_of(v)
+                if dt is not None and dt == jnp.float64:
+                    src = source_line(eqn)
+                    yield self.finding(
+                        "float64 value produced in the step program — TPUs "
+                        "have no f64 hardware path",
+                        location=(f"{prog.name}:{path}"
+                                  + (f" ({src})" if src else "")),
+                        suggestion="cast to float32 (or audit jax_enable_x64 "
+                                   "and numpy-literal promotions)",
+                    )
+                    return  # one finding: the first site is where to start
+
+
+class LowPrecisionAccumulationRule(Rule):
+    """Large reductions accumulating in bf16/fp16 — the sum loses the tail
+    once the running value dwarfs the addends (loss sums, norm computations
+    run in low precision are the classic instance)."""
+
+    rule_id = "precision/low-precision-accumulation"
+    default_severity = Severity.WARNING
+    description = "large sums accumulated in a <=16-bit dtype"
+
+    def check_program(self, prog: ProgramIR,
+                      ctx: AnalysisContext) -> Iterable[Finding]:
+        from .ir import iter_eqns
+
+        min_elems = ctx.options.reduction_min_elems
+        seen = set()
+        for eqn, path in iter_eqns(prog.jaxpr):
+            if eqn.primitive.name not in ("reduce_sum", "cumsum"):
+                continue
+            v = eqn.invars[0]
+            dt = _dtype_of(v)
+            if not _is_low(dt) or _size_of(v) < min_elems:
+                continue
+            src = source_line(eqn)
+            key = src or path
+            if key in seen:
+                continue
+            seen.add(key)
+            yield self.finding(
+                f"{eqn.primitive.name} over {_size_of(v)} "
+                f"{np.dtype(dt).name} elements accumulates in low precision",
+                location=(f"{prog.name}:{path}"
+                          + (f" ({src})" if src else "")),
+                suggestion="astype(float32) before the reduction (XLA fuses "
+                           "the cast; the cost is the accumulator width, "
+                           "not a materialized copy)",
+            )
+
+
+def precision_rules() -> List[Rule]:
+    return [F32LeakRule(), F64PresenceRule(), LowPrecisionAccumulationRule()]
+
+
+__all__ = ["F32LeakRule", "F64PresenceRule", "LowPrecisionAccumulationRule",
+           "precision_rules"]

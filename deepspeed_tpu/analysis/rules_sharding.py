@@ -1,0 +1,168 @@
+"""Sharding rules: silent replication and unaccounted wire traffic.
+
+The GSPMD failure mode this family exists for: a ``PartitionSpec`` typo (or a
+policy that silently falls back to replication) keeps a multi-GB buffer fully
+replicated on every device — the program still runs, just ``W`` times heavier
+than intended — and the collectives GSPMD inserts to feed it never show up in
+any accounting the user looks at.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+import numpy as np
+
+import jax
+
+from .core import AnalysisContext, Finding, Rule, Severity
+from .ir import ProgramIR
+
+
+def _spec_replicated(sharding) -> bool:
+    """True when a sharding places the array wholly on every device."""
+    try:
+        return bool(sharding.is_fully_replicated)
+    except Exception:
+        spec = getattr(sharding, "spec", None)
+        if spec is None:
+            return False
+        return all(e is None for e in tuple(spec))
+
+
+def _leaf_findings(rule: Rule, tree, what: str, threshold: int,
+                   stage: int, persist_elems: int = 0) -> Iterable[Finding]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is None:
+            continue
+        if int(leaf.size) < persist_elems:
+            # below stage3_param_persistence_threshold the policy keeps the
+            # leaf replicated ON PURPOSE (gathering it would cost more than
+            # holding it) — not a finding
+            continue
+        nbytes = int(leaf.size) * leaf.dtype.itemsize
+        if nbytes < threshold:
+            continue
+        if _spec_replicated(sharding):
+            key = jax.tree_util.keystr(path)
+            yield rule.finding(
+                f"{what} leaf {key} ({nbytes / 2**20:.1f} MB) is fully "
+                f"replicated although ZeRO stage {stage} declares it "
+                f"partitioned — every device holds a full copy",
+                location=f"engine.state.{what}{key}",
+                suggestion="check the model's partition specs (a dimension "
+                           "not divisible by the mesh axis falls back to "
+                           "replication) or lower the ZeRO stage to match "
+                           "what you actually want resident",
+            )
+
+
+class ReplicatedLargeArrayRule(Rule):
+    """Arrays above a size threshold that are fully replicated when the
+    declared ZeRO stage says they should be partitioned (engine mode), or
+    any large fully-replicated input on a multi-device mesh (program mode,
+    advisory)."""
+
+    rule_id = "sharding/replicated-large-array"
+    default_severity = Severity.ERROR
+    description = ("large buffers silently replicated across the mesh "
+                   "despite a partitioning policy that says otherwise")
+
+    def check_context(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        eng = ctx.engine
+        if eng is None or ctx.n_devices <= 1:
+            return
+        threshold = ctx.options.replicated_bytes
+        stage = eng.policy.stage
+        state = eng.state
+        persist = int(getattr(eng.config.zero_optimization,
+                              "stage3_param_persistence_threshold", 0) or 0)
+        if stage >= 3 and state.get("params"):
+            yield from _leaf_findings(self, state["params"], "params",
+                                      threshold, stage, persist_elems=persist)
+        if stage >= 1:
+            for what in ("master", "opt"):
+                if state.get(what):
+                    yield from _leaf_findings(self, state[what], what,
+                                              threshold, stage,
+                                              persist_elems=persist)
+
+    def check_program(self, prog: ProgramIR,
+                      ctx: AnalysisContext) -> Iterable[Finding]:
+        # advisory path for bare pjit programs: no policy to hold the program
+        # to, so replication is only *suspicious*, not wrong
+        if ctx.engine is not None or prog.compiled is None or ctx.n_devices <= 1:
+            return
+        try:
+            in_sh = prog.compiled.input_shardings[0]
+        except Exception:
+            return
+        flat = jax.tree_util.tree_leaves(in_sh)
+        for i, (aval, sharding) in enumerate(zip(prog.in_avals, flat)):
+            nbytes = int(np.prod(aval.shape) if aval.shape else 1) * \
+                aval.dtype.itemsize
+            if nbytes < ctx.options.replicated_bytes:
+                continue
+            if _spec_replicated(sharding):
+                yield self.finding(
+                    f"input #{i} ({nbytes / 2**20:.1f} MB {aval.dtype}"
+                    f"{list(aval.shape)}) is fully replicated across "
+                    f"{ctx.n_devices} devices",
+                    location=f"{prog.name}:arg{i}",
+                    severity=Severity.WARNING,
+                    suggestion="shard it with an explicit PartitionSpec if "
+                               "replication is not intended",
+                )
+
+
+class UnaccountedCollectiveRule(Rule):
+    """Full-precision collectives GSPMD inserted while the config promises a
+    quantized wire — traffic invisible to ``runtime_accounting.wire_ledger``.
+
+    Cross-check against PR 1's accounting: the ledger records every op that
+    went through the quantized wire at trace time; any *float* collective above
+    the threshold in the optimized HLO is, by construction, outside it."""
+
+    rule_id = "sharding/unaccounted-collective"
+    default_severity = Severity.WARNING
+    description = ("fp32/bf16 collectives on the wire that bypass the "
+                   "quantized-collective accounting")
+
+    _FLOAT_DTYPES = frozenset({"f64", "f32", "bf16", "f16"})
+
+    def check_program(self, prog: ProgramIR,
+                      ctx: AnalysisContext) -> Iterable[Finding]:
+        if ctx.quantization is None or not prog.hlo:
+            return
+        accounted = sorted(prog.wire_records) or ["(none recorded this trace)"]
+        seen: set = set()
+        for coll in prog.hlo_collectives():
+            if coll.bytes < ctx.options.wire_check_bytes:
+                continue
+            if not any(dt in self._FLOAT_DTYPES for dt in coll.dtypes):
+                continue  # int payload — that IS the quantized wire
+            key = (coll.op, coll.dtypes, coll.bytes)
+            if key in seen:
+                continue  # one finding per distinct shape, not per instance
+            seen.add(key)
+            yield self.finding(
+                f"{coll.op} moves {coll.bytes / 2**20:.1f} MB of "
+                f"{'/'.join(sorted(set(coll.dtypes)))} although quantized "
+                f"collectives are configured; wire-ledger ops this trace: "
+                f"{', '.join(accounted)}",
+                location=f"{prog.name}:hlo:{coll.line[:120]}",
+                suggestion="route this transfer through quantized_reshard / "
+                           "the q-collectives, or accept it and budget the "
+                           "bytes (stage-3 qgrad entry gathers are a known "
+                           "full-precision path)",
+            )
+
+
+def sharding_rules() -> List[Rule]:
+    return [ReplicatedLargeArrayRule(), UnaccountedCollectiveRule()]
+
+
+__all__ = ["ReplicatedLargeArrayRule", "UnaccountedCollectiveRule",
+           "sharding_rules"]

@@ -104,6 +104,18 @@ class WireLedger:
         log_dist(out)
         return out
 
+    def snapshot(self) -> Dict[str, int]:
+        """Per-op trace counts right now — diff two snapshots to attribute
+        quantized-wire records to one trace (the static analyzer's
+        ``ProgramIR.wire_records`` does exactly this)."""
+        return {name: rec.count for name, rec in self.records.items()}
+
+    def delta(self, before: Dict[str, int]) -> Dict[str, int]:
+        """Ops recorded since ``before`` (a :meth:`snapshot` result)."""
+        return {name: rec.count - before.get(name, 0)
+                for name, rec in self.records.items()
+                if rec.count > before.get(name, 0)}
+
     def reset(self) -> None:
         self.records.clear()
 

@@ -32,11 +32,31 @@ def _cost_analysis(compiled) -> Dict[str, float]:
 def profile_compiled_fn(fn: Callable, *args, static_argnums=(),
                         n_timing_runs: int = 3) -> Dict[str, Any]:
     """Compile ``fn(*args)`` and report flops/bytes from XLA plus measured wall
-    time and achieved FLOP/s."""
+    time and achieved FLOP/s.
+
+    The static counts come from ``Compiled.cost_analysis()``; when the
+    backend's executable drops them (the CPU-fallback regime — wall clock is
+    then measuring the wrong machine anyway), the pre-backend
+    ``Lowered.cost_analysis()`` supplies the same program-level flops/bytes,
+    so the report always carries a static cross-check next to the measured
+    path. ``flops_source`` says which level answered.
+    """
     jitted = jax.jit(fn, static_argnums=static_argnums)
     lowered = jitted.lower(*args)
     compiled = lowered.compile()
     ca = _cost_analysis(compiled)
+    flops_source = "compiled"
+    if not ca.get("flops"):
+        lca = _cost_analysis(lowered)
+        if lca.get("flops"):
+            # keep any compiled-level numbers that did survive; fill the
+            # rest from the lowered module
+            ca = {**lca, **{k: v for k, v in ca.items() if v}}
+            flops_source = "lowered"
+        else:
+            # neither level answered: flops=0.0 must read as "unknown",
+            # not as an authoritative compiled-level zero
+            flops_source = "none"
     out = compiled(*args)
     jax.block_until_ready(out)
     t0 = time.perf_counter()
@@ -50,6 +70,7 @@ def profile_compiled_fn(fn: Callable, *args, static_argnums=(),
         "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
         "latency_s": dt,
         "flops_per_s": flops / dt if dt > 0 else 0.0,
+        "flops_source": flops_source,
     }
 
 
@@ -251,9 +272,11 @@ def per_module_profile(cfg, micro_bs: int, seq: int,
         lambda g, st, p: opt.update(g, st, p, jnp.float32(3e-4)),
         master, opt_state, master, n_timing_runs=n_timing_runs)
     # scale the extensive quantities only; flops_per_s is a rate (invariant
-    # under scaling flops and latency together)
-    scaled = {k: v * scale for k, v in one.items() if k != "flops_per_s"}
+    # under scaling flops and latency together) and flops_source is a label
+    scaled = {k: v * scale for k, v in one.items()
+              if k != "flops_per_s" and isinstance(v, (int, float))}
     scaled["flops_per_s"] = one["flops_per_s"]
+    scaled["flops_source"] = one.get("flops_source", "compiled")
     units["optimizer"] = {
         "params": total_params, "count": 1,
         "update": scaled,

@@ -143,6 +143,28 @@ class MonitorConfig(DeepSpeedConfigModel):
                 or self.wandb.enabled)
 
 
+class AnalysisConfig(DeepSpeedConfigModel):
+    """TPU-native block: opt-in static analysis of the compiled step
+    (:mod:`deepspeed_tpu.analysis` — sharding/precision/host-sync/collective-
+    order/config rules over the jaxpr + HLO).
+
+    When ``enabled``, the engine analyzes its fused train program at init
+    (synthesizing an abstract batch for GPT-family models) or at the first
+    ``train_batch`` otherwise — before any step executes. ``fail_on_error``
+    raises :class:`~deepspeed_tpu.analysis.AnalysisError` on ERROR-severity
+    findings; off, they are logged and training proceeds. ``compile`` also
+    runs XLA to get the post-GSPMD HLO (wire-traffic rules; slower init).
+    """
+
+    enabled: bool = False
+    fail_on_error: bool = True
+    compile: bool = False
+    replicated_mb_threshold: float = 16.0
+    donation_mb_threshold: float = 1.0
+    include: List[str] = Field(default_factory=list)
+    exclude: List[str] = Field(default_factory=list)
+
+
 class MeshTopologyConfig(DeepSpeedConfigModel):
     """TPU-native block: requested mesh extents. dp=-1 means all remaining devices."""
 
@@ -232,6 +254,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     mesh: MeshTopologyConfig = Field(default_factory=MeshTopologyConfig)
     progressive_layer_drop: ProgressiveLayerDropConfig = Field(
         default_factory=ProgressiveLayerDropConfig)
+    analysis: AnalysisConfig = Field(default_factory=AnalysisConfig)
 
     # data efficiency / curriculum (parity: runtime/data_pipeline) — parsed, consumed
     # by the data_pipeline module.

@@ -442,6 +442,14 @@ class DeepSpeedEngine:
             # parity: the reference's dump_state prints the resolved config
             log_dist("config state dump:\n" + config.model_dump_json(indent=2))
 
+        # opt-in static analysis (deepspeed_tpu.analysis): lint the fused
+        # step's jaxpr/HLO before anything executes. Runs here when a batch
+        # can be synthesized (GPT-family models); otherwise at the first
+        # train_batch, still ahead of the first executed step.
+        self._analysis_pending = bool(config.analysis.enabled)
+        if self._analysis_pending:
+            self._run_configured_analysis(batch=None, defer_ok=True)
+
     # ------------------------------------------------------------------ state init
     def _init_state(self) -> Dict[str, Any]:
         if self._param_stream_requested:
@@ -513,6 +521,51 @@ class DeepSpeedEngine:
                 jnp.zeros((self._n_curvature,), jnp.float32),
                 NamedSharding(self.mesh, P()))
         return state
+
+    # ------------------------------------------------------------------ analysis
+    def analyze(self, batch=None, compile: bool = False, **kwargs):
+        """Static analysis of the fused train program (no execution).
+
+        ``batch``: a sample ``train_batch`` input (arrays or
+        ``ShapeDtypeStruct``s); synthesized from ``model.gpt_config`` when
+        omitted. Returns a :class:`deepspeed_tpu.analysis.Report`. See
+        :mod:`deepspeed_tpu.analysis` for the rule families and
+        ``docs/STATIC_ANALYSIS.md`` for the catalog."""
+        from ..analysis import analyze_engine
+
+        return analyze_engine(self, batch=batch, compile=compile, **kwargs)
+
+    def _run_configured_analysis(self, batch=None, defer_ok: bool = False):
+        """Drive the opt-in ``analysis`` config block: log findings, raise on
+        ERROR when ``fail_on_error``. Leaves ``_analysis_pending`` set when no
+        batch exists yet and none can be synthesized (retried at the first
+        ``train_batch``) — loudly, so a caller that never supplies one (e.g. a
+        non-GPT model driven purely through ``train_batches``) knows the gate
+        is not armed."""
+        from ..analysis import AnalysisError, synthesize_batch
+
+        acfg = self.config.analysis
+        if batch is None:
+            batch = synthesize_batch(self)
+            if batch is None:
+                if not defer_ok:
+                    raise ValueError(
+                        "analysis: no batch given and none synthesizable "
+                        "(model has no gpt_config)")
+                if not getattr(self, "_analysis_defer_warned", False):
+                    self._analysis_defer_warned = True
+                    logger.warning(
+                        "analysis.enabled: deferred — the model exposes no "
+                        "gpt_config to synthesize a batch from; the analyzer "
+                        "runs at the first train_batch() (train_batches() "
+                        "cannot arm it), or call engine.analyze(batch) "
+                        "directly")
+                return
+        report = self.analyze(batch=batch, compile=acfg.compile)
+        self._analysis_pending = False
+        log_dist("static analysis: " + report.render())
+        if acfg.fail_on_error and report.errors():
+            raise AnalysisError(report)
 
     # ------------------------------------------------------------------ compiled fns
     def _loss_and_grads(self, params, batch, scale, rngs, step=None,
@@ -936,6 +989,11 @@ class DeepSpeedEngine:
         program. ``batch`` arrays are [gas, batch, ...] when gas>1, else [batch, ...].
         Parity: ``PipelineEngine.train_batch``-style one-call API."""
         self.tput_timer.start()
+        if self._analysis_pending:
+            # deferred init-time analysis: the first real batch supplies the
+            # shapes. MUST precede the flops profiler — profiling executes
+            # the step, and this gate's contract is pre-execution.
+            self._run_configured_analysis(batch=batch)
         if (self._flops_profiler is not None
                 and self.global_steps + 1 == self.config.flops_profiler.profile_step):
             self._flops_profiler.profile_train_batch(batch)
@@ -997,6 +1055,10 @@ class DeepSpeedEngine:
                 "1-bit/offload/param-stream runners interleave host work per "
                 "step — call train_batch per step instead")
         k = int(jax.tree_util.tree_leaves(batch)[0].shape[0])
+        if self._analysis_pending:
+            # the k-step batch layout differs from train_batch's; analyze the
+            # per-step program on a synthesized batch where possible
+            self._run_configured_analysis(batch=None, defer_ok=True)
         self._apply_random_ltd()
         batch = self._apply_curriculum(batch)
         batch = self._place_batch(batch, leading_gas=True, leading_steps=True)

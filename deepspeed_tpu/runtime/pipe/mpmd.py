@@ -58,6 +58,49 @@ from .schedule import (
 )
 
 
+def validate_schedule_pairing(num_micro: int, num_stages: int) -> List[str]:
+    """Statically prove the 1F1B command streams pair every recv with a send.
+
+    The MPMD interpreter moves activations/grads through per-(stage, micro)
+    channels; a schedule whose ``RecvActivation``/``RecvGrad`` fires before
+    the matching ``Send`` has run is the single-process rendering of the
+    multihost deadlock class (rank A blocks in a recv no rank ever sends —
+    the same bug family ``deepspeed_tpu.analysis``'s collective-order rules
+    catch in shard_map bodies). Returns a list of violations (empty = sound);
+    the engine refuses to construct on a non-empty list rather than hanging
+    mid-batch.
+    """
+    streams = [list(TrainSchedule(num_micro, num_stages, s).steps())
+               for s in range(num_stages)]
+    if len({len(st) for st in streams}) != 1:
+        return [f"stage streams disagree on slot count: "
+                f"{[len(st) for st in streams]}"]
+    problems: List[str] = []
+    acts, grads = set(), set()
+    for t in range(len(streams[0])):
+        # sends land first within a slot (the interpreter's phase 1)...
+        for s in range(num_stages):
+            for cmd in streams[s][t]:
+                if isinstance(cmd, SendActivation):
+                    acts.add((s + 1, cmd.micro_batch))
+                elif isinstance(cmd, SendGrad):
+                    grads.add((s - 1, cmd.micro_batch))
+        # ...then recvs/compute (phase 2) may consume them
+        for s in range(num_stages):
+            for cmd in streams[s][t]:
+                if isinstance(cmd, RecvActivation) and \
+                        (s, cmd.micro_batch) not in acts:
+                    problems.append(
+                        f"slot {t}: stage {s} receives activation for micro "
+                        f"{cmd.micro_batch} that no stage has sent")
+                elif isinstance(cmd, RecvGrad) and \
+                        (s, cmd.micro_batch) not in grads:
+                    problems.append(
+                        f"slot {t}: stage {s} receives grad for micro "
+                        f"{cmd.micro_batch} that no stage has sent")
+    return problems
+
+
 def _sgd(lr: float):
     """Minimal optax-style transformation used when no optimizer is supplied."""
 
@@ -101,6 +144,12 @@ class MPMDPipelineEngine:
             self._opt_init, self._opt_update = optimizer
         else:  # optax GradientTransformation
             self._opt_init, self._opt_update = optimizer.init, optimizer.update
+
+        problems = validate_schedule_pairing(self.M, self.S)
+        if problems:
+            raise ValueError(
+                "pipeline schedule fails send/recv pairing (would deadlock "
+                "a multi-process run):\n  " + "\n  ".join(problems))
 
         self._stage_fns = [self._make_stage_fn(s) for s in range(self.S)]
         self._fwd_jit: List[Callable] = []

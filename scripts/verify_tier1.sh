@@ -32,4 +32,32 @@ if [ "$rc" -eq 124 ]; then
     echo "verify_tier1: suite hit the 870s tier-1 budget (rc=124); no" \
          "collection errors detected in the portion that ran" >&2
 fi
+
+# --- static analysis gate (docs/STATIC_ANALYSIS.md) -----------------------
+# dslint over the default bench config: traces the engine's fused train
+# program (no execution) and exits 2 on ERROR-severity findings — the
+# sharding/precision/collective/config regressions that would otherwise
+# surface as burned TPU-hours.
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+        python -m deepspeed_tpu.analysis > /tmp/_t1_dslint.log 2>&1; then
+    echo "verify_tier1: FAIL — dslint reported ERROR findings (or crashed):" >&2
+    tail -40 /tmp/_t1_dslint.log >&2
+    exit 1
+fi
+
+# --- lint gate (ruff.toml: analysis subsystem + its tests) ----------------
+# advisory where the interpreter lacks ruff (this image does not bundle it);
+# CI lanes that have it get the real check.
+if python -c "import ruff" 2>/dev/null || command -v ruff >/dev/null 2>&1; then
+    if ! python -m ruff check deepspeed_tpu/analysis tests/test_analysis.py \
+            2>/dev/null && ! ruff check deepspeed_tpu/analysis \
+            tests/test_analysis.py; then
+        echo "verify_tier1: FAIL — ruff findings in the analysis subsystem" >&2
+        exit 1
+    fi
+else
+    echo "verify_tier1: ruff not installed; lint gate skipped" >&2
+fi
+
 exit "$rc"

@@ -60,6 +60,7 @@ def test_leaf_store_roundtrip(tmp_path, rng):
     np.testing.assert_array_equal(m0b, leaves[0] + 1.0)
 
 
+@pytest.mark.slow
 def test_nvme_offload_training_matches_cpu_offload(tmp_path):
     from deepspeed_tpu.models import build_gpt
     from deepspeed_tpu.models.gpt import GPTConfig

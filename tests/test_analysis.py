@@ -1,0 +1,327 @@
+"""dslint static analyzer: every rule family fires on a deliberately-broken
+program and stays silent on a known-good one.
+
+The broken programs are minimal renderings of the real bug classes:
+replicated big param under ZeRO-3, fp32 matmul leak out of a bf16 path,
+missed donation of a state-sized buffer, cond branches disagreeing on their
+collective order inside shard_map, and a quantization knob the traced program
+contradicts. The clean baseline is the shipped TINY GPT engine.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.analysis import (
+    AnalysisError,
+    AnalysisOptions,
+    Severity,
+    analyze_engine,
+    analyze_fn,
+)
+from deepspeed_tpu.models import GPTConfig, build_gpt
+from deepspeed_tpu.models.api import Module
+
+TINY = GPTConfig(vocab_size=256, n_layer=2, n_head=4, d_model=64,
+                 max_seq_len=64)
+
+
+def tiny_engine(stage=3, micro=4, **zero_over):
+    model, _ = build_gpt(TINY)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        config={
+            "train_micro_batch_size_per_gpu": micro,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": stage, **zero_over},
+            "steps_per_print": 0,
+        })
+    return engine
+
+
+def flat_module(shape=(64, 96), n=1):
+    """A Module with ``n`` weight leaves of ``shape`` and a quadratic loss —
+    small, no gather machinery, no gpt_config."""
+
+    def init(rng):
+        return {f"w{i}": jnp.zeros(shape, jnp.float32) for i in range(n)}
+
+    def apply(params, batch, rngs=None, train=True, **kw):
+        x = batch["x"]
+        loss = sum(jnp.mean((x @ w[:x.shape[-1], :x.shape[-1]]) ** 2)
+                   for w in params.values()) + jnp.mean(x ** 2)
+        return loss, {}
+
+    return Module(init=init, apply=apply)
+
+
+# --------------------------------------------------------------------- clean
+def test_clean_engine_no_findings(devices):
+    """The shipped engine must lint clean: no WARNING/ERROR on any family."""
+    engine = tiny_engine(stage=3)
+    report = analyze_engine(engine, compile=True)
+    bad = [f for f in report.findings if f.severity >= Severity.WARNING]
+    assert not bad, report.render()
+
+
+def test_clean_quantized_engine_no_errors(devices):
+    """qw8 engine: int wire present, so the config rule stays silent."""
+    engine = tiny_engine(stage=3, zero_quantized_weights=True)
+    report = analyze_engine(engine)
+    assert not report.errors(), report.render()
+    assert not report.by_rule("config/quantized-wire-missing")
+
+
+# ------------------------------------------------------------------ sharding
+def test_replicated_large_array_fires_once(devices):
+    """ZeRO-3 declared, but the single param leaf has no mesh-divisible dim
+    (7 x 513) — the policy falls back to replication and the rule must say
+    so."""
+    model = flat_module(shape=(7, 513))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 1,
+                # SGD without momentum: no opt-state leaves, so the single
+                # param leaf is the only replicated buffer to flag
+                "optimizer": {"type": "SGD", "params": {"lr": 1e-3}},
+                "zero_optimization": {
+                    "stage": 3, "stage3_param_persistence_threshold": 0},
+                "steps_per_print": 0})
+    batch = {"x": jax.ShapeDtypeStruct((8, 7), jnp.float32)}
+    report = analyze_engine(
+        engine, batch=batch,
+        options=AnalysisOptions(replicated_bytes=1024, donation_bytes=1 << 30))
+    hits = report.by_rule("sharding/replicated-large-array")
+    assert len(hits) == 1, report.render()
+    assert hits[0].severity == Severity.ERROR
+
+
+def test_replicated_rule_silent_when_policy_shards(devices):
+    engine = tiny_engine(stage=3)
+    report = analyze_engine(
+        engine, options=AnalysisOptions(replicated_bytes=1024))
+    assert not report.by_rule("sharding/replicated-large-array"), \
+        report.render()
+
+
+# ----------------------------------------------------------------- precision
+def test_fp32_leak_fires_once(devices):
+    def leaky(x, w):
+        h = x.astype(jnp.float32) @ w.astype(jnp.float32)  # the leak
+        return jnp.sum(h.astype(jnp.bfloat16))
+
+    x = jax.ShapeDtypeStruct((128, 128), jnp.bfloat16)
+    w = jax.ShapeDtypeStruct((128, 128), jnp.bfloat16)
+    report = analyze_fn(leaky, x, w, name="leaky")
+    hits = report.by_rule("precision/fp32-leak")
+    assert len(hits) == 1, report.render()
+
+
+def test_fp32_leak_silent_on_clean_bf16(devices):
+    def clean(x, w):
+        h = x @ w  # stays bf16; fp32 only after the matmul
+        return jnp.sum(h.astype(jnp.float32))
+
+    x = jax.ShapeDtypeStruct((128, 128), jnp.bfloat16)
+    w = jax.ShapeDtypeStruct((128, 128), jnp.bfloat16)
+    report = analyze_fn(clean, x, w, name="clean")
+    assert not report.by_rule("precision/fp32-leak"), report.render()
+
+
+def test_low_precision_accumulation_fires(devices):
+    """The realistic rendering: the backward of a broadcast-add sums 4M bf16
+    cotangents in bf16 (jnp.sum itself upcasts its accumulator — the forward
+    path is fine; the cotangent reduction is where the tail gets dropped)."""
+
+    def fwd(x, b):
+        return jnp.sum(((x + b).astype(jnp.float32)) ** 2)
+
+    x = jax.ShapeDtypeStruct((2048, 2048), jnp.bfloat16)
+    b = jax.ShapeDtypeStruct((2048,), jnp.bfloat16)
+    report = analyze_fn(jax.grad(fwd, argnums=1), x, b, name="bcast-bwd")
+    assert len(report.by_rule("precision/low-precision-accumulation")) == 1, \
+        report.render()
+
+
+# ----------------------------------------------------------------- host-sync
+def test_callback_in_step_fires_once(devices):
+    def with_callback(x):
+        y = jax.pure_callback(
+            lambda v: np.asarray(v) * 2, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+        return jnp.sum(y)
+
+    x = jax.ShapeDtypeStruct((8, 8), jnp.float32)
+    report = analyze_fn(with_callback, x, name="cb")
+    hits = report.by_rule("host-sync/callback-in-step")
+    assert len(hits) == 1, report.render()
+    assert hits[0].severity == Severity.ERROR
+
+
+def test_donation_miss_fires_once_and_donating_fixes_it(devices):
+    def step(state, batch):
+        return state + batch.sum(), jnp.mean(batch)
+
+    state = jax.ShapeDtypeStruct((1024, 1024), jnp.float32)  # 4 MB
+    batch = jax.ShapeDtypeStruct((16, 16), jnp.float32)
+    report = analyze_fn(step, state, batch, name="nodonate")
+    assert len(report.by_rule("host-sync/donation-miss")) == 1, report.render()
+
+    fixed = analyze_fn(step, state, batch, name="donated",
+                       donate_argnums=(0,))
+    assert not fixed.by_rule("host-sync/donation-miss"), fixed.render()
+
+
+# ----------------------------------------------------- collective order
+def test_divergent_branch_collectives_fires_once(devices):
+    from deepspeed_tpu.utils.jax_compat import shard_map
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+
+    def body(x, flag):
+        def with_psum(v):
+            return jax.lax.psum(v, "dp")
+
+        def without(v):
+            return v * 2.0
+
+        return jax.lax.cond(flag[0] > 0, with_psum, without, x)
+
+    fn = shard_map(body, mesh=mesh, in_specs=(P("dp"), P()),
+                   out_specs=P("dp"), check_vma=False)
+    x = jax.ShapeDtypeStruct((8, 4), jnp.float32)
+    flag = jax.ShapeDtypeStruct((1,), jnp.int32)
+    report = analyze_fn(fn, x, flag, name="divergent", mesh=mesh)
+    hits = report.by_rule("collective/divergent-branch-order")
+    assert len(hits) == 1, report.render()
+    assert hits[0].severity == Severity.ERROR
+
+
+def test_balanced_branch_collectives_silent(devices):
+    from deepspeed_tpu.utils.jax_compat import shard_map
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+
+    def body(x, flag):
+        def a(v):
+            return jax.lax.psum(v * 2.0, "dp")
+
+        def b(v):
+            return jax.lax.psum(v + 1.0, "dp")
+
+        return jax.lax.cond(flag[0] > 0, a, b, x)
+
+    fn = shard_map(body, mesh=mesh, in_specs=(P("dp"), P()),
+                   out_specs=P("dp"), check_vma=False)
+    x = jax.ShapeDtypeStruct((8, 4), jnp.float32)
+    flag = jax.ShapeDtypeStruct((1,), jnp.int32)
+    report = analyze_fn(fn, x, flag, name="balanced", mesh=mesh)
+    assert not report.by_rule("collective/divergent-branch-order"), \
+        report.render()
+
+
+def test_collective_in_while_predicate_fires(devices):
+    from deepspeed_tpu.utils.jax_compat import shard_map
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+
+    def body(x):
+        def cond(c):
+            return jax.lax.psum(jnp.sum(c), "dp") < 100.0
+
+        return jax.lax.while_loop(cond, lambda c: c * 2.0, x)
+
+    fn = shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+                   check_vma=False)
+    x = jax.ShapeDtypeStruct((8, 4), jnp.float32)
+    report = analyze_fn(fn, x, name="whilecoll", mesh=mesh)
+    assert len(report.by_rule("collective/collective-in-while-predicate")) == 1
+
+
+# -------------------------------------------------------------------- config
+def test_quantized_wire_missing_fires_once(devices):
+    """zero_quantized_weights promised, but the model has no gather path —
+    the traced step moves no int payload and the knob is inert."""
+    model = flat_module(shape=(64, 96))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 3,
+                                      "zero_quantized_weights": True},
+                "steps_per_print": 0})
+    batch = {"x": jax.ShapeDtypeStruct((8, 64), jnp.float32)}
+    report = analyze_engine(engine, batch=batch)
+    hits = report.by_rule("config/quantized-wire-missing")
+    assert len(hits) == 1, report.render()
+    assert hits[0].severity == Severity.ERROR
+
+
+def test_quantized_weights_below_stage3_warns(devices):
+    engine = tiny_engine(stage=2, zero_quantized_weights=True)
+    report = analyze_engine(engine)
+    assert report.by_rule("config/quantized-weights-below-stage3")
+    # inert-wire is the ERROR-level companion: below stage 3 the gathers the
+    # knob targets don't exist, so the wire is empty too
+    assert report.by_rule("config/quantized-wire-missing")
+
+
+# ------------------------------------------------------------- engine gating
+def test_analysis_config_block_runs_at_init(devices):
+    model, _ = build_gpt(TINY)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 3},
+                "analysis": {"enabled": True},
+                "steps_per_print": 0})
+    assert engine._analysis_pending is False  # ran at init (gpt batch synth)
+
+
+def test_analysis_fail_on_error_raises_at_first_step(devices):
+    """Non-GPT model: init defers (no batch to synthesize); the first
+    train_batch analyzes with the real batch and raises on the inert-knob
+    ERROR before executing anything."""
+    model = flat_module(shape=(64, 96))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 3,
+                                      "zero_quantized_weights": True},
+                "analysis": {"enabled": True},
+                "steps_per_print": 0})
+    assert engine._analysis_pending is True
+    with pytest.raises(AnalysisError):
+        engine.train_batch({"x": np.zeros((8, 64), np.float32)})
+
+
+# ------------------------------------------------------------------- pipe/CLI
+def test_mpmd_schedule_pairing_sound():
+    from deepspeed_tpu.runtime.pipe.mpmd import validate_schedule_pairing
+
+    for m, s in [(2, 2), (4, 2), (8, 4), (3, 3)]:
+        assert validate_schedule_pairing(m, s) == [], (m, s)
+
+
+def test_cli_lists_bench_configs():
+    from deepspeed_tpu.analysis.cli import DEFAULT_BENCH, load_bench_rows
+
+    rows = load_bench_rows()
+    names = [r["name"] for r in rows]
+    assert DEFAULT_BENCH in names
+
+
+def test_profiler_reports_static_flops(devices):
+    from deepspeed_tpu.profiling import profile_compiled_fn
+
+    a = jnp.ones((64, 64), jnp.float32)
+    prof = profile_compiled_fn(lambda x: x @ x, a)
+    assert prof["flops"] > 0
+    assert prof["flops_source"] in ("compiled", "lowered")

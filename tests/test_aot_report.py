@@ -18,6 +18,7 @@ def tiny_report():
     return train_program_report("gpt2-125m", micro_bs=2, seq=256, stage=1)
 
 
+@pytest.mark.slow
 def test_report_fields_and_fit(tiny_report):
     r = tiny_report
     assert r["fits_v5e_hbm"] is True
@@ -33,6 +34,7 @@ def test_report_fields_and_fit(tiny_report):
     json.dumps(r)
 
 
+@pytest.mark.slow
 def test_k_steps_peak_matches_single_step(tiny_report):
     """train_batches' scan must not grow peak HBM (no cross-step accumulator)
     — the property that made k_steps the dispatch-amortization choice."""
@@ -46,6 +48,7 @@ def test_k_steps_peak_matches_single_step(tiny_report):
         tiny_report["per_device_bytes"]["peak"] * 1.05
 
 
+@pytest.mark.slow
 def test_gas_adds_accumulator(tiny_report):
     """gas DOES add a full fp32 grad accumulator across the scan — the
     documented reason bench rows use k_steps instead."""
@@ -59,6 +62,7 @@ def test_gas_adds_accumulator(tiny_report):
     assert grown > 0.5 * n_param_bytes
 
 
+@pytest.mark.slow
 def test_cli_ds_aot():
     p = subprocess.run(
         [sys.executable, "/root/repo/bin/ds_aot", "--model", "gpt2-125m",
@@ -69,6 +73,7 @@ def test_cli_ds_aot():
     assert rep["fits_v5e_hbm"] is True
 
 
+@pytest.mark.slow
 def test_decode_report():
     from deepspeed_tpu.runtime.aot import decode_program_report
 
@@ -82,6 +87,7 @@ def test_decode_report():
     json.dumps(r)
 
 
+@pytest.mark.slow
 def test_find_max_batch_ladder():
     from deepspeed_tpu.runtime.aot import find_max_batch
 
@@ -92,6 +98,7 @@ def test_find_max_batch_ladder():
     assert r["trace"][0] == {"micro_bs": 1, "fits": True}
 
 
+@pytest.mark.slow
 def test_sd_report_tiny():
     from deepspeed_tpu.runtime.aot import sd_program_report
 
@@ -102,6 +109,7 @@ def test_sd_report_tiny():
     json.dumps(r)
 
 
+@pytest.mark.slow
 def test_decode_report_int8_shrinks_arguments():
     from deepspeed_tpu.runtime.aot import decode_program_report
 
@@ -114,6 +122,7 @@ def test_decode_report_int8_shrinks_arguments():
         0.75 * bf["per_device_bytes"]["arguments"]
 
 
+@pytest.mark.slow
 def test_cli_batch_mode(tmp_path):
     specs = tmp_path / "specs.jsonl"
     specs.write_text(
@@ -144,6 +153,7 @@ def test_fit_verdict_margins():
     assert v["confidence"] == "oom"
 
 
+@pytest.mark.slow
 def test_infinity_program_report_whole_moments():
     """The streaming schedule's peak is compiler-accounted (residents are
     program ARGUMENTS of the compiled moment), not an arithmetic sum."""
@@ -164,6 +174,7 @@ def test_infinity_program_report_whole_moments():
     assert r["per_device_bytes"]["peak"] == r["whole_run_peak_bytes"]
 
 
+@pytest.mark.slow
 def test_find_max_decode_batch_ladder(monkeypatch):
     """Binary search over decode batch with compile-time verdicts (the
     serving-capacity analog of find_max_batch); probes are mocked so the
@@ -190,6 +201,7 @@ def test_find_max_decode_batch_ladder(monkeypatch):
     assert r["max_batch"] == 0 and r["report"] is None
 
 
+@pytest.mark.slow
 def test_fused_train_step_matches_engine_semantics():
     """Every AOT report compiles runtime/aot.fused_train_step and presents
     its memory/flops as THE engine program's. Pin the semantics: one step of

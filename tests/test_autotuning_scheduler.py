@@ -15,6 +15,7 @@ from deepspeed_tpu.models import build_gpt
 from deepspeed_tpu.models.gpt import GPTConfig
 
 
+@pytest.mark.slow
 def test_scheduler_runs_real_experiments(tmp_path):
     """Two tiny real trials through the actual run_exp job entry, scheduled
     on the local node; metrics parsed, best selected."""

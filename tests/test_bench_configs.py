@@ -203,6 +203,7 @@ def test_moe_train_row_counts_toward_headline():
     assert s["vs_baseline"] == round(0.30 / 0.45, 3)
 
 
+@pytest.mark.slow
 def test_moe_train_worker_end_to_end():
     """The window grid's measured-MoE row must be executable as-is: run the
     actual bench worker subprocess on the tiny preset (a spec typo or engine

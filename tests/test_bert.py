@@ -10,6 +10,7 @@ import deepspeed_tpu
 from deepspeed_tpu.models import bert as B
 
 
+@pytest.mark.slow
 def test_bert_mlm_trains():
     model, cfg = B.build("tiny-bert")
     engine, _, _, _ = deepspeed_tpu.initialize(
@@ -63,6 +64,7 @@ def test_bert_tp_sharded_matches_single(rng):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_bert_import_matches_hf(rng):
     torch = pytest.importorskip("torch")
     transformers = pytest.importorskip("transformers")

@@ -8,6 +8,7 @@ import jax.numpy as jnp
 
 import deepspeed_tpu
 from deepspeed_tpu.models import GPTConfig, build_gpt
+import pytest
 
 TINY = GPTConfig(vocab_size=256, n_layer=2, n_head=4, d_model=64, max_seq_len=64)
 
@@ -36,6 +37,7 @@ def tree_equal(a, b):
         np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
 
 
+@pytest.mark.slow
 def test_roundtrip_bitwise(tmp_path, devices):
     e = make_engine(stage=2)
     for i in range(3):
@@ -55,6 +57,7 @@ def test_roundtrip_bitwise(tmp_path, devices):
     np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_topology_free_reload(tmp_path, devices):
     """A checkpoint from a stage-3 sharded engine loads into a stage-0 engine
     (the reference needs the universal-checkpoint converter for this)."""
@@ -82,6 +85,7 @@ def test_latest_tag_and_missing(tmp_path, devices):
     assert path is None
 
 
+@pytest.mark.slow
 def test_mid_accumulation_roundtrip(tmp_path, devices):
     """Saving between forward() calls must preserve accumulated grads (review
     finding): resumed training matches uninterrupted training exactly."""

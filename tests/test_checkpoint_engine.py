@@ -86,6 +86,7 @@ def test_get_checkpoint_engine_selection():
         {"checkpoint": {"checkpoint_engine": "nebula"}}), AsyncCheckpointEngine)
 
 
+@pytest.mark.slow
 def test_engine_save_with_async_checkpoint_engine(tmp_path):
     engine, cfg = _engine({"checkpoint": {"checkpoint_engine": "async"}})
     b = _batch(cfg)
@@ -148,6 +149,7 @@ def test_save_16bit_model(tmp_path):
             arr, np.asarray(engine.state["params"]["wte"]))
 
 
+@pytest.mark.slow
 def test_save_16bit_model_stage3_requires_flag(tmp_path):
     engine, cfg = _engine({"bf16": {"enabled": True},
                            "zero_optimization": {"stage": 3}})

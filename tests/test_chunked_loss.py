@@ -47,6 +47,7 @@ def test_chunked_matches_whole_sequence(with_mask, with_labels):
     np.testing.assert_allclose(float(l0), float(l8), rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_chunked_gradients_match():
     cfg0, params = _setup(chunk=0)
     cfg8 = dataclasses.replace(cfg0, loss_chunk=8)
@@ -102,6 +103,7 @@ def test_pipelined_model_honors_loss_chunk():
     np.testing.assert_allclose(float(l_whole), float(l_chunk), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_moe_model_honors_loss_chunk():
     from deepspeed_tpu.models.gpt_moe import (PRESETS, init_params as moe_init,
                                               loss_fn as moe_loss)
@@ -130,6 +132,7 @@ def test_num_tokens_matches_whole_sequence_path():
     assert aux8["num_tokens"] == aux0["num_tokens"]
 
 
+@pytest.mark.slow
 def test_engine_trains_with_chunked_loss():
     import deepspeed_tpu
     from deepspeed_tpu.models import build_gpt

@@ -113,6 +113,7 @@ def test_scheduler_gates_on_step(rng):
 
 
 # ------------------------------------------------------------------- engine
+@pytest.mark.slow
 def test_engine_qat_trains():
     from deepspeed_tpu.models import build_gpt
     from deepspeed_tpu.models.gpt import GPTConfig

@@ -189,6 +189,7 @@ def test_random_ltd_scheduler_ramps():
 
 
 # ------------------------------------------------------------------- engine hook
+@pytest.mark.slow
 def test_engine_curriculum_truncates_seqlen():
     from deepspeed_tpu.models import build_gpt
     from deepspeed_tpu.models.gpt import GPTConfig
@@ -272,6 +273,7 @@ def test_data_analyzer_shards_merge_and_feed_curriculum(tmp_path, rng):
 
 
 # ---------------------------------------------------- model/engine integration
+@pytest.mark.slow
 def test_gpt_random_ltd_layers_drop_tokens(rng):
     import dataclasses
 
@@ -299,6 +301,7 @@ def test_gpt_random_ltd_layers_drop_tokens(rng):
     assert np.isfinite(gsum) and gsum > 0
 
 
+@pytest.mark.slow
 def test_engine_random_ltd_schedule_rebuilds_buckets():
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import build_gpt

@@ -47,6 +47,7 @@ def test_decode_length_is_traced(rng):
                                    atol=2e-5, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_decode_kernel_path_matches_dense_logits(rng):
     """The cached forward with the kernel (use_flash=True) matches the dense
     cached path to float tolerance — per-step logits, not argmax chains (two

@@ -132,6 +132,7 @@ def test_curvature_stretches_quant_schedule(rng):
 
 
 # ----------------------------------------------------------------- engine hook
+@pytest.mark.slow
 def test_engine_probes_curvature_and_trains():
     from deepspeed_tpu.models import build_gpt
     from deepspeed_tpu.models.gpt import GPTConfig
@@ -167,6 +168,7 @@ def test_engine_probes_curvature_and_trains():
     assert curv.max() > 0.0  # the probe ran and produced signal
 
 
+@pytest.mark.slow
 def test_imperative_api_probes_curvature():
     from deepspeed_tpu.models import build_gpt
     from deepspeed_tpu.models.gpt import GPTConfig

@@ -43,6 +43,7 @@ def test_resolve_keeps_effective_batch():
         agent.resolve(0)
 
 
+@pytest.mark.slow
 def test_kill_and_resume_at_new_dp(tmp_path):
     """Worker crashes mid-run at world=4; the cluster 'shrinks' to 2; the agent
     relaunches at dp=2 with identical effective batch and the loss continues

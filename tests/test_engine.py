@@ -55,6 +55,7 @@ def test_train_batch_loss_decreases(stage, devices):
     assert np.isfinite(losses).all()
 
 
+@pytest.mark.slow
 def test_zero_stages_agree(devices):
     """ZeRO is an exact re-layout: every stage must produce identical losses."""
     traces = {}
@@ -82,6 +83,7 @@ def test_zero_shardings_actually_shard(devices):
     assert not mu.sharding.is_fully_replicated
 
 
+@pytest.mark.slow
 def test_forward_backward_step_matches_train_batch(devices):
     e1 = make_engine(stage=1, gas=2, micro=2)
     e2 = make_engine(stage=1, gas=2, micro=2)
@@ -148,6 +150,7 @@ def test_lr_schedule_in_step(devices):
 
 
 # ----------------------------------------------------- comm-dtype / prescale
+@pytest.mark.slow
 def test_prescale_and_comm_dtype_numerics_match_default(rng):
     """prescale_gradients + gradient_predivide_factor and a bf16
     communication_data_type must leave fp32 training numerics (approximately)
@@ -183,6 +186,7 @@ def test_prescale_and_comm_dtype_numerics_match_default(rng):
     np.testing.assert_allclose(base2, base, rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_remat_policies_loss_and_grad_parity():
     """Every remat policy (incl. the named selective save_attn_mlp_out) is a
     pure memory/recompute trade — loss and grads must match no-remat exactly."""

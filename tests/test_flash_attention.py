@@ -49,6 +49,7 @@ def test_uneven_blocks_rejected():
         flash_attention(q, k, v, block_q=64, block_k=64)
 
 
+@pytest.mark.slow
 def test_cross_length_causal_offset():
     """kv_len != q_len: causal mask must use absolute positions (review finding)."""
     q, k, v = make_qkv(T=128)
@@ -72,6 +73,7 @@ def test_cross_length_causal_offset():
 
 
 @pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float32])
+@pytest.mark.slow
 def test_stochastic_mode_close_to_exact(dtype):
     """stochastic_mode (parity: ds_transformer_cuda.cpp:63): bf16 MXU operands
     with fp32 accumulation — close to, but not necessarily bitwise equal to,

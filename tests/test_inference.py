@@ -20,6 +20,7 @@ def params():
     return gpt_mod.init_params(CFG, jax.random.PRNGKey(0))
 
 
+@pytest.mark.slow
 def test_cache_decode_matches_full_forward(params, devices):
     """Incremental KV-cache decoding must reproduce the dense forward logits."""
     ids = np.array(np.random.default_rng(0).integers(0, 128, (2, 16)), np.int32)
@@ -77,6 +78,7 @@ def test_init_inference_api(params, devices):
     assert logits.shape == (1, 8, 128)
 
 
+@pytest.mark.slow
 def test_generate_top_p_nucleus_sampling():
     """top_p ~ 0 degenerates to greedy; top_p = 0.999 still samples."""
     from deepspeed_tpu.inference import DeepSpeedInferenceConfig, InferenceEngine
@@ -100,6 +102,7 @@ def test_generate_top_p_nucleus_sampling():
     assert np.isfinite(wide_p).all()
 
 
+@pytest.mark.slow
 def test_beam_search_beats_or_matches_greedy_logprob():
     """num_beams=1-equivalence and score dominance: the beam-4 sequence's
     total logprob must be >= the greedy sequence's under the same model."""

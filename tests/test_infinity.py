@@ -60,6 +60,7 @@ def _transplant(runner, device_params):
         runner._refresh_push_buf(i, mst)
 
 
+@pytest.mark.slow
 def test_stream_matches_in_hbm_trajectory():
     """With identical initial weights, the streamed (per-unit recompute) step
     must track the fused in-HBM program's loss and updated params."""
@@ -151,6 +152,7 @@ def test_stream_nvme_masters(tmp_path):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_stream_labels_and_loss_mask_match_engine():
     """The stream head honors labels/loss_mask exactly like next_token_loss."""
     e_dev, cfg = _engine()

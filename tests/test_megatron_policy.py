@@ -80,6 +80,7 @@ def _dense_megatron_sd(params, attn="self_attention", prefix="language_model."):
     return sd
 
 
+@pytest.mark.slow
 def test_dense_roundtrip_exact():
     cfg = _dense_cfg()
     params = gpt_mod.init_params(cfg, jax.random.PRNGKey(0))
@@ -192,6 +193,7 @@ def _moe_megatron_sd(cfg, params):
 
 @pytest.mark.parametrize("use_residual", [False, True],
                          ids=["standard", "pr-moe"])
+@pytest.mark.slow
 def test_moe_roundtrip(use_residual):
     cfg = _moe_cfg(use_residual)
     params = moe_mod.init_params(cfg, jax.random.PRNGKey(3))

@@ -18,6 +18,8 @@ from deepspeed_tpu.runtime.zero.mem_estimator import (
     _params_of,
 )
 
+import pytest
+
 
 def test_zero2_math_scales_with_chips():
     n = 1_000_000_000
@@ -69,6 +71,7 @@ def test_all_live_prints_table(capsys):
     assert "largest layer" in out
 
 
+@pytest.mark.slow
 def test_compiled_memory_analysis_exact():
     from deepspeed_tpu.models import build_gpt
     from deepspeed_tpu.models.gpt import GPTConfig

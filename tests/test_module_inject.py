@@ -30,6 +30,7 @@ def _compare_logits(hf_model, input_ids: np.ndarray, atol=2e-3):
     return cfg, params
 
 
+@pytest.mark.slow
 def test_gpt2_import_matches_hf(rng):
     hf_cfg = transformers.GPT2Config(
         vocab_size=97, n_positions=64, n_embd=32, n_layer=2, n_head=4)

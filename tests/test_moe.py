@@ -74,6 +74,7 @@ def test_capacity_drops_tokens():
     assert kept == C  # expert 0 fills its C slots, everyone else dropped
 
 
+@pytest.mark.slow
 def test_single_expert_equals_dense_mlp():
     """E=1, cap covering all tokens: MoE == plain FFN (up to gate weighting = 1)."""
     cfg = MoEConfig(d_model=16, d_ff=32, num_experts=1, capacity_factor=1.0,
@@ -109,6 +110,7 @@ def test_moe_param_split():
     assert counts["expert"] == 2 * (8 * 16 + 16 + 16 * 8 + 8)
 
 
+@pytest.mark.slow
 def test_gpt_moe_trains_with_ep_sharding(devices):
     """Full engine step on dp=4 x ep=2: loss finite, experts sharded over ep,
     aux loss reported."""
@@ -137,6 +139,7 @@ def test_gpt_moe_trains_with_ep_sharding(devices):
     assert losses[-1] < losses[0]  # training moves
 
 
+@pytest.mark.slow
 def test_gpt_moe_all_layers_moe(devices):
     """moe_freq=1 path (every MLP is MoE)."""
     from deepspeed_tpu.models.gpt import GPTConfig

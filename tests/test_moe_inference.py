@@ -30,6 +30,7 @@ def moe_params():
     return gpt_moe.init_params(CFG, jax.random.PRNGKey(0))
 
 
+@pytest.mark.slow
 def test_cached_forward_matches_full_forward(moe_params, rng):
     """Prefill + stepwise decode logits == full uncached forward logits."""
     ids = rng.integers(0, 64, size=(2, 10)).astype(np.int32)
@@ -80,6 +81,7 @@ def test_ep_generate_expert_sharding_is_real(moe_params):
     assert not up_w.sharding.is_fully_replicated
 
 
+@pytest.mark.slow
 def test_moe_beam_search_runs():
     """Beam search's cache-reorder gather works on the MoE cache stacks too
     (both [L, B, H, S, Dh] layouts, batch axis 1)."""

@@ -34,6 +34,7 @@ def _zeroed_moe(params):
     return out
 
 
+@pytest.mark.slow
 def test_roundtrip_restores_bank_and_gate(tmp_path):
     cfg, params = _params()
     files = save_reference_moe_checkpoint(

@@ -99,6 +99,7 @@ def _batch(cfg, seed=0, bs=16, seq=16):
     return {"input_ids": r.integers(0, cfg.vocab_size, size=(bs, seq), dtype=np.int32)}
 
 
+@pytest.mark.slow
 def test_offload_matches_device_adam():
     """cpu-offloaded AdamW must track the on-device AdamW trajectory closely."""
     e_off, cfg = _engine({
@@ -136,6 +137,7 @@ def test_offload_bf16_training():
     assert e.state["params"]["wte"].dtype == jnp.bfloat16
 
 
+@pytest.mark.slow
 def test_offload_checkpoint_roundtrip(tmp_path):
     e, cfg = _engine({
         "zero_optimization": {"stage": 2, "offload_optimizer": {"device": "cpu"}}})

@@ -53,6 +53,7 @@ def _run_compressed(xs, werr, serr, mesh, world):
     return jax.jit(g)(xs, werr, serr)
 
 
+@pytest.mark.slow
 def test_compressed_allreduce_error_feedback_bounded(rng):
     world, n = 4, 256
     topo = MeshTopology.create(dp=world, devices=jax.devices()[:world])
@@ -118,6 +119,7 @@ def _batches(cfg, n, bs, seq=16, gas=1, seed=0):
 
 
 @pytest.mark.parametrize("opt_type", ["OneBitAdam", "ZeroOneAdam", "OneBitLamb"])
+@pytest.mark.slow
 def test_onebit_trains_through_switch(opt_type):
     engine, cfg = _tiny_engine(opt_type, {
         "lr": 1e-3, "freeze_step": 3, "var_freeze_step": 5})
@@ -165,6 +167,7 @@ def test_onebit_rejects_zero2_and_fp16():
         engine.forward({"input_ids": np.zeros((8, 16), np.int32)})
 
 
+@pytest.mark.slow
 def test_onebit_bf16_updates_master():
     """Compressed stage must step the fp32 master, not the bf16 params."""
     from deepspeed_tpu.models import build_gpt
@@ -193,6 +196,7 @@ def test_onebit_bf16_updates_master():
         rtol=1e-2)
 
 
+@pytest.mark.slow
 def test_onebit_with_grad_accumulation():
     engine, cfg = _tiny_engine("OneBitAdam", {"lr": 1e-3, "freeze_step": 2}, gas=2)
     for b in _batches(cfg, 4, 16, gas=2):

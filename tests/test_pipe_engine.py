@@ -33,6 +33,7 @@ def _tiny_cfg():
 
 
 # ------------------------------------------------------------------- SPMD path
+@pytest.mark.slow
 def test_initialize_auto_pipelines_plain_model():
     """A PLAIN build_gpt model + mesh.pp>1 must train pipelined end to end:
     initialize() converts it via Module.to_pipeline (pp=2 x dp=2 x tp=2, ZeRO-1,
@@ -65,6 +66,7 @@ def test_initialize_pp_without_pipeline_model_raises():
             "train_micro_batch_size_per_gpu": 1, "mesh": {"pp": 2, "dp": 4}})
 
 
+@pytest.mark.slow
 def test_pp_dp_tp_zero3_checkpoint_roundtrip(tmp_path):
     """pp=2 x dp=2 x tp=2 with ZeRO-3 param sharding: train, checkpoint, reload
     into a FRESH engine, and the restored state must continue identically."""

@@ -92,6 +92,7 @@ def _engine(extra=None):
     return engine, cfg
 
 
+@pytest.mark.slow
 def test_engine_pld_trains_and_tracks_theta():
     e, cfg = _engine()
     assert e.progressive_layer_drop is not None

@@ -241,6 +241,7 @@ def _batch(rng, gas=1):
     return {"input_ids": rng.integers(0, 64, size=shape, dtype=np.int32)}
 
 
+@pytest.mark.slow
 def test_zero3_quantized_weights_trains_with_ratio(rng, devices):
     """The acceptance row: ZeRO-3 with zero_quantized_weights matches the
     full-precision step loss within int8 tolerance and the accounting ledger
@@ -262,6 +263,7 @@ def test_zero3_quantized_weights_trains_with_ratio(rng, devices):
     assert np.isfinite(float(m["loss"]))
 
 
+@pytest.mark.slow
 def test_quantized_gradients_match_dense_first_step(rng, devices):
     """zero_quantized_gradients replaces the fp psum with the int8 RS+AG
     exchange; the forward is untouched, so the first step's loss must match
@@ -282,6 +284,7 @@ def test_quantized_gradients_match_dense_first_step(rng, devices):
     assert abs(gn_q - gn_d) / (gn_d + 1e-9) < 0.1, (gn_q, gn_d)
 
 
+@pytest.mark.slow
 def test_quantized_gradients_error_feedback_residual(rng, devices):
     """Error feedback: the persistent residual exists, is updated, and loss
     keeps decreasing over repeated steps (the EF convergence property at the

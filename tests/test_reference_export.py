@@ -26,6 +26,7 @@ def _cfg():
                      max_seq_len=24)
 
 
+@pytest.mark.slow
 def test_roundtrip_through_own_importer(tmp_path):
     cfg = _cfg()
     params = jax.tree_util.tree_map(
@@ -48,6 +49,7 @@ def test_roundtrip_through_own_importer(tmp_path):
             err_msg=jax.tree_util.keystr(k))
 
 
+@pytest.mark.slow
 def test_export_loads_into_hf_transformers(tmp_path):
     torch = pytest.importorskip("torch")
     from transformers import GPT2Config, GPT2LMHeadModel
@@ -70,6 +72,7 @@ def test_export_loads_into_hf_transformers(tmp_path):
         rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_export_from_live_engines(tmp_path):
     for extra, sub in [({}, "plain"),
                        ({"zero_optimization": {

@@ -19,6 +19,7 @@ from deepspeed_tpu.models import build_gpt
 from deepspeed_tpu.models.gpt import GPTConfig
 
 
+@pytest.mark.slow
 def test_profile_collectives_sees_psum():
     # GSPMD formulation: a sharded->replicated reduction lowers to an
     # all-reduce thunk, which is what appears on the device timeline (the
@@ -42,6 +43,7 @@ def test_profile_collectives_sees_psum():
     assert "all-reduce" in prof.summary()
 
 
+@pytest.mark.slow
 def test_engine_comms_verify_reports_measured():
     model, cfg = build_gpt(GPTConfig(
         vocab_size=64, d_model=32, n_layer=2, n_head=2, max_seq_len=32))

@@ -45,6 +45,7 @@ def test_sd15_param_inventory_matches_architecture():
     assert 8.3e8 < n_params < 9e8  # SD-1.5 UNet is ~860M params
 
 
+@pytest.mark.slow
 def test_tiny_unet_forward_shapes():
     params = init_sd_unet(TINY_UNET, jax.random.PRNGKey(0))
     lat = jnp.zeros((2, 16, 16, 4))
@@ -55,6 +56,7 @@ def test_tiny_unet_forward_shapes():
     assert np.isfinite(np.asarray(out)).all()
 
 
+@pytest.mark.slow
 def test_tiny_unet_conditioning_matters():
     params = init_sd_unet(TINY_UNET, jax.random.PRNGKey(0))
     rng = np.random.default_rng(0)
@@ -91,6 +93,7 @@ def _to_torch_layout(params):
     return sd
 
 
+@pytest.mark.slow
 def test_unet_import_roundtrip_and_config_inference():
     params = init_sd_unet(TINY_UNET, jax.random.PRNGKey(2))
     sd = _to_torch_layout(params)
@@ -132,6 +135,7 @@ def test_import_rejects_mismatched_state():
         import_sd_unet_state(sd, TINY_UNET)
 
 
+@pytest.mark.slow
 def test_sd_pipeline_from_diffusers_dir(tmp_path):
     """End-to-end: write a diffusers-layout checkpoint dir (safetensors),
     load it, and run the DDIM+CFG+VAE pipeline on the faithful arch."""

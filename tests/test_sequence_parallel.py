@@ -54,6 +54,7 @@ def test_ring_attention_with_tp_heads(rng):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_ring_attention_grads_match_dense(rng):
     topo = MeshTopology.create(dp=1, sp=4, devices=jax.devices()[:4])
     q, k, v = _qkv(rng, B=1, T=16, H=2, Dh=4)
@@ -86,6 +87,7 @@ def test_ulysses_grads_match_dense(rng):
         np.testing.assert_allclose(np.asarray(gr), np.asarray(gd), atol=5e-5, rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_engine_sp_ring_and_ulysses_match_dense(devices):
     """Training through initialize() at sp=2 with ring/Ulysses attention must
     reproduce the dense-attention loss (same params, same batch)."""
@@ -122,6 +124,7 @@ def test_engine_sp_ring_and_ulysses_match_dense(devices):
     np.testing.assert_allclose(uly, dense, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_sp_dispatch_survives_a_second_engine(devices):
     """A later engine binding a different topology must NOT downgrade a ring
     SP engine to dense attention: dispatch reads the trace-bound mesh."""

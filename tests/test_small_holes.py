@@ -132,6 +132,7 @@ def test_wandb_missing_package_degrades_gracefully(monkeypatch):
 
 
 # ----------------------------------------------------------------- diffusion
+@pytest.mark.slow
 def test_unet_shapes_and_determinism(rng):
     from deepspeed_tpu.models.diffusion import UNetConfig, apply_unet, init_unet
 
@@ -176,6 +177,7 @@ def test_stable_diffusion_pipeline_end_to_end(rng):
     assert np.abs(img - img2).max() > 0
 
 
+@pytest.mark.slow
 def test_engine_emits_full_event_set():
     """The gas-boundary monitor events must include loss/lr/grad_norm (and
     loss_scale under fp16) — the reference's engine.py:2183-2206 set."""

@@ -121,6 +121,7 @@ def test_fixed_seed_convergence():
 
 # -------------------------------------------------------- world-size sweep
 @pytest.mark.parametrize("world", [2, 4, 8])
+@pytest.mark.slow
 def test_zero3_train_and_checkpoint_at_world_sizes(world, tmp_path, devices):
     """The reference runs key suites at several world sizes
     (DistributedTest.world_size lists); sweep ZeRO-3 train + ckpt round-trip."""
@@ -155,6 +156,7 @@ def test_zero3_train_and_checkpoint_at_world_sizes(world, tmp_path, devices):
 
 
 @pytest.mark.parametrize("world,tp", [(4, 2), (8, 4)])
+@pytest.mark.slow
 def test_tp_worlds(world, tp, devices):
     model, _ = build_gpt(gpt.GPTConfig(
         vocab_size=64, n_layer=2, n_head=4, d_model=32, max_seq_len=32))

@@ -43,6 +43,7 @@ def _batches(k, gas, seq=16, seed=0):
 
 
 @pytest.mark.parametrize("gas", [1, 2])
+@pytest.mark.slow
 def test_matches_sequential_train_batch(gas):
     k = 3
     ids = _batches(k, gas)
@@ -70,6 +71,7 @@ def test_matches_sequential_train_batch(gas):
                                    rtol=1e-2, atol=1e-3)
 
 
+@pytest.mark.slow
 def test_counters_and_lr_advance_per_step():
     k = 4
     e = _engine()

@@ -68,6 +68,7 @@ def _tiny_engine():
     return engine
 
 
+@pytest.mark.slow
 def test_gathered_parameters_read_and_modify(rng):
     engine = _tiny_engine()
     with ds.zero.GatheredParameters(engine, paths=["wte"]) as full:
@@ -115,6 +116,7 @@ def test_comms_summary_scales_with_steps(rng):
 
 
 # -------------------------------------------------------------- stochastic depth
+@pytest.mark.slow
 def test_stochastic_depth_trains_and_evals_deterministically(rng):
     cfg = gpt.GPTConfig(vocab_size=64, n_layer=4, n_head=2, d_model=32,
                         max_seq_len=32, stochastic_depth=0.5)

@@ -119,6 +119,7 @@ def test_knobs_change_program_structure():
     assert 4 not in windowed, windowed
 
 
+@pytest.mark.slow
 def test_engine_zero3_knobs_end_to_end():
     """Through initialize(): same seed/data, window on vs off -> same loss; the
     windowed program really ran stage-3 sharded params."""
