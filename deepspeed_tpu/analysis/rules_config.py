@@ -9,6 +9,7 @@ program* — set but inert, or structurally impossible to honor.
 
 from __future__ import annotations
 
+import os
 from typing import Iterable, List
 
 import jax.numpy as jnp
@@ -120,10 +121,64 @@ class LossScaleDtypeRule(Rule):
             )
 
 
+class CheckpointUncommittedLoadRule(Rule):
+    """Resume config points at a checkpoint tag with no ``COMMIT`` marker:
+    the save that produced it never completed (crash mid-checkpoint) or the
+    tag was quarantined by the elastic agent. ``load_checkpoint`` will refuse
+    it at runtime — this surfaces the problem at lint time, before a pod is
+    acquired just to die on the first load."""
+
+    rule_id = "config/checkpoint-uncommitted-load"
+    default_severity = Severity.WARNING
+    description = "resume config points at a tag without a COMMIT marker"
+
+    def check_context(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        res = getattr(ctx.config, "resilience", None)
+        if res is None or not getattr(res, "save_dir", None):
+            return
+        # only when a resume will actually happen: auto_resume at init, or a
+        # pinned tag — save_dir alone (manual-save workflows) resumes nothing
+        if not getattr(res, "enabled", False):
+            return
+        if not (getattr(res, "auto_resume", True)
+                or getattr(res, "resume_tag", None)):
+            return
+        from ..resilience import is_committed, read_latest
+
+        save_dir = res.save_dir
+        pinned = getattr(res, "resume_tag", None)
+        tag = pinned or read_latest(save_dir)
+        if tag is None:
+            return  # fresh run: nothing to resume, nothing to check
+        tag_dir = os.path.join(save_dir, tag)
+        via = "resilience.resume_tag" if pinned else f"{save_dir}/latest"
+        if not os.path.isdir(tag_dir):
+            yield self.finding(
+                f"resume config ({via}) points at tag {tag!r} but "
+                f"{tag_dir} does not exist",
+                location=via,
+                suggestion="clear resilience.resume_tag or fix the latest "
+                           "pointer; auto-resume will otherwise fail at init",
+            )
+            return
+        if not is_committed(tag_dir):
+            yield self.finding(
+                f"resume config ({via}) points at tag {tag!r} which has no "
+                f"COMMIT marker — the save never completed (or the tag was "
+                f"quarantined); load_checkpoint will reject it"
+                + ("" if pinned else " and fall back to an older tag"),
+                location=via,
+                suggestion="point at a committed tag (resilience.committed_"
+                           "tags lists them) or let tag=None fall back to "
+                           "the newest committed one",
+            )
+
+
 def config_rules() -> List[Rule]:
     return [QuantizedWireMissingRule(), QuantizedWeightsBelowStage3Rule(),
-            LossScaleDtypeRule()]
+            LossScaleDtypeRule(), CheckpointUncommittedLoadRule()]
 
 
 __all__ = ["QuantizedWireMissingRule", "QuantizedWeightsBelowStage3Rule",
-           "LossScaleDtypeRule", "config_rules"]
+           "LossScaleDtypeRule", "CheckpointUncommittedLoadRule",
+           "config_rules"]

@@ -17,15 +17,24 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Optional, Tuple
 
 import jax
 
 from .. import comm
-from ..utils.logging import log_dist
+from ..resilience import (
+    LATEST_FILE,
+    CheckpointCorruptionError,
+    RetryingWriter,
+    commit_tag,
+    fault_point,
+    invalidate_tag,
+    resolve_tag_for_load,
+    write_latest,
+)
+from ..utils.logging import log_dist, logger
 from .serialization import load_pytree, save_pytree
-
-LATEST_FILE = "latest"
 
 
 def _tag_for(step: int) -> str:
@@ -60,14 +69,25 @@ def _get_ckpt_engine(engine):
 
 def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     client_state: Optional[dict] = None, save_latest: bool = True) -> str:
+    """Crash-consistent tagged save. Write order (``docs/RESILIENCE.md``):
+    content files (atomic each) → fsync pass → ``MANIFEST.json`` (per-file
+    CRC32C + bytes) → fsync'd ``COMMIT`` marker → atomic ``latest`` pointer.
+    A kill at ANY point leaves either the previous committed tag or this one
+    loadable — never partial state."""
     tag = tag or _tag_for(int(engine.state["step"]))
     _validate_tag(tag)
+    fault_point("begin-save")
     ckpt_engine = _get_ckpt_engine(engine)
     ckpt_engine.create(tag)
     ckpt_dir = os.path.join(save_dir, tag)
     is_writer = jax.process_index() == 0
     if is_writer:
         os.makedirs(ckpt_dir, exist_ok=True)
+        # re-saving an existing tag (e.g. emergency drain at the same step as
+        # a periodic save): revoke its COMMIT before touching any content, so
+        # a kill mid-rewrite can never leave a stale marker blessing a mix of
+        # old and new shards
+        invalidate_tag(ckpt_dir)
     writer = getattr(ckpt_engine, "save_array", None)
     # collective: every process participates in gathering sharded leaves
     save_pytree(engine.state, os.path.join(ckpt_dir, "state"), write=is_writer,
@@ -78,6 +98,19 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
         save_pytree(engine._grad_acc, os.path.join(ckpt_dir, "grad_acc"),
                     write=is_writer, file_writer=writer)
     if is_writer:
+        # host-side RNG key: the part of step-exact resume the device state
+        # cannot carry (engine._next_rng splits from it every train_batch);
+        # the MPMD pipe engine has no host RNG chain — saved as null there
+        import numpy as np
+
+        rng = getattr(engine, "_rng", None)
+        resume_state = None
+        provider = getattr(engine, "resume_state_provider", None)
+        if provider is not None:
+            try:
+                resume_state = provider()
+            except Exception as e:  # dataloader hook must not kill a drain save
+                logger.warning(f"resume_state_provider failed: {e}")
         meta = {
             "tag": tag,
             "has_grad_acc": mid_accum,
@@ -86,9 +119,17 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             "skipped_steps": engine.skipped_steps,
             "client_state": client_state or {},
             "ds_config": engine.config.model_dump(mode="json"),
+            "rng_key": (np.asarray(rng, dtype=np.uint32).tolist()
+                        if rng is not None else None),
+            "saved_unix_time": time.time(),
+            "emergency": bool(getattr(engine, "_draining", False)),
+            "preemptions_survived": int(
+                getattr(engine, "_preemptions_survived", 0)),
+            "resume_state": resume_state,
         }
-        with open(os.path.join(ckpt_dir, "meta.json"), "w") as f:
-            json.dump(meta, f, indent=2, default=str)
+        RetryingWriter().write_bytes(
+            os.path.join(ckpt_dir, "meta.json"),
+            json.dumps(meta, indent=2, default=str).encode(), fsync=False)
         # standalone recovery script next to the data (parity: the reference
         # auto-copies zero_to_fp32.py at engine.py:3388): weights are
         # recoverable with numpy+msgpack alone, no framework install
@@ -111,19 +152,32 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             offload.init_host_state()
         ckpt_engine.save(offload.host_state_dict(),
                          os.path.join(ckpt_dir, "host_optimizer.npz"))
-    # durability point: async engines flush all queued writes here, BEFORE the
-    # 'latest' pointer makes the tag resolvable
+    # durability point 1: async engines flush all queued writes here (raising
+    # on any background failure), BEFORE the manifest hashes what's on disk
     ckpt_engine.commit(tag)
-    if is_writer and save_latest:
-        with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-            f.write(tag)
+    if is_writer:
+        # durability point 2: fsync content, write MANIFEST.json (per-file
+        # CRC32C + bytes), write the fsync'd COMMIT marker — only now is the
+        # tag loadable, and only now may 'latest' point at it
+        retrier = RetryingWriter()
+        commit_tag(ckpt_dir, retrier, tag=tag)
+        fault_point("pre-latest", tag_dir=ckpt_dir)
+        if save_latest:
+            write_latest(save_dir, tag, retrier)
     comm.barrier("save_checkpoint")
-    log_dist(f"saved checkpoint {ckpt_dir}")
+    fault_point("end-save", tag_dir=ckpt_dir)
+    log_dist(f"saved checkpoint {ckpt_dir} (committed)")
     return ckpt_dir
 
 
 def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                     load_optimizer_states: bool = True) -> Tuple[Optional[str], dict]:
+    """Verified load. Every candidate tag is checked against its manifest
+    (COMMIT marker present, per-file bytes + CRC32C match) BEFORE any engine
+    state mutates. ``tag=None`` auto-resolves ``latest`` and falls back to
+    the newest committed tag when the pointed-at one is rejected; an explicit
+    ``tag`` is verified strictly — the caller asked for that exact state, so
+    corruption raises instead of silently loading something else."""
     if (getattr(engine, "_param_stream", None) is not None
             and not load_optimizer_states):
         # checked BEFORE any engine state mutates: offload_param checkpoints
@@ -132,16 +186,26 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         raise ValueError(
             "offload_param checkpoints keep the weights inside the host master "
             "state; load_optimizer_states=False would restore no weights")
-    if tag is None:
-        latest_path = os.path.join(load_dir, LATEST_FILE)
-        if not os.path.exists(latest_path):
-            log_dist(f"no 'latest' file at {load_dir}; nothing loaded")
-            return None, {}
-        with open(latest_path) as f:
-            tag = f.read().strip()
+    if tag is not None and not os.path.isdir(os.path.join(load_dir, tag)):
+        raise FileNotFoundError(
+            f"checkpoint {os.path.join(load_dir, tag)} not found")
+    deep = bool(getattr(getattr(engine.config, "resilience", None),
+                        "deep_verify", True))
+    resolved, rejected = resolve_tag_for_load(load_dir, tag, deep=deep)
+    if resolved is None:
+        log_dist(f"no committed checkpoint at {load_dir}; nothing loaded")
+        return None, {}
+    if rejected:
+        rec = getattr(engine, "_recovery_log", None)
+        for bad_tag, reason in rejected:
+            logger.error(
+                f"load_checkpoint: tag {bad_tag!r} rejected ({reason}); "
+                f"falling back to newest committed tag {resolved!r}")
+            if rec is not None:
+                rec.record("tag_rejected_on_load", step=engine.global_steps,
+                           tag=bad_tag, reason=reason)
+    tag = resolved
     ckpt_dir = os.path.join(load_dir, tag)
-    if not os.path.isdir(ckpt_dir):
-        raise FileNotFoundError(f"checkpoint {ckpt_dir} not found")
     state = load_pytree(engine.state, os.path.join(ckpt_dir, "state"))
     if not load_optimizer_states:
         state = {**state, "opt": engine.state["opt"], "master": engine.state["master"]}
@@ -158,6 +222,28 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     engine.global_steps = int(meta.get("global_steps", 0))
     engine.micro_steps = int(meta.get("micro_steps", 0))
     engine.skipped_steps = int(meta.get("skipped_steps", 0))
+    if meta.get("rng_key") is not None:
+        # step-exact resume: restore the host PRNG chain, so the resumed
+        # run's _next_rng splits reproduce the uninterrupted run bitwise
+        import jax.numpy as jnp
+
+        engine._rng = jnp.asarray(meta["rng_key"], dtype=jnp.uint32)
+    engine.resumed_state = meta.get("resume_state")
+    # counter restored from EVERY checkpoint (a periodic save after a survived
+    # preemption carries it too); an emergency tag adds the one being survived
+    engine._preemptions_survived = int(meta.get("preemptions_survived", 0))
+    if meta.get("emergency"):
+        engine._preemptions_survived += 1
+        rec = getattr(engine, "_recovery_log", None)
+        if rec is not None:
+            rec.record("preemption_survived",
+                       value=engine._preemptions_survived,
+                       step=engine.global_steps, tag=tag)
+            saved_at = meta.get("saved_unix_time")
+            if saved_at is not None:
+                rec.record("resume_latency_s",
+                           value=max(0.0, time.time() - float(saved_at)),
+                           step=engine.global_steps, tag=tag)
     offload = (getattr(engine, "_offload", None)
                or getattr(engine, "_param_stream", None))
     if offload is not None and load_optimizer_states:
@@ -177,7 +263,8 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     return ckpt_dir, meta.get("client_state", {})
 
 
-__all__ = ["save_checkpoint", "load_checkpoint", "save_pytree", "load_pytree"]
+__all__ = ["save_checkpoint", "load_checkpoint", "save_pytree", "load_pytree",
+           "CheckpointCorruptionError"]
 
 
 def __getattr__(name):

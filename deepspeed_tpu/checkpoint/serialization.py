@@ -47,17 +47,31 @@ def _fetch_full(leaf) -> np.ndarray:
     return np.asarray(multihost_utils.process_allgather(leaf, tiled=True))
 
 
+#: msgpack layout versions this build can read. Version 1: {leaves, format_version}.
+KNOWN_FORMAT_VERSIONS = (1,)
+
+
 def save_pytree(tree, directory: str, write: bool = True,
                 file_writer=None) -> None:
     """Serialize ``tree``. In multi-process runs EVERY process must call this (leaf
     gathering is collective); only processes with ``write=True`` touch the disk.
 
     ``file_writer(path, np_array)``: pluggable array writer — the checkpoint
-    engines route this (sync np.save by default; the async engine enqueues to
-    its background writers, parity: nebula-style overlap)."""
+    engines route this (atomic tmp-then-replace by default; the async engine
+    enqueues to its background writers, parity: nebula-style overlap).
+
+    Crash consistency (``deepspeed_tpu.resilience``): every file lands via
+    tmp + ``os.replace`` so a kill mid-write never leaves a torn ``.npy``
+    visible, and each shard write passes the ``shard`` fault point so chaos
+    tests can kill mid-checkpoint. Durability (fsync) and integrity (CRC32C
+    manifest + COMMIT marker) are the tag-level commit protocol's job
+    (``resilience.manifest.commit_tag``)."""
+    from ..resilience.chaos import fault_point
+    from ..resilience.retry import RetryingWriter
+
     if write:
         os.makedirs(os.path.join(directory, "arrays"), exist_ok=True)
-    writer = file_writer or (lambda path, arr: np.save(path, arr))
+    writer = file_writer or RetryingWriter().write_array
     flat, _ = _flatten_with_paths(tree)
     meta = []
     for i, (key, leaf) in enumerate(flat):
@@ -71,17 +85,28 @@ def save_pytree(tree, directory: str, write: bool = True,
         if raw_view:
             arr = arr.view(_UINT_FOR_SIZE[arr.dtype.itemsize])
         writer(os.path.join(directory, "arrays", f"{i}.npy"), arr)
+        fault_point("shard", index=i)
         meta.append({"key": key, "index": i, "shape": list(arr.shape),
                      "dtype": dtype_name, "raw_view": raw_view})
     if write:
-        with open(os.path.join(directory, "state.msgpack"), "wb") as f:
-            f.write(msgpack.packb({"leaves": meta, "format_version": 1}))
+        RetryingWriter().write_bytes(
+            os.path.join(directory, "state.msgpack"),
+            msgpack.packb({"leaves": meta, "format_version": 1}), fsync=False)
 
 
 def load_pytree(template, directory: str):
     """Load into the structure (and shardings) of ``template``."""
     with open(os.path.join(directory, "state.msgpack"), "rb") as f:
         meta = msgpack.unpackb(f.read())
+    version = meta.get("format_version") if isinstance(meta, dict) else None
+    if version not in KNOWN_FORMAT_VERSIONS:
+        # fail on the version up front, not on whatever key happens to be
+        # missing three calls later
+        raise ValueError(
+            f"checkpoint {directory} has format_version {version!r}; this "
+            f"build reads {list(KNOWN_FORMAT_VERSIONS)} — it was written by "
+            f"an incompatible (likely newer) deepspeed_tpu, or the metadata "
+            f"file is not a checkpoint state file")
     flat, treedef = _flatten_with_paths(template)
     by_key = {m["key"]: m for m in meta["leaves"]}
     leaves = []

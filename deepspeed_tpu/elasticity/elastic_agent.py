@@ -32,6 +32,9 @@ import sys
 import time
 from typing import Callable, List, Optional, Sequence
 
+from ..resilience import RecoveryLog, quarantine_tag, read_latest
+from ..resilience.preemption import PREEMPTED_EXIT_CODE
+from ..resilience.retry import backoff_delay
 from ..utils.logging import logger
 from .elasticity import (ELASTICITY_CONFIG_ENV, ElasticityError,
                          compute_elastic_config)
@@ -68,6 +71,8 @@ class AgentResult:
     state: str  # "SUCCEEDED" | "FAILED"
     restarts: int
     history: List[WorkerSpec]
+    preemptions: int = 0            # graceful drain exits survived
+    quarantined: List[str] = dataclasses.field(default_factory=list)
 
 
 class DSElasticAgent:
@@ -84,14 +89,34 @@ class DSElasticAgent:
         :func:`probe_device_count` (out-of-process, cached per poll). A change
         triggers restart-at-new-size.
       max_restarts: give up after this many failures (parity: torchelastic
-        ``max_restarts``).
+        ``max_restarts``). Graceful preemption exits
+        (:data:`~deepspeed_tpu.resilience.preemption.PREEMPTED_EXIT_CODE`)
+        do NOT consume restart budget — the worker checkpointed and left on
+        purpose; it is relaunched immediately without backoff.
       poll_interval: seconds between membership checks while the worker runs.
+      checkpoint_dir: the worker's checkpoint directory. When set, the agent
+        (a) applies exponential restart backoff, (b) detects crash loops —
+        ``crash_loop_threshold`` consecutive failures while ``latest`` points
+        at the same tag quarantine that tag
+        (:func:`~deepspeed_tpu.resilience.quarantine_tag`: the next resume
+        falls back to the previous committed tag instead of dying on the
+        poisoned one forever), and (c) appends recovery events to
+        ``<checkpoint_dir>/recovery_events.jsonl``.
+      crash_loop_threshold: K consecutive failures on one tag before it is
+        quarantined.
+      backoff_base / backoff_max: restart delay ``min(max, base * 2**(n-1))``
+      with decorrelating jitter; reset on any successful-looking transition
+        (preemption, membership change, new tag).
     """
 
     def __init__(self, make_cmd: Callable[[WorkerSpec], Sequence[str]],
                  ds_config: dict,
                  device_count_fn: Optional[Callable[[], int]] = None,
-                 max_restarts: int = 10, poll_interval: float = 1.0):
+                 max_restarts: int = 10, poll_interval: float = 1.0,
+                 checkpoint_dir: Optional[str] = None,
+                 crash_loop_threshold: int = 3,
+                 backoff_base: float = 1.0, backoff_max: float = 60.0,
+                 preempted_exit_code: int = PREEMPTED_EXIT_CODE):
         self.make_cmd = make_cmd
         self.ds_config = ds_config
         # config may be a dict or an object with .elasticity (the pydantic
@@ -102,6 +127,24 @@ class DSElasticAgent:
         self.device_count_fn = device_count_fn or probe_device_count
         self.max_restarts = int(max_restarts)
         self.poll_interval = float(poll_interval)
+        self.checkpoint_dir = checkpoint_dir
+        self.crash_loop_threshold = int(crash_loop_threshold)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        # must match the worker's resilience.exit_code when that knob is
+        # customized — otherwise graceful drains are counted as crashes
+        code = int(preempted_exit_code)
+        if code == PREEMPTED_EXIT_CODE:  # not overridden: read the config
+            res_block = (ds_config.get("resilience", {})
+                         if isinstance(ds_config, dict)
+                         else getattr(ds_config, "resilience", None))
+            if isinstance(res_block, dict):
+                code = int(res_block.get("exit_code", PREEMPTED_EXIT_CODE))
+            elif res_block is not None:
+                code = int(getattr(res_block, "exit_code", PREEMPTED_EXIT_CODE))
+        self.preempted_exit_code = code
+        self._events = (RecoveryLog.for_dir(checkpoint_dir, role="agent")
+                        if checkpoint_dir else RecoveryLog(role="agent"))
 
     # ------------------------------------------------------------- resolution
     def resolve(self, world_size: int) -> WorkerSpec:
@@ -119,18 +162,33 @@ class DSElasticAgent:
                           global_batch=final_bs)
 
     # ------------------------------------------------------------- supervision
+    def _latest_tag(self) -> Optional[str]:
+        return read_latest(self.checkpoint_dir) if self.checkpoint_dir else None
+
+    def _backoff(self, consecutive_failures: int) -> float:
+        return backoff_delay(consecutive_failures,
+                             self.backoff_base, self.backoff_max)
+
     def run(self) -> AgentResult:
         restarts = 0
+        preemptions = 0
+        quarantined: List[str] = []
         history: List[WorkerSpec] = []
+        consecutive_failures = 0    # resets on preemption/membership change
+        same_tag_failures = 0
+        last_failed_tag: Optional[str] = None
         while True:
             world = self.device_count_fn()
             spec = self.resolve(world)
             history.append(spec)
+            resume_tag = self._latest_tag()
             argv = list(self.make_cmd(spec))
             logger.info(
-                f"elastic agent: launching worker (attempt {restarts + 1}): "
-                f"world={spec.world_size} micro={spec.micro_batch} "
-                f"gas={spec.gas} global_batch={spec.global_batch}")
+                f"elastic agent: launching worker (attempt "
+                f"{restarts + preemptions + 1}): world={spec.world_size} "
+                f"micro={spec.micro_batch} gas={spec.gas} "
+                f"global_batch={spec.global_batch}"
+                + (f" resume_tag={resume_tag}" if resume_tag else ""))
             # export the fingerprint the worker's runtime must match
             # (ensure_immutable_elastic_config, elasticity.py) — the agent IS
             # the resource scheduler here
@@ -141,20 +199,79 @@ class DSElasticAgent:
             rc = self._watch(proc, launched_world=world)
             if rc == 0:
                 logger.info("elastic agent: worker SUCCEEDED")
-                return AgentResult("SUCCEEDED", restarts, history)
+                return AgentResult("SUCCEEDED", restarts, history,
+                                   preemptions=preemptions,
+                                   quarantined=quarantined)
+            if rc == self.preempted_exit_code:
+                # graceful drain: the worker committed an emergency checkpoint
+                # and left — relaunch immediately, spend no restart budget
+                preemptions += 1
+                consecutive_failures = 0
+                self._events.record("preemption_restart",
+                                    value=preemptions, tag=resume_tag or "")
+                logger.warning(
+                    f"elastic agent: worker preempted (rc={rc}, drained "
+                    f"cleanly); relaunching from its emergency checkpoint "
+                    f"({preemptions} preemption(s) survived)")
+                continue
             restarts += 1
+            if rc is None:
+                # membership change, not a crash: re-resolve at once
+                consecutive_failures = 0
+            else:
+                consecutive_failures += 1
             if restarts > self.max_restarts:
                 logger.error(
                     f"elastic agent: giving up after {restarts - 1} restarts")
-                return AgentResult("FAILED", restarts - 1, history)
-            logger.warning(
-                f"elastic agent: worker exited rc={rc}; restarting "
-                f"({restarts}/{self.max_restarts}) from the latest checkpoint")
+                return AgentResult("FAILED", restarts - 1, history,
+                                   preemptions=preemptions,
+                                   quarantined=quarantined)
+            self._events.record("worker_restart", value=restarts,
+                                rc="membership-change" if rc is None else rc,
+                                tag=resume_tag or "")
+            # crash-loop detection: K consecutive crashes while 'latest'
+            # still points at the same tag → the tag is poisoned (loads but
+            # kills the worker); quarantine it so the next resume falls back
+            # to the previous committed tag
+            failed_tag = self._latest_tag()
+            if rc is not None and failed_tag is not None:
+                if failed_tag == last_failed_tag:
+                    same_tag_failures += 1
+                else:
+                    # latest moved since the previous failure: the worker made
+                    # real progress, so this is not an escalating crash loop
+                    consecutive_failures = 1
+                    same_tag_failures = 1
+                    last_failed_tag = failed_tag
+                if same_tag_failures >= self.crash_loop_threshold:
+                    new_latest = quarantine_tag(
+                        self.checkpoint_dir, failed_tag,
+                        f"crash loop: {same_tag_failures} consecutive worker "
+                        f"failures (last rc={rc}) resuming this tag")
+                    quarantined.append(failed_tag)
+                    self._events.record("tag_quarantined", tag=failed_tag,
+                                        new_latest=new_latest or "")
+                    same_tag_failures = 0
+                    last_failed_tag = None
+            if consecutive_failures > 0:
+                delay = self._backoff(consecutive_failures)
+                logger.warning(
+                    f"elastic agent: worker exited rc={rc}; restarting in "
+                    f"{delay:.1f}s ({restarts}/{self.max_restarts}) from the "
+                    f"latest committed checkpoint")
+                time.sleep(delay)
+            else:
+                logger.warning(
+                    f"elastic agent: restarting ({restarts}/"
+                    f"{self.max_restarts}) after membership change")
 
-    def _watch(self, proc: subprocess.Popen, launched_world: int) -> int:
+    def _watch(self, proc: subprocess.Popen,
+               launched_world: int) -> Optional[int]:
         """Wait on the worker, polling membership against the world size the
         launch was RESOLVED for (a change in the launch window is caught on the
-        first poll); a change kills + restarts (synthetic rc -1 re-resolves)."""
+        first poll); a change kills + restarts (``None`` re-resolves — a
+        synthetic int would collide with real signal exits, ``poll()`` returns
+        ``-signum``)."""
         while True:
             rc = proc.poll()
             if rc is not None:
@@ -171,7 +288,7 @@ class DSElasticAgent:
                 except subprocess.TimeoutExpired:
                     proc.kill()
                     proc.wait()
-                return -1
+                return None
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -183,6 +300,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     p = argparse.ArgumentParser("ds_elastic")
     p.add_argument("--config", required=True, help="DeepSpeed JSON with an elasticity block")
     p.add_argument("--max-restarts", type=int, default=10)
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="worker checkpoint dir: enables crash-loop tag "
+                        "quarantine + recovery-event logging")
+    p.add_argument("--crash-loop-threshold", type=int, default=3)
     p.add_argument("script", help="worker script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
@@ -198,7 +319,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     agent = DSElasticAgent(make_cmd, ds_config,
                            device_count_fn=probe_device_count,
                            max_restarts=args.max_restarts,
-                           poll_interval=30.0)
+                           poll_interval=30.0,
+                           checkpoint_dir=args.checkpoint_dir,
+                           crash_loop_threshold=args.crash_loop_threshold)
     result = agent.run()
     return 0 if result.state == "SUCCEEDED" else 1
 

@@ -78,6 +78,32 @@ class CallbackMonitor:
         self.fn(events)
 
 
+class JSONLMonitor:
+    """Append-only JSONL backend: one ``{"name", "value", "step", "unix_time"}``
+    object per line. TPU-native addition for the resilience layer: unlike the
+    CSV/TB writers it is crash-tolerant by construction (a torn final line is
+    skipped by readers) and trivially mergeable across process generations —
+    the recovery-event trail (``Resilience/*`` events) survives any number of
+    preemptions and restarts."""
+
+    def __init__(self, output_path: str, job_name: str = "DeepSpeedJobName"):
+        import time as _time
+
+        self._time = _time
+        d = os.path.join(output_path or "jsonl_out", job_name)
+        os.makedirs(d, exist_ok=True)
+        self.path = os.path.join(d, "events.jsonl")
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        import json
+
+        with open(self.path, "a") as f:
+            for name, value, step in events:
+                f.write(json.dumps(
+                    {"name": name, "value": float(value), "step": int(step),
+                     "unix_time": self._time.time()}) + "\n")
+
+
 class MonitorMaster:
     """Fan-out to every enabled backend; only process 0 writes."""
 
@@ -101,6 +127,9 @@ class MonitorMaster:
         cs = monitor_config.csv_monitor
         if cs.enabled:
             self.backends.append(CSVMonitor(cs.output_path, cs.job_name))
+        jl = getattr(monitor_config, "jsonl", None)
+        if jl is not None and jl.enabled:
+            self.backends.append(JSONLMonitor(jl.output_path, jl.job_name))
 
     def write_events(self, events: Sequence[Event]) -> None:
         if not self.enabled:

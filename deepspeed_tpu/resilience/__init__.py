@@ -1,0 +1,65 @@
+"""Preemption-safe training: crash-consistent checkpoints, fault injection,
+auto-resume.
+
+The subsystem (see ``docs/RESILIENCE.md``) turns "a checkpoint exists" into
+"a crash at any instruction loses at most one save interval":
+
+- :mod:`.manifest` — the commit protocol: per-shard CRC32C + byte sizes in
+  ``MANIFEST.json``, a fsync'd ``COMMIT`` marker written last, an atomic
+  ``latest`` pointer, verification with precise rejection, fallback to the
+  newest committed tag, and tag quarantine.
+- :mod:`.retry` — :class:`RetryingWriter`: bounded exponential backoff +
+  jitter around every durable-write primitive.
+- :mod:`.chaos` — :class:`FaultPlan`: kill-at-phase / corrupt-shard /
+  truncate-manifest / stall-I/O / transient-error injection, armed via env
+  (``DS_FAULT_PLAN``), config (``resilience.chaos``), or code.
+- :mod:`.preemption` — SIGTERM/SIGINT → drain flag → emergency checkpoint →
+  exit :data:`PREEMPTED_EXIT_CODE`.
+- :mod:`.events` — recovery-event export (JSONL + monitor backends).
+
+Nothing here imports jax at module scope: the elastic agent (a supervisor
+that must never acquire the accelerator) uses the same machinery.
+"""
+
+from .chaos import FAULT_PLAN_ENV, FaultPlan, fault_point, get_fault_plan, install_plan
+from .events import EVENTS_FILENAME, RecoveryLog, read_events
+from .manifest import (
+    CHECKSUMS,
+    COMMIT_NAME,
+    LATEST_FILE,
+    MANIFEST_NAME,
+    QUARANTINE_NAME,
+    CheckpointCorruptionError,
+    UncommittedTagError,
+    build_manifest,
+    checksum_file,
+    commit_tag,
+    committed_tags,
+    crc32c,
+    crc32c_file,
+    invalidate_tag,
+    is_committed,
+    preferred_checksum,
+    quarantine_tag,
+    read_latest,
+    resolve_tag_for_load,
+    verify_tag,
+    write_latest,
+)
+from .preemption import PREEMPTED_EXIT_CODE, PreemptionGuard
+from .retry import DEFAULT_WRITER, RetryBudgetExceeded, RetryingWriter
+
+__all__ = [
+    "CheckpointCorruptionError", "UncommittedTagError",
+    "FaultPlan", "FAULT_PLAN_ENV", "fault_point", "get_fault_plan",
+    "install_plan",
+    "PreemptionGuard", "PREEMPTED_EXIT_CODE",
+    "RecoveryLog", "read_events", "EVENTS_FILENAME",
+    "RetryingWriter", "RetryBudgetExceeded", "DEFAULT_WRITER",
+    "crc32c", "crc32c_file", "checksum_file", "CHECKSUMS",
+    "preferred_checksum", "build_manifest", "commit_tag", "verify_tag",
+    "is_committed", "invalidate_tag", "committed_tags", "read_latest",
+    "write_latest",
+    "resolve_tag_for_load", "quarantine_tag",
+    "MANIFEST_NAME", "COMMIT_NAME", "QUARANTINE_NAME", "LATEST_FILE",
+]

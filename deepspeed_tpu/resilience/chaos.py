@@ -1,0 +1,192 @@
+"""Fault injection for the checkpoint/restore path.
+
+A crash-consistency claim is only as good as the faults it was tested
+against. :class:`FaultPlan` describes one failure to inject — a SIGKILL at a
+named phase of the save protocol, post-commit bit rot (corrupt shard,
+truncated manifest), an I/O stall, or a burst of transient I/O errors — and
+the save path calls :func:`fault_point` at every protocol phase so an armed
+plan fires against the *real* code, not a mock.
+
+Injection channels:
+
+- env: ``DS_FAULT_PLAN='{"kill_at_phase": "pre-commit"}'`` (JSON) — what the
+  subprocess kill/resume tests and the CI smoke use;
+- config: the ``resilience.chaos`` block, installed by the engine at init;
+- code: :func:`install_plan` (unit tests).
+
+Save-protocol phases, in write order (see ``docs/RESILIENCE.md``):
+
+``begin-save`` → ``shard`` (per array, with index) → ``pre-manifest`` →
+``pre-commit`` → ``post-commit`` → ``pre-latest`` → ``end-save``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import time
+from typing import Any, Dict, Optional
+
+from ..utils.logging import logger
+
+FAULT_PLAN_ENV = "DS_FAULT_PLAN"
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """One injected failure.
+
+    ``kill_at_phase``: phase name, or ``"shard:N"`` to die right after shard
+    N's bytes hit the filesystem (mid-checkpoint torn state). The kill is a
+    real ``SIGKILL`` to our own pid — no cleanup handlers run, exactly like a
+    preemption that missed its grace window.
+
+    ``kill_at_save``: which save (0-based, counted from plan install) arms the
+    kill — lets a worker checkpoint successfully N times, then die.
+
+    ``corrupt_shard`` / ``truncate_manifest``: post-commit bit rot, applied to
+    the just-committed tag directory — the load path must *reject* the tag
+    with a precise error and fall back to an older committed one.
+
+    ``stall_io_seconds``/``stall_io_times``: sleep on the first N I/O calls
+    (slow remote FS). ``fail_io_times``: raise ``OSError`` on the first N I/O
+    calls — must be absorbed by the
+    :class:`~deepspeed_tpu.resilience.retry.RetryingWriter`.
+    """
+
+    kill_at_phase: Optional[str] = None
+    kill_at_save: int = 0
+    corrupt_shard: Optional[int] = None
+    truncate_manifest: bool = False
+    stall_io_seconds: float = 0.0
+    stall_io_times: int = 1
+    fail_io_times: int = 0
+
+    # runtime counters (not part of the plan spec)
+    _save_index: int = dataclasses.field(default=-1, repr=False)
+    _io_calls: int = dataclasses.field(default=0, repr=False)
+    _io_failures_left: int = dataclasses.field(default=0, repr=False)
+    _stalls_left: int = dataclasses.field(default=0, repr=False)
+
+    def __post_init__(self) -> None:
+        self._io_failures_left = int(self.fail_io_times)
+        self._stalls_left = int(self.stall_io_times)
+
+    # ------------------------------------------------------------- construction
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FaultPlan":
+        known = {f.name for f in dataclasses.fields(cls)
+                 if not f.name.startswith("_")}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"unknown FaultPlan keys {sorted(unknown)}; known: {sorted(known)}")
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    @classmethod
+    def from_env(cls) -> Optional["FaultPlan"]:
+        raw = os.environ.get(FAULT_PLAN_ENV, "").strip()
+        if not raw:
+            return None
+        return cls.from_dict(json.loads(raw))
+
+    # ------------------------------------------------------------------ hooks
+    def _kill_armed(self, phase: str, index: Optional[int]) -> bool:
+        if self.kill_at_phase is None or self._save_index != self.kill_at_save:
+            return False
+        want = self.kill_at_phase
+        if ":" in want:
+            want_phase, want_idx = want.split(":", 1)
+            return phase == want_phase and index == int(want_idx)
+        return phase == want
+
+    def fault_point(self, phase: str, index: Optional[int] = None,
+                    tag_dir: Optional[str] = None) -> None:
+        """Called by the save protocol at each phase (no-op when disarmed)."""
+        if phase == "begin-save":
+            self._save_index += 1
+        if self._kill_armed(phase, index):
+            logger.warning(
+                f"chaos: SIGKILL at phase {phase!r}"
+                + (f" shard {index}" if index is not None else "")
+                + f" (save #{self._save_index})")
+            os.kill(os.getpid(), signal.SIGKILL)
+        if phase == "post-commit" and self._save_index == self.kill_at_save \
+                and tag_dir is not None:
+            self._apply_bit_rot(tag_dir)
+
+    def _apply_bit_rot(self, tag_dir: str) -> None:
+        if self.corrupt_shard is not None:
+            path = os.path.join(tag_dir, "state", "arrays",
+                                f"{self.corrupt_shard}.npy")
+            if os.path.exists(path):
+                with open(path, "r+b") as f:
+                    f.seek(max(0, os.path.getsize(path) // 2))
+                    chunk = f.read(16) or b"\0"
+                    f.seek(-len(chunk), os.SEEK_CUR)
+                    f.write(bytes(b ^ 0xFF for b in chunk))
+                logger.warning(f"chaos: corrupted shard {path}")
+            self.corrupt_shard = None  # fire once
+        if self.truncate_manifest:
+            path = os.path.join(tag_dir, "MANIFEST.json")
+            if os.path.exists(path):
+                with open(path, "r+b") as f:
+                    f.truncate(max(0, os.path.getsize(path) // 2))
+                logger.warning(f"chaos: truncated {path}")
+            self.truncate_manifest = False
+
+    def on_io(self, what: str) -> None:
+        """Called by RetryingWriter before each I/O attempt."""
+        self._io_calls += 1
+        if self._stalls_left > 0 and self.stall_io_seconds > 0:
+            self._stalls_left -= 1
+            logger.warning(
+                f"chaos: stalling I/O {what!r} for {self.stall_io_seconds}s")
+            time.sleep(self.stall_io_seconds)
+        if self._io_failures_left > 0:
+            self._io_failures_left -= 1
+            raise OSError(f"chaos: injected transient I/O error on {what!r}")
+
+
+# ------------------------------------------------------------------ global plan
+# installed (code/config) and env-derived plans are tracked separately: an
+# installed plan always wins, and clearing it re-exposes the env plan; the env
+# plan is re-parsed whenever DS_FAULT_PLAN changes and keeps its fire-once
+# counters while it doesn't.
+_INSTALLED: Optional[FaultPlan] = None
+_ENV_PLAN: Optional[FaultPlan] = None
+_ENV_SNAPSHOT: Optional[str] = None
+
+
+def install_plan(plan: Optional[FaultPlan]) -> None:
+    """Install (or clear, with ``None``) the process-wide fault plan."""
+    global _INSTALLED
+    _INSTALLED = plan
+    if plan is not None:
+        logger.warning(f"chaos: fault plan armed: {plan}")
+
+
+def get_fault_plan() -> Optional[FaultPlan]:
+    """The installed plan, else one parsed from ``DS_FAULT_PLAN`` (re-parsed
+    when the env var changes; the parsed plan keeps its counters otherwise)."""
+    global _ENV_PLAN, _ENV_SNAPSHOT
+    if _INSTALLED is not None:
+        return _INSTALLED
+    raw = os.environ.get(FAULT_PLAN_ENV, "").strip() or None
+    if raw != _ENV_SNAPSHOT:
+        _ENV_SNAPSHOT = raw
+        _ENV_PLAN = FaultPlan.from_env() if raw else None
+    return _ENV_PLAN
+
+
+def fault_point(phase: str, index: Optional[int] = None,
+                tag_dir: Optional[str] = None) -> None:
+    plan = get_fault_plan()
+    if plan is not None:
+        plan.fault_point(phase, index=index, tag_dir=tag_dir)
+
+
+__all__ = ["FaultPlan", "FAULT_PLAN_ENV", "install_plan", "get_fault_plan",
+           "fault_point"]

@@ -1,0 +1,90 @@
+"""Recovery-event export: what the resilience machinery did, observable.
+
+Two sinks, both optional:
+
+- a JSONL file (``recovery_events.jsonl`` next to the checkpoints) — the
+  supervisor (``DSElasticAgent``) and the engine both append here, so one
+  file tells the whole preemption story across process generations;
+- the training run's :class:`~deepspeed_tpu.monitor.monitor.MonitorMaster`
+  (TensorBoard/CSV/WandB), as ``Resilience/<event>`` scalar events.
+
+This module must stay importable without jax: the elastic agent is a
+supervisor process that must never acquire the accelerator.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+from ..utils.logging import logger
+
+EVENTS_FILENAME = "recovery_events.jsonl"
+
+
+class RecoveryLog:
+    """Append-only recovery event log with counter rollups."""
+
+    def __init__(self, path: Optional[str] = None, monitor: Any = None,
+                 role: str = "engine"):
+        self.path = path
+        self.monitor = monitor  # MonitorMaster-compatible (write_events)
+        self.role = role
+        self.counters: Dict[str, int] = {}
+
+    @classmethod
+    def for_dir(cls, save_dir: str, monitor: Any = None,
+                role: str = "engine") -> "RecoveryLog":
+        os.makedirs(save_dir, exist_ok=True)
+        return cls(os.path.join(save_dir, EVENTS_FILENAME), monitor=monitor,
+                   role=role)
+
+    def record(self, event: str, value: float = 1.0, step: int = 0,
+               **fields: Any) -> None:
+        """``event``: e.g. ``preemption_survived``, ``resume_latency_s``,
+        ``tag_quarantined``, ``worker_restart``, ``emergency_save``."""
+        self.counters[event] = self.counters.get(event, 0) + 1
+        entry = {"unix_time": time.time(), "role": self.role, "event": event,
+                 "value": float(value), "step": int(step), **fields}
+        if self.path is not None:
+            try:
+                with open(self.path, "a") as f:
+                    f.write(json.dumps(entry, sort_keys=True, default=str)
+                            + "\n")
+            except OSError as e:  # event export must never fail training
+                logger.warning(f"recovery event not persisted: {e}")
+        if self.monitor is not None:
+            try:
+                self.monitor.write_events(
+                    [(f"Resilience/{event}", float(value), int(step))])
+            except Exception as e:
+                logger.warning(f"recovery event not exported to monitor: {e}")
+
+    def count(self, event: str) -> int:
+        return self.counters.get(event, 0)
+
+
+def read_events(save_dir_or_path: str) -> list:
+    """Parse a recovery log (dir containing the default filename, or a direct
+    path). Tolerates a torn trailing line (crash mid-append)."""
+    path = save_dir_or_path
+    if os.path.isdir(path):
+        path = os.path.join(path, EVENTS_FILENAME)
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                pass  # torn tail
+    return out
+
+
+__all__ = ["RecoveryLog", "read_events", "EVENTS_FILENAME"]

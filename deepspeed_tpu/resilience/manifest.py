@@ -1,0 +1,423 @@
+"""Crash-consistent checkpoint commit protocol.
+
+A checkpoint tag directory is **committed** by writing, in order:
+
+1. the content files (``state/arrays/<i>.npy``, ``state.msgpack``,
+   ``meta.json``, ...) — each one atomically (tmp + ``os.replace``), then
+   fsync'd;
+2. ``MANIFEST.json``: relative path, byte size, and CRC32C of every content
+   file (fsync'd);
+3. ``COMMIT``: a marker recording the manifest's own size + CRC32C, written
+   last and fsync'd, followed by a directory fsync.
+
+The ``latest`` pointer in the parent directory is updated *after* commit,
+atomically. The invariants a loader can rely on:
+
+- no ``COMMIT`` → the tag never finished writing: reject it, whatever state
+  its files are in;
+- ``COMMIT`` present → the manifest was complete when written, and every
+  content file can be byte-verified against it; any mismatch is post-commit
+  corruption (bit rot, truncation, a torn non-atomic writer) and names the
+  exact file and reason;
+- ``latest`` either points at the previous committed tag or the new one —
+  never at a half-written state.
+
+A SIGKILL at *any* instruction of the save therefore loses at most one save
+interval: :func:`resolve_tag_for_load` walks committed tags newest-first and
+returns the first one that verifies.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.logging import logger
+from .chaos import fault_point
+from .retry import RetryingWriter
+
+MANIFEST_NAME = "MANIFEST.json"
+COMMIT_NAME = "COMMIT"
+QUARANTINE_NAME = "QUARANTINED"
+LATEST_FILE = "latest"
+MANIFEST_VERSION = 1
+
+# files that are protocol metadata, not checkpoint content
+_NON_CONTENT = {MANIFEST_NAME, COMMIT_NAME, QUARANTINE_NAME}
+
+
+# --------------------------------------------------------------------- crc32c
+def _make_crc32c_table() -> List[int]:
+    poly = 0x82F63B78  # Castagnoli, reflected
+    table = []
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+        table.append(crc)
+    return table
+
+
+_CRC32C_TABLE = _make_crc32c_table()
+
+
+def _crc32c_py(data: bytes, value: int = 0) -> int:
+    crc = value ^ 0xFFFFFFFF
+    table = _CRC32C_TABLE
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _resolve_crc32c() -> Tuple[object, bool]:
+    """(impl, is_native). Prefer a C implementation when the image has one;
+    the pure-Python fallback computes the identical CRC-32C (Castagnoli), so
+    the two interoperate freely on the same checkpoint — but at single-digit
+    MB/s it cannot hash multi-GB checkpoints in production."""
+    try:  # google-crc32c
+        import google_crc32c
+
+        return (lambda data, value=0:
+                int(google_crc32c.extend(value, bytes(data)))), True
+    except Exception:
+        pass
+    try:  # crc32c (ICRAR)
+        import crc32c as _c
+
+        return (lambda data, value=0:
+                int(_c.crc32c(bytes(data), value))), True
+    except Exception:
+        pass
+    return _crc32c_py, False
+
+
+crc32c, _CRC32C_IS_NATIVE = _resolve_crc32c()
+
+
+def _crc32(data: bytes, value: int = 0) -> int:
+    import zlib
+
+    return zlib.crc32(data, value) & 0xFFFFFFFF
+
+
+#: checksum registry: every algorithm a manifest may record. The manifest
+#: stamps which one it used, so readers and writers never have to agree on a
+#: default — a checkpoint written with crc32 verifies on a host that has a
+#: native crc32c and vice versa.
+CHECKSUMS = {"crc32c": crc32c, "crc32": _crc32}
+
+
+def preferred_checksum() -> str:
+    """CRC32C when a C implementation is importable (storage-standard,
+    matches GCS object checksums); otherwise stdlib zlib.crc32 — also
+    C-speed, because hashing a multi-GB checkpoint through the pure-Python
+    CRC32C table (~5 MB/s) would turn every save and verified load into
+    minutes of CPU. Overridable via ``DS_CHECKPOINT_CHECKSUM``."""
+    forced = os.environ.get("DS_CHECKPOINT_CHECKSUM", "").strip().lower()
+    if forced:
+        if forced not in CHECKSUMS:
+            raise ValueError(
+                f"DS_CHECKPOINT_CHECKSUM={forced!r}; known: {sorted(CHECKSUMS)}")
+        return forced
+    return "crc32c" if _CRC32C_IS_NATIVE else "crc32"
+
+
+def checksum_file(path: str, algo: str,
+                  chunk_bytes: int = 4 << 20) -> Tuple[int, int]:
+    """(checksum, byte size) of a file, streamed."""
+    fn = CHECKSUMS[algo]
+    crc = 0
+    n = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(chunk_bytes)
+            if not chunk:
+                break
+            crc = fn(chunk, crc)
+            n += len(chunk)
+    return crc, n
+
+
+def crc32c_file(path: str, chunk_bytes: int = 1 << 20) -> Tuple[int, int]:
+    """(crc32c, byte size) of a file, streamed."""
+    return checksum_file(path, "crc32c", chunk_bytes)
+
+
+# ------------------------------------------------------------------ exceptions
+class CheckpointCorruptionError(RuntimeError):
+    """A tag failed verification; the message names the file and the reason."""
+
+    def __init__(self, tag_dir: str, reason: str):
+        self.tag_dir = tag_dir
+        self.reason = reason
+        super().__init__(f"checkpoint {tag_dir}: {reason}")
+
+
+class UncommittedTagError(CheckpointCorruptionError):
+    """The tag has no ``COMMIT`` marker: the save never finished (crash
+    mid-write) or the tag was quarantined."""
+
+
+# ------------------------------------------------------------- manifest build
+def _content_files(tag_dir: str) -> List[str]:
+    out = []
+    for root, _dirs, files in os.walk(tag_dir):
+        for name in files:
+            if name in _NON_CONTENT or name.endswith(".tmp"):
+                continue
+            out.append(os.path.relpath(os.path.join(root, name), tag_dir))
+    return sorted(out)
+
+
+def build_manifest(tag_dir: str, tag: Optional[str] = None,
+                   algo: Optional[str] = None) -> Dict:
+    algo = algo or preferred_checksum()
+    files: Dict[str, Dict] = {}
+    for rel in _content_files(tag_dir):
+        crc, n = checksum_file(os.path.join(tag_dir, rel), algo)
+        files[rel] = {"bytes": n, "checksum": f"{crc:08x}"}
+    return {
+        "manifest_version": MANIFEST_VERSION,
+        "tag": tag or os.path.basename(os.path.normpath(tag_dir)),
+        "checksum": algo,
+        "created_unix_time": time.time(),
+        "files": files,
+    }
+
+
+def commit_tag(tag_dir: str, writer: Optional[RetryingWriter] = None,
+               tag: Optional[str] = None) -> Dict:
+    """Run phases 2-3 of the protocol over an already-written tag directory:
+    fsync all content, write the manifest, write ``COMMIT``. Returns the
+    manifest. Fault points: ``pre-manifest``, ``pre-commit``, ``post-commit``."""
+    writer = writer or RetryingWriter()
+    # durability pass: content files were written atomically but with fsync
+    # deferred; flush them (and their directories) before the manifest can
+    # promise anything about them
+    dirs = {tag_dir}
+    for rel in _content_files(tag_dir):
+        writer.fsync_file(os.path.join(tag_dir, rel))
+        dirs.add(os.path.dirname(os.path.join(tag_dir, rel)))
+    for d in dirs:
+        writer.fsync_dir(d)
+    fault_point("pre-manifest", tag_dir=tag_dir)
+    manifest = build_manifest(tag_dir, tag=tag)
+    manifest_bytes = json.dumps(manifest, indent=1, sort_keys=True).encode()
+    writer.write_bytes(os.path.join(tag_dir, MANIFEST_NAME), manifest_bytes)
+    fault_point("pre-commit", tag_dir=tag_dir)
+    algo = manifest["checksum"]
+    commit = {
+        "tag": manifest["tag"],
+        "checksum": algo,
+        "manifest_bytes": len(manifest_bytes),
+        "manifest_checksum": f"{CHECKSUMS[algo](manifest_bytes):08x}",
+        "committed_unix_time": time.time(),
+    }
+    writer.write_bytes(os.path.join(tag_dir, COMMIT_NAME),
+                       json.dumps(commit, sort_keys=True).encode())
+    fault_point("post-commit", tag_dir=tag_dir)
+    return manifest
+
+
+def invalidate_tag(tag_dir: str,
+                   writer: Optional[RetryingWriter] = None) -> None:
+    """Revoke a tag's commit status BEFORE rewriting it in place (a re-save
+    of the same step, e.g. an emergency drain right after a periodic save).
+    Without this, a kill mid-rewrite would leave the *old* COMMIT blessing a
+    mix of old and new shards. Removing COMMIT first restores the invariant:
+    the tag is uncommitted for the whole rewrite window."""
+    writer = writer or RetryingWriter()
+    removed = False
+    for name in (COMMIT_NAME, MANIFEST_NAME, QUARANTINE_NAME):
+        path = os.path.join(tag_dir, name)
+        if os.path.exists(path):
+            writer.call(os.remove, path, describe=f"remove {name}")
+            removed = True
+    if removed:
+        writer.fsync_dir(tag_dir)
+
+
+# ------------------------------------------------------------------ verify
+def is_committed(tag_dir: str) -> bool:
+    return (os.path.exists(os.path.join(tag_dir, COMMIT_NAME))
+            and not os.path.exists(os.path.join(tag_dir, QUARANTINE_NAME)))
+
+
+def verify_tag(tag_dir: str, deep: bool = True) -> Dict:
+    """Verify a tag against its manifest; raise with a precise reason.
+
+    ``deep=False`` checks existence + byte sizes only (cheap);
+    ``deep=True`` additionally CRC32C-verifies every content file.
+    Returns the parsed manifest on success.
+    """
+    if not os.path.isdir(tag_dir):
+        raise CheckpointCorruptionError(tag_dir, "tag directory does not exist")
+    if os.path.exists(os.path.join(tag_dir, QUARANTINE_NAME)):
+        try:
+            with open(os.path.join(tag_dir, QUARANTINE_NAME)) as f:
+                why = json.load(f).get("reason", "unknown")
+        except Exception:
+            why = "unknown"
+        raise UncommittedTagError(
+            tag_dir, f"tag is quarantined (reason: {why})")
+    commit_path = os.path.join(tag_dir, COMMIT_NAME)
+    if not os.path.exists(commit_path):
+        raise UncommittedTagError(
+            tag_dir, "no COMMIT marker: the save never completed "
+            "(crash/preemption mid-checkpoint); this tag must not be loaded")
+    try:
+        with open(commit_path, "rb") as f:
+            commit = json.loads(f.read().decode())
+    except (ValueError, OSError) as e:
+        raise CheckpointCorruptionError(
+            tag_dir, f"COMMIT marker unreadable: {e}")
+    manifest_path = os.path.join(tag_dir, MANIFEST_NAME)
+    if not os.path.exists(manifest_path):
+        raise CheckpointCorruptionError(
+            tag_dir, "COMMIT present but MANIFEST.json missing")
+    raw = open(manifest_path, "rb").read()
+    algo = commit.get("checksum", "crc32c")
+    if algo not in CHECKSUMS:
+        raise CheckpointCorruptionError(
+            tag_dir, f"COMMIT records unknown checksum algorithm {algo!r}; "
+            f"this build knows {sorted(CHECKSUMS)}")
+    if len(raw) != int(commit.get("manifest_bytes", -1)):
+        raise CheckpointCorruptionError(
+            tag_dir, f"MANIFEST.json is {len(raw)} bytes but COMMIT recorded "
+            f"{commit.get('manifest_bytes')} (truncated or rewritten manifest)")
+    actual_crc = f"{CHECKSUMS[algo](raw):08x}"
+    if actual_crc != commit.get("manifest_checksum"):
+        raise CheckpointCorruptionError(
+            tag_dir, f"MANIFEST.json {algo} {actual_crc} != committed "
+            f"{commit.get('manifest_checksum')}")
+    manifest = json.loads(raw.decode())
+    for rel, entry in manifest["files"].items():
+        path = os.path.join(tag_dir, rel)
+        if not os.path.exists(path):
+            raise CheckpointCorruptionError(
+                tag_dir, f"content file {rel!r} missing")
+        size = os.path.getsize(path)
+        if size != int(entry["bytes"]):
+            raise CheckpointCorruptionError(
+                tag_dir, f"content file {rel!r} is {size} bytes, manifest "
+                f"says {entry['bytes']} (truncated/torn write)")
+        if deep:
+            crc, _ = checksum_file(path, algo)
+            if f"{crc:08x}" != entry["checksum"]:
+                raise CheckpointCorruptionError(
+                    tag_dir, f"content file {rel!r} {algo} {crc:08x} != "
+                    f"manifest {entry['checksum']} (corrupted shard)")
+    return manifest
+
+
+# ------------------------------------------------------------- tag resolution
+_STEP_RE = re.compile(r"(\d+)$")
+
+
+def _tag_sort_key(save_dir: str, tag: str) -> Tuple[int, float]:
+    m = _STEP_RE.search(tag)
+    step = int(m.group(1)) if m else -1
+    try:
+        mtime = os.path.getmtime(os.path.join(save_dir, tag, COMMIT_NAME))
+    except OSError:
+        mtime = 0.0
+    return (step, mtime)
+
+
+def committed_tags(save_dir: str) -> List[str]:
+    """Committed (non-quarantined) tags, oldest → newest."""
+    if not os.path.isdir(save_dir):
+        return []
+    tags = [t for t in os.listdir(save_dir)
+            if is_committed(os.path.join(save_dir, t))]
+    return sorted(tags, key=lambda t: _tag_sort_key(save_dir, t))
+
+
+def read_latest(save_dir: str) -> Optional[str]:
+    path = os.path.join(save_dir, LATEST_FILE)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return f.read().strip() or None
+
+
+def write_latest(save_dir: str, tag: str,
+                 writer: Optional[RetryingWriter] = None) -> None:
+    """Atomically repoint ``latest`` (tmp + fsync + rename + dir fsync)."""
+    (writer or RetryingWriter()).write_bytes(
+        os.path.join(save_dir, LATEST_FILE), tag.encode())
+
+
+def resolve_tag_for_load(save_dir: str, tag: Optional[str] = None,
+                         deep: bool = True
+                         ) -> Tuple[Optional[str], List[Tuple[str, str]]]:
+    """Pick the tag to load. Explicit ``tag``: verify it, no fallback — the
+    caller asked for that state specifically. ``tag=None``: try ``latest``,
+    then every other committed tag newest-first; return the first that
+    verifies plus the ``(tag, reason)`` list of rejected ones. ``(None, [])``
+    when the directory holds no checkpoint at all."""
+    if tag is not None:
+        verify_tag(os.path.join(save_dir, tag), deep=deep)
+        return tag, []
+    rejected: List[Tuple[str, str]] = []
+    candidates: List[str] = []
+    latest = read_latest(save_dir)
+    if latest is not None:
+        candidates.append(latest)
+    for t in reversed(committed_tags(save_dir)):
+        if t not in candidates:
+            candidates.append(t)
+    if not candidates:
+        return None, []
+    for t in candidates:
+        try:
+            verify_tag(os.path.join(save_dir, t), deep=deep)
+            return t, rejected
+        except CheckpointCorruptionError as e:
+            logger.error(f"checkpoint tag {t!r} rejected: {e.reason}")
+            rejected.append((t, e.reason))
+    raise CheckpointCorruptionError(
+        save_dir, "no loadable checkpoint: every candidate tag failed "
+        "verification: " + "; ".join(f"{t}: {r}" for t, r in rejected))
+
+
+def quarantine_tag(save_dir: str, tag: str, reason: str,
+                   writer: Optional[RetryingWriter] = None) -> Optional[str]:
+    """Mark a tag unloadable (crash-looping workers keep dying on it) and
+    repoint ``latest`` at the newest remaining committed tag. Returns the new
+    latest tag (None if no committed tag remains). The tag's data is kept on
+    disk for post-mortem; only its load eligibility is revoked."""
+    writer = writer or RetryingWriter()
+    tag_dir = os.path.join(save_dir, tag)
+    writer.write_bytes(
+        os.path.join(tag_dir, QUARANTINE_NAME),
+        json.dumps({"reason": reason, "quarantined_unix_time": time.time()},
+                   sort_keys=True).encode())
+    remaining = committed_tags(save_dir)
+    new_latest = remaining[-1] if remaining else None
+    if new_latest is not None:
+        write_latest(save_dir, new_latest, writer)
+    else:
+        try:
+            os.remove(os.path.join(save_dir, LATEST_FILE))
+        except OSError:
+            pass
+    logger.error(
+        f"checkpoint tag {tag!r} QUARANTINED ({reason}); latest -> "
+        f"{new_latest!r}")
+    return new_latest
+
+
+__all__ = [
+    "CheckpointCorruptionError", "UncommittedTagError",
+    "crc32c", "crc32c_file", "checksum_file", "CHECKSUMS",
+    "preferred_checksum",
+    "build_manifest", "commit_tag", "verify_tag", "is_committed",
+    "invalidate_tag",
+    "committed_tags", "read_latest", "write_latest", "resolve_tag_for_load",
+    "quarantine_tag",
+    "MANIFEST_NAME", "COMMIT_NAME", "QUARANTINE_NAME", "LATEST_FILE",
+]

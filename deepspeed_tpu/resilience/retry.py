@@ -1,0 +1,166 @@
+"""Bounded-retry file I/O for checkpoint durability.
+
+Checkpoint writes cross filesystems that fail transiently (GCS fuse mounts,
+NFS, overlayfs under memory pressure). A failed ``np.save`` two shards into a
+50-shard checkpoint must not abort the save — it should be retried with
+backoff, and only a *persistent* failure surfaces. :class:`RetryingWriter`
+wraps every durable-write primitive the commit protocol uses (tmp-write,
+fsync, atomic replace) in bounded exponential backoff with jitter.
+
+Jitter is deterministic-per-process but decorrelated (``os.urandom``): the
+usual thundering-herd argument applies when many hosts hit shared storage
+after the same fault.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import time
+from typing import Any, Callable, Optional, Sequence, Tuple, Type
+
+from ..utils.logging import logger
+
+# Errors worth retrying: the transient-FS class. Everything else (TypeError,
+# KeyboardInterrupt, ...) propagates immediately.
+TRANSIENT_ERRORS: Tuple[Type[BaseException], ...] = (OSError, IOError)
+
+
+def _jitter01() -> float:
+    """Uniform [0,1) without perturbing any seeded RNG stream (training code
+    owns numpy/jax RNG state; checkpoint I/O must not consume from it)."""
+    return struct.unpack("<I", os.urandom(4))[0] / 2**32
+
+
+def backoff_delay(attempt: int, base_delay: float, max_delay: float) -> float:
+    """Jittered exponential backoff before retry ``attempt`` (1-based):
+    ``min(max_delay, base_delay * 2**(attempt-1)) * (0.5 + jitter/2)``. The
+    single backoff curve for everything in the recovery path (checkpoint I/O
+    retries, elastic-agent worker relaunches) — tune it here, not per caller."""
+    delay = min(max_delay, base_delay * 2 ** max(0, attempt - 1))
+    return delay * (0.5 + _jitter01() / 2)
+
+
+class RetryBudgetExceeded(OSError):
+    """A durable write failed every attempt; the last error is chained."""
+
+
+class RetryingWriter:
+    """Run file-I/O callables with bounded exponential backoff + jitter.
+
+    ``attempts``: total tries (1 = no retry). Delay before retry *k* (1-based)
+    is ``min(max_delay, base_delay * 2**(k-1)) * (0.5 + jitter/2)``.
+
+    A :class:`~deepspeed_tpu.resilience.chaos.FaultPlan` hooks in here: the
+    plan's stall/transient-error injections are applied inside :meth:`call`,
+    so fault-injection tests exercise exactly the retry path production uses.
+    """
+
+    def __init__(self, attempts: int = 5, base_delay: float = 0.05,
+                 max_delay: float = 2.0,
+                 sleep: Callable[[float], None] = time.sleep):
+        if attempts < 1:
+            raise ValueError(f"attempts must be >= 1, got {attempts}")
+        self.attempts = int(attempts)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self._sleep = sleep
+        self.retries_performed = 0  # cumulative, for recovery-event export
+
+    # ------------------------------------------------------------------ core
+    def call(self, fn: Callable[..., Any], *args: Any,
+             describe: Optional[str] = None, **kwargs: Any) -> Any:
+        from .chaos import get_fault_plan
+
+        plan = get_fault_plan()
+        what = describe or getattr(fn, "__name__", "io")
+        last: Optional[BaseException] = None
+        for attempt in range(1, self.attempts + 1):
+            try:
+                if plan is not None:
+                    plan.on_io(what)  # may stall or raise a transient error
+                return fn(*args, **kwargs)
+            except TRANSIENT_ERRORS as e:
+                last = e
+                if attempt == self.attempts:
+                    break
+                delay = backoff_delay(attempt, self.base_delay, self.max_delay)
+                self.retries_performed += 1
+                logger.warning(
+                    f"checkpoint I/O {what!r} failed (attempt "
+                    f"{attempt}/{self.attempts}): {e}; retrying in {delay:.3f}s")
+                self._sleep(delay)
+        raise RetryBudgetExceeded(
+            f"checkpoint I/O {what!r} failed after {self.attempts} attempts: "
+            f"{last}") from last
+
+    # ----------------------------------------------------- durable primitives
+    def atomic_write(self, path: str, dump: Callable[[Any], None],
+                     fsync: bool = True, describe: Optional[str] = None) -> None:
+        """THE atomic-publish primitive every durable write goes through:
+        ``dump(file)`` serializes into a tmp file in the target directory,
+        optionally fsync'd, then ``os.replace`` publishes it and (when
+        fsync'd) the directory entry is flushed too. After this returns the
+        target is either absent/old or complete — never torn; on failure no
+        tmp orphan survives."""
+
+        def _write() -> None:
+            tmp = f"{path}.{os.getpid()}.tmp"
+            try:
+                with open(tmp, "wb") as f:
+                    dump(f)
+                    if fsync:
+                        f.flush()
+                        os.fsync(f.fileno())
+                os.replace(tmp, path)
+            finally:
+                if os.path.exists(tmp):
+                    try:
+                        os.remove(tmp)
+                    except OSError:
+                        pass
+            if fsync:
+                self.fsync_dir(os.path.dirname(path) or ".")
+
+        self.call(_write,
+                  describe=describe or f"write {os.path.basename(path)}")
+
+    def write_bytes(self, path: str, data: bytes, fsync: bool = True) -> None:
+        self.atomic_write(path, lambda f: f.write(data), fsync=fsync)
+
+    def write_array(self, path: str, arr, fsync: bool = False) -> None:
+        """Atomic ``.npy`` write (shard granularity). fsync is deferred to the
+        manifest/commit stage by default — per-shard fsync serializes the
+        whole save on flush latency; the COMMIT marker is what promises
+        durability, and it is only written after a full-directory fsync pass."""
+        import numpy as np
+
+        self.atomic_write(path, lambda f: np.save(f, arr), fsync=fsync)
+
+    def fsync_dir(self, directory: str) -> None:
+        """Durably record directory entries (the renames above)."""
+        try:
+            fd = os.open(directory, os.O_RDONLY)
+        except OSError:
+            return  # e.g. non-POSIX target; rename atomicity still holds
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    def fsync_file(self, path: str) -> None:
+        def _sync() -> None:
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+
+        self.call(_sync, describe=f"fsync {os.path.basename(path)}")
+
+
+DEFAULT_WRITER = RetryingWriter()
+
+
+__all__ = ["RetryingWriter", "RetryBudgetExceeded", "TRANSIENT_ERRORS",
+           "DEFAULT_WRITER"]

@@ -18,7 +18,12 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ...resilience.retry import RetryingWriter
 from ...utils.logging import log_dist, logger
+
+
+class CheckpointWriteError(IOError):
+    """A checkpoint write failed persistently; commit/load must not proceed."""
 
 
 class CheckpointEngine:
@@ -45,18 +50,26 @@ class CheckpointEngine:
 
 
 class NativeCheckpointEngine(CheckpointEngine):
-    """Synchronous writer (parity: ``TorchCheckpointEngine``)."""
+    """Synchronous writer (parity: ``TorchCheckpointEngine``). All writes are
+    atomic (tmp + ``os.replace``) and retried with backoff
+    (:class:`~deepspeed_tpu.resilience.retry.RetryingWriter`): a kill mid-write
+    leaves only a ``.tmp`` orphan, never a torn file under the final name."""
+
+    def __init__(self, config_params=None):
+        super().__init__(config_params)
+        self._writer = RetryingWriter()
 
     def save(self, state_dict: Dict[str, np.ndarray], path: str) -> None:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        tmp = f"{path}.{os.getpid()}.tmp"
-        with open(tmp, "wb") as f:
-            np.savez(f, **state_dict)
-        os.replace(tmp, path)
+        self._writer.atomic_write(path, lambda f: np.savez(f, **state_dict),
+                                  fsync=False,
+                                  describe=f"save {os.path.basename(path)}")
 
     def save_array(self, path: str, arr: np.ndarray) -> None:
-        """Single-array write (the serialization layer's file granularity)."""
-        np.save(path, arr)
+        """Single-array write (the serialization layer's file granularity).
+        Same tmp-then-``os.replace`` discipline as :meth:`save` — a direct
+        ``np.save`` here could leave a torn ``.npy`` under the final name."""
+        self._writer.write_array(path, arr)
 
     def load(self, path: str, map_location=None) -> Dict[str, np.ndarray]:
         with np.load(path, allow_pickle=False) as d:
@@ -76,6 +89,7 @@ class AsyncCheckpointEngine(CheckpointEngine):
         super().__init__(config_params)
         self._q: "queue.Queue[Optional[Tuple[Dict, str]]]" = queue.Queue()
         self._errors: List[str] = []
+        self._errors_lock = threading.Lock()
         self._inner = NativeCheckpointEngine()
         self._threads = [threading.Thread(target=self._worker, daemon=True)
                          for _ in range(writers)]
@@ -95,7 +109,8 @@ class AsyncCheckpointEngine(CheckpointEngine):
                 else:
                     self._inner.save(sd, path)
             except Exception as e:
-                self._errors.append(f"{path}: {e}")
+                with self._errors_lock:
+                    self._errors.append(f"{path}: {e}")
             finally:
                 self._q.task_done()
 
@@ -109,9 +124,11 @@ class AsyncCheckpointEngine(CheckpointEngine):
         self._q.put(({"__single__": arr}, path))
 
     def _raise_errors(self) -> None:
-        if self._errors:
+        with self._errors_lock:
             errs, self._errors = self._errors, []
-            raise IOError(f"async checkpoint writes failed: {errs}")
+        if errs:
+            raise CheckpointWriteError(
+                f"async checkpoint writes failed: {errs}")
 
     def load(self, path: str, map_location=None) -> Dict[str, np.ndarray]:
         self._q.join()
@@ -119,6 +136,11 @@ class AsyncCheckpointEngine(CheckpointEngine):
         return self._inner.load(path)
 
     def commit(self, tag: str) -> bool:
+        """Durability barrier. MUST raise — not log — when any background
+        writer recorded an error: a commit that "succeeds" over a failed
+        shard write is a fabricated durability point, and the COMMIT marker
+        the resilience layer writes after this call would bless partial
+        state."""
         self._q.join()
         self._raise_errors()
         log_dist(f"checkpoint tag {tag} committed (async)")

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
 import numpy as np
@@ -441,6 +442,37 @@ class DeepSpeedEngine:
         if config.dump_state:
             # parity: the reference's dump_state prints the resolved config
             log_dist("config state dump:\n" + config.model_dump_json(indent=2))
+
+        # ---------------- resilience: preemption drain + auto-resume
+        # (docs/RESILIENCE.md). Verification of checkpoint commit markers is
+        # unconditional in load_checkpoint; this block adds the preemption
+        # lifecycle: signal handlers, emergency save, resume from LATEST.
+        self._preemption_guard = None
+        self._recovery_log = None
+        self._draining = False
+        self._drain_polled_at = None  # micro_steps of the last drain poll
+        self._preemptions_survived = 0
+        self.resume_state_provider: Optional[Callable[[], Any]] = None
+        self.resumed_state: Any = None
+        res = config.resilience
+        if res.chaos:
+            from ..resilience.chaos import FaultPlan, install_plan
+
+            install_plan(FaultPlan.from_dict(dict(res.chaos)))
+        if res.enabled:
+            from ..resilience import PreemptionGuard, RecoveryLog
+
+            if jax.process_index() == 0:
+                self._recovery_log = RecoveryLog.for_dir(
+                    res.save_dir, monitor=self._monitor)
+            if res.install_signal_handlers:
+                self._preemption_guard = PreemptionGuard().install()
+            if res.auto_resume:
+                loaded, _ = self.load_checkpoint(res.save_dir,
+                                                 tag=res.resume_tag)
+                if loaded is not None:
+                    log_dist(f"resilience: auto-resumed from {loaded} "
+                             f"(step {self.global_steps})")
 
         # opt-in static analysis (deepspeed_tpu.analysis): lint the fused
         # step's jaxpr/HLO before anything executes. Runs here when a batch
@@ -947,6 +979,9 @@ class DeepSpeedEngine:
     def backward(self, loss=None) -> None:
         """Gradient accumulation bookkeeping (grads were produced in ``forward``)."""
         self.micro_steps += 1
+        # micro-batch boundary: state (incl. the accumulation buffer) is
+        # consistent here, so a requested drain can checkpoint mid-window
+        self._maybe_drain()
 
     def is_gradient_accumulation_boundary(self) -> bool:
         """Parity: ``runtime/engine.py:1739``."""
@@ -983,6 +1018,7 @@ class DeepSpeedEngine:
             self._update_curvature(self._ev_last_batch, leading_gas=False)
         if self.wall_clock_breakdown():
             self.timers("step").stop(sync_on=self.state["step"])
+        self._maybe_drain()
 
     def train_batch(self, batch) -> Dict[str, Any]:
         """Fused full step: ``gas`` micro-batches + optimizer update in one compiled
@@ -1030,6 +1066,7 @@ class DeepSpeedEngine:
             # parity: the step-end timer breakdown (engine.py:2226-2241)
             log_dist(self.timers.log(["batch_input", "train_batch"]))
         self.tput_timer.stop(sync_on=metrics["loss"])
+        self._maybe_drain()
         return metrics
 
     def train_batches(self, batch) -> Dict[str, Any]:
@@ -1073,6 +1110,7 @@ class DeepSpeedEngine:
             self._finish_step(mi)
         last = jax.tree_util.tree_map(lambda a: a[-1], host)
         last["mean_loss"] = float(np.mean(np.asarray(host["loss"])))
+        self._maybe_drain()
         return last
 
     def _apply_random_ltd(self) -> None:
@@ -1242,6 +1280,88 @@ class DeepSpeedEngine:
     @property
     def params(self):
         return self.state["params"]
+
+    # ------------------------------------------------------------------ resilience
+    def install_preemption_guard(self):
+        """Install SIGTERM/SIGINT drain handlers (main thread only). Called
+        automatically at init when ``resilience.enabled`` with
+        ``install_signal_handlers``; exposed for engines constructed off the
+        main thread or with handlers disabled in config."""
+        if self._preemption_guard is None:
+            from ..resilience import PreemptionGuard
+
+            self._preemption_guard = PreemptionGuard()
+        return self._preemption_guard.install()
+
+    def request_drain(self, reason: str = "manual") -> None:
+        """Cooperative preemption: checkpoint + exit at the next micro-batch
+        boundary, exactly as a SIGTERM would. Requires the ``resilience``
+        block (there is no save_dir to checkpoint into otherwise) — refused
+        loudly rather than swallowed."""
+        if not self.config.resilience.enabled:
+            raise ValueError(
+                "request_drain needs resilience.enabled with a save_dir — "
+                "without it the drain would be silently ignored at the next "
+                "boundary")
+        if self._preemption_guard is None:
+            from ..resilience import PreemptionGuard
+
+            self._preemption_guard = PreemptionGuard()
+        self._preemption_guard.request_drain(reason)
+
+    def _maybe_drain(self) -> None:
+        """Micro-batch-boundary drain check: emergency-save and exit with the
+        distinguished preemption code when a drain was signalled.
+
+        Multi-process runs must AGREE on the boundary: the emergency save
+        gathers sharded leaves collectively, so a host that drains alone while
+        its peers run the next step's collectives deadlocks the pod. With
+        ``process_count() > 1`` every boundary allgathers the local drain
+        flags (a host-level bool exchange) and any host's signal drains all —
+        the same sync-point pattern as jax's ``reached_preemption``."""
+        res = self.config.resilience
+        if self._draining or not res.enabled:
+            return
+        if self._drain_polled_at == self.micro_steps:
+            # backward() already polled this micro-batch; the post-step call
+            # would pay a second multihost allgather for the same boundary
+            return
+        self._drain_polled_at = self.micro_steps
+        g = self._preemption_guard
+        local = bool(g is not None and g.drain_requested)
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            flags = multihost_utils.process_allgather(
+                np.asarray([local], dtype=np.bool_))
+            drain = bool(np.asarray(flags).any())
+        else:
+            drain = local
+        if not drain:
+            return
+        signal_name = (g.signal_name if local and g is not None
+                       else "peer-preemption")
+        self._draining = True  # save_checkpoint marks the meta as emergency
+        t0 = time.monotonic()
+        log_dist(f"drain requested ({signal_name}): emergency checkpoint "
+                 f"to {res.save_dir} at step {self.global_steps}")
+        try:
+            path = self.save_checkpoint(res.save_dir)
+        except BaseException as e:
+            if self._recovery_log is not None:
+                self._recovery_log.record(
+                    "emergency_save_failed", step=self.global_steps,
+                    error=str(e))
+            logger.error(f"emergency checkpoint FAILED: {e}")
+            raise SystemExit(1) from e
+        if self._recovery_log is not None:
+            self._recovery_log.record(
+                "emergency_save", value=time.monotonic() - t0,
+                step=self.global_steps, tag=os.path.basename(path),
+                signal=signal_name or "")
+        log_dist(f"drain complete: {path} committed in "
+                 f"{time.monotonic() - t0:.2f}s; exiting {res.exit_code}")
+        raise SystemExit(res.exit_code)
 
     # ------------------------------------------------------------------ checkpoint
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,

@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Fast fault-injection smoke for CI (scripts/verify_tier1.sh).
+
+One SIGKILL injected mid-checkpoint (pre-commit phase, via ``DS_FAULT_PLAN``)
+against the real training worker on the CPU mesh, then a relaunch that must
+auto-resume from the newest *committed* tag and finish with monotone steps.
+This is the cheap end of the resilience test pyramid — the full phase matrix
+with bitwise state comparison lives in
+``tests/test_resilience.py::test_sigkill_at_every_phase_resumes_bitwise``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+
+def fail(msg: str) -> int:
+    print(f"chaos_smoke: FAIL — {msg}")
+    return 1
+
+
+def main() -> int:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(root, "tests", "resilience_worker.py")
+    with tempfile.TemporaryDirectory() as td:
+        ckpt = os.path.join(td, "ckpt")
+        log = os.path.join(td, "log.jsonl")
+        cmd = [sys.executable, worker, "--ckpt-dir", ckpt, "--steps", "3",
+               "--log", log]
+        env = dict(os.environ)
+        # kill during the 2nd save (after step 2), right before COMMIT: the
+        # worst spot — all bytes written, durability marker missing
+        env["DS_FAULT_PLAN"] = json.dumps(
+            {"kill_at_phase": "pre-commit", "kill_at_save": 1})
+        p1 = subprocess.run(cmd, env=env, timeout=240)
+        if p1.returncode not in (-9, 137):
+            return fail(f"injected SIGKILL did not fire (rc={p1.returncode})")
+
+        # the killed tag must exist but carry no COMMIT marker
+        killed_tag = os.path.join(ckpt, "global_step2")
+        if os.path.exists(os.path.join(killed_tag, "COMMIT")):
+            return fail("tag killed pre-commit has a COMMIT marker")
+        with open(os.path.join(ckpt, "latest")) as f:
+            if f.read().strip() != "global_step1":
+                return fail("latest pointer moved past the committed tag")
+
+        env.pop("DS_FAULT_PLAN")
+        p2 = subprocess.run(cmd, env=env, timeout=240)
+        if p2.returncode != 0:
+            return fail(f"auto-resume run exited rc={p2.returncode}")
+        steps = [json.loads(ln)["step"] for ln in open(log)]
+        if steps != sorted(steps):
+            return fail(f"steps reset after resume: {steps}")
+        if steps[-1] != 3:
+            return fail(f"resume did not reach step 3: {steps}")
+        if not os.path.exists(os.path.join(ckpt, "global_step3", "COMMIT")):
+            return fail("final checkpoint not committed")
+    print(f"chaos_smoke: PASS — SIGKILL pre-commit absorbed, auto-resumed "
+          f"(steps {steps})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,6 +46,18 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 
+# --- fault-injection smoke (docs/RESILIENCE.md) ---------------------------
+# one SIGKILL injected mid-checkpoint + successful auto-resume on the CPU
+# mesh: the crash-consistency contract regressing must fail the gate, not
+# the next preemption in production.
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        python scripts/chaos_smoke.py > /tmp/_t1_chaos.log 2>&1; then
+    echo "verify_tier1: FAIL — fault-injection smoke (kill + auto-resume):" >&2
+    tail -40 /tmp/_t1_chaos.log >&2
+    exit 1
+fi
+grep -a "chaos_smoke: PASS" /tmp/_t1_chaos.log || true
+
 # --- lint gate (ruff.toml: analysis subsystem + its tests) ----------------
 # advisory where the interpreter lacks ruff (this image does not bundle it);
 # CI lanes that have it get the real check.

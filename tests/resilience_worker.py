@@ -1,0 +1,98 @@
+"""Resilience worker fixture: tiny GPT on one forced-CPU device, checkpoint
+after every step, ``resilience`` block enabled (auto-resume + drain
+handlers). Faults are injected via the ``DS_FAULT_PLAN`` env var set by the
+driver (test_resilience.py, scripts/chaos_smoke.py) — the worker itself has
+no fault-specific code, which is the point: the kill lands in the production
+save path.
+
+Exit codes: 0 = reached --steps; 83 (PREEMPTED_EXIT_CODE) = drained after
+SIGTERM; -9 / 137 = fault-plan SIGKILL fired.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--steps", type=int, required=True)
+    p.add_argument("--out-state", default=None,
+                   help="npz path for the final engine state (bitwise compare)")
+    p.add_argument("--log", default=None, help="jsonl per-step log")
+    p.add_argument("--step-sleep", type=float, default=0.0,
+                   help="per-step sleep (gives the driver a SIGTERM window)")
+    p.add_argument("--ready-file", default=None,
+                   help="written after the first step completes")
+    args = p.parse_args()
+
+    # single forced-CPU device, independent of the inherited test env
+    flags = " ".join(
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count"))
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=1"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["DS_TPU_ACCELERATOR"] = "cpu"
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_gpt, gpt
+
+    model, _ = build_gpt(gpt.GPTConfig(
+        vocab_size=64, n_layer=2, n_head=2, d_model=32, max_seq_len=32))
+    engine, _, _, _ = ds.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "bf16": {"enabled": False},
+        "steps_per_print": 0,
+        # auto-resume from the newest committed tag + SIGTERM drain -> 83
+        "resilience": {"enabled": True, "save_dir": args.ckpt_dir},
+    })
+
+    def batch_for(step: int):
+        r = np.random.default_rng(1000 + step)
+        return {"input_ids": r.integers(0, 64, size=(2, 16), dtype=np.int32)}
+
+    while engine.global_steps < args.steps:
+        m = engine.train_batch(batch_for(engine.global_steps))
+        if args.log:
+            with open(args.log, "a") as f:
+                f.write(json.dumps({"step": engine.global_steps,
+                                    "loss": float(m["loss"])}) + "\n")
+        if args.ready_file and engine.global_steps == 1:
+            with open(args.ready_file, "w") as f:
+                f.write("ready")
+        if args.step_sleep:
+            time.sleep(args.step_sleep)
+        engine.save_checkpoint(args.ckpt_dir)
+
+    if args.out_state:
+        from deepspeed_tpu.checkpoint.serialization import (
+            _UINT_FOR_SIZE,
+            _fetch_full,
+            _flatten_with_paths,
+        )
+
+        flat, _ = _flatten_with_paths(engine.state)
+        out = {}
+        for key, leaf in flat:
+            arr = _fetch_full(leaf)
+            if arr.dtype.kind not in "biufc":
+                key = f"{key}::{arr.dtype}"
+                arr = arr.view(_UINT_FOR_SIZE[arr.dtype.itemsize])
+            out[key.replace("/", ".")] = arr
+        np.savez(args.out_state, **out)
+    print(f"WORKER_DONE step={engine.global_steps}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

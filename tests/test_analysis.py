@@ -325,3 +325,45 @@ def test_profiler_reports_static_flops(devices):
     prof = profile_compiled_fn(lambda x: x @ x, a)
     assert prof["flops"] > 0
     assert prof["flops_source"] in ("compiled", "lowered")
+
+
+# ------------------------------------------------------------ config/resilience
+def test_checkpoint_uncommitted_load_rule(tmp_path):
+    """Resume config pointing at a COMMIT-less tag warns at lint time; a
+    committed tag (or nothing to resume) stays silent."""
+    from deepspeed_tpu.analysis.core import AnalysisContext
+    from deepspeed_tpu.analysis.rules_config import CheckpointUncommittedLoadRule
+    from deepspeed_tpu.resilience import commit_tag
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    rule = CheckpointUncommittedLoadRule()
+    tag_dir = tmp_path / "global_step5"
+    (tag_dir / "state").mkdir(parents=True)
+    (tag_dir / "state" / "state.msgpack").write_bytes(b"x" * 32)
+    cfg = DeepSpeedConfig.load({
+        "train_micro_batch_size_per_gpu": 1,
+        "resilience": {"enabled": True, "save_dir": str(tmp_path),
+                       "resume_tag": "global_step5"}})
+    findings = list(rule.check_context(AnalysisContext(config=cfg)))
+    assert len(findings) == 1
+    assert "COMMIT" in findings[0].message
+    assert findings[0].severity == Severity.WARNING
+
+    commit_tag(str(tag_dir))  # now committed -> silent
+    assert not list(rule.check_context(AnalysisContext(config=cfg)))
+
+    # resume_tag naming a directory that does not exist -> flagged
+    cfg_missing = DeepSpeedConfig.load({
+        "train_micro_batch_size_per_gpu": 1,
+        "resilience": {"enabled": True, "save_dir": str(tmp_path),
+                       "resume_tag": "global_step99"}})
+    findings = list(rule.check_context(AnalysisContext(config=cfg_missing)))
+    assert len(findings) == 1 and "does not exist" in findings[0].message
+
+    # fresh run (no latest, no pin): nothing to resume, nothing to flag
+    fresh = tmp_path / "fresh"
+    fresh.mkdir()
+    cfg_fresh = DeepSpeedConfig.load({
+        "train_micro_batch_size_per_gpu": 1,
+        "resilience": {"enabled": True, "save_dir": str(fresh)}})
+    assert not list(rule.check_context(AnalysisContext(config=cfg_fresh)))

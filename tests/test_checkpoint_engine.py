@@ -162,3 +162,48 @@ def test_save_16bit_model_stage3_requires_flag(tmp_path):
             "stage": 3, "stage3_gather_16bit_weights_on_model_save": True}})
     engine2.train_batch(_batch(cfg2))
     assert os.path.exists(engine2.save_16bit_model(str(tmp_path)))
+
+
+# ------------------------------------------------------- crash consistency (PR 4)
+def test_native_save_array_is_atomic(tmp_path, monkeypatch, rng):
+    """save_array must be tmp-then-replace: a failure between serialize and
+    publish leaves NO file (torn or otherwise) under the final name."""
+    e = NativeCheckpointEngine()
+    arr = rng.normal(size=(16,)).astype(np.float32)
+    e.save_array(str(tmp_path / "a.npy"), arr)
+    np.testing.assert_array_equal(np.load(tmp_path / "a.npy"), arr)
+    assert not list(tmp_path.glob("*.tmp"))
+
+    def boom(src, dst):
+        raise OSError("fs died at publish time")
+
+    monkeypatch.setattr(os, "replace", boom)
+    from deepspeed_tpu.resilience.retry import RetryingWriter
+
+    e2 = NativeCheckpointEngine()
+    e2._writer = RetryingWriter(attempts=2, sleep=lambda d: None)
+    with pytest.raises(OSError, match="after 2 attempts"):
+        e2.save_array(str(tmp_path / "b.npy"), arr)
+    monkeypatch.undo()
+    assert not (tmp_path / "b.npy").exists()
+    assert not list(tmp_path.glob("*.tmp"))
+
+
+def test_async_commit_raises_on_background_write_error(tmp_path):
+    """A failed background write must fail commit() loudly — a commit that
+    returns True over a lost shard is a fabricated durability point."""
+    from deepspeed_tpu.runtime.checkpoint_engine.checkpoint_engine import (
+        CheckpointWriteError,
+    )
+
+    e = AsyncCheckpointEngine(writers=1)
+    blocker = tmp_path / "blocker"
+    blocker.write_text("a file where a directory is needed")
+    e.save({"a": np.ones((4,), np.float32)},
+           str(blocker / "sub" / "x.npz"))  # makedirs under a file -> OSError
+    with pytest.raises(CheckpointWriteError, match="async checkpoint writes failed"):
+        e.commit("tag")
+    # errors are consumed by the raise; a subsequent good save commits fine
+    e.save({"a": np.ones((4,), np.float32)}, str(tmp_path / "ok.npz"))
+    assert e.commit("tag2") is True
+    e.shutdown()

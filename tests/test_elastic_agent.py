@@ -101,3 +101,67 @@ def test_kill_and_resume_at_new_dp(tmp_path):
     # cold-start loss and remains finite)
     assert run2[0]["loss"] < run1[0]["loss"]
     assert all(np.isfinite(r["loss"]) for r in records)
+
+
+# ----------------------------------------------------------- resilience (PR 4)
+def _fake_committed_ckpt(ckpt_dir, tags):
+    """Minimal committed tags + latest pointer, no engine involved."""
+    from deepspeed_tpu.resilience import commit_tag, write_latest
+
+    for i, t in enumerate(tags):
+        tag_dir = os.path.join(str(ckpt_dir), t)
+        os.makedirs(os.path.join(tag_dir, "state"), exist_ok=True)
+        with open(os.path.join(tag_dir, "state", "state.msgpack"), "wb") as f:
+            f.write(bytes([i]) * 64)
+        commit_tag(tag_dir)
+    write_latest(str(ckpt_dir), tags[-1])
+
+
+def test_crash_loop_quarantines_poisoned_tag(tmp_path):
+    """K consecutive failures while 'latest' points at one tag quarantine it:
+    the next resume falls back to the previous committed tag instead of
+    crash-looping on the poisoned one until max_restarts."""
+    from deepspeed_tpu.resilience import is_committed, read_events, read_latest
+
+    ckpt = tmp_path / "ckpt"
+    _fake_committed_ckpt(ckpt, ["global_step1", "global_step2"])
+    agent = DSElasticAgent(
+        lambda s: [sys.executable, "-c", "import sys; sys.exit(3)"],
+        ELASTIC_CONFIG, device_count_fn=lambda: 4, max_restarts=3,
+        poll_interval=0.05, checkpoint_dir=str(ckpt), crash_loop_threshold=2,
+        backoff_base=0.01, backoff_max=0.05)
+    result = agent.run()
+    assert result.state == "FAILED"
+    assert result.quarantined == ["global_step2"]
+    assert read_latest(str(ckpt)) == "global_step1"
+    assert not is_committed(str(ckpt / "global_step2"))
+    assert is_committed(str(ckpt / "global_step1"))
+    events = {e["event"] for e in read_events(str(ckpt))}
+    assert {"worker_restart", "tag_quarantined"} <= events
+
+
+def test_preempted_exit_spends_no_restart_budget(tmp_path):
+    """Exit code 83 (drained preemption) relaunches immediately and does not
+    count as a failure — even with max_restarts=0."""
+    from deepspeed_tpu.resilience import read_events
+    from deepspeed_tpu.resilience.preemption import PREEMPTED_EXIT_CODE
+
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    marker = tmp_path / "first_launch_done"
+    script = (
+        "import os, sys\n"
+        f"p = {str(marker)!r}\n"
+        "if not os.path.exists(p):\n"
+        f"    open(p, 'w').write('x'); sys.exit({PREEMPTED_EXIT_CODE})\n"
+        "sys.exit(0)\n")
+    agent = DSElasticAgent(
+        lambda s: [sys.executable, "-c", script],
+        ELASTIC_CONFIG, device_count_fn=lambda: 4, max_restarts=0,
+        poll_interval=0.05, checkpoint_dir=str(ckpt))
+    result = agent.run()
+    assert result.state == "SUCCEEDED"
+    assert result.restarts == 0
+    assert result.preemptions == 1
+    assert any(e["event"] == "preemption_restart"
+               for e in read_events(str(ckpt)))

@@ -1,0 +1,402 @@
+"""Preemption-safe training: commit protocol, fault injection, auto-resume.
+
+The acceptance bar (ISSUE 4): a SIGKILL injected at every checkpoint-write
+phase never yields a load of partial state — resume restores either the
+previous committed tag or the new one, and a killed-and-resumed run ends
+bitwise-identical to an uninterrupted one.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPTConfig, build_gpt
+from deepspeed_tpu.resilience import (
+    CheckpointCorruptionError,
+    FaultPlan,
+    PREEMPTED_EXIT_CODE,
+    RetryBudgetExceeded,
+    RetryingWriter,
+    UncommittedTagError,
+    commit_tag,
+    committed_tags,
+    crc32c,
+    install_plan,
+    is_committed,
+    quarantine_tag,
+    read_events,
+    read_latest,
+    resolve_tag_for_load,
+    verify_tag,
+    write_latest,
+)
+
+WORKER = os.path.join(os.path.dirname(__file__), "resilience_worker.py")
+TINY = GPTConfig(vocab_size=256, n_layer=2, n_head=4, d_model=64, max_seq_len=64)
+
+
+@pytest.fixture(autouse=True)
+def _clear_fault_plan():
+    yield
+    install_plan(None)
+
+
+def make_engine(save_dir=None, handlers=False, extra=None):
+    model, _ = build_gpt(TINY)
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 0,
+    }
+    if save_dir is not None:
+        cfg["resilience"] = {"enabled": True, "save_dir": str(save_dir),
+                             "install_signal_handlers": handlers}
+    cfg.update(extra or {})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    return engine
+
+
+def batch(seed=0, n=16):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, 256, size=(n, 32), dtype=np.int32)}
+
+
+def _corrupt(path: str) -> None:
+    with open(path, "r+b") as f:
+        f.seek(os.path.getsize(path) // 2)
+        chunk = f.read(8) or b"\0"
+        f.seek(-len(chunk), os.SEEK_CUR)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+
+
+# ------------------------------------------------------------------- primitives
+def test_crc32c_known_vectors():
+    # RFC 3720 / Castagnoli test vectors — guards the pure-Python fallback
+    # (and any C implementation the image provides) against each other
+    assert crc32c(b"") == 0
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(b"\x00" * 32) == 0x8A9136AA
+    assert crc32c(b"\xff" * 32) == 0x62A8AB43
+    # incremental == one-shot
+    assert crc32c(b"6789", crc32c(b"12345")) == crc32c(b"123456789")
+
+
+def test_retrying_writer_absorbs_transient_errors(tmp_path):
+    install_plan(FaultPlan(fail_io_times=2))
+    w = RetryingWriter(attempts=4, sleep=lambda d: None)
+    w.write_bytes(str(tmp_path / "x.bin"), b"payload")
+    assert (tmp_path / "x.bin").read_bytes() == b"payload"
+    assert w.retries_performed >= 2
+
+
+def test_retrying_writer_bounded():
+    install_plan(FaultPlan(fail_io_times=99))
+    w = RetryingWriter(attempts=3, sleep=lambda d: None)
+    with pytest.raises(RetryBudgetExceeded, match="after 3 attempts"):
+        w.write_bytes("/tmp/never_written.bin", b"x")
+
+
+def test_fault_plan_io_stall(tmp_path):
+    import time
+
+    install_plan(FaultPlan(stall_io_seconds=0.2, stall_io_times=1))
+    t0 = time.monotonic()
+    RetryingWriter().write_bytes(str(tmp_path / "s.bin"), b"x")
+    assert time.monotonic() - t0 >= 0.2
+
+
+def _mk_tag(save_dir, name="global_step1", payload=b"A" * 100):
+    tag_dir = os.path.join(str(save_dir), name)
+    os.makedirs(os.path.join(tag_dir, "state", "arrays"), exist_ok=True)
+    with open(os.path.join(tag_dir, "state", "arrays", "0.npy"), "wb") as f:
+        f.write(payload)
+    with open(os.path.join(tag_dir, "meta.json"), "w") as f:
+        f.write("{}")
+    return tag_dir
+
+
+def test_manifest_commit_verify_quarantine(tmp_path):
+    tag_dir = _mk_tag(tmp_path)
+    # uncommitted: must be rejected even though every content file is fine
+    with pytest.raises(UncommittedTagError, match="no COMMIT marker"):
+        verify_tag(tag_dir)
+    assert not is_committed(tag_dir)
+    manifest = commit_tag(tag_dir)
+    assert set(manifest["files"]) == {"meta.json", "state/arrays/0.npy"}
+    assert is_committed(tag_dir)
+    verify_tag(tag_dir)
+    # corrupt one shard: precise rejection naming file + reason
+    _corrupt(os.path.join(tag_dir, "state", "arrays", "0.npy"))
+    with pytest.raises(CheckpointCorruptionError,
+                       match=r"state/arrays/0\.npy.*corrupted shard"):
+        verify_tag(tag_dir)
+    # shallow check still passes (size unchanged) — deep=True is what catches it
+    verify_tag(tag_dir, deep=False)
+    # truncated manifest: rejected against the COMMIT record
+    tag2 = _mk_tag(tmp_path, "global_step2")
+    commit_tag(tag2)
+    with open(os.path.join(tag2, "MANIFEST.json"), "r+b") as f:
+        f.truncate(os.path.getsize(os.path.join(tag2, "MANIFEST.json")) // 2)
+    with pytest.raises(CheckpointCorruptionError, match="truncated or rewritten"):
+        verify_tag(tag2)
+    # quarantine revokes load eligibility but keeps the data
+    tag3 = _mk_tag(tmp_path, "global_step3")
+    commit_tag(tag3)
+    write_latest(str(tmp_path), "global_step3")
+    quarantine_tag(str(tmp_path), "global_step3", "crash loop")
+    assert not is_committed(tag3)
+    assert os.path.exists(os.path.join(tag3, "state", "arrays", "0.npy"))
+    with pytest.raises(UncommittedTagError, match="quarantined"):
+        verify_tag(tag3)
+
+
+def test_invalidate_before_rewrite(tmp_path):
+    """Re-saving an existing tag must first revoke its COMMIT: a kill during
+    the rewrite would otherwise leave a stale marker blessing mixed shards."""
+    from deepspeed_tpu.resilience import invalidate_tag
+
+    tag_dir = _mk_tag(tmp_path)
+    commit_tag(tag_dir)
+    assert is_committed(tag_dir)
+    invalidate_tag(tag_dir)
+    assert not is_committed(tag_dir)
+    with pytest.raises(UncommittedTagError):
+        verify_tag(tag_dir)
+    commit_tag(tag_dir)  # rewrite completes: commit restores loadability
+    verify_tag(tag_dir)
+
+
+def test_checksum_algo_recorded_not_assumed(tmp_path, monkeypatch):
+    """The manifest records its checksum algorithm; readers dispatch on the
+    record, not on their own environment — write with crc32c, verify under a
+    host forced to crc32."""
+    tag_dir = _mk_tag(tmp_path, "global_step9")
+    monkeypatch.setenv("DS_CHECKPOINT_CHECKSUM", "crc32c")
+    commit_tag(tag_dir)
+    manifest = json.load(open(os.path.join(tag_dir, "MANIFEST.json")))
+    assert manifest["checksum"] == "crc32c"
+    monkeypatch.setenv("DS_CHECKPOINT_CHECKSUM", "crc32")
+    verify_tag(tag_dir)  # still verifies: algo comes from the COMMIT record
+    monkeypatch.setenv("DS_CHECKPOINT_CHECKSUM", "md5")
+    with pytest.raises(ValueError, match="DS_CHECKPOINT_CHECKSUM"):
+        commit_tag(_mk_tag(tmp_path, "global_step10"))
+
+
+def test_resolve_falls_back_to_newest_committed(tmp_path):
+    for i in (1, 2, 3):
+        commit_tag(_mk_tag(tmp_path, f"global_step{i}", payload=bytes([i]) * 50))
+    write_latest(str(tmp_path), "global_step3")
+    # bit rot in the latest tag: resolution falls back to step2 and reports why
+    _corrupt(os.path.join(str(tmp_path), "global_step3", "state", "arrays", "0.npy"))
+    tag, rejected = resolve_tag_for_load(str(tmp_path))
+    assert tag == "global_step2"
+    assert [t for t, _ in rejected] == ["global_step3"]
+    # explicit tag: no fallback, the corruption raises
+    with pytest.raises(CheckpointCorruptionError):
+        resolve_tag_for_load(str(tmp_path), tag="global_step3")
+    # empty dir: (None, []) — "nothing to load" is not an error
+    assert resolve_tag_for_load(str(tmp_path / "empty")) == (None, [])
+    # all tags bad: a precise aggregate error
+    for i in (1, 2):
+        _corrupt(os.path.join(str(tmp_path), f"global_step{i}",
+                              "state", "arrays", "0.npy"))
+    with pytest.raises(CheckpointCorruptionError, match="no loadable checkpoint"):
+        resolve_tag_for_load(str(tmp_path))
+
+
+# ------------------------------------------------------------- engine protocol
+def test_engine_save_writes_commit_protocol(tmp_path, devices):
+    e = make_engine()
+    e.train_batch(batch(0))
+    ckpt = e.save_checkpoint(str(tmp_path))
+    assert os.path.exists(os.path.join(ckpt, "MANIFEST.json"))
+    assert os.path.exists(os.path.join(ckpt, "COMMIT"))
+    manifest = verify_tag(ckpt)  # full CRC pass over what the engine wrote
+    assert any(f.startswith("state/arrays/") for f in manifest["files"])
+    assert read_latest(str(tmp_path)) == "global_step1"
+    meta = json.load(open(os.path.join(ckpt, "meta.json")))
+    assert len(meta["rng_key"]) == 2  # host PRNG chain for step-exact resume
+    assert meta["emergency"] is False
+    # overwrite of the same tag (e.g. drain at the step of a periodic save):
+    # COMMIT is revoked up front and restored by the new commit
+    ckpt2 = e.save_checkpoint(str(tmp_path), tag="global_step1")
+    verify_tag(ckpt2)
+
+    # corrupt the only tag: auto-load must reject it with a precise error,
+    # not half-load; an older committed tag would be the fallback
+    shard = os.path.join(ckpt, "state", "arrays", "0.npy")
+    _corrupt(shard)
+    e2 = make_engine()
+    with pytest.raises(CheckpointCorruptionError, match="no loadable checkpoint"):
+        e2.load_checkpoint(str(tmp_path))
+
+
+def test_engine_load_falls_back_to_previous_committed_tag(tmp_path, devices):
+    e = make_engine()
+    e.train_batch(batch(0))
+    e.save_checkpoint(str(tmp_path))
+    e.train_batch(batch(1))
+    e.save_checkpoint(str(tmp_path))
+    assert committed_tags(str(tmp_path)) == ["global_step1", "global_step2"]
+    # bit rot in the newest tag
+    _corrupt(os.path.join(str(tmp_path), "global_step2", "state", "arrays", "1.npy"))
+    e2 = make_engine()
+    path, _ = e2.load_checkpoint(str(tmp_path))
+    assert path.endswith("global_step1")
+    assert e2.global_steps == 1
+    # explicit request for the corrupt tag still raises
+    e3 = make_engine()
+    with pytest.raises(CheckpointCorruptionError, match="corrupted shard"):
+        e3.load_checkpoint(str(tmp_path), tag="global_step2")
+
+
+def test_format_version_rejected_explicitly(tmp_path, devices):
+    import msgpack
+
+    e = make_engine()
+    e.train_batch(batch(0))
+    ckpt = e.save_checkpoint(str(tmp_path))
+    state_msgpack = os.path.join(ckpt, "state", "state.msgpack")
+    with open(state_msgpack, "rb") as f:
+        meta = msgpack.unpackb(f.read())
+    meta["format_version"] = 99
+    with open(state_msgpack, "wb") as f:
+        f.write(msgpack.packb(meta))
+    from deepspeed_tpu.checkpoint.serialization import load_pytree
+
+    with pytest.raises(ValueError, match="format_version 99"):
+        load_pytree(e.state, os.path.join(ckpt, "state"))
+
+
+# ------------------------------------------------------------------ preemption
+def test_drain_emergency_save_and_auto_resume(tmp_path, devices):
+    e = make_engine(save_dir=tmp_path)
+    e.train_batch(batch(0))
+    e.request_drain("test-preemption")
+    with pytest.raises(SystemExit) as exc:
+        e.train_batch(batch(1))
+    assert exc.value.code == PREEMPTED_EXIT_CODE
+    tags = committed_tags(str(tmp_path))
+    assert tags == ["global_step2"]  # the drained step was saved, committed
+    meta = json.load(open(tmp_path / "global_step2" / "meta.json"))
+    assert meta["emergency"] is True
+
+    # a fresh engine with the same resilience block auto-resumes at init
+    e2 = make_engine(save_dir=tmp_path)
+    assert e2.global_steps == 2
+    assert e2._preemptions_survived == 1
+    events = {ev["event"] for ev in read_events(str(tmp_path))}
+    assert {"emergency_save", "preemption_survived",
+            "resume_latency_s"} <= events
+    # training continues normally from the drained state
+    m = e2.train_batch(batch(2))
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_sigterm_sets_drain_flag_in_process(tmp_path, devices):
+    e = make_engine(save_dir=tmp_path, handlers=True)
+    guard = e._preemption_guard
+    try:
+        assert guard.installed
+        e.train_batch(batch(0))
+        os.kill(os.getpid(), signal.SIGTERM)  # delivered to our handler
+        assert guard.drain_requested and guard.signal_name == "SIGTERM"
+        with pytest.raises(SystemExit) as exc:
+            e.train_batch(batch(1))
+        assert exc.value.code == PREEMPTED_EXIT_CODE
+    finally:
+        guard.uninstall()
+    assert committed_tags(str(tmp_path)) == ["global_step2"]
+
+
+# ------------------------------------------------------------- kill-and-resume
+def _run_worker(ckpt, steps, out_state=None, fault=None, log=None,
+                timeout=240):
+    cmd = [sys.executable, WORKER, "--ckpt-dir", str(ckpt),
+           "--steps", str(steps)]
+    if out_state:
+        cmd += ["--out-state", str(out_state)]
+    if log:
+        cmd += ["--log", str(log)]
+    env = dict(os.environ)
+    env.pop("DS_FAULT_PLAN", None)
+    if fault:
+        env["DS_FAULT_PLAN"] = json.dumps(fault)
+    return subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=timeout)
+
+
+def _assert_bitwise_equal(npz_a, npz_b):
+    with np.load(npz_a) as a, np.load(npz_b) as b:
+        assert sorted(a.files) == sorted(b.files)
+        for k in a.files:
+            np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("phase", ["shard:1", "pre-manifest", "pre-commit",
+                                   "post-commit", "pre-latest"])
+def test_sigkill_at_every_phase_resumes_bitwise(tmp_path, phase):
+    """The acceptance criterion: SIGKILL at each write phase, then resume —
+    final state must be bitwise identical to an uninterrupted run."""
+    steps = 4
+    ref = _run_worker(tmp_path / "ref", steps, out_state=tmp_path / "ref.npz")
+    assert ref.returncode == 0, ref.stderr[-800:]
+
+    ckpt = tmp_path / "ckpt"
+    # kill during the 3rd save (save #2, i.e. the one after step 3)
+    killed = _run_worker(ckpt, steps,
+                         fault={"kill_at_phase": phase, "kill_at_save": 2})
+    assert killed.returncode in (-9, 137), (
+        f"fault plan did not fire: rc={killed.returncode}\n{killed.stderr[-800:]}")
+    # never a torn visible state: every tag present is either committed+valid
+    # or has no COMMIT marker at all
+    for tag in os.listdir(ckpt):
+        tag_dir = os.path.join(str(ckpt), tag)
+        if not os.path.isdir(tag_dir):
+            continue
+        if is_committed(tag_dir):
+            verify_tag(tag_dir)
+    resumed = _run_worker(ckpt, steps, out_state=tmp_path / "resumed.npz")
+    assert resumed.returncode == 0, resumed.stderr[-800:]
+    _assert_bitwise_equal(tmp_path / "ref.npz", tmp_path / "resumed.npz")
+
+
+@pytest.mark.slow
+def test_sigterm_drain_subprocess_roundtrip(tmp_path):
+    """Full preemption lifecycle out of process: SIGTERM → drain save →
+    exit 83 → relaunch auto-resumes and finishes with continuous steps."""
+    ckpt = tmp_path / "ckpt"
+    log = tmp_path / "log.jsonl"
+    ready = tmp_path / "ready"
+    cmd = [sys.executable, WORKER, "--ckpt-dir", str(ckpt), "--steps", "50",
+           "--log", str(log), "--step-sleep", "0.3",
+           "--ready-file", str(ready)]
+    env = dict(os.environ)
+    env.pop("DS_FAULT_PLAN", None)
+    proc = subprocess.Popen(cmd, env=env)
+    import time
+
+    deadline = time.monotonic() + 240
+    while not ready.exists():
+        assert proc.poll() is None, "worker died before its first step"
+        assert time.monotonic() < deadline, "worker never became ready"
+        time.sleep(0.2)
+    proc.send_signal(signal.SIGTERM)
+    rc = proc.wait(timeout=120)
+    assert rc == PREEMPTED_EXIT_CODE
+    drained_step = max(json.loads(ln)["step"] for ln in log.read_text().splitlines())
+    meta_tag = read_latest(str(ckpt))
+    assert json.load(open(ckpt / meta_tag / "meta.json"))["emergency"] is True
+
+    done = _run_worker(ckpt, steps=drained_step + 2, log=log)
+    assert done.returncode == 0, done.stderr[-800:]
+    steps = [json.loads(ln)["step"] for ln in log.read_text().splitlines()]
+    assert steps == sorted(steps)  # resumed, never reset
+    assert steps[-1] == drained_step + 2
