@@ -122,10 +122,101 @@ class ShardMapBranchlessGuardRule(Rule):
                 break  # one body per shard_map eqn
 
 
+class UnoverlappedQuantizedCollectiveRule(Rule):
+    """A quantized collective on the train hot path with nothing to overlap.
+
+    Cutting the wire bytes 4x (``comm/quantized.py``) buys little if the
+    remaining int payload still sits exposed on the critical path. The
+    overlap schedules (``runtime/zero/gather.py``) are on by default; this
+    rule is the CI gate that they stayed on:
+
+    - **param gathers** (``zero_quantized_weights`` + stage 3): the pipelined
+      gather scan records its ops as ``qgather[zero3/pf]`` — the gather for
+      window k+d is issued d iterations before its consumer, so the async
+      scheduler has independent compute to hide it under. A bare
+      ``qgather[zero3]`` record means the gather is issued and consumed in
+      the same scan iteration: nothing overlappable between issue and use.
+    - **gradient exchange** (``zero_quantized_gradients``): the bucketed path
+      emits per-layer uint8 reduce-scatter/all-gather *inside* the backward
+      scan; a program whose uint8 collectives all sit outside any scan runs
+      the whole exchange monolithically after backward — fully exposed.
+    - when optimized HLO is available (``--compile``) and the backend emitted
+      async collective pairs at all, a uint8 collective still in sync form is
+      reported as the residual evidence.
+    """
+
+    rule_id = "collective/unoverlapped-quantized-collective"
+    default_severity = Severity.ERROR
+    description = "quantized collective with no overlappable compute between issue and use"
+
+    def check_program(self, prog: ProgramIR,
+                      ctx: AnalysisContext) -> Iterable[Finding]:
+        qc = ctx.quantization
+        if qc is None:
+            return
+
+        if qc.weights:
+            inline = sorted({name for name in prog.wire_records
+                             if name.startswith("qgather[zero3]")})
+            if inline:
+                yield self.finding(
+                    f"quantized ZeRO-3 gathers issued inline (issue-and-"
+                    f"consume in the same scan iteration): "
+                    f"{', '.join(inline)} — the int wire sits exposed on the "
+                    f"layer loop's critical path",
+                    location=f"{prog.name} (wire ledger)",
+                    suggestion="leave zero_optimization.overlap_comm unset/"
+                               "true (the pipelined gather scan), and check "
+                               "stage3_max_live_parameters is not clamping "
+                               "the prefetch depth to zero",
+                )
+
+        if qc.gradients:
+            in_scan, outside = [], []
+            for eqn, path in iter_eqns(prog.jaxpr):
+                if eqn.primitive.name not in ("all_to_all", "all_gather"):
+                    continue
+                if not any(str(getattr(v.aval, "dtype", "")) == "uint8"
+                           for v in eqn.invars):
+                    continue
+                (in_scan if "/scan[" in path or "/while[" in path
+                 else outside).append(path)
+            if outside and not in_scan:
+                yield self.finding(
+                    "the quantized gradient exchange runs monolithically "
+                    f"after the backward ({len(outside)} uint8 collectives, "
+                    "none inside the backward scan) — the whole gradient "
+                    "wire is exposed instead of overlapping backward compute",
+                    location=f"{prog.name}:{outside[0]}",
+                    suggestion="leave zero_optimization.overlap_comm unset/"
+                               "true and use a model exposing grad_bucket_key "
+                               "(build_gpt models do) so the exchange is "
+                               "bucketed per layer inside the backward scan",
+                )
+
+        if prog.hlo:
+            colls = prog.hlo_collectives()
+            if any("-start" in c.line for c in colls):
+                sync_u8 = [c for c in colls
+                           if "u8" in c.dtypes and "-start" not in c.line]
+                for c in sync_u8[:4]:
+                    yield self.finding(
+                        f"quantized collective compiled in sync form while "
+                        f"the backend schedules async pairs: {c.line}",
+                        location=prog.name,
+                        severity=Severity.WARNING,
+                        suggestion="check the producer/consumer distance of "
+                                   "this op — the latency-hiding scheduler "
+                                   "found nothing to hide it under",
+                    )
+
+
 def collective_rules() -> List[Rule]:
     return [DivergentBranchCollectivesRule(), CollectiveInWhilePredicateRule(),
-            ShardMapBranchlessGuardRule()]
+            ShardMapBranchlessGuardRule(),
+            UnoverlappedQuantizedCollectiveRule()]
 
 
 __all__ = ["DivergentBranchCollectivesRule", "CollectiveInWhilePredicateRule",
-           "ShardMapBranchlessGuardRule", "collective_rules"]
+           "ShardMapBranchlessGuardRule",
+           "UnoverlappedQuantizedCollectiveRule", "collective_rules"]

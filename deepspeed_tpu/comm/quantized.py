@@ -374,6 +374,81 @@ def qall_to_all(
                                 orig_size=x.shape[-1]).astype(x.dtype)
 
 
+# --------------------------------------------------------------------------- grad buckets
+def grad_bucket_reduce(tree, resid, scale, *, bits: int = 8,
+                       block_size: int = DEFAULT_BLOCK,
+                       axis_name: AxisName = "dp",
+                       op_name: str = "qgrad_bucket"):
+    """Identity on ``tree`` in forward; the *backward* runs this bucket's
+    quantized dp gradient exchange on the cotangents (mean reduce-scatter +
+    all-gather, the ZeRO++ exchange of :func:`qreduce_scatter` /
+    :func:`qall_gather`), inside whatever scan the forward sits in.
+
+    Applied per layer by :func:`~deepspeed_tpu.runtime.zero.gather
+    .zero3_layer_scan` under a bound
+    :class:`~deepspeed_tpu.runtime.zero.gather.GradBucketContext`, this splits
+    the monolithic post-backward gradient exchange into per-layer buckets
+    emitted *inside the backward scan body* — each bucket's collectives are
+    data-independent of the neighboring layers' backward matmuls, so XLA's
+    async-collective scheduler can hide the gradient wire under backward
+    compute instead of exposing one monolithic exchange at the end.
+
+    ``resid``: this bucket's error-feedback residual (any shape whose size
+    covers the padded flat bucket), or None. Its returned "cotangent" IS the
+    updated residual — the caller reads it out of ``jax.grad`` (gradients are
+    just values; the tap repurposes the dead residual-input slot to thread
+    per-bucket EF state through the backward scan without new plumbing).
+    ``scale``: traced loss scale the cotangents carry; the residual is kept in
+    unscaled units so it survives dynamic loss-scale changes. Cotangent of
+    ``scale`` is reported as zero (the caller never differentiates wrt it).
+    """
+
+    @jax.custom_vjp
+    def tap(t, r, s):
+        return t
+
+    def tap_fwd(t, r, s):
+        return t, (r, s)
+
+    def tap_bwd(res, g):
+        r, s = res
+        leaves, treedef = jax.tree_util.tree_flatten(g)
+        sizes = [int(np.prod(l.shape)) if l.shape else 1 for l in leaves]
+        flat = jnp.concatenate(
+            [l.astype(jnp.float32).ravel() for l in leaves])
+        W = int(lax.psum(1, axis_name))
+        n = flat.shape[0]
+        npad = ((n + W - 1) // W) * W
+        flat = jnp.pad(flat, (0, npad - n))
+        kw = dict(bits=bits, block_size=block_size, mean=True,
+                  op_name=f"{op_name}_rs")
+        s_val = s if s is not None else jnp.float32(1.0)
+        if r is not None:
+            if int(np.prod(r.shape)) != npad:
+                raise ValueError(
+                    f"grad_bucket_reduce: residual size {r.shape} != padded "
+                    f"bucket size {npad} (pad the per-bucket residual to a "
+                    f"multiple of the dp extent {W})")
+            red, new_r = qreduce_scatter(
+                flat, axis_name, residual=r.reshape(-1) * s_val, **kw)
+            d_resid = (new_r / s_val).reshape(r.shape).astype(r.dtype)
+        else:
+            red = qreduce_scatter(flat, axis_name, **kw)
+            d_resid = None
+        full = qall_gather(red, axis_name, axis=0, tiled=True, bits=bits,
+                           block_size=block_size, op_name=f"{op_name}_ag")
+        out, off = [], 0
+        for l, sz in zip(leaves, sizes):
+            out.append(full[off:off + sz].reshape(l.shape).astype(l.dtype))
+            off += sz
+        d_tree = jax.tree_util.tree_unflatten(treedef, out)
+        d_scale = jnp.zeros_like(s) if s is not None else None
+        return d_tree, d_resid, d_scale
+
+    tap.defvjp(tap_fwd, tap_bwd)
+    return tap(tree, resid, scale)
+
+
 # --------------------------------------------------------------------------- GSPMD helper
 def _normalize_entries(spec, rank: int) -> Tuple:
     entries = tuple(spec) if spec is not None else ()
@@ -430,6 +505,78 @@ def _qreshard_bwd(spec, bits, block_size, op_name, _res, g):
 quantized_reshard.defvjp(_qreshard_fwd, _qreshard_bwd)
 
 
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def quantized_matmul_reshard(h, w, spec: P, bits: int = 8,
+                             block_size: int = DEFAULT_BLOCK,
+                             op_name: str = "qmatmul_reshard"):
+    """``h @ w`` where ``w`` arrives over the quantized wire and is consumed
+    *without materializing a dequantized fp copy*: quantize shard-locally,
+    constrain the int payload to ``spec`` (XLA's inserted all-gather moves
+    uint8 + per-block scales), then feed the payload straight into the
+    dequant-fused matmul (:mod:`deepspeed_tpu.ops.pallas.dequant_matmul` —
+    per-VMEM-tile dequantization on TPU, XLA reshape fallback elsewhere).
+
+    ``h``: [..., D]; ``w``: [D, F]; returns [..., F]. Backward is EQuARX-style
+    split: ``d_h = g @ w_hat^T`` recomputes ``w_hat`` from the saved *int*
+    payload (the only weight residual held between forward and backward —
+    4x smaller than the fp copy autodiff would otherwise save), and
+    ``d_w = h^T @ g`` passes straight through the quantize/dequantize pair
+    (the same straight-through rule as :func:`quantized_reshard`).
+    """
+    out, _ = _qmatmul_fwd(h, w, spec, bits, block_size, op_name)
+    return out
+
+
+def _qmatmul_fwd(h, w, spec, bits, block_size, op_name):
+    from ..models.api import maybe_shard
+    from ..ops.pallas.dequant_matmul import dequant_matmul
+
+    D, F = w.shape
+    lead = h.shape[:-1]
+    h2 = h.reshape(-1, D)
+    if not quantization_shrinks(F, bits, block_size, w.dtype.itemsize):
+        entries = _normalize_entries(spec, w.ndim)
+        wg = maybe_shard(w, P(*entries))
+        return (h2 @ wg.astype(h.dtype)).reshape(lead + (F,)), (h2, w)
+    q, s, z = quantize_blockwise(w, bits=bits, block_size=block_size)
+    _record(f"{op_name}{tuple(spec)}", _payload_bytes(w), _payload_bytes(q, s, z))
+    entries = _normalize_entries(spec, w.ndim)
+    q = maybe_shard(q, P(*entries))
+    sspec = P(*entries[:-1], None)
+    s = maybe_shard(s, sspec)
+    z = maybe_shard(z, sspec)
+    out = dequant_matmul(h2.astype(jnp.float32), q, s, z, orig_size=F,
+                         bits=bits).astype(h.dtype)
+    # zero-size marker carries w's dtype through the residual pytree (a bare
+    # np.dtype is not a traceable leaf)
+    return out.reshape(lead + (F,)), (h2, (q, s, z, jnp.zeros((0, F), w.dtype)))
+
+
+def _qmatmul_bwd(spec, bits, block_size, op_name, res, g):
+    h2, wres = res
+    lead = g.shape[:-1]
+    g2 = g.reshape(-1, g.shape[-1]).astype(jnp.float32)
+    if isinstance(wres, tuple):
+        q, s, z, marker = wres
+        wdtype = marker.dtype
+        # the int payload is the only weight residual; the fp view exists
+        # transiently for the two backward matmuls
+        w_hat = dequantize_blockwise(q, s, z, bits=bits,
+                                     orig_size=marker.shape[-1])
+    else:
+        wdtype = wres.dtype
+        w_hat = wres.astype(jnp.float32)
+    d_h = (g2 @ w_hat.T).astype(h2.dtype).reshape(lead + (h2.shape[-1],))
+    d_w = (h2.astype(jnp.float32).T @ g2).astype(wdtype)
+    return d_h, d_w
+
+
+quantized_matmul_reshard.defvjp(
+    lambda h, w, spec, bits, block_size, op_name:
+        _qmatmul_fwd(h, w, spec, bits, block_size, op_name),
+    _qmatmul_bwd)
+
+
 def quantized_reshard_tree(tree, specs, bits: int = 8,
                            block_size: int = DEFAULT_BLOCK,
                            op_name: str = "qreshard"):
@@ -459,7 +606,9 @@ __all__ = [
     "qall_gather",
     "qreduce_scatter",
     "qall_to_all",
+    "grad_bucket_reduce",
     "quantized_reshard",
+    "quantized_matmul_reshard",
     "quantized_reshard_tree",
     "wire_bytes_per_element",
     "DEFAULT_BLOCK",

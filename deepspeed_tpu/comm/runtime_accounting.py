@@ -60,9 +60,16 @@ class WireLedger:
     observable the ZeRO++-style config knobs are tuned against — per-op
     compression ratios, independent of the facade's enable flag (compression
     evidence must not vanish because comms logging is off).
+
+    ``overlap``: the measured exposed-vs-overlapped collective-time column
+    (:func:`profile_overlap` result dict), attached after a profiled step so
+    the compression evidence and the latency-hiding evidence render together
+    — bytes saved mean nothing if the remaining wire still sits exposed on
+    the critical path.
     """
 
     records: Dict[str, WireRecord] = field(default_factory=dict)
+    overlap: Optional[Dict[str, float]] = None
 
     def record(self, op_name: str, logical_bytes: int, wire_bytes: int) -> None:
         rec = self.records.setdefault(op_name, WireRecord())
@@ -91,6 +98,10 @@ class WireLedger:
             }
         return out
 
+    def set_overlap(self, overlap: Optional[Dict[str, float]]) -> None:
+        """Attach a measured overlap column (:meth:`OverlapStats.to_dict`)."""
+        self.overlap = dict(overlap) if overlap else None
+
     def summary(self) -> str:
         lines = ["quantized wire accounting (trace-time):"]
         for name, row in self.summary_dict().items():
@@ -100,6 +111,13 @@ class WireLedger:
                 f"({row['ratio']}x)")
         if not self.records:
             lines.append("  (no quantized collectives traced)")
+        if self.overlap:
+            o = self.overlap
+            lines.append(
+                f"  overlap (measured): collective={o.get('collective_us', 0):.0f}us "
+                f"exposed={o.get('exposed_us', 0):.0f}us "
+                f"overlapped={o.get('overlapped_us', 0):.0f}us "
+                f"({o.get('hidden_frac', 0.0):.0%} hidden)")
         out = "\n".join(lines)
         log_dist(out)
         return out
@@ -182,6 +200,169 @@ def _parse_trace_dir(trace_dir: str,
     if prof.ops:
         prof.wall_us = t_max - t_min
     return prof
+
+
+@dataclass
+class OverlapStats:
+    """Exposed-vs-overlapped collective time, from the device timeline.
+
+    Per device lane: ``collective_us`` is the union of collective-thunk
+    intervals; ``overlapped_us`` the part of that union concurrently covered
+    by non-collective device compute on the same device (the wire XLA's
+    scheduler actually hid); ``exposed_us`` the rest — the step-time cost of
+    communication. ``compute_us`` is the compute-interval union and
+    ``busy_us`` the union of ALL device activity, so by construction
+    ``busy_us == compute_us + exposed_us`` and
+    ``collective_us == exposed_us + overlapped_us`` — the accounting always
+    sums to where the step time went. All values are summed across devices.
+    """
+
+    collective_us: float = 0.0
+    exposed_us: float = 0.0
+    overlapped_us: float = 0.0
+    compute_us: float = 0.0
+    busy_us: float = 0.0
+    n_devices: int = 1
+    wall_us: float = 0.0
+
+    @property
+    def hidden_frac(self) -> float:
+        return self.overlapped_us / self.collective_us if self.collective_us else 0.0
+
+    def to_dict(self) -> Dict[str, float]:
+        return {
+            "collective_us": round(self.collective_us, 1),
+            "exposed_us": round(self.exposed_us, 1),
+            "overlapped_us": round(self.overlapped_us, 1),
+            "compute_us": round(self.compute_us, 1),
+            "busy_us": round(self.busy_us, 1),
+            "hidden_frac": round(self.hidden_frac, 4),
+            "n_devices": self.n_devices,
+            "wall_us": round(self.wall_us, 1),
+        }
+
+    def summary(self) -> str:
+        return (f"collective overlap ({self.n_devices} devices): "
+                f"collective={self.collective_us:.0f}us "
+                f"exposed={self.exposed_us:.0f}us "
+                f"overlapped={self.overlapped_us:.0f}us "
+                f"({self.hidden_frac:.0%} hidden under "
+                f"{self.compute_us:.0f}us compute)")
+
+
+def _union(intervals) -> list:
+    """Merge [(start, end), ...] into a disjoint sorted union."""
+    out = []
+    for s, e in sorted(intervals):
+        if out and s <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], e))
+        else:
+            out.append((s, e))
+    return out
+
+
+def _measure(intervals) -> float:
+    return sum(e - s for s, e in intervals)
+
+
+def _intersect(a: list, b: list) -> float:
+    """Total overlap between two disjoint sorted interval unions."""
+    total, i, j = 0.0, 0, 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def overlap_from_events(events, n_devices: Optional[int] = None) -> OverlapStats:
+    """Compute :class:`OverlapStats` from chrome-trace ``traceEvents``.
+
+    Groups complete (``ph == "X"``) events by trace pid (one per device
+    lane), splits them into collective thunks (async ``-start`` events carry
+    the transfer duration; ``-done`` markers are skipped like in
+    :func:`_parse_trace_dir`) and everything else (compute), and does the
+    interval math per lane. Pure function of the event list — the unit tests
+    feed synthetic traces."""
+    by_pid: Dict[Any, Dict[str, list]] = {}
+    t_min, t_max = float("inf"), 0.0
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        name = e.get("name", "")
+        if name.startswith("end:"):
+            continue
+        ts = float(e.get("ts", 0.0))
+        dur = float(e.get("dur", 0.0))
+        if dur <= 0:
+            continue
+        m = _COLLECTIVE_RE.match(name)
+        if m and m.group(2) == "-done":
+            continue
+        lane = by_pid.setdefault(e.get("pid", 0),
+                                 {"coll": [], "comp": []})
+        lane["coll" if m else "comp"].append((ts, ts + dur))
+        t_min = min(t_min, ts)
+        t_max = max(t_max, ts + dur)
+    stats = OverlapStats(n_devices=n_devices or max(1, len(by_pid)))
+    for lane in by_pid.values():
+        coll = _union(lane["coll"])
+        comp = _union(lane["comp"])
+        busy = _union(lane["coll"] + lane["comp"])
+        c_us = _measure(coll)
+        hidden = _intersect(coll, comp)
+        stats.collective_us += c_us
+        stats.overlapped_us += hidden
+        stats.exposed_us += c_us - hidden
+        stats.compute_us += _measure(comp)
+        stats.busy_us += _measure(busy)
+    if t_max > 0:
+        stats.wall_us = t_max - t_min
+    return stats
+
+
+def _events_from_trace_dir(trace_dir: str) -> list:
+    paths = sorted(glob.glob(os.path.join(
+        trace_dir, "plugins", "profile", "*", "*.trace.json.gz")))
+    if not paths:
+        raise FileNotFoundError(
+            f"no trace.json.gz under {trace_dir} — did the profiler run?")
+    events = []
+    for path in paths:
+        with gzip.open(path, "rt") as f:
+            events.extend(json.load(f).get("traceEvents", []))
+    return events
+
+
+def profile_overlap(fn: Callable[[], Any],
+                    trace_dir: Optional[str] = None,
+                    n_devices: Optional[int] = None,
+                    attach: bool = True) -> OverlapStats:
+    """Run ``fn()`` under the profiler and return the exposed-vs-overlapped
+    collective-time accounting from the device timeline. ``attach=True``
+    (default) also attaches the result to :data:`wire_ledger` so
+    ``engine.comms_summary()`` and bench rows render the overlap column."""
+    own = trace_dir is None
+    d = trace_dir or tempfile.mkdtemp(prefix="ds_tpu_overlap_")
+    try:
+        with jax.profiler.trace(d):
+            out = fn()
+            jax.block_until_ready(out)
+        stats = overlap_from_events(
+            _events_from_trace_dir(d),
+            n_devices=n_devices or jax.device_count())
+    finally:
+        if own:  # multi-MB chrome traces must not accumulate in /tmp
+            shutil.rmtree(d, ignore_errors=True)
+    if attach:
+        wire_ledger.set_overlap(stats.to_dict())
+    log_dist(stats.summary())
+    return stats
 
 
 def profile_collectives(fn: Callable[[], Any],

@@ -79,6 +79,12 @@ class Module:
     # the GPTConfig this module was built from, when it is a build_gpt model —
     # checkpoint exporters need it (checkpoint/reference_export.py)
     gpt_config: Optional[Any] = None
+    # params subtree (top-level key) whose layer stack runs through
+    # zero3_layer_scan — the engine's quantized-gradient program buckets that
+    # subtree's dp reduce-scatter per layer INSIDE the backward scan
+    # (runtime/zero/gather.py grad_bucket_window) instead of folding it into
+    # the monolithic post-backward exchange
+    grad_bucket_key: Optional[str] = None
     # optional ZeRO-Infinity decomposition: () -> StreamSpec (models/gpt.py
     # make_stream). Exposes the model as embed / repeated-layer / head units so
     # the param-stream runner (runtime/zero/infinity.py) can keep master

@@ -447,6 +447,23 @@ def _dropout(x, rate, rng, train, salt: int):
     return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
 
 
+def _head_quantization():
+    """Active quantized-LM-head config (``zero_quantized_head``), or None.
+    Read from the same trace-bound config the gather windowing rides; inert
+    inside the quantized-gradient shard_map (no config is bound there)."""
+    from ..runtime.zero.gather import _active_cfg
+
+    zcfg = _active_cfg()
+    if zcfg is None or int(getattr(zcfg, "stage", 0)) < 3:
+        return None
+    if not (getattr(zcfg, "zero_quantized_weights", False)
+            and getattr(zcfg, "zero_quantized_head", False)):
+        return None
+    from ..comm.quantized import QuantizedCommConfig
+
+    return QuantizedCommConfig.from_zero_config(zcfg)
+
+
 # --------------------------------------------------------------------------- forward
 def forward(cfg: GPTConfig, params: Dict[str, Any], input_ids: jnp.ndarray,
             rngs: Optional[Dict[str, jax.Array]] = None, train: bool = True,
@@ -562,7 +579,20 @@ def forward(cfg: GPTConfig, params: Dict[str, Any], input_ids: jnp.ndarray,
             "imported CLIP text tower): call forward(..., return_hidden=True) "
             "— there is no LM head to produce logits with")
     head = params["wte"] if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
+    qh = _head_quantization()
+    if qh is not None:
+        # zero_quantized_head: the head gather rides the int wire AND the
+        # dequantized fp copy is never materialized — the payload feeds the
+        # logits matmul's prologue (ops/pallas/dequant_matmul.py on TPU, the
+        # fused XLA fallback elsewhere), with a straight-through backward
+        from ..comm.quantized import quantized_matmul_reshard
+
+        B2, T2, D2 = x.shape
+        logits = quantized_matmul_reshard(
+            x.reshape(-1, D2), head.astype(x.dtype).T, P(None, "tp"),
+            qh.bits, qh.block_size, "qmatmul[lm_head]").reshape(B2, T2, -1)
+    else:
+        logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
     if cfg.lm_head_bias and not cfg.tie_embeddings:
         logits = logits + params["lm_head_b"].astype(logits.dtype)
     return logits
@@ -1140,4 +1170,5 @@ def build(cfg_or_name) -> Tuple[Module, GPTConfig]:
         with_ltd_keep=with_ltd_keep,
         stream=lambda: GPTStream(cfg),
         gpt_config=cfg,
+        grad_bucket_key="blocks",
     ), cfg

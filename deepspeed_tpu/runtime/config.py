@@ -436,6 +436,16 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
                 "zero_quantize_error_feedback set without "
                 "zero_quantized_gradients: the error-feedback residual only "
                 "applies to the quantized gradient exchange and is ignored")
+        if z.overlap_comm is False and z.stage >= ZeroStageEnum.weights:
+            # explicit opt-out of the latency-hiding schedules: legal (A/B
+            # baselines need it) but the dslint hot-path gate
+            # (collective/unoverlapped-quantized-collective) will flag any
+            # quantized collective left exposed by this choice
+            logger.warning(
+                "overlap_comm=false: ZeRO-3 gathers run inline "
+                "(issue-and-consume in the same scan iteration) — expect "
+                "exposed collective time; the pipelined schedule is the "
+                "default for a reason (docs/COMM_COMPRESSION.md)")
 
     # ------------------------------------------------------------------ helpers
     @property

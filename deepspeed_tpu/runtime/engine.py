@@ -372,20 +372,45 @@ class DeepSpeedEngine:
                 # scope the per-layer MoQ gate to the probed subtree so a
                 # non-layer leaf whose leading dim coincides is never gated
                 self._compression.curvature_scope = ev_scope.replace(".", "/")
+        self._qgrad_bucket_key = None
         if self._qcomm.gradients:
-            # flat-buffer geometry of the quantized gradient exchange: the
-            # whole grad tree travels as ONE padded fp32 vector (pad to a
-            # multiple of the dp extent so reduce-scatter chunks evenly;
-            # block padding is the quantizer's own business)
-            n = int(sum(int(np.prod(s.shape) or 1)
-                        for s in jax.tree_util.tree_leaves(param_shapes)))
             W = self.topo.data_parallel_size
-            self._qgrad_n = n
-            self._qgrad_npad = ((n + W - 1) // W) * W
+            total = int(sum(int(np.prod(s.shape) or 1)
+                            for s in jax.tree_util.tree_leaves(param_shapes)))
+            # overlapped (bucketed) exchange: the model's layer-scan subtree
+            # reduces per layer INSIDE the backward scan (zero3_layer_scan's
+            # grad-bucket tap) so the wire runs under backward compute; only
+            # the non-stacked leaves (embeddings, final LN, head) keep the
+            # monolithic post-backward exchange. Stochastic rounding stays
+            # monolithic: the per-bucket taps have no per-layer rng stream.
+            bk = getattr(model, "grad_bucket_key", None)
+            if (config.zero_optimization.overlap_comm_effective
+                    and not self._qcomm.stochastic
+                    and bk and isinstance(param_shapes, dict)
+                    and bk in param_shapes):
+                bleaves = jax.tree_util.tree_leaves(param_shapes[bk])
+                L = int(bleaves[0].shape[0]) if bleaves else 0
+                if L > 1 and all(lf.shape[:1] == (L,) for lf in bleaves):
+                    self._qgrad_bucket_key = bk
+                    n_layer = sum(int(np.prod(lf.shape[1:]) or 1)
+                                  for lf in bleaves)
+                    self._qgrad_bucket_L = L
+                    self._qgrad_bucket_npad = ((n_layer + W - 1) // W) * W
+                    total -= L * n_layer
+            # flat-buffer geometry of the monolithic quantized gradient
+            # exchange (the whole tree, or the non-bucketed rest): ONE padded
+            # fp32 vector (pad to a multiple of the dp extent so
+            # reduce-scatter chunks evenly; block padding is the quantizer's
+            # own business)
+            self._qgrad_n = total
+            self._qgrad_npad = ((total + W - 1) // W) * W
             log_dist(
                 f"zero_quantized_gradients: int{self._qcomm.bits} "
                 f"block={self._qcomm.block_size} exchange over dp={W} "
-                f"({n} grads, padded {self._qgrad_npad}"
+                f"({total} grads monolithic, padded {self._qgrad_npad}"
+                + (f"; {self._qgrad_bucket_L} per-layer buckets of "
+                   f"{self._qgrad_bucket_npad} overlapped in backward"
+                   if self._qgrad_bucket_key else "")
                 + (", error feedback on" if self._qcomm.error_feedback else "")
                 + ")")
         base_specs = model.specs(param_shapes)
@@ -546,6 +571,14 @@ class DeepSpeedEngine:
             state["qgrad_residual"] = jax.device_put(
                 jnp.zeros((W, self._qgrad_npad), jnp.float32),
                 NamedSharding(self.mesh, P("dp", None)))
+            if self._qgrad_bucket_key is not None:
+                # per-layer-bucket residual for the overlapped exchange
+                # (bucket l, rank i) — rides the backward scan as the grad
+                # tap's EF state
+                state["qgrad_bucket_residual"] = jax.device_put(
+                    jnp.zeros((self._qgrad_bucket_L, W,
+                               self._qgrad_bucket_npad), jnp.float32),
+                    NamedSharding(self.mesh, P(None, "dp", None)))
         if self._n_curvature:
             # normalized per-layer Hessian eigenvalues; 0 = "not yet probed"
             # (factor 1 in the MoQ gate), refreshed by _update_curvature
@@ -647,7 +680,8 @@ class DeepSpeedEngine:
         grads = _constrain(grads, self.grad_shardings)
         return loss, aux, grads
 
-    def _qdp_grads(self, params, batch, scale, rng, residual):
+    def _qdp_grads(self, params, batch, scale, rng, residual,
+                   bucket_residual=None):
         """Quantized dp gradient exchange (``zero_quantized_gradients``).
 
         The declarative path has no pre-reduction gradients to intercept — XLA
@@ -656,21 +690,39 @@ class DeepSpeedEngine:
         ``runtime/fp16/onebit.py``) and replaces the fp reduction with the
         ZeRO++ exchange: block-int quantized reduce-scatter (dequantize, reduce
         in fp32, only the wire is int) + quantized all-gather of the reduced
-        shards. ``residual``: the persistent ``[W, n_pad]`` error-feedback
-        buffer, or None. Returns ``(loss, grads, new_residual)`` with grads
-        replicated (the caller re-constrains to the ZeRO grad shardings).
+        shards.
+
+        With ``overlap_comm`` (default) and a model exposing
+        ``grad_bucket_key``, the layer-stack subtree leaves the monolithic
+        exchange: each layer's params pass through
+        :func:`~deepspeed_tpu.comm.quantized.grad_bucket_reduce` inside
+        ``zero3_layer_scan``, so its quantized reduce-scatter + all-gather are
+        emitted per bucket INSIDE the backward scan — collectives the
+        scheduler can overlap with the neighboring layers' backward matmuls.
+        Only the non-stacked leaves (embeddings, head, final LN) remain in the
+        post-backward monolithic exchange.
+
+        ``residual``: the persistent ``[W, n_pad]`` error-feedback buffer for
+        the monolithic part, or None. ``bucket_residual``: the
+        ``[L, W, n_pad_layer]`` per-bucket EF stack (bucket mode + EF only).
+        Returns ``(loss, grads, new_residual, new_bucket_residual)`` with
+        grads replicated (the caller re-constrains to the ZeRO grad
+        shardings).
         """
         from ..comm.quantized import qall_gather, qreduce_scatter
         from ..utils.jax_compat import shard_map
         from .fp16.onebit import _flatten, _unflatten
+        from .zero.gather import GradBucketContext, grad_bucket_window
 
         qc = self._qcomm
         n, n_pad = self._qgrad_n, self._qgrad_npad
+        bk = self._qgrad_bucket_key
         param_specs_repl = jax.tree_util.tree_map(lambda _: P(), self.param_specs)
         batch_specs = jax.tree_util.tree_map(lambda _: P("dp"), batch)
         has_resid = residual is not None
+        has_bresid = bucket_residual is not None
 
-        def body(p, b, r, resid, scale_in):
+        def body(p, b, r, resid, bresid, scale_in):
             r = jax.random.fold_in(r, jax.lax.axis_index("dp"))
             r_model, r_round = jax.random.split(r)
 
@@ -680,8 +732,35 @@ class DeepSpeedEngine:
                 loss, aux = out if isinstance(out, tuple) else (out, {})
                 return loss.astype(jnp.float32) * scale_in, loss
 
-            g_tree, loss = jax.grad(loss_fn, has_aux=True)(p)
-            flat = jnp.pad(_flatten(g_tree), (0, n_pad - n))
+            if bk is not None:
+                # bucketed path: the layer subtree's exchange happens inside
+                # the backward scan via the grad tap; the EF stack rides the
+                # params so its updated value comes back as its "gradient"
+                p_in = dict(p)
+                if has_bresid:
+                    p_in[bk] = dict(p[bk])
+                    p_in[bk]["_qgrad_resid"] = bresid  # [L, 1, npad_l]
+                bctx = GradBucketContext(qc=qc, scale=scale_in)
+                with grad_bucket_window(bctx):
+                    g_tree, loss = jax.grad(loss_fn, has_aux=True)(p_in)
+                if not bctx.tapped:
+                    raise ValueError(
+                        "zero_quantized_gradients bucket mode: the model "
+                        f"declares grad_bucket_key={bk!r} but its apply() "
+                        "never entered zero3_layer_scan — the bucketed "
+                        "exchange would silently skip the dp reduction")
+                bucket_g = dict(g_tree[bk])
+                new_bresid = (bucket_g.pop("_qgrad_resid") if has_bresid
+                              else jnp.zeros((1, 1, 0), jnp.float32))
+                rest_g = {k: v for k, v in g_tree.items() if k != bk}
+                rest_p = {k: v for k, v in p.items() if k != bk}
+            else:
+                g_tree, loss = jax.grad(loss_fn, has_aux=True)(p)
+                new_bresid = jnp.zeros((1, 1, 0), jnp.float32)
+                bucket_g = None
+                rest_g, rest_p = g_tree, p
+
+            flat = jnp.pad(_flatten(rest_g), (0, n_pad - n))
             kw = dict(bits=qc.bits, block_size=qc.block_size,
                       stochastic=qc.stochastic, rng=r_round,
                       mean=True, op_name="qgrad_reduce_scatter")
@@ -698,25 +777,34 @@ class DeepSpeedEngine:
             full = qall_gather(red, "dp", axis=0, tiled=True, bits=qc.bits,
                                block_size=qc.block_size,
                                op_name="qgrad_all_gather")
-            grads = _unflatten(full[:n], p)
-            return grads, jax.lax.pmean(loss, "dp"), new_resid
+            grads = _unflatten(full[:n], rest_p)
+            if bucket_g is not None:
+                grads = dict(grads)
+                grads[bk] = bucket_g
+            return grads, jax.lax.pmean(loss, "dp"), new_resid, new_bresid
 
-        resid_in = residual if has_resid else jnp.zeros(
-            (self.topo.data_parallel_size, 0), jnp.float32)
+        W = self.topo.data_parallel_size
+        resid_in = residual if has_resid else jnp.zeros((W, 0), jnp.float32)
+        bresid_in = bucket_residual if has_bresid else jnp.zeros(
+            (1, W, 0), jnp.float32)
         sm = shard_map(
             body,
             mesh=self.mesh,
-            in_specs=(param_specs_repl, batch_specs, P(), P("dp", None), P()),
-            out_specs=(param_specs_repl, P(), P("dp", None)),
+            in_specs=(param_specs_repl, batch_specs, P(), P("dp", None),
+                      P(None, "dp", None), P()),
+            out_specs=(param_specs_repl, P(), P("dp", None),
+                       P(None, "dp", None)),
             check_vma=False,
         )
-        grads, loss, new_resid = sm(params, batch, rng, resid_in,
-                                    jnp.asarray(scale, jnp.float32))
+        grads, loss, new_resid, new_bresid = sm(
+            params, batch, rng, resid_in, bresid_in,
+            jnp.asarray(scale, jnp.float32))
         inv = 1.0 / scale
         grads = jax.tree_util.tree_map(
             lambda g: g.astype(jnp.float32) * inv, grads)
         grads = _constrain(grads, self.grad_shardings)
-        return loss, grads, (new_resid if has_resid else None)
+        return (loss, grads, (new_resid if has_resid else None),
+                (new_bresid if has_bresid else None))
 
     def _micro_step(self, state, grad_acc, batch, rng):
         """fwd+bwd for one micro-batch, accumulate into ``grad_acc``. Parity:
@@ -733,11 +821,14 @@ class DeepSpeedEngine:
             # replicated), so a bound zero_quantized_weights config would only
             # inject weight fake-quant noise and record wire savings that
             # never hit a wire — the gradient exchange is the whole story
-            loss, grads, new_resid = self._qdp_grads(
+            loss, grads, new_resid, new_bresid = self._qdp_grads(
                 state["params"], batch, scale, rng,
-                state.get("qgrad_residual"))
+                state.get("qgrad_residual"),
+                state.get("qgrad_bucket_residual"))
             if new_resid is not None:
                 new_state["qgrad_residual"] = new_resid
+            if new_bresid is not None:
+                new_state["qgrad_bucket_residual"] = new_bresid
         else:
             rngs = {"dropout": rng}
             loss, aux, grads = self._loss_and_grads(
@@ -785,14 +876,15 @@ class DeepSpeedEngine:
 
         new_scaler = update_scaler(self.pc, state["scaler"], finite)
         new_state = dict(state)  # passthrough for extra keys (e.g. onebit errors)
-        if "qgrad_residual" in state:
-            # an overflow micro-step writes inf/NaN into the error-feedback
-            # residual (the quantizer's block scale goes inf); carrying that
-            # forward would poison every later step even after the loss scale
-            # recovers — drop the residual along with the skipped update
-            resid = state["qgrad_residual"]
-            new_state["qgrad_residual"] = jnp.where(
-                finite, resid, jnp.zeros_like(resid))
+        for ef_key in ("qgrad_residual", "qgrad_bucket_residual"):
+            if ef_key in state:
+                # an overflow micro-step writes inf/NaN into the error-feedback
+                # residual (the quantizer's block scale goes inf); carrying
+                # that forward would poison every later step even after the
+                # loss scale recovers — drop it along with the skipped update
+                resid = state[ef_key]
+                new_state[ef_key] = jnp.where(
+                    finite, resid, jnp.zeros_like(resid))
         new_state.update({
             "params": new_params,
             "master": new_master,
@@ -1261,6 +1353,22 @@ class DeepSpeedEngine:
         if wire_ledger.records:
             out += "\n" + wire_ledger.summary()
         return out
+
+    def measure_overlap(self, batch):
+        """Run ONE ``train_batch`` under the profiler and return the
+        exposed-vs-overlapped collective-time accounting
+        (:class:`~deepspeed_tpu.comm.runtime_accounting.OverlapStats`) from
+        the device timeline — the observable the ``overlap_comm`` schedules
+        are tuned against. Also attaches the result to ``wire_ledger`` so
+        :meth:`comms_summary` and bench rows render the overlap column.
+        The step is dispatched once un-profiled first, so the trace sees a
+        steady-state step, never the compile (a caller that only ever ran
+        ``train_batches`` — the k_steps bench rows — has no compiled
+        ``train_batch`` program at all)."""
+        from ..comm.runtime_accounting import profile_overlap
+
+        self.train_batch(batch)  # warmup: compile + first dispatch untraced
+        return profile_overlap(lambda: self.train_batch(batch))
 
     def comms_verify(self, batch) -> str:
         """MEASURED per-collective counts/time for one ``train_batch`` from a

@@ -3,10 +3,17 @@
 Parity: reference ``runtime/zero/config.py:78`` (``DeepSpeedZeroConfig``),
 ``runtime/zero/offload_config.py`` (offload sub-configs). The JSON schema is the
 DeepSpeed ``"zero_optimization"`` block, so existing DeepSpeed configs parse
-unchanged. Knobs that only exist to schedule CUDA streams (``overlap_comm``,
-bucket sizes) are accepted and recorded — on TPU, XLA's static schedule already
-overlaps collectives, so they inform the partitioning policy rather than stream
-management.
+unchanged.
+
+``overlap_comm`` is real here (unlike the CUDA side-stream scheduling it names
+in the reference): it gates the software-pipelined ZeRO-3 gather scan
+(``runtime/zero/gather.py`` issues window k+1's all-gather before window k's
+matmuls consume their params, so XLA's async-collective scheduler can hide the
+wire under compute) and the per-layer-bucket quantized gradient reduce-scatter
+emitted inside the backward scan (``runtime/engine.py``). Unset means ON —
+latency hiding is the default; ``overlap_comm: false`` restores the inline
+schedules. ``overlap_prefetch_depth`` sets how many gather windows are in
+flight ahead of consumption (the scan-carry double/triple buffer).
 """
 
 from __future__ import annotations
@@ -67,7 +74,12 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     reduce_bucket_size: int = Field(int(5e8), ge=0)
     allgather_partitions: bool = True
     allgather_bucket_size: int = Field(int(5e8), ge=0)
+    # None = on (latency hiding is the default schedule); False restores the
+    # inline gather/reduce schedules — see the module docstring
     overlap_comm: Optional[bool] = None
+    # gather windows held in flight ahead of the consuming layer window
+    # (scan-carry buffering depth for the pipelined ZeRO-3 gather scan)
+    overlap_prefetch_depth: int = Field(1, ge=1, le=4)
     load_from_fp32_weights: bool = True
     elastic_checkpoint: bool = False
     # legacy flat key — migrated into offload_optimizer in model_post_init (not a
@@ -91,6 +103,12 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     # reduce-scatter + all-gather instead of a full-precision psum.
     zero_quantized_weights: bool = False
     zero_quantized_gradients: bool = False
+    # opt-in: gather the LM head through the dequant-FUSED matmul
+    # (comm/quantized.quantized_matmul_reshard) — the int payload is the only
+    # gathered artifact and dequantization happens in the logits matmul's
+    # prologue. Separate knob because head fake-quant noise perturbs the
+    # logits directly (the block weights' noise washes through layernorms).
+    zero_quantized_head: bool = False
     zero_quantize_bits: int = Field(8, ge=4, le=8)       # 8 or 4 (int4 packed)
     zero_quantize_block_size: int = Field(256, ge=8)     # elements per scale/zp
     zero_quantize_stochastic: bool = False               # unbiased rounding
@@ -113,6 +131,11 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     @property
     def quantized_comm_enabled(self) -> bool:
         return self.zero_quantized_weights or self.zero_quantized_gradients
+
+    @property
+    def overlap_comm_effective(self) -> bool:
+        """``overlap_comm`` with the unset default resolved to ON."""
+        return self.overlap_comm is not False
 
     @property
     def offload_optimizer_device(self) -> str:

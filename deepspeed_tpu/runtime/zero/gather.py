@@ -16,22 +16,44 @@ equivalent control point is the *structure of the layer loop* the compiler sees:
   gather for chunk ``i`` overlaps chunk ``i-1``'s tail compute under XLA's
   latency-hiding scheduler. That IS the prefetch-bucket trade the reference
   tunes by hand with side streams.
+- **software pipelining** (``overlap_comm``, on by default): the windowed scan
+  alone is NOT a latency-hiding scheduler — window ``i``'s gather is issued
+  and consumed in the same scan iteration, so XLA has nothing to overlap it
+  under. The pipelined scan restructures the loop so iteration ``i`` *issues*
+  the gather for window ``i+d`` (``d = overlap_prefetch_depth``) and
+  *consumes* the window gathered ``d`` iterations ago, held in the scan
+  carry. The in-flight gather has no data dependence on the current window's
+  matmuls, so the async-collective scheduler can run the (quantized) wire
+  under compute — ZeRO-Infinity's double-buffered layer prefetch
+  (``runtime/zero/infinity.py``), replicated on the device wire. Numerics are
+  unchanged: the same gathers feed the same body in the same order.
 
 ``zero3_layer_scan`` picks the window ``k`` from the configured knobs:
 ``stage3_prefetch_bucket_size`` (elements) sets the gather granularity,
 ``stage3_max_live_parameters`` caps the live set —
 ``k = clamp(prefetch // per_layer, 1, min(L, max_live // per_layer))``, rounded
 down to a divisor of ``L``. ``k == 1`` (no active config, stage < 3, tight
-max_live, or sub-layer prefetch) reduces to the plain per-layer scan.
+max_live, or sub-layer prefetch) reduces to the per-layer schedule (which the
+pipelined scan still overlaps layer-by-layer).
 
 The engine binds the config around tracing (:func:`gather_window`); models call
 :func:`zero3_layer_scan` instead of a bare ``lax.scan`` over layers. Tests
-assert the knob moves compiled peak memory via ``compiled.memory_analysis()``.
+assert the knob moves compiled peak memory via ``compiled.memory_analysis()``
+and that the pipelined schedule matches the inline one bitwise.
+
+The same scan is also the emission point for the *bucketed quantized gradient
+reduce-scatter* (:func:`grad_bucket_window` / ``engine._qdp_grads``): when a
+bucket context is bound, each layer's params pass through an identity-forward
+``custom_vjp`` tap whose backward runs that layer's quantized dp
+reduce-scatter + all-gather *inside the backward scan body* — per-bucket
+collectives the scheduler can overlap with the previous layer's backward
+matmuls, instead of one monolithic exchange after the whole backward.
 """
 
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import threading
 from typing import Any, Callable, Optional
 
@@ -117,6 +139,55 @@ def _quantization():
     return QuantizedCommConfig.from_zero_config(cfg)
 
 
+def overlap_depth() -> int:
+    """Pipelined-gather depth from the bound config: how many windows are
+    gathered ahead of consumption. 0 = inline (issue-and-consume in the same
+    iteration) — stage < 3, no config, or ``overlap_comm: false``."""
+    cfg = _active_cfg()
+    if cfg is None or int(getattr(cfg, "stage", 0)) < 3:
+        return 0
+    overlap = getattr(cfg, "overlap_comm", None)
+    if overlap is False:
+        return 0
+    return max(1, int(getattr(cfg, "overlap_prefetch_depth", 1) or 1))
+
+
+# ----------------------------------------------------------------- grad buckets
+@dataclasses.dataclass
+class GradBucketContext:
+    """Bound by the engine around tracing its quantized-gradient program:
+    makes :func:`zero3_layer_scan` tap each layer's params with the per-bucket
+    quantized reduce-scatter (identity forward, the dp exchange in backward).
+
+    ``scale``: the traced loss-scale the cotangents carry (the error-feedback
+    residual is kept in unscaled units across dynamic loss-scale changes).
+    ``resid_key``: leaf name under which the engine injects the per-layer
+    error-feedback residual stack into the scanned blocks pytree."""
+
+    qc: Any
+    axis_name: str = "dp"
+    scale: Any = None
+    resid_key: str = "_qgrad_resid"
+    # trace-time handshake: set True when a scan actually emitted the taps, so
+    # the engine can tell a model that never called zero3_layer_scan apart
+    tapped: bool = False
+
+
+def _active_bucket_ctx() -> Optional[GradBucketContext]:
+    return getattr(_state, "bucket_ctx", None)
+
+
+@contextlib.contextmanager
+def grad_bucket_window(ctx: GradBucketContext):
+    """Bind the gradient-bucket context for the duration of a trace."""
+    prev = getattr(_state, "bucket_ctx", None)
+    _state.bucket_ctx = ctx
+    try:
+        yield
+    finally:
+        _state.bucket_ctx = prev
+
+
 def _gather_layer(tree, gathered_spec, qc, lead_none: bool = False,
                   op_name: str = "qgather[zero3]"):
     """Constrain ``tree`` to its gathered (non-dp) spec — explicitly through
@@ -146,9 +217,43 @@ def _gather_layer(tree, gathered_spec, qc, lead_none: bool = False,
         is_leaf=lambda v: v is None)
 
 
+def _tree_index(tree, i: int):
+    return jax.tree_util.tree_map(lambda x: x[i], tree)
+
+
+def _bucket_tapped_scan(body: Callable, carry: Any, blocks: Any,
+                        bctx: GradBucketContext):
+    """The gradient-bucket schedule: plain per-layer scan with each layer's
+    params passed through the identity-forward reduce tap, so the *backward*
+    scan emits one quantized dp reduce-scatter + all-gather per layer bucket
+    (overlappable with the neighboring layers' backward matmuls). The
+    engine-injected error-feedback residual stack rides the scan xs; its
+    "cotangent" out of ``jax.grad`` is the updated residual."""
+    from ...comm.quantized import grad_bucket_reduce
+
+    resid_stack = None
+    if isinstance(blocks, dict) and bctx.resid_key in blocks:
+        resid_stack = blocks[bctx.resid_key]
+        blocks = {k: v for k, v in blocks.items() if k != bctx.resid_key}
+    bctx.tapped = True
+    resid_injected = resid_stack is not None
+
+    def tapped(c, xs):
+        layer, r = xs if resid_injected else (xs, None)
+        layer = grad_bucket_reduce(
+            layer, r, bctx.scale, bits=bctx.qc.bits,
+            block_size=bctx.qc.block_size, axis_name=bctx.axis_name)
+        return body(c, layer)
+
+    xs = (blocks, resid_stack) if resid_injected else blocks
+    carry, _ = jax.lax.scan(tapped, carry, xs)
+    return carry
+
+
 def zero3_layer_scan(body: Callable, carry: Any, blocks: Any,
                      gathered_spec: Optional[Any] = None):
-    """``lax.scan(body, carry, blocks)`` with ZeRO-3 gather windowing.
+    """``lax.scan(body, carry, blocks)`` with ZeRO-3 gather windowing and
+    (by default) software-pipelined gather prefetch.
 
     ``body``: a scan body ``(carry, layer_params) -> (carry, out)`` (per-layer
     outs are discarded). ``gathered_spec``: pytree of PartitionSpecs matching
@@ -163,13 +268,83 @@ def zero3_layer_scan(body: Callable, carry: Any, blocks: Any,
     int8/int4 payload, and the layer computes on the dequantized values —
     ZeRO++'s qwZ with a straight-through backward (the reverse-path gradient
     reduction stays full precision unless ``zero_quantized_gradients``).
+
+    With ``overlap_comm`` on (the default at stage 3), the window loop is
+    software-pipelined: iteration ``i`` issues the gather for window ``i+d``
+    and consumes the window gathered ``d`` iterations earlier from the scan
+    carry (``d = overlap_prefetch_depth``, clamped so at most
+    ``stage3_max_live_parameters`` params are live). The gathers feeding the
+    body are the same values in the same order — only the issue point moves —
+    so the pipelined forward is bitwise-identical to the inline one (backward
+    cotangents agree to float dtype resolution; XLA fuses the restructured
+    loop's cotangent matmuls differently) while giving XLA's async-collective
+    scheduler a window of independent compute to hide the wire under.
     """
     leaves = jax.tree_util.tree_leaves(blocks)
     if not leaves:
         return carry
+
+    bctx = _active_bucket_ctx()
+    if bctx is not None:
+        # engine's quantized-gradient trace: per-layer grad-reduce taps, no
+        # gather constraints (params enter the shard_map replicated)
+        return _bucket_tapped_scan(body, carry, blocks, bctx)
+
     L = leaves[0].shape[0]
     k = window_size(blocks, L)
     qc = _quantization() if gathered_spec is not None else None
+
+    # ---------------- pipelined (overlap_comm) schedule
+    depth = overlap_depth() if gathered_spec is not None else 0
+    if depth:
+        N = L // k
+        if k <= 1:
+            stacked, lead_none = blocks, False
+
+            def consume(c, w):
+                c, _ = body(c, w)
+                return c
+        else:
+            stacked = jax.tree_util.tree_map(
+                lambda x: x.reshape((N, k) + x.shape[1:]), blocks)
+            lead_none = True
+
+            def consume(c, w):
+                c, _ = jax.lax.scan(body, c, w)
+                return c
+
+        d = min(depth, N - 1)
+        cfg = _active_cfg()
+        max_live = int(getattr(cfg, "stage3_max_live_parameters", 0) or 0)
+        per_win = _params_per_layer(blocks) * k
+        if max_live > 0 and per_win > 0:
+            # depth raises the live set to (d+1) windows; honor the cap
+            d = min(d, max(0, max_live // per_win - 1))
+
+        if d >= 1:
+            def gather(w):
+                return _gather_layer(w, gathered_spec, qc,
+                                     lead_none=lead_none,
+                                     op_name="qgather[zero3/pf]")
+
+            # prologue: the first d windows' gathers are in flight before the
+            # loop starts (ZeRO-Infinity's double-buffer, on the device wire)
+            pref = tuple(gather(_tree_index(stacked, i)) for i in range(d))
+            rest = jax.tree_util.tree_map(lambda x: x[d:], stacked)
+
+            def pbody(cb, w_raw):
+                c, buf = cb
+                nxt = gather(w_raw)   # issue window i+d: no data dependence
+                c = consume(c, buf[0])  # ... on window i's matmuls here
+                return (c, buf[1:] + (nxt,)), None
+
+            (carry, buf), _ = jax.lax.scan(pbody, (carry, pref), rest)
+            for w in buf:  # epilogue: drain the in-flight windows
+                carry = consume(carry, w)
+            return carry
+        # d clamped to 0 (max_live too tight for double buffering): inline
+
+    # ---------------- inline (issue-and-consume-in-iteration) schedule
     if k <= 1:
         if qc is None:
             carry, _ = jax.lax.scan(body, carry, blocks)

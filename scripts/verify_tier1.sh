@@ -46,6 +46,21 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 
+# --- overlap gate (docs/COMM_COMPRESSION.md "Overlap & fusion") -----------
+# the pipelined quantized-gather scan, bucketed gradient exchange, overlap
+# ledger arithmetic, and the collective/unoverlapped-quantized-collective
+# rule's fire/stay-silent behavior must stay green even when the full suite
+# hits its budget mid-run (the dslint gate above already proves the default
+# bench row is clean under the rule).
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_overlap.py -q -m 'not slow' \
+        -p no:cacheprovider -p no:randomly > /tmp/_t1_overlap.log 2>&1; then
+    echo "verify_tier1: FAIL — overlap tests (tests/test_overlap.py):" >&2
+    tail -30 /tmp/_t1_overlap.log >&2
+    exit 1
+fi
+grep -aE '^[0-9]+ passed' /tmp/_t1_overlap.log || true
+
 # --- fault-injection smoke (docs/RESILIENCE.md) ---------------------------
 # one SIGKILL injected mid-checkpoint + successful auto-resume on the CPU
 # mesh: the crash-consistency contract regressing must fail the gate, not

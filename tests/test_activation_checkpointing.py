@@ -43,6 +43,12 @@ def _params(rng):
 
 
 def test_checkpoint_matches_plain(rng):
+    # Both grads are compiled: remat determinism is an intra-program XLA
+    # guarantee, and the engine only ever remats inside jit. Eager op-by-op
+    # dispatch compiles the recomputed forward as separate tiny programs whose
+    # fusion/layout choices differ at the last ulp from the plain backward —
+    # that divergence is a dispatch artifact, not a remat correctness property
+    # (this exact comparison, unjitted, failed from the seed onward).
     params = _params(rng)
     x = jnp.asarray(rng.normal(size=(4, 16)), jnp.float32)
 
@@ -52,8 +58,8 @@ def test_checkpoint_matches_plain(rng):
     def loss_ckpt(p):
         return checkpoint(lambda q: _mlp(q, x), p)
 
-    g1 = jax.grad(loss_plain)(params)
-    g2 = jax.grad(loss_ckpt)(params)
+    g1 = jax.jit(jax.grad(loss_plain))(params)
+    g2 = jax.jit(jax.grad(loss_ckpt))(params)
     for k in g1:
         np.testing.assert_array_equal(np.asarray(g1[k]), np.asarray(g2[k]))
 
