@@ -328,6 +328,7 @@ def _worker(cfg: dict) -> None:
           "sd_aot": _worker_sd_aot,
           "kernels_aot": _worker_kernels_aot,
           "infinity_aot": _worker_infinity_aot,
+          "chaos_mttr": _worker_chaos_mttr,
           "moe_aot": _worker_moe_aot}[cfg["kind"]]
     print(json.dumps(fn(cfg)))
 
@@ -574,6 +575,83 @@ def _worker_train(cfg: dict) -> dict:
                                        "n_params", "wire_bytes_per_step")
                              if k in runner.last_stats}
     return out
+
+
+def _worker_chaos_mttr(cfg: dict) -> dict:
+    """MTTR row (docs/RESILIENCE.md "In-run health"): inject a NaN at a known
+    data cursor and measure the self-heal — detection + rollback latency,
+    steps to rejoin a pre-divergence loss level, and the poisoned cursors
+    provably excluded. Runs the REAL engine health loop (sentinel config +
+    chaos injector), not a simulation."""
+    import math
+    import tempfile
+    import time as _time
+
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import build_gpt
+    from deepspeed_tpu.models import gpt as gpt_mod
+    from deepspeed_tpu.resilience import FaultPlan, install_plan
+
+    mcfg = gpt_mod.PRESETS[cfg["model"]]
+    model, mcfg = build_gpt(mcfg)
+    micro_bs, seq = cfg["micro_bs"], cfg["seq"]
+    steps, nan_at = int(cfg["steps"]), int(cfg["nan_at"])
+    with tempfile.TemporaryDirectory() as td:
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model,
+            config={
+                "train_micro_batch_size_per_gpu": micro_bs,
+                "optimizer": {"type": "AdamW", "params": {"lr": 3e-4}},
+                "bf16": {"enabled": False},
+                "steps_per_print": 0,
+                "resilience": {
+                    "enabled": True, "save_dir": td,
+                    "install_signal_handlers": False,
+                    "sentinel": {"enabled": True, "warmup_steps": 1,
+                                 "checkpoint_interval": 1,
+                                 "cursor_checkpointable": True}},
+            })
+        install_plan(FaultPlan.from_dict({"nan_at_step": nan_at}))
+
+        def make_batch(cursor):
+            r = np.random.default_rng(cursor)
+            return {"input_ids": r.integers(
+                0, mcfg.vocab_size, size=(micro_bs, seq), dtype=np.int32)}
+
+        losses, rollback = [], None
+        detect_wall = heal_wall = None
+        t0 = _time.monotonic()
+        while engine.global_steps < steps:
+            m = engine.train_batch(make_batch(engine.data_cursor))
+            if m.get("skipped_batch"):
+                continue
+            h = m.get("health", {}).get("rolled_back")
+            if h:
+                rollback = h
+                detect_wall = _time.monotonic() - t0
+            elif math.isfinite(float(m["loss"])):
+                losses.append(float(m["loss"]))
+                if rollback is not None and heal_wall is None:
+                    heal_wall = _time.monotonic() - t0
+        install_plan(None)
+        health = engine._health
+        return {
+            "config": cfg["name"],
+            "healed": rollback is not None and math.isfinite(losses[-1]),
+            "rollbacks": health.rollbacks,
+            "rollback_latency_s": (round(rollback["latency_s"], 4)
+                                   if rollback else None),
+            # wall-clock from divergence detection to the first healthy
+            # post-heal step — the row's MTTR
+            "mttr_s": (round(heal_wall - detect_wall, 3)
+                       if heal_wall is not None else None),
+            "skipped_cursors": health.skipped_cursors,
+            "final_loss": round(losses[-1], 4) if losses else None,
+            "steps": int(engine.global_steps),
+            "data_cursor": int(engine.data_cursor),
+        }
 
 
 def _worker_moe_train(cfg: dict) -> dict:
@@ -1298,6 +1376,13 @@ def cpu_fallback_configs() -> list:
          "model": "gpt2-125m", "micro_bs": 2, "seq": 128, "stage": 3,
          "steps": 3, "precision": "fp32", "quantized_weights": True,
          "force_cpu": True},
+    ] + [
+        # MTTR evidence: NaN at a known cursor -> sentinel rollback ->
+        # poisoned-batch skip -> rejoin; the heal mechanics are
+        # chip-independent (host-side detection + checkpoint restore)
+        {"kind": "chaos_mttr", "name": "cpu-chaos-nan-mttr",
+         "model": "gpt2-125m", "micro_bs": 2, "seq": 128, "steps": 5,
+         "nan_at": 3, "force_cpu": True},
     ] + [{"kind": "inference", "name": "cpu-fallback-decode", "model": "gpt2-125m",
           "batch": 1, "prompt": 32, "gen": 16, "reps": 3, "force_cpu": True},
          # real-TPU-compiler evidence even when the tunnel is down

@@ -174,11 +174,54 @@ class CheckpointUncommittedLoadRule(Rule):
             )
 
 
+class RollbackWithoutDataCursorRule(Rule):
+    """Divergence rollback is armed (``resilience.sentinel.enabled``) but the
+    dataloader is not cursor-checkpointable. Rollback restores state AND the
+    data cursor, then skips the poisoned cursor window — which only excludes
+    the poison if the dataloader is a deterministic function of
+    ``engine.data_cursor`` (declared via ``sentinel.cursor_checkpointable``)
+    or checkpoints its own position through ``engine.resume_state_provider``.
+    Without either, a healed run silently re-feeds whatever the iterator
+    happens to produce next: the poisoned batch may replay (rollback loop
+    until the budget trips) or healthy data may be skipped."""
+
+    rule_id = "config/rollback-without-data-cursor"
+    default_severity = Severity.WARNING
+    description = "divergence rollback armed without a cursor-checkpointable dataloader"
+
+    def check_context(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        res = getattr(ctx.config, "resilience", None)
+        sen = getattr(res, "sentinel", None)
+        if res is None or sen is None:
+            return
+        if not (getattr(res, "enabled", False)
+                and getattr(sen, "enabled", False)):
+            return
+        if getattr(sen, "cursor_checkpointable", False):
+            return
+        if (ctx.engine is not None
+                and getattr(ctx.engine, "resume_state_provider", None)
+                is not None):
+            return
+        yield self.finding(
+            "resilience.sentinel.enabled arms divergence rollback, but "
+            "nothing declares the dataloader cursor-checkpointable — after a "
+            "rollback the data-cursor skip cannot guarantee the poisoned "
+            "batches are excluded (or that healthy ones aren't)",
+            location="config.resilience.sentinel",
+            suggestion="drive batches from engine.data_cursor and set "
+                       "sentinel.cursor_checkpointable=true, or register "
+                       "engine.resume_state_provider to checkpoint the "
+                       "dataloader position",
+        )
+
+
 def config_rules() -> List[Rule]:
     return [QuantizedWireMissingRule(), QuantizedWeightsBelowStage3Rule(),
-            LossScaleDtypeRule(), CheckpointUncommittedLoadRule()]
+            LossScaleDtypeRule(), CheckpointUncommittedLoadRule(),
+            RollbackWithoutDataCursorRule()]
 
 
 __all__ = ["QuantizedWireMissingRule", "QuantizedWeightsBelowStage3Rule",
            "LossScaleDtypeRule", "CheckpointUncommittedLoadRule",
-           "config_rules"]
+           "RollbackWithoutDataCursorRule", "config_rules"]

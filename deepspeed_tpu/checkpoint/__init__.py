@@ -117,6 +117,9 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
             "global_steps": engine.global_steps,
             "micro_steps": engine.micro_steps,
             "skipped_steps": engine.skipped_steps,
+            # deterministic dataloader index: resume and divergence rollback
+            # both land on the exact next batch (docs/RESILIENCE.md)
+            "data_cursor": int(getattr(engine, "data_cursor", 0)),
             "client_state": client_state or {},
             "ds_config": engine.config.model_dump(mode="json"),
             "rng_key": (np.asarray(rng, dtype=np.uint32).tolist()
@@ -222,6 +225,10 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     engine.global_steps = int(meta.get("global_steps", 0))
     engine.micro_steps = int(meta.get("micro_steps", 0))
     engine.skipped_steps = int(meta.get("skipped_steps", 0))
+    # pre-cursor checkpoints (older format) approximate the cursor with the
+    # batch count a skip-free run would have consumed
+    engine.data_cursor = int(meta.get(
+        "data_cursor", engine.global_steps + engine.skipped_steps))
     if meta.get("rng_key") is not None:
         # step-exact resume: restore the host PRNG chain, so the resumed
         # run's _next_rng splits reproduce the uninterrupted run bitwise

@@ -70,12 +70,34 @@ class WireLedger:
 
     records: Dict[str, WireRecord] = field(default_factory=dict)
     overlap: Optional[Dict[str, float]] = None
+    # graceful-degradation history: ops demoted off the quantized wire by the
+    # health subsystem (resilience/rollback.py WireDemotionController) — kept
+    # in the ledger so comms_summary() shows the wire's true state, not just
+    # its configured one
+    demotions: list = field(default_factory=list)
 
     def record(self, op_name: str, logical_bytes: int, wire_bytes: int) -> None:
         rec = self.records.setdefault(op_name, WireRecord())
         rec.count += 1
         rec.logical_bytes += int(logical_bytes)
         rec.wire_bytes += int(wire_bytes)
+
+    def record_demotion(self, op: str, step: int, reason: str) -> None:
+        """A quantized op fell back to the full-precision wire at ``step``."""
+        self.demotions.append({"op": op, "step": int(step), "reason": reason,
+                               "repromoted_step": None})
+
+    def record_repromotion(self, op: str, step: int) -> None:
+        """The newest open demotion of ``op`` ended at ``step``."""
+        for d in reversed(self.demotions):
+            if d["op"] == op and d["repromoted_step"] is None:
+                d["repromoted_step"] = int(step)
+                return
+
+    def demoted_ops(self) -> list:
+        """Ops currently on the full-precision wire (open demotions)."""
+        return [d["op"] for d in self.demotions
+                if d["repromoted_step"] is None]
 
     def ratio(self, prefix: Optional[str] = None) -> float:
         """Aggregate logical/wire compression ratio over ops matching
@@ -118,6 +140,12 @@ class WireLedger:
                 f"exposed={o.get('exposed_us', 0):.0f}us "
                 f"overlapped={o.get('overlapped_us', 0):.0f}us "
                 f"({o.get('hidden_frac', 0.0):.0%} hidden)")
+        for d in self.demotions:
+            end = (f"re-promoted at step {d['repromoted_step']}"
+                   if d["repromoted_step"] is not None else "STILL DEMOTED")
+            lines.append(
+                f"  degraded wire: {d['op']} -> full-precision at step "
+                f"{d['step']} ({d['reason']}); {end}")
         out = "\n".join(lines)
         log_dist(out)
         return out
@@ -136,6 +164,7 @@ class WireLedger:
 
     def reset(self) -> None:
         self.records.clear()
+        self.demotions.clear()
 
 
 wire_ledger = WireLedger()

@@ -104,32 +104,87 @@ class JSONLMonitor:
                      "unix_time": self._time.time()}) + "\n")
 
 
+class _SafeBackend:
+    """Degraded-mode wrapper: a backend whose sink fails (full disk, sick
+    remote FS, wandb outage) buffers events in memory instead of killing the
+    training step, and re-flushes the buffer — in order — once the sink
+    recovers. The buffer is bounded (oldest events drop first); entering and
+    leaving degraded mode each log once. Part of the resilience layer's
+    graceful-degradation contract (``docs/RESILIENCE.md`` "In-run health")."""
+
+    def __init__(self, backend, buffer_limit: int = 4096):
+        self.backend = backend
+        self.buffer_limit = int(buffer_limit)
+        self._buffer: List[Event] = []
+        self.degraded = False
+        self.dropped = 0
+
+    @property
+    def name(self) -> str:
+        return type(self.backend).__name__
+
+    def write_events(self, events: Sequence[Event]) -> None:
+        pending = self._buffer + list(events)
+        try:
+            self.backend.write_events(pending)
+        except Exception as e:
+            if len(pending) > self.buffer_limit:
+                self.dropped += len(pending) - self.buffer_limit
+                pending = pending[-self.buffer_limit:]
+            self._buffer = pending
+            if not self.degraded:
+                self.degraded = True
+                logger.warning(
+                    f"monitor backend {self.name} failed ({e}); degrading to "
+                    f"in-memory buffering (limit {self.buffer_limit} events) "
+                    f"— training continues")
+            return
+        if self.degraded:
+            logger.warning(
+                f"monitor backend {self.name} recovered; "
+                f"{len(self._buffer)} buffered events flushed"
+                + (f", {self.dropped} dropped" if self.dropped else ""))
+            self.degraded = False
+        self._buffer = []
+
+
 class MonitorMaster:
-    """Fan-out to every enabled backend; only process 0 writes."""
+    """Fan-out to every enabled backend; only process 0 writes. Each backend
+    rides a :class:`_SafeBackend`: a failing sink buffers in memory and
+    never fails the training step."""
 
     def __init__(self, monitor_config, extra_backends: Optional[List] = None):
-        self.backends: List = list(extra_backends or [])
+        self.backends: List = [_SafeBackend(b) for b in (extra_backends or [])]
         self.enabled = jax.process_index() == 0
         if not self.enabled:
             return
         tb = monitor_config.tensorboard
         if tb.enabled:
             try:
-                self.backends.append(TensorBoardMonitor(tb.output_path, tb.job_name))
+                self.backends.append(
+                    _SafeBackend(TensorBoardMonitor(tb.output_path, tb.job_name)))
             except Exception as e:  # tensorboardX missing/broken shouldn't kill training
                 logger.warning(f"tensorboard monitor disabled: {e}")
         wb = getattr(monitor_config, "wandb", None)
         if wb is not None and wb.enabled:
             try:
-                self.backends.append(WandbMonitor(wb.team, wb.group, wb.project))
+                self.backends.append(
+                    _SafeBackend(WandbMonitor(wb.team, wb.group, wb.project)))
             except Exception as e:  # wandb not installed / offline init failure
                 logger.warning(f"wandb monitor disabled: {e}")
         cs = monitor_config.csv_monitor
         if cs.enabled:
-            self.backends.append(CSVMonitor(cs.output_path, cs.job_name))
+            self.backends.append(
+                _SafeBackend(CSVMonitor(cs.output_path, cs.job_name)))
         jl = getattr(monitor_config, "jsonl", None)
         if jl is not None and jl.enabled:
-            self.backends.append(JSONLMonitor(jl.output_path, jl.job_name))
+            self.backends.append(
+                _SafeBackend(JSONLMonitor(jl.output_path, jl.job_name)))
+
+    @property
+    def degraded(self) -> bool:
+        """Whether any backend is currently buffering in degraded mode."""
+        return any(b.degraded for b in self.backends)
 
     def write_events(self, events: Sequence[Event]) -> None:
         if not self.enabled:

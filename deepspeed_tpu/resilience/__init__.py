@@ -16,12 +16,28 @@ The subsystem (see ``docs/RESILIENCE.md``) turns "a checkpoint exists" into
 - :mod:`.preemption` — SIGTERM/SIGINT → drain flag → emergency checkpoint →
   exit :data:`PREEMPTED_EXIT_CODE`.
 - :mod:`.events` — recovery-event export (JSONL + monitor backends).
+- :mod:`.watchdog` — :class:`HealthWatchdog`: per-phase deadlines over the
+  step loop (compile/step/collective/checkpoint); stall → stack dump + wire
+  ledger + recovery event + drain escalation; straggler identification.
+- :mod:`.rollback` — :class:`SpikeDetector` (EMA z-score divergence
+  sentinel), :class:`HealthController` (auto-rollback to the newest
+  committed checkpoint + deterministic data-cursor skip, in-memory anchor
+  fallback), :class:`WireDemotionController` (quantized-wire demotion to
+  fp32 on repeated overflow, re-promotion after a clean window).
 
 Nothing here imports jax at module scope: the elastic agent (a supervisor
 that must never acquire the accelerator) uses the same machinery.
 """
 
-from .chaos import FAULT_PLAN_ENV, FaultPlan, fault_point, get_fault_plan, install_plan
+from .chaos import (
+    FAULT_PLAN_ENV,
+    FaultPlan,
+    TrainingFaults,
+    fault_point,
+    get_fault_plan,
+    install_plan,
+    training_faults,
+)
 from .events import EVENTS_FILENAME, RecoveryLog, read_events
 from .manifest import (
     CHECKSUMS,
@@ -48,11 +64,27 @@ from .manifest import (
 )
 from .preemption import PREEMPTED_EXIT_CODE, PreemptionGuard
 from .retry import DEFAULT_WRITER, RetryBudgetExceeded, RetryingWriter
+from .rollback import (
+    DivergenceError,
+    HealthController,
+    SpikeDetector,
+    WireDemotionController,
+)
+from .watchdog import (
+    STACKS_FILENAME,
+    HealthWatchdog,
+    allgather_host_stats,
+    identify_stragglers,
+)
 
 __all__ = [
     "CheckpointCorruptionError", "UncommittedTagError",
-    "FaultPlan", "FAULT_PLAN_ENV", "fault_point", "get_fault_plan",
-    "install_plan",
+    "FaultPlan", "TrainingFaults", "FAULT_PLAN_ENV", "fault_point",
+    "get_fault_plan", "install_plan", "training_faults",
+    "HealthWatchdog", "identify_stragglers", "allgather_host_stats",
+    "STACKS_FILENAME",
+    "SpikeDetector", "HealthController", "WireDemotionController",
+    "DivergenceError",
     "PreemptionGuard", "PREEMPTED_EXIT_CODE",
     "RecoveryLog", "read_events", "EVENTS_FILENAME",
     "RetryingWriter", "RetryBudgetExceeded", "DEFAULT_WRITER",

@@ -54,6 +54,28 @@ class FaultPlan:
     (slow remote FS). ``fail_io_times``: raise ``OSError`` on the first N I/O
     calls — must be absorbed by the
     :class:`~deepspeed_tpu.resilience.retry.RetryingWriter`.
+
+    Training-path injectors (the in-run health loop's fault surface,
+    ``docs/RESILIENCE.md`` "In-run health"; consumed by the engine via
+    :func:`training_faults` once per ``train_batch``):
+
+    - ``nan_at_step`` (the ``nan-at-step:N`` injector): the batch consumed at
+      data-cursor ``N`` reports a NaN loss — the divergence sentinel must
+      detect it, roll back to the newest committed checkpoint, and skip the
+      poisoned cursor. Keyed to the *data cursor*, not the global step, so a
+      successful rollback-with-skip provably never re-triggers it (and a
+      broken skip loops until ``max_rollbacks`` trips — a loud failure).
+    - ``stall_collective`` (the ``stall-collective:S`` injector): a one-shot
+      host-side stall of ``S`` seconds inside the engine's ``collective``
+      watchdog phase, at the first executed batch with data cursor >=
+      ``stall_collective_at_step`` — a hung/straggling collective the
+      hang watchdog must detect within its deadline.
+    - ``ef_overflow_steps`` (the ``ef-overflow`` injector): force the next
+      ``K`` executed steps to *account* as quantized-gradient overflows
+      (``metrics["overflow"] = True``) — drives the wire-demotion policy
+      (repeated overflow -> fp32 wire) without having to construct a real
+      error-feedback blow-up. The in-program overflow handling itself
+      (skip + EF residual reset) is exercised by the real overflow tests.
     """
 
     kill_at_phase: Optional[str] = None
@@ -63,16 +85,24 @@ class FaultPlan:
     stall_io_seconds: float = 0.0
     stall_io_times: int = 1
     fail_io_times: int = 0
+    # training-path injectors
+    nan_at_step: Optional[int] = None
+    stall_collective: float = 0.0
+    stall_collective_at_step: int = 1
+    ef_overflow_steps: int = 0
 
     # runtime counters (not part of the plan spec)
     _save_index: int = dataclasses.field(default=-1, repr=False)
     _io_calls: int = dataclasses.field(default=0, repr=False)
     _io_failures_left: int = dataclasses.field(default=0, repr=False)
     _stalls_left: int = dataclasses.field(default=0, repr=False)
+    _collective_stall_fired: bool = dataclasses.field(default=False, repr=False)
+    _ef_overflows_left: int = dataclasses.field(default=0, repr=False)
 
     def __post_init__(self) -> None:
         self._io_failures_left = int(self.fail_io_times)
         self._stalls_left = int(self.stall_io_times)
+        self._ef_overflows_left = int(self.ef_overflow_steps)
 
     # ------------------------------------------------------------- construction
     @classmethod
@@ -137,6 +167,29 @@ class FaultPlan:
                 logger.warning(f"chaos: truncated {path}")
             self.truncate_manifest = False
 
+    def training_faults(self, cursor: int) -> "TrainingFaults":
+        """Resolve the training-path injections armed for the batch at data
+        cursor ``cursor`` (called by the engine once per executed batch)."""
+        nan = self.nan_at_step is not None and cursor == int(self.nan_at_step)
+        if nan:
+            logger.warning(f"chaos: poisoning batch at data cursor {cursor} "
+                           f"(loss -> NaN)")
+        stall = 0.0
+        if (self.stall_collective > 0 and not self._collective_stall_fired
+                and cursor >= int(self.stall_collective_at_step)):
+            self._collective_stall_fired = True
+            stall = float(self.stall_collective)
+            logger.warning(
+                f"chaos: stalling collective for {stall}s at cursor {cursor}")
+        ef = False
+        if self._ef_overflows_left > 0:
+            self._ef_overflows_left -= 1
+            ef = True
+            logger.warning(
+                f"chaos: forcing quantized-gradient overflow at cursor "
+                f"{cursor} ({self._ef_overflows_left} more)")
+        return TrainingFaults(nan_loss=nan, stall_s=stall, ef_overflow=ef)
+
     def on_io(self, what: str) -> None:
         """Called by RetryingWriter before each I/O attempt."""
         self._io_calls += 1
@@ -148,6 +201,15 @@ class FaultPlan:
         if self._io_failures_left > 0:
             self._io_failures_left -= 1
             raise OSError(f"chaos: injected transient I/O error on {what!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainingFaults:
+    """Injections resolved for one executed batch (all off when no plan)."""
+
+    nan_loss: bool = False
+    stall_s: float = 0.0
+    ef_overflow: bool = False
 
 
 # ------------------------------------------------------------------ global plan
@@ -188,5 +250,17 @@ def fault_point(phase: str, index: Optional[int] = None,
         plan.fault_point(phase, index=index, tag_dir=tag_dir)
 
 
-__all__ = ["FaultPlan", "FAULT_PLAN_ENV", "install_plan", "get_fault_plan",
-           "fault_point"]
+_NO_FAULTS = TrainingFaults()
+
+
+def training_faults(cursor: int) -> TrainingFaults:
+    """The training-path injections armed for data cursor ``cursor``
+    (all-off sentinel when no plan is installed)."""
+    plan = get_fault_plan()
+    if plan is None:
+        return _NO_FAULTS
+    return plan.training_faults(cursor)
+
+
+__all__ = ["FaultPlan", "TrainingFaults", "FAULT_PLAN_ENV", "install_plan",
+           "get_fault_plan", "fault_point", "training_faults"]

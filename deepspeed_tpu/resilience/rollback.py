@@ -1,0 +1,367 @@
+"""Divergence sentinels, automatic rollback, and degraded-mode fallbacks.
+
+The in-run numerical half of self-healing training (``docs/RESILIENCE.md``
+"In-run health"). Three cooperating pieces, orchestrated per optimizer step
+by :class:`HealthController` (the engine calls ``after_step(metrics)`` once
+per completed step — the metrics are already on host, so every check here is
+O(1) host arithmetic, no extra device work):
+
+- :class:`SpikeDetector` — EMA z-score over a scalar stream (loss,
+  grad-norm). A non-finite value fires immediately; a finite value fires
+  when it sits more than ``zscore`` standard deviations above the EMA mean
+  (EMA variance, warmup-gated). The spike itself is NOT absorbed into the
+  EMA, so a detector that just fired keeps its healthy baseline.
+
+- Rollback (:meth:`HealthController._rollback`): restore the newest
+  *committed* checkpoint (PR 3 protocol — the anchor is always verifiable),
+  falling back to the in-memory snapshot when the disk anchor is missing or
+  unreadable, then arm a deterministic **data-cursor skip**: every batch
+  consumed since the restored checkpoint (``[restored_cursor,
+  cursor_at_divergence)``) is skipped without executing, so the run rejoins
+  a healthy trajectory without replaying the poison. ``max_rollbacks``
+  bounds the loop — a poison the skip cannot clear raises
+  :class:`DivergenceError` instead of thrashing chip time forever.
+
+- :class:`WireDemotionController` — graceful degradation of the quantized
+  gradient wire: ``demote_after`` consecutive overflow steps demote the
+  exchange to the fp32 wire (an engine recompile; recorded in the wire
+  ledger so ``comms_summary()`` shows it), and ``repromote_after``
+  consecutive clean steps restore the quantized wire (with the
+  error-feedback residuals reset — a stale residual from before the blow-up
+  would re-poison the first re-promoted step).
+
+Checkpoint-I/O degradation: the controller's periodic auto-save
+(``checkpoint_interval``) absorbs I/O failure — the step is never killed;
+the anchor degrades to the in-memory snapshot and a
+``checkpoint_io_degraded`` recovery event marks the run record.
+
+Imports of jax live inside methods: the resilience package stays importable
+by the supervisor (elastic agent) without acquiring an accelerator.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils.logging import log_dist, logger
+
+
+class DivergenceError(RuntimeError):
+    """Self-healing exhausted: rollback budget spent or no anchor exists."""
+
+
+class SpikeDetector:
+    """EMA z-score spike detector over one scalar stream.
+
+    ``update(value)`` returns a reason string when ``value`` is divergent
+    (non-finite, or a > ``zscore``-sigma spike after ``warmup`` healthy
+    samples), else None. Only healthy samples update the EMA statistics.
+
+    ``min_rel``: relative-deviation floor. A converged loss curve drives the
+    EMA variance toward zero, where ordinary batch-to-batch wobble measures
+    as tens of sigma — a spike must ALSO exceed ``min_rel * |mean|`` above
+    the mean before it counts as divergence, so the detector stays calm on
+    flat curves without losing real blow-ups (which are never 1% events).
+    """
+
+    def __init__(self, zscore: float = 6.0, beta: float = 0.98,
+                 warmup: int = 20, min_rel: float = 0.1, name: str = "loss"):
+        self.zscore = float(zscore)
+        self.beta = float(beta)
+        self.warmup = int(warmup)
+        self.min_rel = float(min_rel)
+        self.name = name
+        self.mean = 0.0
+        self.var = 0.0
+        self.count = 0
+
+    def update(self, value: float) -> Optional[str]:
+        v = float(value)
+        if not math.isfinite(v):
+            return f"non-finite {self.name} ({v})"
+        if self.count >= self.warmup:
+            std = math.sqrt(max(self.var, 1e-12))
+            z = (v - self.mean) / std
+            floor = self.min_rel * max(abs(self.mean), 1e-8)
+            if z > self.zscore and (v - self.mean) > floor:
+                return (f"{self.name} spike: {v:.4g} is {z:.1f} sigma above "
+                        f"EMA {self.mean:.4g} (threshold {self.zscore}, "
+                        f"rel floor {self.min_rel})")
+        b = self.beta if self.count > 0 else 0.0
+        delta = v - self.mean
+        self.mean = b * self.mean + (1.0 - b) * v
+        self.var = b * (self.var + (1.0 - b) * delta * delta)
+        self.count += 1
+        return None
+
+    def state_dict(self) -> Dict[str, float]:
+        return {"mean": self.mean, "var": self.var, "count": self.count}
+
+
+class WireDemotionController:
+    """Overflow-driven demotion of the quantized gradient wire (see module
+    docstring). ``after_step`` returns "demoted"/"repromoted"/None."""
+
+    def __init__(self, engine, demote_after: int = 3, repromote_after: int = 100,
+                 recovery_log=None):
+        self.engine = engine
+        self.demote_after = int(demote_after)
+        self.repromote_after = int(repromote_after)
+        self.recovery_log = recovery_log
+        self.consecutive_overflows = 0
+        self.clean_steps = 0
+        self.demotions = 0
+
+    @property
+    def active(self) -> bool:
+        return bool(self.engine._qcomm.gradients)
+
+    def after_step(self, metrics: Dict[str, Any]) -> Optional[str]:
+        if not self.active:
+            return None
+        overflow = bool(metrics.get("overflow", False))
+        if not self.engine._qgrad_demoted:
+            self.consecutive_overflows = (
+                self.consecutive_overflows + 1 if overflow else 0)
+            if self.consecutive_overflows >= self.demote_after:
+                self._demote()
+                return "demoted"
+            return None
+        self.clean_steps = 0 if overflow else self.clean_steps + 1
+        if self.clean_steps >= self.repromote_after:
+            self._repromote()
+            return "repromoted"
+        return None
+
+    def _demote(self) -> None:
+        from ..comm.runtime_accounting import wire_ledger
+
+        eng = self.engine
+        step = int(eng.global_steps)
+        reason = (f"{self.consecutive_overflows} consecutive overflow steps "
+                  f"on the quantized gradient exchange")
+        logger.error(
+            f"wire demotion: qgrad -> fp32 wire at step {step} ({reason}); "
+            f"re-promotion after {self.repromote_after} clean steps")
+        eng._qgrad_demoted = True
+        eng._compile_steps()
+        wire_ledger.record_demotion("qgrad", step, reason)
+        self.demotions += 1
+        self.consecutive_overflows = 0
+        self.clean_steps = 0
+        if self.recovery_log is not None:
+            self.recovery_log.record("wire_demoted", step=step, op="qgrad",
+                                     reason=reason)
+
+    def _repromote(self) -> None:
+        from ..comm.runtime_accounting import wire_ledger
+
+        import jax.numpy as jnp
+
+        eng = self.engine
+        step = int(eng.global_steps)
+        log_dist(f"wire re-promotion: qgrad back to the quantized wire at "
+                 f"step {step} ({self.clean_steps} clean steps)")
+        # stale EF residuals predate the blow-up; a fresh start is the only
+        # sound baseline for the re-promoted exchange
+        for key in ("qgrad_residual", "qgrad_bucket_residual"):
+            if key in eng.state:
+                eng.state[key] = jnp.zeros_like(eng.state[key])
+        eng._qgrad_demoted = False
+        eng._compile_steps()
+        wire_ledger.record_repromotion("qgrad", step)
+        self.clean_steps = 0
+        if self.recovery_log is not None:
+            self.recovery_log.record("wire_repromoted", step=step, op="qgrad")
+
+
+class HealthController:
+    """Per-step health orchestration for one engine (see module docstring)."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        res = engine.config.resilience
+        self.cfg = res.sentinel
+        self.save_dir = res.save_dir
+        self.recovery_log = engine._recovery_log
+        self.loss_detector = SpikeDetector(
+            zscore=self.cfg.zscore, beta=self.cfg.ema_beta,
+            warmup=self.cfg.warmup_steps,
+            min_rel=self.cfg.min_relative_spike, name="loss")
+        self.grad_detector = (
+            SpikeDetector(zscore=self.cfg.grad_norm_zscore,
+                          beta=self.cfg.ema_beta,
+                          warmup=self.cfg.warmup_steps,
+                          min_rel=self.cfg.min_relative_spike,
+                          name="grad_norm")
+            if self.cfg.grad_norm_zscore > 0 else None)
+        self.demotion = WireDemotionController(
+            engine, demote_after=res.degraded.demote_after,
+            repromote_after=res.degraded.repromote_after,
+            recovery_log=self.recovery_log)
+        self.rollbacks = 0
+        self.skipped_cursors: List[int] = []
+        self._skip_until: Optional[int] = None
+        self._memory_snapshot: Optional[Dict[str, Any]] = None
+        self.checkpoint_io_degraded = False
+        if self.cfg.enabled and self.cfg.memory_fallback:
+            # the init-time state (possibly just auto-resumed) is the floor
+            # anchor: a divergence before the first committed save still has
+            # somewhere sound to land
+            self._take_memory_snapshot()
+
+    # ------------------------------------------------------------- skip set
+    def should_skip(self, cursor: int) -> bool:
+        """Whether the batch at ``cursor`` is inside the poisoned window."""
+        return (self.cfg.skip_poisoned_batches
+                and self._skip_until is not None
+                and cursor < self._skip_until)
+
+    def note_skipped(self, cursor: int) -> None:
+        self.skipped_cursors.append(int(cursor))
+        if self._skip_until is not None and cursor + 1 >= self._skip_until:
+            self._skip_until = None  # window cleared; back to normal
+        if self.recovery_log is not None:
+            self.recovery_log.record("poison_skip", step=self.engine.global_steps,
+                                     cursor=int(cursor))
+
+    # ------------------------------------------------------------ per step
+    def after_step(self, metrics: Dict[str, Any]) -> Dict[str, Any]:
+        """Run all health checks for one completed step. May mutate the
+        engine (rollback, wire demotion, auto-checkpoint). Returns a dict of
+        what happened (empty when healthy)."""
+        info: Dict[str, Any] = {}
+        demoted = self.demotion.after_step(metrics)
+        if demoted:
+            info["wire"] = demoted
+        if self.cfg.enabled:
+            reason = None
+            overflow = bool(metrics.get("overflow", False))
+            if not overflow:
+                # overflow steps report non-finite/garbage loss by
+                # construction and are already healed by the loss-scale
+                # machinery — only non-overflow metrics feed the sentinels.
+                # The imperative boundary path carries no "loss" key (the
+                # boundary program computes no loss); its loss channel is
+                # merged in by the caller when available.
+                loss = metrics.get("loss")
+                if loss is not None:
+                    reason = self.loss_detector.update(float(loss))
+                if reason is None and self.grad_detector is not None:
+                    gn = float(metrics.get("grad_norm", 0.0))
+                    if math.isfinite(gn):  # finite-only: inf grad == overflow
+                        reason = self.grad_detector.update(gn)
+            if reason is not None:
+                info["rolled_back"] = self._rollback(reason)
+                return info
+            interval = int(self.cfg.checkpoint_interval or 0)
+            if interval > 0 and self.engine.global_steps % interval == 0:
+                self._auto_checkpoint()
+        return info
+
+    # ----------------------------------------------------------- anchoring
+    def _take_memory_snapshot(self) -> None:
+        import jax
+
+        eng = self.engine
+        self._memory_snapshot = {
+            "state": jax.device_get(eng.state),
+            "rng": jax.device_get(eng._rng),
+            "global_steps": eng.global_steps,
+            "micro_steps": eng.micro_steps,
+            "skipped_steps": eng.skipped_steps,
+            "data_cursor": eng.data_cursor,
+        }
+
+    def _auto_checkpoint(self) -> None:
+        from .retry import RetryBudgetExceeded
+
+        eng = self.engine
+        try:
+            eng.save_checkpoint(self.save_dir)
+            if self.checkpoint_io_degraded:
+                self.checkpoint_io_degraded = False
+                log_dist("health: checkpoint I/O recovered; disk anchors "
+                         "resume")
+        except (OSError, RetryBudgetExceeded) as e:
+            # degrade, don't die: the step already succeeded — losing the
+            # run to a sick filesystem would be worse than a stale anchor
+            if not self.checkpoint_io_degraded:
+                self.checkpoint_io_degraded = True
+                logger.error(
+                    f"health: periodic checkpoint failed ({e}); degrading to "
+                    f"the in-memory anchor until I/O recovers")
+            if self.recovery_log is not None:
+                self.recovery_log.record("checkpoint_io_degraded",
+                                         step=eng.global_steps, error=str(e))
+        if self.cfg.memory_fallback:
+            self._take_memory_snapshot()
+
+    def _restore_memory_snapshot(self) -> None:
+        import jax
+
+        snap = self._memory_snapshot
+        eng = self.engine
+        eng.state = jax.device_put(snap["state"], eng.state_shardings)
+        eng._rng = jax.device_put(snap["rng"])
+        eng._grad_acc = None
+        eng.global_steps = int(snap["global_steps"])
+        eng.micro_steps = int(snap["micro_steps"])
+        eng.skipped_steps = int(snap["skipped_steps"])
+        eng.data_cursor = int(snap["data_cursor"])
+
+    # ------------------------------------------------------------ rollback
+    def _rollback(self, reason: str) -> Dict[str, Any]:
+        eng = self.engine
+        if self.rollbacks >= self.cfg.max_rollbacks:
+            raise DivergenceError(
+                f"divergence detected ({reason}) but the rollback budget "
+                f"({self.cfg.max_rollbacks}) is spent — the run cannot "
+                f"self-heal; inspect recovery_events.jsonl")
+        from_step = int(eng.global_steps)
+        from_cursor = int(eng.data_cursor)
+        t0 = time.monotonic()
+        logger.error(f"divergence at step {from_step} ({reason}): rolling "
+                     f"back to the newest committed checkpoint")
+        source = "disk"
+        loaded = None
+        try:
+            loaded, _ = eng.load_checkpoint(self.save_dir)
+        except Exception as e:
+            logger.error(f"rollback: disk anchor unusable ({e})")
+        if loaded is None:
+            if self._memory_snapshot is None:
+                raise DivergenceError(
+                    f"divergence detected ({reason}) but no rollback anchor "
+                    f"exists (no committed checkpoint in {self.save_dir!r} "
+                    f"and memory_fallback is off)")
+            self._restore_memory_snapshot()
+            source = "memory"
+        to_step = int(eng.global_steps)
+        to_cursor = int(eng.data_cursor)
+        # poison window: every batch consumed since the anchor. The detector
+        # cannot know which of them started the divergence (the spike crosses
+        # the threshold with a lag), so the whole window is skipped — the
+        # deterministic cursor makes the exclusion exact and replayable.
+        self._skip_until = from_cursor if from_cursor > to_cursor else None
+        self.rollbacks += 1
+        elapsed = time.monotonic() - t0
+        skipped = list(range(to_cursor, from_cursor))
+        log_dist(
+            f"rollback complete ({source} anchor, {elapsed:.2f}s): step "
+            f"{from_step} -> {to_step}; skipping poisoned data cursors "
+            f"{skipped if skipped else '(none)'}")
+        if self.recovery_log is not None:
+            self.recovery_log.record(
+                "divergence_rollback", value=elapsed, step=to_step,
+                reason=reason, from_step=from_step, source=source,
+                skip_cursors=skipped)
+        return {"reason": reason, "from_step": from_step, "to_step": to_step,
+                "source": source, "skip_cursors": skipped,
+                "latency_s": elapsed}
+
+
+__all__ = ["SpikeDetector", "HealthController", "WireDemotionController",
+           "DivergenceError"]

@@ -1,0 +1,265 @@
+"""Hang/straggler watchdog: per-phase deadlines over a host-side heartbeat.
+
+A run that *crashes* is handled by the preemption/commit machinery
+(:mod:`.preemption`, :mod:`.manifest`); a run that *hangs* — a deadlocked
+collective, a wedged remote filesystem, a compile that never returns — burns
+chip time silently until a human notices. :class:`HealthWatchdog` is the
+in-process tripwire: the engine brackets each phase of the step loop
+(``compile``, ``step``, ``collective``, ``checkpoint``) with
+:meth:`HealthWatchdog.phase`, and a daemon thread checks the active phase
+against its configured deadline. On a stall it
+
+1. dumps all thread stacks (``faulthandler``) to
+   ``watchdog_stacks.txt`` next to the checkpoints — the post-mortem a hung
+   pod otherwise never produces,
+2. logs the quantized-wire ledger (what the collectives were moving when the
+   run wedged),
+3. records a ``watchdog_stall`` recovery event
+   (:class:`~deepspeed_tpu.resilience.events.RecoveryLog`), and
+4. escalates through the *existing* SIGTERM drain path (the ``on_stall``
+   callback — the engine wires it to ``request_drain``): if the stall
+   clears (a straggler, not a deadlock), the next micro-batch boundary
+   performs a committed emergency save and exits with the preemption code,
+   so the supervisor relaunches onto healthy capacity. A phase that
+   completes after a stall was flagged records ``watchdog_recovered``.
+
+Multi-host identification: a *pod-level* hang usually has ONE sick host.
+:func:`identify_stragglers` is the pure policy (per-host step durations ->
+outlier indices); the engine feeds it an allgather of per-host step times at
+step boundaries (the only safe place — a collective issued from the watchdog
+thread while the main thread is mid-program would deadlock the very pod it
+is watching), so the slow host is named in the recovery event every healthy
+peer writes.
+
+The thread only ever *reads* phase state and *writes* logs/events — it
+never touches device state, so a false positive costs a stack dump and a
+drain request, never a corrupted step.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..utils.logging import logger
+
+STACKS_FILENAME = "watchdog_stacks.txt"
+
+#: Engine phases with independent deadlines. ``idle`` (between steps, waiting
+#: on the caller's dataloader) is deliberately unbounded: the engine cannot
+#: distinguish a slow dataloader from a finished run.
+PHASES = ("compile", "step", "collective", "checkpoint")
+
+
+class HealthWatchdog:
+    """Deadline monitor over the engine's step-loop phases.
+
+    ``deadlines``: seconds per phase name (missing/<=0 disables that phase's
+    check). ``on_stall(phase, elapsed)``: escalation callback, invoked once
+    per stall episode from the watchdog thread. ``stacks_dir``: where the
+    stall stack dump lands (None disables the dump).
+    """
+
+    def __init__(
+        self,
+        deadlines: Dict[str, float],
+        poll_interval: float = 1.0,
+        on_stall: Optional[Callable[[str, float], None]] = None,
+        recovery_log=None,
+        stacks_dir: Optional[str] = None,
+    ):
+        self.deadlines = {k: float(v) for k, v in deadlines.items()}
+        self.poll_interval = float(poll_interval)
+        self.on_stall = on_stall
+        self.recovery_log = recovery_log
+        self.stacks_dir = stacks_dir
+        self._lock = threading.Lock()
+        self._phase: Optional[str] = None
+        self._phase_start: float = 0.0
+        self._phase_seq = 0          # increments on every enter/exit
+        self._stalled_seq: Optional[int] = None  # seq a stall fired for
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.stall_count = 0
+        self.last_stall: Optional[Tuple[str, float]] = None
+
+    # ------------------------------------------------------------- phase API
+    @contextmanager
+    def phase(self, name: str):
+        """Bracket one deadline-checked phase (the engine's step loop)."""
+        seq = self._enter(name)
+        try:
+            yield self
+        finally:
+            self._exit(seq)
+
+    def _enter(self, name: str) -> int:
+        with self._lock:
+            self._phase = name
+            self._phase_start = time.monotonic()
+            self._phase_seq += 1
+            return self._phase_seq
+
+    def _exit(self, seq: int) -> None:
+        with self._lock:
+            elapsed = time.monotonic() - self._phase_start
+            phase = self._phase
+            recovered = self._stalled_seq == seq
+            self._phase = None
+            self._phase_seq += 1
+            self._stalled_seq = None
+        if recovered and phase is not None:
+            # the stall cleared: a straggler, not a deadlock — record it so
+            # the run record distinguishes "slow" from "dead"
+            logger.warning(
+                f"watchdog: phase {phase!r} recovered after {elapsed:.1f}s "
+                f"(deadline {self.deadlines.get(phase, 0)}s)")
+            if self.recovery_log is not None:
+                self.recovery_log.record("watchdog_recovered", value=elapsed,
+                                         phase=phase)
+
+    # ---------------------------------------------------------- thread loop
+    def start(self) -> "HealthWatchdog":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="ds-health-watchdog", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5 * self.poll_interval + 1.0)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            self._check()
+
+    def _check(self) -> None:
+        with self._lock:
+            phase = self._phase
+            seq = self._phase_seq
+            elapsed = time.monotonic() - self._phase_start
+            already = self._stalled_seq == seq
+        if phase is None or already:
+            return
+        deadline = self.deadlines.get(phase, 0.0)
+        if deadline <= 0 or elapsed <= deadline:
+            return
+        with self._lock:
+            if self._phase_seq != seq:  # phase ended while we decided
+                return
+            self._stalled_seq = seq
+        self.stall_count += 1
+        self.last_stall = (phase, elapsed)
+        self._on_stall_detected(phase, elapsed)
+
+    def _on_stall_detected(self, phase: str, elapsed: float) -> None:
+        logger.error(
+            f"watchdog: phase {phase!r} exceeded its {self.deadlines[phase]}s "
+            f"deadline ({elapsed:.1f}s elapsed) — dumping stacks and "
+            f"escalating to the drain path")
+        self._dump_stacks(phase, elapsed)
+        self._dump_wire_ledger()
+        if self.recovery_log is not None:
+            try:
+                self.recovery_log.record("watchdog_stall", value=elapsed,
+                                         phase=phase,
+                                         deadline_s=self.deadlines[phase])
+            except Exception as e:  # event export must never kill the thread
+                logger.warning(f"watchdog: stall event not recorded: {e}")
+        if self.on_stall is not None:
+            try:
+                self.on_stall(phase, elapsed)
+            except Exception as e:
+                logger.error(f"watchdog: escalation callback failed: {e}")
+
+    def _dump_stacks(self, phase: str, elapsed: float) -> None:
+        if self.stacks_dir is None:
+            return
+        try:
+            os.makedirs(self.stacks_dir, exist_ok=True)
+            path = os.path.join(self.stacks_dir, STACKS_FILENAME)
+            with open(path, "a") as f:
+                f.write(f"\n=== watchdog stall: phase={phase} "
+                        f"elapsed={elapsed:.1f}s unix_time={time.time():.0f} "
+                        f"pid={os.getpid()} ===\n")
+                f.flush()
+                faulthandler.dump_traceback(file=f, all_threads=True)
+            logger.error(f"watchdog: thread stacks dumped to {path}")
+        except OSError as e:
+            logger.warning(f"watchdog: stack dump failed: {e}")
+
+    def _dump_wire_ledger(self) -> None:
+        try:
+            from ..comm.runtime_accounting import wire_ledger
+
+            if wire_ledger.records:
+                logger.error("watchdog: wire state at stall:\n"
+                             + wire_ledger.summary())
+        except Exception as e:  # accounting must never kill the watchdog
+            logger.warning(f"watchdog: wire ledger dump failed: {e}")
+
+
+# ------------------------------------------------------------- stragglers
+def identify_stragglers(
+    durations_s: Sequence[float], factor: float = 2.0, floor_s: float = 1.0,
+) -> List[int]:
+    """Indices of hosts whose step duration marks them sick.
+
+    A host is a straggler when its duration exceeds ``factor`` x the LOWER
+    median of all hosts AND the absolute excess is above ``floor_s`` (tiny
+    steps jitter far more than 2x without meaning anything). The lower
+    median matters on even host counts: with the upper one, a 2-host pod
+    could structurally never flag its slow host (the reference point would
+    BE the straggler's own duration), and half-sick pods would hide
+    themselves. Pure policy — the engine supplies the allgathered per-host
+    durations.
+    """
+    vals = [float(d) for d in durations_s]
+    if len(vals) < 2:
+        return []
+    med = sorted(vals)[(len(vals) - 1) // 2]
+    return [i for i, d in enumerate(vals)
+            if d > max(med * factor, med + floor_s)]
+
+
+def allgather_host_stats(duration_s: float) -> Optional[List[dict]]:
+    """Allgather ``{process_index, hostname, step_s}`` across hosts.
+
+    Call ONLY from the main thread at a step boundary (it is a collective).
+    Returns None in single-process runs. Hostnames travel as fixed-width
+    byte rows so the exchange is one array allgather.
+    """
+    import socket
+
+    import numpy as np
+
+    import jax
+
+    if jax.process_count() == 1:
+        return None
+    from jax.experimental import multihost_utils
+
+    name = socket.gethostname().encode()[:64]
+    row = np.zeros(72, np.uint8)
+    row[:len(name)] = np.frombuffer(name, np.uint8)
+    row[64:72] = np.frombuffer(
+        np.asarray([duration_s], np.float64).tobytes(), np.uint8)
+    rows = np.asarray(multihost_utils.process_allgather(row))
+    rows = rows.reshape(-1, 72)
+    out = []
+    for i, r in enumerate(rows):
+        host = bytes(r[:64]).rstrip(b"\0").decode(errors="replace")
+        dur = float(np.frombuffer(bytes(r[64:72]), np.float64)[0])
+        out.append({"process_index": i, "hostname": host, "step_s": dur})
+    return out
+
+
+__all__ = ["HealthWatchdog", "identify_stragglers", "allgather_host_stats",
+           "PHASES", "STACKS_FILENAME"]

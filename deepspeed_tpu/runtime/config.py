@@ -177,6 +177,84 @@ class AnalysisConfig(DeepSpeedConfigModel):
     exclude: List[str] = Field(default_factory=list)
 
 
+class SentinelConfig(DeepSpeedConfigModel):
+    """In-run numerical health sentinels + divergence rollback
+    (:mod:`deepspeed_tpu.resilience.rollback`; ``docs/RESILIENCE.md``
+    "In-run health").
+
+    When ``enabled`` (requires the parent ``resilience`` block), every
+    completed step's loss (and grad norm, when ``grad_norm_zscore`` > 0)
+    feeds an EMA z-score spike detector; a non-finite loss or a >
+    ``zscore``-sigma spike triggers automatic rollback to the newest
+    committed checkpoint plus a deterministic data-cursor skip over the
+    batches consumed since it. ``checkpoint_interval`` > 0 makes the engine
+    auto-save every N steps (the rollback anchor); ``memory_fallback`` keeps
+    a host-RAM copy of the last anchored state so rollback survives a sick
+    filesystem (one extra host-RAM state copy — budget for it on big
+    models). ``cursor_checkpointable`` declares that the caller's dataloader
+    is a deterministic function of ``engine.data_cursor`` (dslint's
+    ``config/rollback-without-data-cursor`` warns when rollback is armed
+    without this declaration or a ``resume_state_provider``).
+    ``max_rollbacks`` bounds the heal loop; exceeding it raises
+    :class:`~deepspeed_tpu.resilience.rollback.DivergenceError`.
+    """
+
+    enabled: bool = False
+    zscore: float = Field(6.0, gt=0)
+    grad_norm_zscore: float = Field(8.0, ge=0)  # 0 disables the grad channel
+    # relative-deviation floor: a spike must also sit min_relative_spike
+    # above the EMA mean (fractionally) — keeps the z-score calm on flat,
+    # converged curves where the EMA variance collapses
+    min_relative_spike: float = Field(0.1, ge=0)
+    ema_beta: float = Field(0.98, gt=0, lt=1)
+    warmup_steps: int = Field(20, ge=1)
+    max_rollbacks: int = Field(3, ge=1)
+    checkpoint_interval: int = Field(0, ge=0)  # 0: caller saves manually
+    skip_poisoned_batches: bool = True
+    memory_fallback: bool = True
+    cursor_checkpointable: bool = False
+
+
+class WatchdogConfig(DeepSpeedConfigModel):
+    """Hang/straggler watchdog (:mod:`deepspeed_tpu.resilience.watchdog`).
+
+    When ``enabled`` (requires the parent ``resilience`` block), a daemon
+    thread checks the engine's active phase against per-phase deadlines
+    (seconds; <= 0 disables that phase's check). On a stall: thread stacks
+    dump to ``<save_dir>/watchdog_stacks.txt``, the wire ledger is logged, a
+    ``watchdog_stall`` recovery event is recorded, and (with ``escalate``)
+    the existing SIGTERM drain path is triggered — a cleared stall then
+    produces a committed emergency save + preemption exit at the next
+    boundary. ``straggler_check_every`` > 0 allgathers per-host step times
+    every N steps in multi-host runs and names hosts slower than
+    ``straggler_factor`` x the median in a ``straggler_detected`` event.
+    """
+
+    enabled: bool = False
+    poll_interval_s: float = Field(1.0, gt=0)
+    compile_deadline_s: float = 1800.0
+    step_deadline_s: float = 300.0
+    collective_deadline_s: float = 120.0
+    checkpoint_deadline_s: float = 600.0
+    escalate: bool = True
+    straggler_check_every: int = Field(0, ge=0)
+    straggler_factor: float = Field(2.0, gt=1)
+
+
+class DegradedModeConfig(DeepSpeedConfigModel):
+    """Graceful-degradation policy (``docs/RESILIENCE.md`` "In-run health").
+
+    ``demote_after`` consecutive overflow steps demote the quantized
+    gradient exchange to the fp32 wire (recorded in the wire ledger /
+    ``comms_summary``); ``repromote_after`` consecutive clean steps restore
+    it (error-feedback residuals reset). Active whenever the parent
+    ``resilience`` block is enabled and ``zero_quantized_gradients`` is on.
+    """
+
+    demote_after: int = Field(3, ge=1)
+    repromote_after: int = Field(100, ge=1)
+
+
 class ResilienceConfig(DeepSpeedConfigModel):
     """TPU-native block: preemption-safe training
     (:mod:`deepspeed_tpu.resilience`; ``docs/RESILIENCE.md``).
@@ -204,6 +282,9 @@ class ResilienceConfig(DeepSpeedConfigModel):
     exit_code: int = 83
     deep_verify: bool = True
     chaos: Dict[str, Any] = Field(default_factory=dict)
+    sentinel: SentinelConfig = Field(default_factory=SentinelConfig)
+    watchdog: WatchdogConfig = Field(default_factory=WatchdogConfig)
+    degraded: DegradedModeConfig = Field(default_factory=DegradedModeConfig)
 
     @model_validator(mode="after")
     def _check(self) -> "ResilienceConfig":
@@ -211,6 +292,11 @@ class ResilienceConfig(DeepSpeedConfigModel):
             raise ValueError(
                 "resilience.enabled requires resilience.save_dir (where "
                 "emergency checkpoints land and auto-resume looks)")
+        if (self.sentinel.enabled or self.watchdog.enabled) and not self.enabled:
+            raise ValueError(
+                "resilience.sentinel / resilience.watchdog require "
+                "resilience.enabled (rollback anchors and drain escalation "
+                "both live in resilience.save_dir)")
         if not (0 < self.exit_code < 256):
             raise ValueError(
                 f"resilience.exit_code must be in 1..255, got {self.exit_code}")

@@ -23,6 +23,7 @@ update timing, accumulation boundaries) matches the reference.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import os
 import time
@@ -434,6 +435,25 @@ class DeepSpeedEngine:
         self.global_steps = 0
         self.micro_steps = 0
         self.skipped_steps = 0
+        # data cursor: count of global batches CONSUMED (stepped on, skipped
+        # on overflow, or skipped as poisoned) — the deterministic index a
+        # cursor-checkpointable dataloader is driven by. Persisted in
+        # checkpoint meta so resume/rollback land on the exact next batch.
+        self.data_cursor = 0
+        # per-program compile tracking for the watchdog: a program's first
+        # dispatch runs under the (long) "compile" deadline, later ones under
+        # "step". Reset by _compile_steps so a health-driven recompile
+        # (demotion/re-promotion, ltd bucket change) is judged as a compile.
+        self._tb_dispatched = False
+        self._tbs_dispatched = False
+        # imperative-path poison skip: gas micro-batches remaining to consume
+        # without executing (forward() arms it at a window start)
+        self._skip_window_remaining = 0
+        self._last_loss = None
+        # graceful degradation: quantized gradient exchange demoted to the
+        # fp32 wire (resilience/rollback.py WireDemotionController); read at
+        # trace time by _micro_step, flipped only via _compile_steps recompile
+        self._qgrad_demoted = False
         self._last_metrics: Dict[str, Any] = {}
         self._monitor = None
         if config.monitor.enabled:
@@ -498,6 +518,35 @@ class DeepSpeedEngine:
                 if loaded is not None:
                     log_dist(f"resilience: auto-resumed from {loaded} "
                              f"(step {self.global_steps})")
+
+        # in-run health (docs/RESILIENCE.md "In-run health"): hang watchdog
+        # + numerical sentinels/rollback + quantized-wire demotion. Built
+        # AFTER auto-resume so the sentinel's in-memory anchor snapshots the
+        # resumed state, not the fresh init.
+        self._watchdog = None
+        self._health = None
+        if res.enabled:
+            wd = res.watchdog
+            if wd.enabled:
+                from ..resilience.watchdog import HealthWatchdog
+
+                self._watchdog = HealthWatchdog(
+                    deadlines={
+                        "compile": wd.compile_deadline_s,
+                        "step": wd.step_deadline_s,
+                        "collective": wd.collective_deadline_s,
+                        "checkpoint": wd.checkpoint_deadline_s,
+                    },
+                    poll_interval=wd.poll_interval_s,
+                    on_stall=(self._watchdog_escalate if wd.escalate
+                              else None),
+                    recovery_log=self._recovery_log,
+                    stacks_dir=res.save_dir,
+                ).start()
+            if res.sentinel.enabled or self._qcomm.gradients:
+                from ..resilience.rollback import HealthController
+
+                self._health = HealthController(self)
 
         # opt-in static analysis (deepspeed_tpu.analysis): lint the fused
         # step's jaxpr/HLO before anything executes. Runs here when a batch
@@ -815,7 +864,7 @@ class DeepSpeedEngine:
         it resident)."""
         scale = state["scaler"].scale if self.pc.loss_scaling else jnp.float32(1.0)
         new_state = dict(state)
-        if self._qcomm.gradients:
+        if self._qcomm.gradients and not self._qgrad_demoted:
             # deliberately NO gather_window binding here: inside the qdp
             # shard_map every sharding constraint is a no-op (params enter
             # replicated), so a bound zero_quantized_weights config would only
@@ -919,6 +968,8 @@ class DeepSpeedEngine:
 
     def _compile_steps(self) -> None:
         ss = self.state_shardings
+        self._tb_dispatched = False   # fresh programs: next dispatch is a compile
+        self._tbs_dispatched = False
         self._micro_jit = None   # imperative-API jits are compiled lazily on first
         self._boundary_jit = None  # forward()/step() use (train_batch never pays)
         self._zero_jit = None
@@ -1044,6 +1095,24 @@ class DeepSpeedEngine:
             raise RuntimeError(
                 "ZeRO-Offload/Infinity uses the fused train_batch() API (the host "
                 "optimizer step is driven once per global batch)")
+        # imperative-path poison skip (post-rollback): at a window start
+        # (micro == 0), a poisoned cursor arms a gas-wide skip — the caller
+        # keeps its forward/backward/step rhythm, but the window's
+        # micro-batches are consumed without executing, no grads accumulate,
+        # and step() sees no boundary
+        if (self._health is not None and self._skip_window_remaining == 0
+                and int(self.state["micro"]) == 0
+                and self._health.should_skip(self.data_cursor)):
+            cursor = self.data_cursor
+            self.data_cursor += 1
+            self._health.note_skipped(cursor)
+            self._skip_window_remaining = self.gas
+            log_dist(f"health: skipping poisoned global batch at data cursor "
+                     f"{cursor} ({self.gas} micro-batch(es))")
+        if self._skip_window_remaining > 0:
+            self._skip_window_remaining -= 1
+            return (self._last_loss if self._last_loss is not None
+                    else jnp.float32(jnp.nan))
         if self.wall_clock_breakdown():
             self.timers("forward").start()
         batch = self._apply_curriculum(batch)
@@ -1106,6 +1175,15 @@ class DeepSpeedEngine:
         # out of HBM during the inter-step window
         self._grad_acc = None
         self._finish_step(metrics)
+        self.data_cursor += 1
+        if self._health is not None:
+            # the boundary program computes no loss — merge the window's
+            # last forward() loss in so the sentinel's loss channel works on
+            # the imperative path too
+            m = dict(self._last_metrics)
+            if "loss" not in m and self._last_loss is not None:
+                m["loss"] = self._last_loss
+            self._health.after_step(m)
         if self._eigenvalue is not None and self._ev_last_batch is not None:
             self._update_curvature(self._ev_last_batch, leading_gas=False)
         if self.wall_clock_breakdown():
@@ -1116,6 +1194,14 @@ class DeepSpeedEngine:
         """Fused full step: ``gas`` micro-batches + optimizer update in one compiled
         program. ``batch`` arrays are [gas, batch, ...] when gas>1, else [batch, ...].
         Parity: ``PipelineEngine.train_batch``-style one-call API."""
+        if self._health is not None and self._health.should_skip(self.data_cursor):
+            # post-rollback poison window: consume the cursor without
+            # executing — the run rejoins a healthy trajectory without
+            # replaying the batches that diverged it (docs/RESILIENCE.md)
+            return self._skip_poisoned_batch()
+        from ..resilience.chaos import training_faults
+
+        inj = training_faults(self.data_cursor)
         self.tput_timer.start()
         if self._analysis_pending:
             # deferred init-time analysis: the first real batch supplies the
@@ -1137,20 +1223,41 @@ class DeepSpeedEngine:
         if wcb:
             self.timers("batch_input").stop()
             self.timers("train_batch").start()
-        runner = self._onebit or self._offload or self._param_stream
-        if runner is not None:
-            self.state, metrics = runner.train_batch(batch, self._next_rng())
-        else:
-            with mesh_context(self.mesh):
-                self.state, metrics = self._train_batch_jit(
-                    self.state, batch, self._next_rng())
-        if wcb:
-            # the fused program is one dispatch; fwd/bwd/step attribution
-            # inside it comes from jax.profiler traces (module docstring)
-            self.timers("train_batch").stop(sync_on=metrics["loss"])
-        self.micro_steps += self.gas
-        self._last_loss = metrics["loss"]
-        self._finish_step(metrics)
+        if inj.stall_s:
+            # chaos stall-collective injector: a hung/straggling collective,
+            # run under the watchdog's "collective" phase so the deadline
+            # machinery sees exactly what a real wedged wire looks like
+            with self._watch_phase("collective"):
+                time.sleep(inj.stall_s)
+        t_step = time.monotonic()
+        with self._watch_phase("compile" if not self._tb_dispatched else "step"):
+            runner = self._onebit or self._offload or self._param_stream
+            if runner is not None:
+                self.state, metrics = runner.train_batch(batch, self._next_rng())
+            else:
+                with mesh_context(self.mesh):
+                    self.state, metrics = self._train_batch_jit(
+                        self.state, batch, self._next_rng())
+            self._tb_dispatched = True
+            if wcb:
+                # the fused program is one dispatch; fwd/bwd/step attribution
+                # inside it comes from jax.profiler traces (module docstring)
+                self.timers("train_batch").stop(sync_on=metrics["loss"])
+            self.micro_steps += self.gas
+            if inj.nan_loss:
+                metrics = dict(metrics)
+                metrics["loss"] = jnp.float32(jnp.nan)
+            if inj.ef_overflow:
+                metrics = dict(metrics)
+                metrics["overflow"] = jnp.bool_(True)
+            self._last_loss = metrics["loss"]
+            self._finish_step(metrics)  # floats metrics: syncs the dispatch
+        self.data_cursor += 1
+        if self._health is not None:
+            hinfo = self._health.after_step(metrics)
+            if hinfo:
+                metrics = dict(metrics)
+                metrics["health"] = hinfo
         if self._eigenvalue is not None:
             self._update_curvature(batch)
         if (wcb and self.config.steps_per_print and
@@ -1158,6 +1265,7 @@ class DeepSpeedEngine:
             # parity: the step-end timer breakdown (engine.py:2226-2241)
             log_dist(self.timers.log(["batch_input", "train_batch"]))
         self.tput_timer.stop(sync_on=metrics["loss"])
+        self._straggler_poll(time.monotonic() - t_step)
         self._maybe_drain()
         return metrics
 
@@ -1184,6 +1292,16 @@ class DeepSpeedEngine:
                 "1-bit/offload/param-stream runners interleave host work per "
                 "step — call train_batch per step instead")
         k = int(jax.tree_util.tree_leaves(batch)[0].shape[0])
+        if self._health is not None and any(
+                self._health.should_skip(self.data_cursor + i)
+                for i in range(k)):
+            # the fused window overlaps the post-rollback poison set; skip is
+            # window-granular here (the k steps are one program) — each
+            # cursor is consumed and recorded individually
+            out = None
+            for _ in range(k):
+                out = self._skip_poisoned_batch()
+            return out
         if self._analysis_pending:
             # the k-step batch layout differs from train_batch's; analyze the
             # per-step program on a synthesized batch where possible
@@ -1191,17 +1309,45 @@ class DeepSpeedEngine:
         self._apply_random_ltd()
         batch = self._apply_curriculum(batch)
         batch = self._place_batch(batch, leading_gas=True, leading_steps=True)
-        with mesh_context(self.mesh):
-            self.state, stacked = self._train_batches_jit(
-                self.state, batch, self._next_rng())
-        self.micro_steps += self.gas * k
-        host = jax.device_get(stacked)  # one transfer for all K steps' metrics
+        with self._watch_phase("compile" if not self._tbs_dispatched else "step"):
+            with mesh_context(self.mesh):
+                self.state, stacked = self._train_batches_jit(
+                    self.state, batch, self._next_rng())
+            self._tbs_dispatched = True
+            self.micro_steps += self.gas * k
+            host = jax.device_get(stacked)  # one transfer for all K steps' metrics
+        rolled_back = False
+        healthy = k
         for i in range(k):
             mi = jax.tree_util.tree_map(lambda a, i=i: a[i], host)
             self._last_loss = mi["loss"]
             self._finish_step(mi)
-        last = jax.tree_util.tree_map(lambda a: a[-1], host)
-        last["mean_loss"] = float(np.mean(np.asarray(host["loss"])))
+            self.data_cursor += 1
+            if self._health is not None:
+                hinfo = self._health.after_step(mi)
+                if hinfo.get("rolled_back"):
+                    # the window's remaining steps are discarded by the
+                    # restored state; their metrics must not feed schedulers
+                    # or the sentinel baselines (rollback already reset the
+                    # cursor to the anchor's — the un-poisoned tail of this
+                    # window simply replays from there)
+                    rolled_back = True
+                    healthy = i  # steps 0..i-1 were accepted
+                    break
+        if rolled_back:
+            # the returned metrics must describe the ACCEPTED trajectory —
+            # the diverged step and the discarded tail must not hand the
+            # caller a NaN loss for a call that healed
+            if healthy > 0:
+                last = jax.tree_util.tree_map(lambda a: a[healthy - 1], host)
+                last["mean_loss"] = float(
+                    np.mean(np.asarray(host["loss"][:healthy])))
+            else:
+                last = {"loss": float("nan"), "mean_loss": float("nan")}
+            last["health"] = hinfo
+        else:
+            last = jax.tree_util.tree_map(lambda a: a[-1], host)
+            last["mean_loss"] = float(np.mean(np.asarray(host["loss"])))
         self._maybe_drain()
         return last
 
@@ -1262,6 +1408,18 @@ class DeepSpeedEngine:
                           if self.pc.loss_scaling else "")
             log_dist(f"step {self.global_steps}: non-finite grads, step "
                      f"skipped{scale_note}")
+            # the skipped micro-step must be visible in the run record, not
+            # only in stdout: a Resilience/overflow_skip scalar + recovery
+            # event (RecoveryLog.record writes the monitor scalar itself)
+            if self._recovery_log is not None:
+                self._recovery_log.record(
+                    "overflow_skip", step=self.global_steps,
+                    data_cursor=int(getattr(self, "data_cursor", 0)),
+                    loss_scale=(float(self.state["scaler"].scale)
+                                if self.pc.loss_scaling else None))
+            elif self._monitor is not None:
+                self._monitor.write_events([
+                    ("Resilience/overflow_skip", 1.0, self.global_steps)])
         if self._monitor is not None and "loss" in metrics:
             # parity: the reference's gas-boundary event set
             # (engine.py:2183-2206: Train/Samples/{train_loss,lr,loss_scale})
@@ -1440,8 +1598,9 @@ class DeepSpeedEngine:
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
 
-            flags = multihost_utils.process_allgather(
-                np.asarray([local], dtype=np.bool_))
+            with self._watch_phase("collective"):
+                flags = multihost_utils.process_allgather(
+                    np.asarray([local], dtype=np.bool_))
             drain = bool(np.asarray(flags).any())
         else:
             drain = local
@@ -1471,13 +1630,77 @@ class DeepSpeedEngine:
                  f"{time.monotonic() - t0:.2f}s; exiting {res.exit_code}")
         raise SystemExit(res.exit_code)
 
+    # ------------------------------------------------------- in-run health
+    def _watch_phase(self, name: str):
+        """The watchdog's deadline bracket for ``name``; inert without one."""
+        if self._watchdog is not None:
+            return self._watchdog.phase(name)
+        return contextlib.nullcontext()
+
+    def _watchdog_escalate(self, phase: str, elapsed: float) -> None:
+        """Stall escalation (called from the watchdog thread): route the
+        stall into the existing SIGTERM drain path — if the stall clears
+        (straggler, not deadlock), the next micro-batch boundary performs a
+        committed emergency save and exits with the preemption code."""
+        try:
+            self.request_drain(f"watchdog-stall:{phase}")
+        except Exception as e:  # escalation must never kill the watchdog
+            logger.error(f"watchdog escalation failed: {e}")
+
+    def _skip_poisoned_batch(self) -> Dict[str, Any]:
+        """Consume one data cursor without executing (post-rollback poison
+        window). Returns marker metrics; no optimizer step happens."""
+        cursor = self.data_cursor
+        self.data_cursor += 1
+        self._health.note_skipped(cursor)
+        log_dist(f"health: skipped poisoned batch at data cursor {cursor} "
+                 f"(step stays {self.global_steps})")
+        m = dict(self._last_metrics) if self._last_metrics else {
+            "loss": float("nan")}
+        m["skipped_batch"] = True
+        m["skipped_cursor"] = cursor
+        return m
+
+    def _straggler_poll(self, step_duration_s: float) -> None:
+        """Multi-host straggler identification at a step boundary: allgather
+        per-host step durations every ``straggler_check_every`` steps and
+        name hosts slower than ``straggler_factor`` x the median. A boundary
+        collective (never issued from the watchdog thread — that would
+        deadlock the pod it watches)."""
+        if self._watchdog is None or jax.process_count() == 1:
+            return
+        wd = self.config.resilience.watchdog
+        every = int(wd.straggler_check_every or 0)
+        if every <= 0 or self.global_steps % every != 0:
+            return
+        from ..resilience.watchdog import allgather_host_stats, identify_stragglers
+
+        stats = allgather_host_stats(step_duration_s)
+        if not stats:
+            return
+        slow = identify_stragglers([s["step_s"] for s in stats],
+                                   factor=wd.straggler_factor)
+        for idx in slow:
+            s = stats[idx]
+            logger.warning(
+                f"straggler: host {s['hostname']!r} (process "
+                f"{s['process_index']}) took {s['step_s']:.2f}s vs pod "
+                f"median — flagged at step {self.global_steps}")
+            if self._recovery_log is not None:
+                self._recovery_log.record(
+                    "straggler_detected", value=s["step_s"],
+                    step=self.global_steps, hostname=s["hostname"],
+                    process_index=s["process_index"])
+
     # ------------------------------------------------------------------ checkpoint
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[dict] = None, save_latest: bool = True) -> str:
         from ..checkpoint import save_checkpoint as _save
 
-        return _save(self, save_dir, tag=tag, client_state=client_state or {},
-                     save_latest=save_latest)
+        with self._watch_phase("checkpoint"):
+            return _save(self, save_dir, tag=tag,
+                         client_state=client_state or {},
+                         save_latest=save_latest)
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True) -> Tuple[Optional[str], dict]:

@@ -1,12 +1,20 @@
 #!/usr/bin/env python
 """Fast fault-injection smoke for CI (scripts/verify_tier1.sh).
 
-One SIGKILL injected mid-checkpoint (pre-commit phase, via ``DS_FAULT_PLAN``)
-against the real training worker on the CPU mesh, then a relaunch that must
-auto-resume from the newest *committed* tag and finish with monotone steps.
+Two heal cycles against the real training worker on the CPU mesh:
+
+1. **kill + resume** — one SIGKILL injected mid-checkpoint (pre-commit
+   phase, via ``DS_FAULT_PLAN``), then a relaunch that must auto-resume from
+   the newest *committed* tag and finish with monotone steps.
+2. **NaN → rollback → rejoin** — a ``nan_at_step`` injection poisons one
+   batch; the divergence sentinel must roll the run back to the newest
+   committed checkpoint, skip the poisoned data cursor, and finish all steps
+   with a finite loss IN THE SAME PROCESS (exit 0 = the run self-healed).
+
 This is the cheap end of the resilience test pyramid — the full phase matrix
 with bitwise state comparison lives in
-``tests/test_resilience.py::test_sigkill_at_every_phase_resumes_bitwise``.
+``tests/test_resilience.py::test_sigkill_at_every_phase_resumes_bitwise``,
+and the in-run health acceptance suite in ``tests/test_watchdog.py``.
 """
 
 import json
@@ -19,6 +27,39 @@ import tempfile
 def fail(msg: str) -> int:
     print(f"chaos_smoke: FAIL — {msg}")
     return 1
+
+
+def nan_rollback_cycle(worker: str) -> int:
+    """NaN at data cursor 2 -> auto-rollback -> skip -> finish 4 steps."""
+    with tempfile.TemporaryDirectory() as td:
+        ckpt = os.path.join(td, "ckpt")
+        log = os.path.join(td, "log.jsonl")
+        env = dict(os.environ)
+        env["DS_FAULT_PLAN"] = json.dumps({"nan_at_step": 2})
+        p = subprocess.run(
+            [sys.executable, worker, "--ckpt-dir", ckpt, "--steps", "4",
+             "--log", log, "--sentinel"], env=env, timeout=240)
+        if p.returncode != 0:
+            return fail(f"sentinel run did not self-heal (rc={p.returncode})")
+        rows = [json.loads(ln) for ln in open(log)]
+        if not any(r["rolled_back"] for r in rows):
+            return fail("no divergence rollback recorded in the step log")
+        events = [json.loads(ln)["event"]
+                  for ln in open(os.path.join(ckpt, "recovery_events.jsonl"))]
+        for needed in ("divergence_rollback", "poison_skip"):
+            if needed not in events:
+                return fail(f"recovery event {needed!r} missing ({events})")
+        final = rows[-1]
+        if final["step"] != 4 or not (final["loss"] == final["loss"]):
+            return fail(f"run did not rejoin a healthy trajectory: {final}")
+        # the poisoned cursor must be excluded: cursor advances past the
+        # step count by exactly the skipped batches
+        if final["cursor"] <= final["step"]:
+            return fail(f"poisoned cursor was not skipped: {final}")
+    print(f"chaos_smoke: PASS — NaN at cursor 2 healed by rollback + skip "
+          f"(final step {final['step']}, cursor {final['cursor']}, "
+          f"loss {final['loss']:.4f})")
+    return 0
 
 
 def main() -> int:
@@ -59,7 +100,7 @@ def main() -> int:
             return fail("final checkpoint not committed")
     print(f"chaos_smoke: PASS — SIGKILL pre-commit absorbed, auto-resumed "
           f"(steps {steps})")
-    return 0
+    return nan_rollback_cycle(worker)
 
 
 if __name__ == "__main__":

@@ -62,12 +62,13 @@ fi
 grep -aE '^[0-9]+ passed' /tmp/_t1_overlap.log || true
 
 # --- fault-injection smoke (docs/RESILIENCE.md) ---------------------------
-# one SIGKILL injected mid-checkpoint + successful auto-resume on the CPU
-# mesh: the crash-consistency contract regressing must fail the gate, not
-# the next preemption in production.
-if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+# two heal cycles on the CPU mesh: SIGKILL mid-checkpoint + auto-resume
+# (crash consistency), and injected NaN -> divergence rollback -> poisoned
+# data-cursor skip -> rejoin (in-run health). Either contract regressing
+# must fail the gate, not the next incident in production.
+if ! timeout -k 10 420 env JAX_PLATFORMS=cpu \
         python scripts/chaos_smoke.py > /tmp/_t1_chaos.log 2>&1; then
-    echo "verify_tier1: FAIL — fault-injection smoke (kill + auto-resume):" >&2
+    echo "verify_tier1: FAIL — fault-injection smoke (kill/NaN heal cycles):" >&2
     tail -40 /tmp/_t1_chaos.log >&2
     exit 1
 fi

@@ -27,6 +27,9 @@ def main() -> int:
                    help="per-step sleep (gives the driver a SIGTERM window)")
     p.add_argument("--ready-file", default=None,
                    help="written after the first step completes")
+    p.add_argument("--sentinel", action="store_true",
+                   help="enable the divergence sentinel (rollback + cursor "
+                        "skip) and drive batches from engine.data_cursor")
     args = p.parse_args()
 
     # single forced-CPU device, independent of the inherited test env
@@ -48,13 +51,19 @@ def main() -> int:
 
     model, _ = build_gpt(gpt.GPTConfig(
         vocab_size=64, n_layer=2, n_head=2, d_model=32, max_seq_len=32))
+    res_cfg = {"enabled": True, "save_dir": args.ckpt_dir}
+    if args.sentinel:
+        # tight thresholds: the worker runs a handful of steps, so the
+        # sentinel must arm immediately (warmup 1) and a NaN must heal
+        res_cfg["sentinel"] = {"enabled": True, "warmup_steps": 1,
+                               "cursor_checkpointable": True}
     engine, _, _, _ = ds.initialize(model=model, config={
         "train_micro_batch_size_per_gpu": 2,
         "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
         "bf16": {"enabled": False},
         "steps_per_print": 0,
         # auto-resume from the newest committed tag + SIGTERM drain -> 83
-        "resilience": {"enabled": True, "save_dir": args.ckpt_dir},
+        "resilience": res_cfg,
     })
 
     def batch_for(step: int):
@@ -62,11 +71,18 @@ def main() -> int:
         return {"input_ids": r.integers(0, 64, size=(2, 16), dtype=np.int32)}
 
     while engine.global_steps < args.steps:
-        m = engine.train_batch(batch_for(engine.global_steps))
+        cursor = engine.data_cursor if args.sentinel else engine.global_steps
+        m = engine.train_batch(batch_for(cursor))
+        if m.get("skipped_batch"):
+            continue  # poisoned cursor consumed without a step
         if args.log:
             with open(args.log, "a") as f:
                 f.write(json.dumps({"step": engine.global_steps,
-                                    "loss": float(m["loss"])}) + "\n")
+                                    "cursor": engine.data_cursor,
+                                    "loss": float(m["loss"]),
+                                    "rolled_back": bool(
+                                        m.get("health", {}).get("rolled_back"))
+                                    }) + "\n")
         if args.ready_file and engine.global_steps == 1:
             with open(args.ready_file, "w") as f:
                 f.write("ready")

@@ -367,3 +367,41 @@ def test_checkpoint_uncommitted_load_rule(tmp_path):
         "train_micro_batch_size_per_gpu": 1,
         "resilience": {"enabled": True, "save_dir": str(fresh)}})
     assert not list(rule.check_context(AnalysisContext(config=cfg_fresh)))
+
+
+def test_rollback_without_data_cursor_rule(tmp_path):
+    """Divergence rollback armed without a cursor-checkpointable dataloader
+    warns; declaring the cursor (config flag or resume_state_provider)
+    silences it, as does leaving the sentinel off."""
+    from deepspeed_tpu.analysis.core import AnalysisContext
+    from deepspeed_tpu.analysis.rules_config import RollbackWithoutDataCursorRule
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    rule = RollbackWithoutDataCursorRule()
+
+    def cfg(sentinel):
+        return DeepSpeedConfig.load({
+            "train_micro_batch_size_per_gpu": 1,
+            "resilience": {"enabled": True, "save_dir": str(tmp_path),
+                           "sentinel": sentinel}})
+
+    armed = cfg({"enabled": True})
+    findings = list(rule.check_context(AnalysisContext(config=armed)))
+    assert len(findings) == 1
+    assert findings[0].severity == Severity.WARNING
+    assert findings[0].rule_id == "config/rollback-without-data-cursor"
+
+    # declared cursor-checkpointable -> silent
+    declared = cfg({"enabled": True, "cursor_checkpointable": True})
+    assert not list(rule.check_context(AnalysisContext(config=declared)))
+
+    # a registered resume_state_provider on the engine -> silent
+    class _Eng:
+        resume_state_provider = staticmethod(lambda: {"cursor": 0})
+
+    assert not list(rule.check_context(
+        AnalysisContext(config=armed, engine=_Eng())))
+
+    # sentinel off -> nothing armed, nothing to flag
+    off = cfg({"enabled": False})
+    assert not list(rule.check_context(AnalysisContext(config=off)))

@@ -1,0 +1,176 @@
+"""Data-cursor contract (ISSUE 8 satellite; docs/RESILIENCE.md "In-run
+health"): ``engine.data_cursor`` counts consumed global batches, rides
+checkpoint meta, and makes resume/rollback land on the exact next batch —
+checkpoint→resume is bitwise, and rollback-with-skip provably excludes the
+poisoned batch indices from training.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint.serialization import _fetch_full, _flatten_with_paths
+from deepspeed_tpu.models import GPTConfig, build_gpt
+from deepspeed_tpu.resilience import FaultPlan, install_plan
+
+TINY = GPTConfig(vocab_size=64, n_layer=2, n_head=2, d_model=32, max_seq_len=32)
+
+
+@pytest.fixture(autouse=True)
+def _clear_fault_plan():
+    yield
+    install_plan(None)
+
+
+def make_engine(resilience=None):
+    model, _ = build_gpt(TINY)
+    cfg = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "bf16": {"enabled": False},
+        "steps_per_print": 0,
+        "mesh": {"dp": 8},
+    }
+    if resilience is not None:
+        cfg["resilience"] = resilience
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    return engine
+
+
+def batch_for(cursor: int):
+    r = np.random.default_rng(1000 + cursor)
+    return {"input_ids": r.integers(0, 64, size=(8, 16), dtype=np.int32)}
+
+
+def state_arrays(engine):
+    return {key: np.asarray(_fetch_full(leaf))
+            for key, leaf in _flatten_with_paths(engine.state)[0]}
+
+
+def test_cursor_counts_consumed_batches_and_rides_meta(tmp_path):
+    engine = make_engine()
+    assert engine.data_cursor == 0
+    for _ in range(3):
+        engine.train_batch(batch_for(engine.data_cursor))
+    assert engine.data_cursor == 3
+    path = engine.save_checkpoint(str(tmp_path))
+    meta = json.load(open(f"{path}/meta.json"))
+    assert meta["data_cursor"] == 3
+
+    engine2 = make_engine()
+    engine2.load_checkpoint(str(tmp_path))
+    assert engine2.data_cursor == 3  # the exact next batch index
+
+
+def test_resume_lands_on_exact_next_batch_bitwise(tmp_path):
+    """Continuous 5-step run vs 3 steps + save + fresh-engine resume + 2
+    steps, both driven by batch_for(data_cursor): final state is BITWISE
+    identical — the cursor (plus the restored rng chain) fully determines
+    the remaining trajectory."""
+    a = make_engine()
+    for _ in range(5):
+        a.train_batch(batch_for(a.data_cursor))
+
+    b = make_engine()
+    for _ in range(3):
+        b.train_batch(batch_for(b.data_cursor))
+    b.save_checkpoint(str(tmp_path))
+
+    c = make_engine()
+    c.load_checkpoint(str(tmp_path))
+    assert c.data_cursor == 3
+    for _ in range(2):
+        c.train_batch(batch_for(c.data_cursor))
+
+    ref, got = state_arrays(a), state_arrays(c)
+    assert sorted(ref) == sorted(got)
+    for key in ref:
+        np.testing.assert_array_equal(ref[key], got[key], err_msg=key)
+
+
+def test_rollback_skip_excludes_poisoned_indices(tmp_path):
+    """Every executed (weight-updating) batch index is recorded; after a
+    NaN at cursor 3 heals, cursor 3 appears in the skip record and is never
+    executed again — and the healthy cursors each execute exactly once."""
+    engine = make_engine(resilience={
+        "enabled": True, "save_dir": str(tmp_path),
+        "install_signal_handlers": False,
+        "sentinel": {"enabled": True, "warmup_steps": 1,
+                     "checkpoint_interval": 1,
+                     "cursor_checkpointable": True}})
+    install_plan(FaultPlan.from_dict({"nan_at_step": 3}))
+    executed = []
+    while engine.global_steps < 6:
+        cursor = engine.data_cursor
+        m = engine.train_batch(batch_for(cursor))
+        if m.get("skipped_batch") or m.get("health", {}).get("rolled_back"):
+            continue
+        executed.append(cursor)
+    install_plan(None)
+    assert engine._health.skipped_cursors == [3]
+    assert 3 not in executed
+    # six steps from six distinct healthy cursors, in order
+    assert executed == [0, 1, 2, 4, 5, 6]
+    assert engine.data_cursor == 7
+
+
+def test_imperative_api_cursor_counts_boundaries():
+    """forward/backward/step: the cursor counts GLOBAL batches — one per
+    accumulation boundary, not one per micro-batch."""
+    model, _ = build_gpt(TINY)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": False},
+        "steps_per_print": 0,
+        "mesh": {"dp": 8},
+    })
+    for i in range(2):
+        engine.forward(batch_for(i))
+        engine.backward()
+        engine.step()
+    assert engine.global_steps == 1
+    assert engine.data_cursor == 1
+
+
+def test_imperative_path_sentinel_and_poison_skip(tmp_path):
+    """The sentinel works on forward/backward/step too: boundary metrics
+    (which carry no loss) merge the window's forward loss for the loss
+    channel, and after a rollback forward() consumes the poison window
+    without executing."""
+    engine = make_engine(resilience={
+        "enabled": True, "save_dir": str(tmp_path),
+        "install_signal_handlers": False,
+        "sentinel": {"enabled": True, "warmup_steps": 1,
+                     "checkpoint_interval": 2,
+                     "cursor_checkpointable": True}})
+
+    def one_step():
+        loss = engine.forward(batch_for(engine.data_cursor))
+        engine.backward()
+        engine.step()
+        return loss
+
+    for _ in range(3):  # anchors at step 2; no KeyError on any boundary
+        one_step()
+    assert engine._health.loss_detector.count == 3  # loss channel fed
+    assert engine.data_cursor == 3
+
+    rb = engine._health._rollback("test-injected divergence")
+    assert rb["to_step"] == 2 and rb["skip_cursors"] == [2]
+    assert engine.data_cursor == 2
+
+    # the poisoned cursor is consumed by forward() without executing: no
+    # micro advance, step() sees no boundary, no weights change
+    params_before = np.asarray(engine.state["params"]["wte"])
+    one_step()
+    assert engine._health.skipped_cursors == [2]
+    assert engine.global_steps == 2  # nothing stepped
+    np.testing.assert_array_equal(
+        params_before, np.asarray(engine.state["params"]["wte"]))
+    # the next healthy cursor trains normally
+    one_step()
+    assert engine.global_steps == 3 and engine.data_cursor == 4

@@ -42,6 +42,14 @@ def test_two_process_distributed_train_and_checkpoint(tmp_path):
         for pid in range(2)
     ]
     rcs = [p.wait(timeout=550) for p in procs]
+    if 76 in rcs:  # multihost_worker.BACKEND_UNSUPPORTED_EXIT
+        pytest.skip(
+            "this jaxlib's CPU client cannot execute cross-process programs "
+            "('Multiprocess computations aren't implemented on the CPU "
+            "backend', raised from the engine's jitted state init) — the "
+            "distributed code paths themselves are exercised single-process "
+            "by test_single_process_dp8_equivalent below and on real "
+            "multi-chip hardware by the MULTICHIP_r* runs")
     assert rcs == [0, 0]
 
     outs = [json.loads((tmp_path / f"out{pid}.json").read_text())
@@ -52,3 +60,44 @@ def test_two_process_distributed_train_and_checkpoint(tmp_path):
     # the multi-host checkpoint round-trip continued identically on both
     for o in outs:
         np.testing.assert_allclose(o["resumed"], o["ref"], rtol=1e-6)
+
+
+def test_single_process_dp8_equivalent(tmp_path):
+    """The worker's exact scenario — dp data-parallel ZeRO-2 train, save,
+    fresh-engine reload, identical continuation — on the in-process 8-device
+    mesh. Every sharded-compute path the 2-process test would run (batch
+    placement over dp, GSPMD grad reduction, collective checkpoint gathers,
+    reload resharding) compiles and executes identically here; what it
+    cannot cover is the jax.distributed rendezvous + cross-process barrier,
+    which this jaxlib's CPU client refuses (see the skip above)."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_gpt, gpt
+
+    def make():
+        model, _ = build_gpt(gpt.GPTConfig(
+            vocab_size=64, n_layer=2, n_head=2, d_model=32, max_seq_len=32))
+        engine, _, _, _ = ds.initialize(model=model, config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "zero_optimization": {"stage": 2},
+            "mesh": {"dp": 8},
+            "bf16": {"enabled": False},
+            "steps_per_print": 0,
+        })
+        return engine
+
+    engine = make()
+    r = np.random.default_rng(0)
+    ids = r.integers(0, 64, size=(8, 16), dtype=np.int32)
+    losses = [float(engine.train_batch({"input_ids": ids})["loss"])
+              for _ in range(3)]
+    assert losses[-1] < losses[0]
+
+    engine.save_checkpoint(str(tmp_path / "ckpt"))
+    ref = float(engine.train_batch({"input_ids": ids})["loss"])
+
+    engine2 = make()
+    path, _ = engine2.load_checkpoint(str(tmp_path / "ckpt"))
+    assert path is not None
+    got = float(engine2.train_batch({"input_ids": ids})["loss"])
+    np.testing.assert_allclose(got, ref, rtol=1e-6)

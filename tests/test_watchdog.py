@@ -1,0 +1,372 @@
+"""In-run health acceptance suite (ISSUE 8; docs/RESILIENCE.md "In-run
+health"): the three self-healing pillars proven against injected faults.
+
+1. Numerical sentinels: an injected NaN at a known data cursor triggers
+   automatic rollback to the newest committed checkpoint plus a
+   deterministic skip of the poisoned cursor, and the loss trajectory
+   rejoins the clean run.
+2. Hang watchdog: an injected collective stall is detected within the
+   configured deadline, dumps stacks, and escalates through the drain path
+   to a COMMITTED emergency save.
+3. Graceful degradation: forced error-feedback overflows demote the
+   quantized gradient exchange to the fp32 wire (visible in
+   ``comms_summary``), and a clean window re-promotes it; failed monitor
+   and checkpoint I/O buffer in memory instead of killing the step.
+"""
+
+import math
+import os
+import time
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.comm.runtime_accounting import wire_ledger
+from deepspeed_tpu.models import GPTConfig, build_gpt
+from deepspeed_tpu.resilience import (
+    FaultPlan,
+    PREEMPTED_EXIT_CODE,
+    STACKS_FILENAME,
+    SpikeDetector,
+    committed_tags,
+    identify_stragglers,
+    install_plan,
+    read_events,
+)
+
+TINY = GPTConfig(vocab_size=64, n_layer=2, n_head=2, d_model=32, max_seq_len=32)
+
+
+@pytest.fixture(autouse=True)
+def _clear_fault_plan():
+    yield
+    install_plan(None)
+
+
+def make_engine(save_dir, *, sentinel=None, watchdog=None, degraded=None,
+                zero=None, extra=None):
+    model, _ = build_gpt(TINY)
+    res = {"enabled": True, "save_dir": str(save_dir),
+           "install_signal_handlers": False}
+    if sentinel is not None:
+        res["sentinel"] = sentinel
+    if watchdog is not None:
+        res["watchdog"] = watchdog
+    if degraded is not None:
+        res["degraded"] = degraded
+    cfg = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "bf16": {"enabled": False},
+        "steps_per_print": 0,
+        "mesh": {"dp": 8},
+        "resilience": res,
+    }
+    if zero is not None:
+        cfg["zero_optimization"] = zero
+    cfg.update(extra or {})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    return engine
+
+
+def batch_for(cursor: int):
+    r = np.random.default_rng(1000 + cursor)
+    return {"input_ids": r.integers(0, 64, size=(8, 16), dtype=np.int32)}
+
+
+def drive(engine, steps: int):
+    """Cursor-driven training loop (the contract sentinel rollback assumes);
+    returns {step: loss} of executed (non-skipped, non-rolled-back) steps."""
+    losses = {}
+    while engine.global_steps < steps:
+        m = engine.train_batch(batch_for(engine.data_cursor))
+        if m.get("skipped_batch") or m.get("health", {}).get("rolled_back"):
+            continue
+        losses[engine.global_steps] = float(m["loss"])
+    return losses
+
+
+# ------------------------------------------------------------ spike detector
+def test_spike_detector_fires_on_nan_and_spike_only():
+    det = SpikeDetector(zscore=4.0, beta=0.9, warmup=5, min_rel=0.1)
+    # warmup + stable stream: no detection, statistics build
+    for i in range(20):
+        assert det.update(4.0 + 0.01 * ((-1) ** i)) is None
+    mean_before = det.mean
+    # ordinary wobble on a flat curve: huge z (variance collapsed) but under
+    # the relative floor -> calm
+    assert det.update(4.03) is None
+    # a real spike: both sigma and relative floor exceeded
+    reason = det.update(8.0)
+    assert reason is not None and "spike" in reason
+    # the spike was NOT absorbed into the EMA baseline
+    assert det.mean < 4.1 and abs(det.mean - mean_before) < 0.1
+    # non-finite fires immediately, even during warmup
+    fresh = SpikeDetector(warmup=100)
+    assert "non-finite" in fresh.update(float("nan"))
+    assert "non-finite" in fresh.update(float("inf"))
+
+
+def test_spike_detector_warmup_gates_spikes():
+    det = SpikeDetector(zscore=2.0, warmup=10, min_rel=0.0)
+    assert det.update(1.0) is None
+    assert det.update(100.0) is None  # count=1 < warmup: spike not judged
+
+
+# ------------------------------------------------- pillar 1: NaN -> rollback
+def test_nan_rollback_skips_poison_and_rejoins(tmp_path):
+    """Acceptance: injected NaN at data cursor 4 -> auto-rollback + cursor
+    skip; the healed trajectory rejoins the clean run's loss level."""
+    clean = drive(make_engine(tmp_path / "clean"), steps=8)
+
+    engine = make_engine(
+        tmp_path / "chaos",
+        sentinel={"enabled": True, "warmup_steps": 1,
+                  "checkpoint_interval": 1, "cursor_checkpointable": True})
+    install_plan(FaultPlan.from_dict({"nan_at_step": 4}))
+    healed = drive(engine, steps=8)
+    install_plan(None)
+
+    h = engine._health
+    assert h.rollbacks == 1
+    assert h.skipped_cursors == [4]          # exactly the poison, nothing else
+    assert engine.data_cursor == 9           # 8 stepped + 1 skipped
+    events = {e["event"] for e in read_events(str(tmp_path / "chaos"))}
+    assert {"divergence_rollback", "poison_skip"} <= events
+    rb = [e for e in read_events(str(tmp_path / "chaos"))
+          if e["event"] == "divergence_rollback"][0]
+    assert rb["skip_cursors"] == [4] and rb["from_step"] == 5
+    # rejoin: every loss after the heal is finite, and the final level
+    # matches the clean run within a small tolerance (the healed run trained
+    # on one fewer batch, so bitwise equality is impossible by construction)
+    assert all(math.isfinite(v) for v in healed.values())
+    assert abs(healed[8] - clean[8]) < 0.05 * abs(clean[8])
+
+
+def test_rollback_budget_exhaustion_raises(tmp_path):
+    """A poison the skip cannot clear (sentinel armed but skipping disabled)
+    must fail LOUDLY once the budget is spent, not thrash forever."""
+    from deepspeed_tpu.resilience import DivergenceError
+
+    engine = make_engine(
+        tmp_path,
+        sentinel={"enabled": True, "warmup_steps": 1, "max_rollbacks": 2,
+                  "checkpoint_interval": 1, "skip_poisoned_batches": False,
+                  "cursor_checkpointable": True})
+    engine.train_batch(batch_for(engine.data_cursor))
+    install_plan(FaultPlan.from_dict({"nan_at_step": 1}))
+    with pytest.raises(DivergenceError, match="budget"):
+        for _ in range(6):
+            engine.train_batch(batch_for(engine.data_cursor))
+    assert engine._health.rollbacks == 2
+
+
+# ----------------------------------------------- pillar 2: stall -> watchdog
+def test_stall_detected_within_deadline_and_emergency_save(tmp_path):
+    """Acceptance: an injected collective stall is detected within the
+    watchdog deadline, dumps stacks, and escalates through the drain path to
+    a committed emergency save + preemption exit."""
+    engine = make_engine(
+        tmp_path,
+        watchdog={"enabled": True, "poll_interval_s": 0.05,
+                  "collective_deadline_s": 0.3})
+    engine.train_batch(batch_for(0))
+    install_plan(FaultPlan.from_dict(
+        {"stall_collective": 1.2, "stall_collective_at_step": 1}))
+    t0 = time.monotonic()
+    with pytest.raises(SystemExit) as exc:
+        engine.train_batch(batch_for(1))
+    assert exc.value.code == PREEMPTED_EXIT_CODE
+    assert engine._watchdog.stall_count == 1
+    phase, elapsed = engine._watchdog.last_stall
+    assert phase == "collective"
+    assert elapsed < 1.0  # detected within the deadline, not at stall end
+    assert time.monotonic() - t0 < 30
+    # the escalation produced a COMMITTED emergency save
+    tags = committed_tags(str(tmp_path))
+    assert tags, "no committed emergency checkpoint"
+    events = {e["event"] for e in read_events(str(tmp_path))}
+    assert {"watchdog_stall", "watchdog_recovered", "emergency_save"} <= events
+    stall = [e for e in read_events(str(tmp_path))
+             if e["event"] == "watchdog_stall"][0]
+    assert stall["phase"] == "collective"
+    # the stack dump exists and names this test's frames
+    stacks = (tmp_path / STACKS_FILENAME).read_text()
+    assert "watchdog stall: phase=collective" in stacks
+    assert "train_batch" in stacks
+    engine._watchdog.stop()
+
+
+def test_watchdog_quiet_on_healthy_run(tmp_path):
+    engine = make_engine(
+        tmp_path,
+        watchdog={"enabled": True, "poll_interval_s": 0.05,
+                  "step_deadline_s": 120.0, "collective_deadline_s": 120.0})
+    for _ in range(2):
+        engine.train_batch(batch_for(engine.data_cursor))
+    time.sleep(0.2)  # several poll cycles with no phase active
+    assert engine._watchdog.stall_count == 0
+    engine._watchdog.stop()
+
+
+def test_identify_stragglers_pure():
+    assert identify_stragglers([10.0, 10.5, 31.0, 9.8], factor=2.0) == [2]
+    assert identify_stragglers([10.0, 10.5, 11.0, 9.8], factor=2.0) == []
+    # 2-host pod: the lower median makes the slow host detectable (the
+    # upper median would be the straggler's own duration — never flaggable)
+    assert identify_stragglers([1.0, 30.0], factor=2.0) == [1]
+    # half-sick even pod: both slow hosts flagged, not hidden by each other
+    assert identify_stragglers([1.0, 1.1, 10.0, 10.5], factor=2.0) == [2, 3]
+    # tiny steps: 2x of nothing is noise, the absolute floor keeps it quiet
+    assert identify_stragglers([0.01, 0.025, 0.012], factor=2.0) == []
+    assert identify_stragglers([5.0]) == []  # single host: nothing to compare
+
+
+# --------------------------------------- pillar 3: overflow -> wire demotion
+def test_ef_overflow_demotes_then_repromotes(tmp_path):
+    """Acceptance: repeated forced EF overflows demote the quantized
+    gradient exchange to the fp32 wire (recorded in comms_summary); a clean
+    window re-promotes it and the quantized wire records traffic again."""
+    wire_ledger.reset()
+    engine = make_engine(
+        tmp_path,
+        zero={"stage": 2, "zero_quantized_gradients": True,
+              "zero_quantize_error_feedback": True},
+        degraded={"demote_after": 2, "repromote_after": 3})
+    engine.train_batch(batch_for(0))
+    assert not engine._qgrad_demoted
+
+    install_plan(FaultPlan.from_dict({"ef_overflow_steps": 2}))
+    engine.train_batch(batch_for(1))
+    assert not engine._qgrad_demoted  # one overflow is weather, not climate
+    m = engine.train_batch(batch_for(2))
+    install_plan(None)
+    assert engine._qgrad_demoted
+    assert m["health"]["wire"] == "demoted"
+    assert wire_ledger.demoted_ops() == ["qgrad"]
+    summary = engine.comms_summary()
+    assert "degraded wire: qgrad -> full-precision" in summary
+    assert "STILL DEMOTED" in summary
+
+    # overflow micro-steps are visible in the run record (satellite: no
+    # silent skips)
+    events = [e["event"] for e in read_events(str(tmp_path))]
+    assert events.count("overflow_skip") == 2
+    assert "wire_demoted" in events
+
+    qgrad_traces = wire_ledger.records["qgrad_reduce_scatter[dp]"].count
+    for c in (3, 4):
+        engine.train_batch(batch_for(c))
+        assert engine._qgrad_demoted  # clean window not yet complete
+    m = engine.train_batch(batch_for(5))
+    assert not engine._qgrad_demoted
+    assert m["health"]["wire"] == "repromoted"
+    # EF residuals were reset for the fresh quantized start
+    assert float(np.abs(np.asarray(engine.state["qgrad_residual"])).max()) == 0
+    engine.train_batch(batch_for(6))
+    # the re-promotion retraced the quantized exchange: new ledger records
+    assert wire_ledger.records["qgrad_reduce_scatter[dp]"].count > qgrad_traces
+    summary = engine.comms_summary()
+    assert "re-promoted at step" in summary
+    assert "wire_repromoted" in [e["event"] for e in read_events(str(tmp_path))]
+    wire_ledger.reset()
+
+
+# ------------------------------------------------ degradation: monitor + ckpt
+def test_monitor_degrades_to_memory_buffer_and_reflushes():
+    from deepspeed_tpu.monitor.monitor import MonitorMaster, _SafeBackend
+    from deepspeed_tpu.runtime.config import MonitorConfig
+
+    sunk, fail = [], {"on": True}
+
+    class Flaky:
+        def write_events(self, events):
+            if fail["on"]:
+                raise OSError("disk full")
+            sunk.extend(events)
+
+    mm = MonitorMaster(MonitorConfig(), extra_backends=[Flaky()])
+    mm.write_events([("Train/loss", 1.0, 1)])  # must not raise
+    mm.write_events([("Train/loss", 2.0, 2)])
+    assert mm.degraded and sunk == []
+    fail["on"] = False
+    mm.write_events([("Train/loss", 3.0, 3)])
+    assert not mm.degraded
+    # buffered events flushed in order, nothing lost
+    assert [e[1] for e in sunk] == [1.0, 2.0, 3.0]
+
+    # bounded buffer: oldest events drop first
+    sb = _SafeBackend(Flaky(), buffer_limit=2)
+    fail["on"] = True
+    for i in range(5):
+        sb.write_events([("x", float(i), i)])
+    assert len(sb._buffer) == 2 and sb.dropped == 3
+    assert [e[1] for e in sb._buffer] == [3.0, 4.0]
+
+
+def test_checkpoint_io_degrades_to_memory_anchor(tmp_path, monkeypatch):
+    """Periodic-save I/O failure must not kill the step: the anchor degrades
+    to the in-memory snapshot, and a later divergence still heals from it."""
+    engine = make_engine(
+        tmp_path,
+        sentinel={"enabled": True, "warmup_steps": 1,
+                  "checkpoint_interval": 1, "cursor_checkpointable": True})
+
+    def broken_save(save_dir, *a, **k):
+        raise OSError("filesystem went away")
+
+    monkeypatch.setattr(engine, "save_checkpoint", broken_save)
+    engine.train_batch(batch_for(0))  # auto-save fails -> degraded, no raise
+    engine.train_batch(batch_for(1))
+    h = engine._health
+    assert h.checkpoint_io_degraded
+    assert h._memory_snapshot is not None
+    events = [e["event"] for e in read_events(str(tmp_path))]
+    assert "checkpoint_io_degraded" in events
+
+    install_plan(FaultPlan.from_dict({"nan_at_step": 2}))
+    m = engine.train_batch(batch_for(2))
+    install_plan(None)
+    rb = m["health"]["rolled_back"]
+    assert rb["source"] == "memory"  # no committed tag exists on disk
+    # healed from the memory anchor: training continues
+    m = engine.train_batch(batch_for(engine.data_cursor))
+    assert m.get("skipped_batch")  # the poisoned cursor is consumed first
+    m = engine.train_batch(batch_for(engine.data_cursor))
+    assert math.isfinite(float(m["loss"]))
+
+
+# ------------------------------------------------------------ config guards
+def test_sentinel_requires_resilience_block():
+    model, _ = build_gpt(TINY)
+    with pytest.raises(Exception, match="resilience.sentinel"):
+        deepspeed_tpu.initialize(model=model, config={
+            "train_micro_batch_size_per_gpu": 1,
+            "mesh": {"dp": 8},
+            "resilience": {"sentinel": {"enabled": True}},
+        })
+
+
+def test_overflow_skip_event_without_resilience_block(tmp_path):
+    """The Resilience/overflow_skip scalar reaches the monitor even when the
+    resilience block (and its recovery log) is off."""
+    from deepspeed_tpu.monitor.monitor import CallbackMonitor, MonitorMaster
+    from deepspeed_tpu.runtime.config import MonitorConfig
+
+    model, _ = build_gpt(TINY)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": False},
+        "mesh": {"dp": 8},
+        "steps_per_print": 0,
+    })
+    events = []
+    engine._monitor = MonitorMaster(
+        MonitorConfig(), extra_backends=[CallbackMonitor(events.extend)])
+    install_plan(FaultPlan.from_dict({"ef_overflow_steps": 1}))
+    engine.train_batch(batch_for(0))
+    install_plan(None)
+    assert ("Resilience/overflow_skip", 1.0, 1) in events
+    assert engine.skipped_steps == 1
