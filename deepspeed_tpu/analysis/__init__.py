@@ -37,13 +37,14 @@ from .rules_collectives import collective_rules
 from .rules_config import config_rules
 from .rules_hostsync import hostsync_rules
 from .rules_precision import precision_rules
+from .rules_serving import serving_rules
 from .rules_sharding import sharding_rules
 
 
 def default_rules() -> List[Rule]:
-    """The shipped rule set, all five families."""
+    """The shipped rule set, all six families."""
     return (sharding_rules() + precision_rules() + hostsync_rules()
-            + collective_rules() + config_rules())
+            + collective_rules() + config_rules() + serving_rules())
 
 
 def options_from_config(block) -> AnalysisOptions:
@@ -150,6 +151,21 @@ def analyze_engine(engine, batch: Any = None, compile: bool = False,
     return analyzer.run([prog], ctx)
 
 
+def analyze_compile_log(engine_or_log,
+                        rules: Optional[Sequence[Rule]] = None) -> Report:
+    """Audit an Inference/Serving engine's compiled-program cache-miss
+    stream (``engine.compile_log``) — or a raw list of
+    ``{"kind", "shape"}`` events — for the recompile-per-step pathology
+    (``serving/unbucketed-decode-shape``). Pure host analysis: no tracing,
+    no device work."""
+    if isinstance(engine_or_log, (list, tuple)):
+        ctx = AnalysisContext(compile_log=list(engine_or_log))
+    else:
+        ctx = AnalysisContext(engine=engine_or_log)
+    return Analyzer(rules=rules or serving_rules(),
+                    options=ctx.options).run([], ctx)
+
+
 def analyze_fn(fn: Callable, *args, name: str = "program",
                donate_argnums: Sequence[int] = (), compile: bool = False,
                config: Any = None, mesh: Any = None,
@@ -176,5 +192,5 @@ __all__ = [
     "Severity", "Finding", "Rule", "Report", "Analyzer", "AnalysisContext",
     "AnalysisOptions", "AnalysisError", "ProgramIR", "capture",
     "default_rules", "options_from_config", "analyze_engine", "analyze_fn",
-    "synthesize_batch",
+    "analyze_compile_log", "synthesize_batch",
 ]

@@ -138,6 +138,10 @@ class AnalysisContext:
     config: Any = None              # DeepSpeedConfig (or None)
     mesh: Any = None                # jax.sharding.Mesh (or None)
     options: AnalysisOptions = dataclasses.field(default_factory=AnalysisOptions)
+    # compiled-program cache-miss stream from an Inference/Serving engine
+    # ({"kind","shape","time"} dicts); rules_serving audits it. When None,
+    # rules fall back to ctx.engine.compile_log if the engine exposes one.
+    compile_log: Any = None
 
     @property
     def n_devices(self) -> int:

@@ -1,0 +1,100 @@
+"""Serving rules: decode hot paths that recompile per step.
+
+XLA compiles per input shape. A decode loop that feeds the growing context
+back as a fresh shape ("cache" sliced to the valid length, prompt+generated
+re-run each token, an un-padded per-request batch) silently compiles EVERY
+step — seconds of compile per token of decode, the single worst serving
+pathology and invisible until you read the logs. The inference/serving
+engines record every compiled-program cache miss in ``compile_log``
+(``{"kind", "shape", "time"}``); this rule audits that stream.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from .core import AnalysisContext, Finding, Rule, Severity
+
+# ≥3 consecutive same-kind compiles whose shapes differ in exactly one
+# dimension by the same small positive stride is the creeping-shape
+# signature (stride = tokens appended per step). Bucketed shape sets
+# (powers of two) double between misses — unequal strides, never flagged.
+_MIN_RUN = 3
+_MAX_STRIDE = 8
+
+
+def _stride(prev, cur):
+    """(dim, delta) when cur grows from prev in exactly one dimension by a
+    small positive delta; None otherwise."""
+    if len(prev) != len(cur):
+        return None
+    diffs = [(d, c - p) for d, (p, c) in enumerate(zip(prev, cur)) if c != p]
+    if len(diffs) != 1:
+        return None
+    d, delta = diffs[0]
+    if 0 < delta <= _MAX_STRIDE:
+        return (d, delta)
+    return None
+
+
+class UnbucketedDecodeShapeRule(Rule):
+    """A decode/generate hot path compiled ≥3 consecutive shapes creeping
+    along one dimension at a fixed stride — the recompile-per-step bug."""
+
+    rule_id = "serving/unbucketed-decode-shape"
+    default_severity = Severity.ERROR
+    description = "decode hot path recompiles per step (unbucketed shape)"
+
+    def check_context(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        log = getattr(ctx, "compile_log", None)
+        if log is None and ctx.engine is not None:
+            log = getattr(ctx.engine, "compile_log", None)
+        if not log:
+            return
+        by_kind = {}
+        for ev in log:
+            shape = tuple(ev.get("shape") or ())
+            if shape:
+                by_kind.setdefault(ev.get("kind", "?"), []).append(shape)
+        for kind, shapes in by_kind.items():
+            yield from self._check_stream(kind, shapes)
+
+    def _check_stream(self, kind: str, shapes: List[tuple]
+                      ) -> Iterable[Finding]:
+        run = 1
+        run_stride = None
+        for i in range(1, len(shapes)):
+            st = _stride(shapes[i - 1], shapes[i])
+            if st is not None and (run_stride is None or st == run_stride):
+                run += 1
+                run_stride = st
+                if run == _MIN_RUN:
+                    d, delta = st
+                    first, cur = shapes[i - run + 1], shapes[i]
+                    yield self.finding(
+                        f"'{kind}' compiled {run}+ consecutive shapes "
+                        f"creeping along dim {d} by +{delta} per call "
+                        f"(e.g. {first} -> {cur}) — every decode step is "
+                        f"paying a fresh XLA compile",
+                        location=f"compile_log[{kind}]",
+                        suggestion="pad the dynamic dimension to a bucket "
+                                   "(DeepSpeedInferenceConfig.decode_buckets "
+                                   "/ serving shape buckets) or keep the "
+                                   "cache fixed-shape with a traced valid "
+                                   "length, so one compiled program serves "
+                                   "every step",
+                    )
+                    return  # one finding per stream is enough signal
+            elif st is not None:
+                # a stride CHANGE still leaves the current pair as the start
+                # of a new run — discarding it would delay detection by one
+                # compile
+                run = 2
+                run_stride = st
+            else:
+                run = 1
+                run_stride = None
+
+
+def serving_rules() -> List[Rule]:
+    return [UnbucketedDecodeShapeRule()]

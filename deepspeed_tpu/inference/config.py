@@ -50,6 +50,20 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     # value is ENFORCED at generate() — unlike the reference, which accepts
     # the field but never checks it
     max_batch_size: int = 0
+    # decode shape buckets (shared with the serving path,
+    # ``inference/serving/buckets.py``): when set, ``generate`` rounds
+    # max_new_tokens UP to the nearest bucket and slices the output back, so
+    # nearby request shapes reuse one compiled program instead of compiling
+    # per (B, T, max_new) triple. Costs eos-frozen no-op steps up to the
+    # bucket boundary; sampling draws per-step keys, so bucketed and
+    # unbucketed runs of the same seed can sample differently.
+    decode_buckets: Optional[list] = None
+    # every compiled-program cache miss is appended to ``engine.compile_log``
+    # and (when a monitor is attached via ``set_monitor``) emitted as an
+    # ``Inference/compile_events`` scalar — silent per-shape recompiles are
+    # the decode hot path's classic perf bug (dslint:
+    # serving/unbucketed-decode-shape)
+    log_compile_events: bool = True
     replace_with_kernel_inject: bool = Field(False, alias="kernel_inject")
     enable_cuda_graph: bool = True  # TPU analog: AOT-compiled fixed-shape decode step
     replace_method: str = "auto"

@@ -60,6 +60,10 @@ class InferenceEngine:
         self._decode_fns: Dict[Tuple, Callable] = {}
         self._profile_model_time = False
         self._model_times = []
+        # compiled-program cache misses, in order (the evidence stream the
+        # serving/unbucketed-decode-shape dslint rule audits)
+        self.compile_log = []
+        self.monitor = None
 
         # dtype conversion + TP placement (parity: engine init flow :38-150).
         # Quantized {"q"/"q4","s"} leaves pass through whole: the int8/int4
@@ -175,6 +179,30 @@ class InferenceEngine:
                for leaf, s in zip(flat, self._quant_scales)]
         return jax.tree_util.tree_unflatten(treedef, out)
 
+    def set_monitor(self, monitor) -> None:
+        """Attach a ``MonitorMaster``-like sink for compile events."""
+        self.monitor = monitor
+
+    def _log_compile(self, kind: str, shape: Tuple[int, ...]) -> None:
+        if not self.config.log_compile_events:
+            return
+        from .serving.buckets import record_compile
+
+        record_compile(self.compile_log, self.monitor,
+                       "Inference/compile_events", kind, shape,
+                       hint="repeated shape misses on a hot path? consider "
+                            "decode_buckets")
+
+    def _bucket_max_new(self, max_new: int) -> int:
+        """Round max_new up to the configured decode bucket (serving shape
+        buckets) so repeat shapes hit the compiled-fn cache; callers slice
+        generated output back to the requested length."""
+        if not self.config.decode_buckets:
+            return max_new
+        from .serving.buckets import bucket_for
+
+        return bucket_for(max_new, self.config.decode_buckets)
+
     def profile_model_time(self, use_cuda_events: bool = False) -> None:
         """Parity: ``inference/engine.py:151``."""
         self._profile_model_time = True
@@ -199,6 +227,8 @@ class InferenceEngine:
     def _get_prefill_fn(self, shape):
         key = ("prefill", shape)
         if key not in self._decode_fns:
+            self._log_compile("prefill", shape)
+
             def fn(params, ids):
                 params = self._materialize(params)
                 cache = self.model.init_cache(shape[0], shape[1], self.dtype)
@@ -232,6 +262,8 @@ class InferenceEngine:
             raise ValueError(
                 f"max_new_tokens {max_new} < min_out_tokens "
                 f"{self.config.min_out_tokens}")
+        requested = max_new
+        max_new = self._bucket_max_new(max_new)
         key = jax.random.PRNGKey(seed)
         eos = -1 if eos_token_id is None else eos_token_id
         if num_beams > 1:
@@ -240,18 +272,22 @@ class InferenceEngine:
                                  "knobs cannot combine with num_beams > 1")
             gen_key = (B, T, max_new, "beam", num_beams, eos)
             if gen_key not in self._decode_fns:
+                self._log_compile("generate_beam", (B, T, max_new))
                 self._decode_fns[gen_key] = self._build_beam_fn(
                     B, T, max_new, num_beams, eos)
         else:
             gen_key = (B, T, max_new, temperature, top_k, top_p,
                        repetition_penalty, eos)
             if gen_key not in self._decode_fns:
+                self._log_compile("generate", (B, T, max_new))
                 self._decode_fns[gen_key] = self._build_generate_fn(*gen_key)
         fn = self._decode_fns[gen_key]
         t0 = time.perf_counter()
         with mesh_context(self.mesh):
             out = fn(self.params, input_ids, key)
         out = np.asarray(jax.device_get(out))
+        if max_new != requested:  # bucket padding: slice back
+            out = out[:, :T + requested]
         if self._profile_model_time:
             self._model_times.append(time.perf_counter() - t0)
         return out

@@ -1,0 +1,28 @@
+"""Continuous-batching serving: paged KV cache + per-step scheduler.
+
+See ``docs/SERVING.md``. Layering:
+
+- :mod:`.paging` — host-side page allocator (free list; page 0 reserved).
+- :mod:`.buckets` — the shape-bucket helpers the serving engine and
+  ``InferenceEngine`` share to bound compile counts.
+- :mod:`.scheduler` — device-free admit/evict/preempt over decode slots.
+- :mod:`.engine` — compiled prefill/decode/scatter programs (the executor).
+- :mod:`.bench` — open-loop workload, TTFT/tokens-per-sec reports, and the
+  static-batch baseline A/B.
+"""
+
+from .buckets import bucket_for, default_buckets
+from .engine import ServingConfig, ServingEngine
+from .paging import PageAllocator, RESERVED_PAGE, pages_for
+from .scheduler import ContinuousBatchingScheduler, Request, RequestState
+from .bench import (make_open_loop_workload, percentile, run_continuous,
+                    run_static_baseline)
+
+__all__ = [
+    "PageAllocator", "RESERVED_PAGE", "pages_for",
+    "bucket_for", "default_buckets",
+    "ContinuousBatchingScheduler", "Request", "RequestState",
+    "ServingConfig", "ServingEngine",
+    "make_open_loop_workload", "percentile", "run_continuous",
+    "run_static_baseline",
+]

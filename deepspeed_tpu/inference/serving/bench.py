@@ -1,0 +1,164 @@
+"""Request-level serving benchmark: open-loop arrivals, TTFT + tokens/s.
+
+Open loop (arrivals follow a Poisson clock regardless of completions) is the
+honest serving load: a closed loop would slow the arrival rate down whenever
+the server stalls, hiding exactly the tail it is supposed to expose. The
+workload is synthetic but seeded, so A/B runs replay identical requests.
+
+Two runners share a report schema:
+
+- :func:`run_continuous` — the paged continuous-batching stack
+  (``ServingEngine`` + ``ContinuousBatchingScheduler``).
+- :func:`run_static_baseline` — ``InferenceEngine.generate`` batches in
+  arrival order: every request in a batch waits for the batch to fill, pads
+  to the longest prompt, decodes to the LONGEST max_new in the batch, and
+  nobody's slot frees early. That is today's ``generate`` serving story and
+  the baseline the continuous row must beat on aggregate tokens/s at equal
+  HBM budget.
+
+Useful tokens are counted identically on both sides (each request's own
+``max_new_tokens``), so tokens/s differences come from scheduling, not
+accounting.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .scheduler import ContinuousBatchingScheduler, Request
+
+
+def percentile(xs: Sequence[float], p: float) -> float:
+    if not xs:
+        return float("nan")
+    xs = sorted(xs)
+    idx = min(len(xs) - 1, int(round(p / 100.0 * (len(xs) - 1))))
+    return float(xs[idx])
+
+
+def make_open_loop_workload(n_requests: int, rate_rps: float,
+                            prompt_len: tuple, max_new: tuple,
+                            vocab_size: int, seed: int = 0,
+                            eos_token_id: Optional[int] = None
+                            ) -> List[Request]:
+    """Poisson arrivals at ``rate_rps``; prompt/generation lengths uniform in
+    the given inclusive ranges. Mixed lengths on purpose — the paged cache's
+    whole value proposition is not paying max_len per request."""
+    rng = np.random.default_rng(seed)
+    t = 0.0
+    out = []
+    for _ in range(n_requests):
+        t += float(rng.exponential(1.0 / rate_rps))
+        pl = int(rng.integers(prompt_len[0], prompt_len[1] + 1))
+        mn = int(rng.integers(max_new[0], max_new[1] + 1))
+        out.append(Request(
+            prompt=rng.integers(0, vocab_size, (pl,)).astype(np.int32),
+            max_new_tokens=mn, eos_token_id=eos_token_id, arrival_time=t))
+    return out
+
+
+def _report(requests: Sequence[Request], t0: float, t_end: float,
+            mode: str, extra: Optional[Dict] = None) -> Dict:
+    ttft, per_tok, total_tokens = [], [], 0
+    for r in requests:
+        arrive = t0 + r.arrival_time
+        if r.t_first_token is not None:
+            ttft.append(r.t_first_token - arrive)
+        n = min(len(r.tokens), r.max_new_tokens)
+        total_tokens += n
+        # run-to-completion baselines deliver every token at once
+        # (t_done == t_first): per-token cadence is undefined there, not 0
+        if (r.t_done is not None and n > 1
+                and r.t_done > r.t_first_token):
+            per_tok.append((r.t_done - r.t_first_token) / (n - 1))
+
+    def ms(x, nd=2):
+        return None if x != x else round(x * 1e3, nd)  # NaN -> JSON null
+
+    wall = max(t_end - t0, 1e-9)
+    row = {
+        "mode": mode,
+        "requests": len(requests),
+        "finished": sum(r.t_done is not None for r in requests),
+        "total_tokens": int(total_tokens),
+        "wall_s": round(wall, 3),
+        "tokens_per_sec": round(total_tokens / wall, 2),
+        "ttft_p50_ms": ms(percentile(ttft, 50)),
+        "ttft_p99_ms": ms(percentile(ttft, 99)),
+        "per_token_p50_ms": ms(percentile(per_tok, 50), 3),
+        "per_token_p99_ms": ms(percentile(per_tok, 99), 3),
+    }
+    if extra:
+        row.update(extra)
+    return row
+
+
+def run_continuous(engine, workload: Sequence[Request],
+                   max_wall_s: float = 600.0) -> Dict:
+    """Drive the scheduler under the workload's arrival clock."""
+    sched: ContinuousBatchingScheduler = engine.make_scheduler()
+    pending = sorted(workload, key=lambda r: r.arrival_time)
+    t0 = time.monotonic()
+    i = 0
+    while i < len(pending) or not sched.idle:
+        now = time.monotonic() - t0
+        if now > max_wall_s:
+            break
+        while i < len(pending) and pending[i].arrival_time <= now:
+            sched.submit(pending[i])
+            i += 1
+        if sched.idle:
+            if i < len(pending):  # nothing in flight: sleep to next arrival
+                time.sleep(min(max(pending[i].arrival_time - now, 0.0), 0.25))
+            continue
+        sched.step()
+    t_end = time.monotonic()
+    return _report(workload, t0, t_end, "continuous", extra={
+        "decode_steps": sched.steps,
+        "preemptions": sum(r.preemptions for r in workload),
+        "num_slots": sched.num_slots,
+        "hbm_token_slots": engine.hbm_token_slots(),
+        "compiled_programs": len(engine.compile_log),
+    })
+
+
+def run_static_baseline(infer_engine, workload: Sequence[Request],
+                        batch_size: int, max_wall_s: float = 600.0) -> Dict:
+    """Static batching over the same requests: fill a batch in arrival
+    order, right-pad prompts, generate everyone to the batch max max_new.
+    Request timing: first token and completion both land when the whole
+    batch returns (``generate`` is run-to-completion)."""
+    pending = sorted(workload, key=lambda r: r.arrival_time)
+    # one fixed batch shape for the whole run (workload max prompt/gen):
+    # warmup compiles it once, so the A/B times scheduling, not the
+    # baseline's per-group recompiles
+    tmax = max(len(r.prompt) for r in pending)
+    gen = max(r.max_new_tokens for r in pending)
+    t0 = time.monotonic()
+    for start in range(0, len(pending), batch_size):
+        group = pending[start:start + batch_size]
+        # open loop: the batch cannot launch before its last member arrives
+        launch = t0 + max(r.arrival_time for r in group)
+        now = time.monotonic()
+        if now + max_wall_s < launch:
+            break
+        if launch > now:
+            time.sleep(launch - now)
+        if time.monotonic() - t0 > max_wall_s:
+            break
+        ids = np.zeros((batch_size, tmax), np.int32)
+        for j, r in enumerate(group):
+            ids[j, :len(r.prompt)] = r.prompt
+        out = np.asarray(infer_engine.generate(ids, max_new_tokens=gen))
+        t_batch = time.monotonic()
+        for j, r in enumerate(group):
+            r.t_first_token = t_batch
+            r.t_done = t_batch
+            r.tokens = [int(x) for x in
+                        out[j, tmax:tmax + r.max_new_tokens]]
+    t_end = time.monotonic()
+    return _report(workload, t0, t_end, "static", extra={
+        "batch_size": batch_size})

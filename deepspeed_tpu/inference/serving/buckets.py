@@ -1,0 +1,65 @@
+"""Shape buckets shared by the serving engine and ``InferenceEngine``.
+
+Every distinct (batch, length) pair a jitted program sees is a compile; an
+unbucketed serving path compiles per request shape and a naive decode loop
+compiles per STEP (the bug the ``serving/unbucketed-decode-shape`` dslint
+rule catches). Rounding lengths up to a small geometric bucket set bounds
+the compile count at ``log2(max/min)`` programs, each reused forever.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+
+def default_buckets(lo: int = 32, hi: int = 1024) -> Tuple[int, ...]:
+    """Powers of two from ``lo`` up to AND covering ``hi``."""
+    if lo < 1 or hi < lo:
+        raise ValueError(f"bad bucket range [{lo}, {hi}]")
+    out = []
+    b = 1
+    while b < lo:
+        b *= 2
+    while b < hi:
+        out.append(b)
+        b *= 2
+    out.append(b)
+    return tuple(out)
+
+
+def record_compile(compile_log: list, monitor, channel: str, kind: str,
+                   shape: Tuple[int, ...], hint: str = "") -> None:
+    """Append one compiled-program cache-miss record and emit it.
+
+    The single schema both engines log and the
+    ``serving/unbucketed-decode-shape`` dslint rule consumes:
+    ``{"kind", "shape", "time"}``. ``channel`` names the monitor scalar
+    (``Serving/compile_events`` / ``Inference/compile_events``); ``hint`` is
+    appended to the log line once misses start repeating (n >= 4)."""
+    import time
+
+    from ...utils.logging import log_dist
+
+    compile_log.append({"kind": kind,
+                        "shape": tuple(int(x) for x in shape),
+                        "time": time.time()})
+    n = len(compile_log)
+    log_dist(f"{channel.split('/')[0].lower()} engine: compiling {kind} "
+             f"shape={shape} (compile #{n})"
+             + (f" — {hint}" if hint and n >= 4 else ""))
+    if monitor is not None:
+        monitor.write_events([(channel, n, n)])
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= n. Raises when nothing covers ``n`` (the caller
+    sized its bucket set to the model/serving bound on purpose — silently
+    exceeding it would recompile)."""
+    if n < 0:
+        raise ValueError(f"bucket_for({n})")
+    for b in sorted(buckets):
+        if n <= b:
+            return int(b)
+    raise ValueError(f"length {n} exceeds the largest bucket "
+                     f"{max(buckets)} — raise the bucket set or reject the "
+                     f"request at admission")

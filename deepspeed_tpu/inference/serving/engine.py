@@ -1,0 +1,374 @@
+"""Serving engine: bucketed chunked prefill + fixed-slot paged decode.
+
+The device half of the continuous-batching stack (the host half is
+``scheduler.ContinuousBatchingScheduler``). Three compiled program families,
+each with a bounded shape set:
+
+- **decode** — ONE program: ``models/gpt.paged_decode_step`` over the fixed
+  decode slot array [num_slots], greedy-sampled in-program. Every serving
+  step replays this executable regardless of which requests occupy the
+  slots; nothing about request arrival order can cause a recompile.
+- **prefill** — one program per chunk bucket (powers of two up to
+  ``prefill_chunk``): the prompt streams through the contiguous-cache
+  forward in fixed-size chunks, so prompt length changes the chunk COUNT,
+  not the compiled shapes. Prefill is disaggregated from decode: it never
+  touches the page pool until the final scatter.
+- **scatter** — one program: ``write_prompt_kv`` placing the prefilled
+  dense K/V into the request's pages.
+
+Every first build of any of these is recorded in ``compile_log`` (and the
+optional monitor) — the evidence stream the
+``serving/unbucketed-decode-shape`` dslint rule audits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import List, Optional, Tuple, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...models import gpt as gpt_mod
+from ...utils.logging import log_dist
+from .buckets import bucket_for, default_buckets, record_compile
+from .paging import pages_for
+from .scheduler import ContinuousBatchingScheduler
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    """Knobs for the serving path. ``num_slots`` is the admission limit —
+    pass an int you trust, or "auto" to derive it from the AOT fit ladder
+    (``runtime.aot.serving_admission_limit``, compile-time verdicts only)."""
+
+    num_slots: Union[int, str] = 4
+    page_size: int = 64
+    max_model_len: int = 1024           # prompt + generation bound
+    num_pages: Optional[int] = None     # default: every slot can max out
+    prefill_chunk: int = 128
+    # decode block: when no scheduling event (admission, page growth, eos,
+    # slot finish) can occur within the next K steps, the scheduler runs K
+    # decode steps as ONE compiled scan — K-1 host round-trips saved per
+    # block. Must be <= page_size (inactive slots park on the sink page for
+    # at most one page worth of steps).
+    decode_block: int = 4
+    dtype: str = "bfloat16"
+    kernel_impl: Optional[str] = None   # None=auto | "kernel" | "gather"
+    eos_token_id: Optional[int] = None
+    model_name: Optional[str] = None    # for num_slots="auto"
+
+    @property
+    def pages_per_seq(self) -> int:
+        return pages_for(self.max_model_len, self.page_size)
+
+
+class ServingEngine:
+    """Executor over a GPT config + params (see module docstring)."""
+
+    def __init__(self, cfg: gpt_mod.GPTConfig, params,
+                 serving: Optional[ServingConfig] = None, monitor=None):
+        self.cfg = cfg
+        self.serving = serving or ServingConfig()
+        self.monitor = monitor
+        self.compile_log: List[dict] = []
+        s = self.serving
+        if s.max_model_len > cfg.max_seq_len and not (cfg.rotary or cfg.alibi):
+            raise ValueError(
+                f"max_model_len {s.max_model_len} exceeds the model's learned "
+                f"position table ({cfg.max_seq_len})")
+        self.num_slots = self._resolve_slots()
+        self.num_pages = (s.num_pages if s.num_pages is not None
+                          else self.num_slots * s.pages_per_seq + 1)
+        self.dtype = jnp.dtype({"bf16": "bfloat16", "fp32": "float32",
+                                "fp16": "float16"}.get(s.dtype, s.dtype))
+
+        def _cast(x):
+            if gpt_mod._is_qleaf(x):
+                return x
+            return (x.astype(self.dtype)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x)
+
+        self.params = jax.tree_util.tree_map(_cast, params,
+                                             is_leaf=gpt_mod._is_qleaf)
+        self.paged_cache = gpt_mod.init_paged_cache(
+            cfg, self.num_pages, s.page_size, self.dtype)
+        # prefill's contiguous scratch cache: chunks append at chunk-aligned
+        # positions, so it must cover the bucket-padded context
+        chunks = -(-s.max_model_len // s.prefill_chunk)
+        self._dense_S = chunks * s.prefill_chunk
+        self._chunk_buckets = default_buckets(
+            min(32, s.prefill_chunk), s.prefill_chunk)
+        if not (1 <= s.decode_block <= s.page_size):
+            raise ValueError(f"decode_block {s.decode_block} must be in "
+                             f"[1, page_size={s.page_size}]")
+        self._prefill_fns = {}
+        self._prefill_fused_fns = {}
+        self._prefill_batch_fns = {}
+        self._decode_fns = {}
+        self._scatter_fn = None
+
+    def _resolve_slots(self) -> int:
+        s = self.serving
+        if s.num_slots != "auto":
+            return int(s.num_slots)
+        if not s.model_name:
+            raise ValueError("num_slots='auto' needs model_name for the AOT "
+                             "fit ladder")
+        from ...runtime.aot import serving_admission_limit
+
+        limit = serving_admission_limit(
+            s.model_name, prompt=min(128, s.max_model_len),
+            gen=min(128, s.max_model_len))
+        if limit["max_slots"] < 1:
+            raise ValueError(
+                f"AOT fit ladder found no decode batch that fits for "
+                f"{s.model_name}: {limit}")
+        log_dist(f"serving: admission limit {limit['max_slots']} slots "
+                 f"(AOT fit ladder, {s.model_name})")
+        return int(limit["max_slots"])
+
+    # -------------------------------------------------------------- programs
+    def _log_compile(self, kind: str, shape: Tuple[int, ...]) -> None:
+        record_compile(self.compile_log, self.monitor,
+                       "Serving/compile_events", kind, shape)
+
+    def _get_prefill(self, chunk: int):
+        if chunk not in self._prefill_fns:
+            self._log_compile("serving_prefill", (1, chunk))
+
+            def fn(params, ids, cache):
+                return gpt_mod.forward_with_cache(self.cfg, params, ids, cache)
+
+            self._prefill_fns[chunk] = jax.jit(fn, donate_argnums=(2,))
+        return self._prefill_fns[chunk]
+
+    def _get_prefill_fused(self, chunk: int):
+        """Single-dispatch prefill for contexts <= one chunk: dense forward,
+        page scatter, and the next-token argmax fused into one program (the
+        common short-prompt admission path — 3 dispatches + a host sync
+        collapse into 1)."""
+        if chunk not in self._prefill_fused_fns:
+            self._log_compile("serving_prefill_fused", (1, chunk))
+
+            def fn(params, ids, paged, table, length):
+                cache = gpt_mod.init_cache(self.cfg, 1, chunk, self.dtype)
+                logits, cache = gpt_mod.forward_with_cache(
+                    self.cfg, params, ids, cache)
+                paged = gpt_mod.write_prompt_kv(paged, cache, table, length)
+                last = jax.lax.dynamic_index_in_dim(logits[0], length - 1, 0,
+                                                    keepdims=False)
+                return jnp.argmax(last).astype(jnp.int32), paged
+
+            self._prefill_fused_fns[chunk] = jax.jit(fn, donate_argnums=(2,))
+        return self._prefill_fused_fns[chunk]
+
+    def _get_prefill_batch(self, chunk: int):
+        """Admission-batch prefill: every request admitted in one scheduler
+        cycle (short prompts) prefills as ONE [num_slots, chunk] program —
+        the prefill analog of the fixed decode slot array. Inactive rows
+        carry length 0 + sink tables, so their writes drop."""
+        if chunk not in self._prefill_batch_fns:
+            self._log_compile("serving_prefill_batch",
+                              (self.num_slots, chunk))
+
+            def fn(params, ids, paged, tables, lengths):
+                cache = gpt_mod.init_cache(self.cfg, self.num_slots, chunk,
+                                           self.dtype)
+                logits, cache = gpt_mod.forward_with_cache(
+                    self.cfg, params, ids, cache)
+                paged = gpt_mod.write_prompt_kv_batch(paged, cache, tables,
+                                                      lengths)
+                idx = jnp.maximum(lengths - 1, 0)[:, None, None]
+                last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
+                return jnp.argmax(last, axis=-1).astype(jnp.int32), paged
+
+            self._prefill_batch_fns[chunk] = jax.jit(fn, donate_argnums=(2,))
+        return self._prefill_batch_fns[chunk]
+
+    def _get_decode(self, steps: int = 1):
+        """The decode program for a ``steps``-long block (the scheduler uses
+        only 1 and ``decode_block``, so at most two shapes compile)."""
+        if steps not in self._decode_fns:
+            self._log_compile("serving_decode", (steps, self.num_slots))
+            impl = self.serving.kernel_impl
+
+            def one(cache, toks, tables, lengths, params):
+                logits, cache = gpt_mod.paged_decode_step(
+                    self.cfg, params, toks, cache, tables, lengths, impl=impl)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+            if steps == 1:
+                def fn(params, cache, toks, tables, lengths):
+                    nxt, cache = one(cache, toks, tables, lengths, params)
+                    return nxt[None], cache
+            else:
+                def fn(params, cache, toks, tables, lengths):
+                    def body(carry, _):
+                        toks, lengths, cache = carry
+                        nxt, cache = one(cache, toks, tables, lengths, params)
+                        return (nxt, lengths + 1, cache), nxt
+
+                    (_, _, cache), out = jax.lax.scan(
+                        body, (toks, lengths, cache), None, length=steps)
+                    return out, cache
+
+            self._decode_fns[steps] = jax.jit(fn, donate_argnums=(1,))
+        return self._decode_fns[steps]
+
+    def _get_scatter(self):
+        if self._scatter_fn is None:
+            self._log_compile("serving_scatter", (self._dense_S,))
+
+            def fn(paged, dense, table, length):
+                return gpt_mod.write_prompt_kv(paged, dense, table, length)
+
+            self._scatter_fn = jax.jit(fn, donate_argnums=(0,))
+        return self._scatter_fn
+
+    # -------------------------------------------------------------- executor
+    def prefill(self, slot: int, tokens: np.ndarray,
+                table_row: np.ndarray) -> int:
+        """Chunked prefill of one request's context; writes its KV into the
+        slot's pages; returns the greedy next token."""
+        del slot  # pages are named by table_row; the slot id is host-side
+        s = self.serving
+        tokens = np.asarray(tokens, np.int32)
+        T = int(tokens.shape[0])
+        if T < 1 or T > s.max_model_len:
+            raise ValueError(f"context length {T} outside (0, "
+                             f"{s.max_model_len}]")
+        if T <= s.prefill_chunk:  # fused short-prompt path: one dispatch
+            chunk = bucket_for(T, self._chunk_buckets)
+            ids = np.zeros((1, chunk), np.int32)
+            ids[0, :T] = tokens
+            tok, self.paged_cache = self._get_prefill_fused(chunk)(
+                self.params, jnp.asarray(ids), self.paged_cache,
+                jnp.asarray(table_row, jnp.int32), jnp.int32(T))
+            return int(tok)
+        cache = gpt_mod.init_cache(self.cfg, 1, self._dense_S, self.dtype)
+        pos = 0
+        logits = None
+        while pos < T:
+            rem = T - pos
+            chunk = (s.prefill_chunk if rem >= s.prefill_chunk
+                     else bucket_for(rem, self._chunk_buckets))
+            ids = np.zeros((1, chunk), np.int32)
+            ids[0, :min(rem, chunk)] = tokens[pos:pos + chunk]
+            logits, cache = self._get_prefill(chunk)(
+                self.params, jnp.asarray(ids), cache)
+            last_idx = min(rem, chunk) - 1
+            pos += chunk
+        self.paged_cache = self._get_scatter()(
+            self.paged_cache, cache, jnp.asarray(table_row, jnp.int32),
+            jnp.int32(T))
+        return int(jnp.argmax(logits[0, last_idx]))
+
+    def prefill_many(self, items) -> dict:
+        """Prefill one admission cycle's requests: short prompts (<= one
+        chunk) batch into a single dispatch; longer prompts take the serial
+        chunked path. ``items``: [(slot, tokens, table_row)]; returns
+        {slot: first_token}."""
+        s = self.serving
+        out = {}
+        short = [(slot, np.asarray(t, np.int32), row) for slot, t, row in items
+                 if len(t) <= s.prefill_chunk]
+        for slot, t, row in items:
+            if len(t) > s.prefill_chunk:
+                out[slot] = self.prefill(slot, t, row)
+        if not short:
+            return out
+        if len(short) == 1:  # no batching win; reuse the fused single path
+            slot, t, row = short[0]
+            out[slot] = self.prefill(slot, t, row)
+            return out
+        chunk = bucket_for(max(len(t) for _, t, _ in short),
+                           self._chunk_buckets)
+        ids = np.zeros((self.num_slots, chunk), np.int32)
+        tables = np.zeros((self.num_slots, s.pages_per_seq), np.int32)
+        lengths = np.zeros(self.num_slots, np.int32)
+        for j, (slot, t, row) in enumerate(short):
+            ids[j, :len(t)] = t
+            tables[j] = row
+            lengths[j] = len(t)
+        toks, self.paged_cache = self._get_prefill_batch(chunk)(
+            self.params, jnp.asarray(ids), self.paged_cache,
+            jnp.asarray(tables), jnp.asarray(lengths))
+        toks = np.asarray(toks)
+        for j, (slot, _, _) in enumerate(short):
+            out[slot] = int(toks[j])
+        return out
+
+    def decode(self, tokens: np.ndarray, tables: np.ndarray,
+               lengths: np.ndarray, active: np.ndarray,
+               steps: int = 1) -> np.ndarray:
+        """``steps`` fixed-shape decode steps over every slot as one
+        dispatch; returns [steps, num_slots] sampled tokens (inactive slots
+        write to the reserved sink page and their outputs are ignored)."""
+        del active  # the program runs all slots; masking is host-side
+        out, self.paged_cache = self._get_decode(steps)(
+            self.params, self.paged_cache, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(tables, jnp.int32), jnp.asarray(lengths, jnp.int32))
+        return np.asarray(out)
+
+    def warmup(self) -> int:
+        """Compile every serving program shape before traffic arrives:
+        fused prefill per chunk bucket, the chunked long-prompt path (+
+        scatter) when configured, and both decode block sizes. Safe against
+        live state — warmup tokens carry all-zero block tables and zero
+        lengths, so every write lands on the reserved sink page. Returns the
+        number of compiled programs."""
+        s = self.serving
+        sink_row = np.zeros(s.pages_per_seq, np.int32)
+        for chunk in self._chunk_buckets:
+            # cap at prefill_chunk: the top bucket can exceed it (non-pow2
+            # prefill_chunk) and a longer probe would take the chunked path,
+            # leaving the fused/batch programs for this bucket uncompiled
+            t = np.zeros(min(chunk, s.prefill_chunk, s.max_model_len),
+                         np.int32)
+            self.prefill(0, t, sink_row)
+            if self.num_slots >= 2:  # the admission-batch program
+                self.prefill_many([(0, t, sink_row), (1, t, sink_row)])
+        if s.max_model_len > s.prefill_chunk:
+            # the chunked long-prompt path: full chunks compile ONE program,
+            # but the final partial chunk lands on any REACHABLE bucket —
+            # compile each (a long prompt's remainder must not pay a
+            # mid-traffic compile). Bucket b is reachable when some legal
+            # remainder maps to it, even if prefill_chunk + b itself
+            # overshoots max_model_len.
+            max_rem = s.max_model_len - s.prefill_chunk
+            prev = 0
+            for b in self._chunk_buckets:
+                if max_rem > prev:
+                    n = s.prefill_chunk + min(b, max_rem)
+                    self.prefill(0, np.zeros(n, np.int32), sink_row)
+                prev = b
+        zeros = np.zeros(self.num_slots, np.int32)
+        tables = np.zeros((self.num_slots, s.pages_per_seq), np.int32)
+        mask = np.zeros(self.num_slots, bool)
+        steps_set = {1}
+        k = 1
+        while k * 2 <= s.decode_block:  # the scheduler's power-of-two blocks
+            k *= 2
+            steps_set.add(k)
+        for steps in sorted(steps_set):
+            self.decode(zeros, tables, zeros, mask, steps=steps)
+        return len(self.compile_log)
+
+    # -------------------------------------------------------------- assembly
+    def make_scheduler(self, clock=time.monotonic
+                       ) -> ContinuousBatchingScheduler:
+        return ContinuousBatchingScheduler(
+            executor=self, num_slots=self.num_slots,
+            num_pages=self.num_pages, page_size=self.serving.page_size,
+            pages_per_seq=self.serving.pages_per_seq,
+            decode_block=self.serving.decode_block,
+            max_context=self.serving.max_model_len, clock=clock)
+
+    def hbm_token_slots(self) -> int:
+        """Token capacity of the pool (page 0 excluded) — the "equal HBM
+        budget" side of the static-batch A/B."""
+        return (self.num_pages - 1) * self.serving.page_size

@@ -1,0 +1,74 @@
+"""Fixed-size KV page allocator (host side).
+
+The device holds one page pool per layer (``models/gpt.init_paged_cache``);
+this allocator hands out pool slot ids. Page 0 is RESERVED as the sink that
+inactive decode slots and masked scatter lanes write into — a block-table
+entry of 0 therefore always names a valid (garbage) page, which is what lets
+the Pallas kernel's ``index_map`` read table rows past a request's length
+without bounds checks.
+
+Allocation is all-or-nothing (a request either gets every page it asked for
+or none), frees are checked (double-free and foreign pages raise), and the
+free list is LIFO so recently-touched pages — still warm in whatever cache
+level applies — are reused first.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+RESERVED_PAGE = 0
+
+
+def pages_for(tokens: int, page_size: int) -> int:
+    """Pages needed to hold ``tokens`` KV entries."""
+    if tokens <= 0:
+        return 0
+    return -(-tokens // page_size)
+
+
+class PageAllocator:
+    """Free-list allocator over a pool of ``num_pages`` pages (ids
+    ``1 .. num_pages-1``; page 0 reserved)."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError(
+                f"num_pages must be >= 2 (page 0 is the reserved sink), "
+                f"got {num_pages}")
+        self.num_pages = int(num_pages)
+        self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
+        self._allocated = set()
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def allocated_pages(self) -> int:
+        return len(self._allocated)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Allocate ``n`` pages, or None (and allocate NOTHING) if the pool
+        cannot cover the request — the caller decides between queueing and
+        preempting."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self._allocated.update(pages)
+        return pages
+
+    def free(self, pages: Sequence[int]) -> None:
+        for p in pages:
+            p = int(p)
+            if p == RESERVED_PAGE:
+                raise ValueError("freeing the reserved sink page 0")
+            if p not in self._allocated:
+                raise ValueError(f"double-free or foreign page {p}")
+            self._allocated.remove(p)
+            self._free.append(p)

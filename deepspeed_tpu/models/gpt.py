@@ -1145,6 +1145,199 @@ def forward_with_cache(cfg: GPTConfig, params, input_ids: jnp.ndarray, cache):
     return logits, {"k": new_k, "v": new_v, "pos": pos + T}
 
 
+# ----------------------------------------------------------- paged KV decode
+def init_paged_cache(cfg: GPTConfig, num_pages: int, page_size: int,
+                     dtype=jnp.bfloat16) -> Dict[str, jnp.ndarray]:
+    """Block-allocated KV cache: one shared page pool per layer,
+    [L, H, P, page_size, Dh]. Requests own pages through a *block table*
+    (``inference/serving/paging.py``); HBM holds ``P * page_size`` token
+    slots total, shared by every in-flight request — the vLLM/paged-attention
+    memory model, vs the contiguous :func:`init_cache` which reserves
+    ``max_len`` slots per batch row whether used or not.
+
+    Page 0 is the allocator's reserved sink: inactive decode slots and
+    masked scatter lanes write there, so pool page ids handed to requests
+    start at 1."""
+    shape = (cfg.n_layer, cfg.n_head, num_pages, page_size, cfg.head_dim)
+    return {"k_pages": jnp.zeros(shape, dtype),
+            "v_pages": jnp.zeros(shape, dtype)}
+
+
+def write_prompt_kv_batch(paged_cache: Dict[str, jnp.ndarray],
+                          dense_cache: Dict[str, jnp.ndarray],
+                          block_tables: jnp.ndarray,  # [F, pages_per_seq]
+                          lengths: jnp.ndarray,       # [F] valid tokens/row
+                          ) -> Dict[str, jnp.ndarray]:
+    """Scatter a BATCH of prefilled requests' dense K/V into their pages.
+
+    Prefill runs on the contiguous cache (the existing, tested
+    :func:`forward_with_cache` path, compiled per bucket shape); each row's
+    K/V is then placed into the pages its block-table row names — the
+    prefill/decode disaggregation boundary. Positions past a row's length
+    (bucket padding, or a wholly inactive row with length 0) scatter out of
+    bounds and are dropped."""
+    k = dense_cache["k"]  # [L, F, H, S, Dh]
+    v = dense_cache["v"]
+    S = k.shape[3]
+    F = k.shape[1]
+    P = paged_cache["k_pages"].shape[2]
+    ps = paged_cache["k_pages"].shape[3]
+    pos = jnp.broadcast_to(jnp.arange(S)[None, :], (F, S))
+    tables = jnp.asarray(block_tables, jnp.int32)
+    lengths = jnp.asarray(lengths, jnp.int32)
+    page_of_pos = jnp.take_along_axis(tables, pos // ps, axis=1)  # [F, S]
+    # pad positions get page id P (out of bounds) -> mode="drop" discards them
+    page = jnp.where(pos < lengths[:, None], page_of_pos, P)
+    off = pos % ps
+    dt = paged_cache["k_pages"].dtype
+    # k_pages[l, h, page[f, s], off[f, s], :] = k[l, f, h, s, :]
+    return {
+        "k_pages": paged_cache["k_pages"].at[:, :, page, off, :].set(
+            k.transpose(0, 2, 1, 3, 4).astype(dt), mode="drop"),
+        "v_pages": paged_cache["v_pages"].at[:, :, page, off, :].set(
+            v.transpose(0, 2, 1, 3, 4).astype(dt), mode="drop"),
+    }
+
+
+def write_prompt_kv(paged_cache: Dict[str, jnp.ndarray],
+                    dense_cache: Dict[str, jnp.ndarray],
+                    block_table: jnp.ndarray,  # [pages_per_seq] int32
+                    length: jnp.ndarray,       # scalar int32: valid tokens
+                    row: int = 0) -> Dict[str, jnp.ndarray]:
+    """Single-request :func:`write_prompt_kv_batch` over ``dense_cache`` row
+    ``row``."""
+    one = {"k": dense_cache["k"][:, row:row + 1],
+           "v": dense_cache["v"][:, row:row + 1]}
+    table = jnp.asarray(block_table, jnp.int32)[None]
+    return write_prompt_kv_batch(paged_cache, one, table,
+                                 jnp.asarray(length, jnp.int32)[None])
+
+
+def _paged_attn_sublayer(cfg: GPTConfig, x, w, k_pages, v_pages, tables,
+                         lengths, impl=None):
+    """Cached self-attention over the page pool (pre-LN + residual) for ONE
+    new token per row. x: [B, 1, D]; k_pages/v_pages: [H, P, ps, Dh];
+    tables: [B, pages_per_seq]; lengths: [B] tokens already in the cache
+    (the new token is appended at position ``lengths[b]``).
+    Returns (x + attn_out, k_pages, v_pages)."""
+    from ..ops.pallas.decode_attention import paged_decode_attention
+
+    B, T, D = x.shape
+    assert T == 1
+    H, Dh = cfg.n_head, cfg.head_dim
+    ps = k_pages.shape[2]
+    h = layer_norm(x, w["ln1_scale"], w["ln1_bias"], cfg.layer_norm_eps)
+    qkv = _wm(h, w["qkv_w"]) + w["qkv_b"]
+    q, k_, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, 1, H, Dh)
+    k_ = k_.reshape(B, 1, H, Dh)
+    v = v.reshape(B, 1, H, Dh)
+    positions = lengths[:, None]  # [B, 1] — each row at its OWN position
+    if cfg.rotary:
+        rd = int(cfg.rotary_pct * Dh)
+        rd -= rd % 2
+        q = _rope(q, positions, rd, cfg.rotary_interleaved)
+        k_ = _rope(k_, positions, rd, cfg.rotary_interleaved)
+    # append the new token's k/v into each row's current tail page
+    page = jnp.take_along_axis(tables, (lengths // ps)[:, None],
+                               axis=1)[:, 0]  # [B]
+    off = lengths % ps
+    dt = k_pages.dtype
+    k_pages = k_pages.at[:, page, off, :].set(
+        k_[:, 0].astype(dt).transpose(1, 0, 2))
+    v_pages = v_pages.at[:, page, off, :].set(
+        v[:, 0].astype(dt).transpose(1, 0, 2))
+    scale = (cfg.attention_scale if cfg.attention_scale is not None
+             else 1.0 / np.sqrt(Dh))
+    attn = paged_decode_attention(q.astype(dt), k_pages, v_pages,
+                                  lengths + 1, tables, softmax_scale=scale,
+                                  impl=impl)
+    attn = attn.reshape(B, 1, D).astype(x.dtype)
+    attn = _wm(attn, w["attn_out_w"]) + w["attn_out_b"]
+    return x + attn, k_pages, v_pages
+
+
+def paged_decode_step(cfg: GPTConfig, params, input_ids: jnp.ndarray,
+                      paged_cache: Dict[str, jnp.ndarray],
+                      block_tables: jnp.ndarray, lengths: jnp.ndarray,
+                      impl: Optional[str] = None
+                      ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """One decode step over the paged cache: ``input_ids`` [B] (or [B, 1]) new
+    tokens, one per slot, each appended at its row's own ``lengths[b]``.
+    Returns (logits [B, V], new paged_cache).
+
+    The continuous-batching hot path: B is the FIXED decode slot count, so
+    one compiled program serves every step regardless of which requests
+    occupy the slots; inactive slots (lengths 0, table row all page-0) write
+    to the reserved sink page and produce ignored logits. Supports the dense
+    and the quantized ({"q"/"q4","s"}) layer stacks like
+    :func:`forward_with_cache`; alibi/local-attention configs are not yet
+    paged."""
+    if cfg.alibi or cfg.local_attention_period > 1:
+        raise ValueError("paged decode does not support alibi/local-window "
+                         "attention yet (the paged kernel has no bias input)")
+    ids = jnp.asarray(input_ids)
+    if ids.ndim == 1:
+        ids = ids[:, None]
+    B = ids.shape[0]
+    lengths = jnp.asarray(lengths, jnp.int32)
+    positions = lengths[:, None]
+    x = jnp.take(params["wte"], ids, axis=0)
+    if not cfg.rotary and not cfg.alibi:
+        x = x + jnp.take(params["wpe"], positions + cfg.pos_offset, axis=0)
+    if cfg.embed_layernorm:
+        x = layer_norm(x, params["emb_ln_scale"], params["emb_ln_bias"],
+                       cfg.layer_norm_eps)
+    qkv_w = params["blocks"]["qkv_w"]
+    quantized = _is_qleaf(qkv_w)
+    compute_dtype = (params["lnf_scale"].dtype if quantized else qkv_w.dtype)
+    x = x.astype(compute_dtype)
+    x = maybe_shard(x, P(BATCH, None, None))
+    blocks = params["blocks"]
+
+    def one_block(x, layer_w, k_p, v_p):
+        y, k_p, v_p = _paged_attn_sublayer(cfg, x, layer_w, k_p, v_p,
+                                           block_tables, lengths, impl=impl)
+        # parallel residual (NeoX/GPT-J): the MLP reads the PRE-attention
+        # stream — same composition as _block_with_cache
+        mlp_in = x if cfg.parallel_residual else y
+        return y + _mlp_delta(cfg, mlp_in, layer_w), k_p, v_p
+
+    if quantized:
+        # indexed (not scanned) weight stacks — same HBM-copy avoidance as
+        # forward_with_cache's quantized branch
+        def body(carry, layer_in):
+            x, i = carry
+            k_p, v_p = layer_in
+            layer_w = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, i, 0,
+                                                       keepdims=False),
+                blocks)
+            x, k_p, v_p = one_block(x, layer_w, k_p, v_p)
+            return (x, i + 1), (k_p, v_p)
+
+        (x, _), (new_k, new_v) = jax.lax.scan(
+            body, (x, jnp.int32(0)),
+            (paged_cache["k_pages"], paged_cache["v_pages"]))
+    else:
+        def body(carry, layer_in):
+            x, i = carry
+            layer_w, k_p, v_p = layer_in
+            x, k_p, v_p = one_block(x, layer_w, k_p, v_p)
+            return (x, i + 1), (k_p, v_p)
+
+        (x, _), (new_k, new_v) = jax.lax.scan(
+            body, (x, jnp.int32(0)),
+            (blocks, paged_cache["k_pages"], paged_cache["v_pages"]))
+    x = layer_norm(x, params["lnf_scale"], params["lnf_bias"],
+                   cfg.layer_norm_eps)
+    head = params["wte"] if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
+    if cfg.lm_head_bias and not cfg.tie_embeddings:
+        logits = logits + params["lm_head_b"].astype(logits.dtype)
+    return logits[:, 0, :], {"k_pages": new_k, "v_pages": new_v}
+
+
 def build(cfg_or_name) -> Tuple[Module, GPTConfig]:
     """Build a GPT :class:`Module` from a config or preset name."""
     cfg = PRESETS[cfg_or_name] if isinstance(cfg_or_name, str) else cfg_or_name

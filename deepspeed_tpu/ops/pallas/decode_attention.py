@@ -7,20 +7,35 @@ softmax.cu`` + ``pt_binding.cpp`` attention bindings, workspace layout
 validity mask, in one kernel, without materializing [B, H, S] probabilities in
 HBM.
 
-Layout is [B, H, S, Dh] — sequence in the sublane dimension, head_dim in the
-lane dimension — so every block the kernel touches is Mosaic-tileable: K/V
-stream as (block_k, Dh) tiles (block_k a multiple of the sublane tile, Dh the
-full lane extent) and the q/out blocks are full-dim (1, Dh) slices. The head
-and batch axes are size-1 leading block dims selected by the grid index map.
+Two cache layouts share the kernel body:
 
-Grid = (B, H, S/block_k): the cache's sequence dimension is a GRID axis, so each
-program instance holds only one [block_k, Dh] K/V tile in VMEM — long contexts
-stream tile by tile (TPU iterates the innermost grid dimension sequentially on
-one core, so the online-softmax state lives in VMEM scratch across tiles). The
-current cache length arrives as a scalar array input (the analog of the
-reference's ``current_tokens`` workspace field) — one compiled kernel serves
-every decode step of a generation; tiles entirely past the valid length
-contribute nothing (their rows mask to -inf).
+- **Contiguous** (:func:`decode_attention`): K/V are [B, H, S, Dh] — sequence
+  in the sublane dimension, head_dim in the lane dimension — so every block
+  the kernel touches is Mosaic-tileable: K/V stream as (block_k, Dh) tiles
+  and the q/out blocks are full-dim (1, Dh) slices.
+- **Paged** (:func:`paged_decode_attention`): K/V live in a shared page pool
+  [H, P, page_size, Dh]; each request owns a *block table* row naming its
+  pages in order. The grid's innermost axis walks the table and the K/V
+  ``index_map`` reads the page id from the scalar-prefetched table — the
+  gather happens in the BlockSpec, so the kernel body is identical to the
+  contiguous case with ``block_k = page_size``.
+
+Grid = (B, H, S/block_k): the cache's sequence dimension is a GRID axis, so
+each program instance holds only one [block_k, Dh] K/V tile in VMEM — long
+contexts stream tile by tile (TPU iterates the innermost grid dimension
+sequentially on one core, so the online-softmax state lives in VMEM scratch
+across tiles).
+
+Per-request valid lengths ride scalar prefetch
+(``pltpu.PrefetchScalarGridSpec``), NOT a VMEM operand. The previous revision
+fed the length as a (1, 1) float-tiled VMEM array with no memory space and a
+q ``index_map`` that disagreed with the transposed [B, H, 1, Dh] layout —
+Mosaic rejected the block-shape/array-shape/index_map triple once the batch
+grid axis was wide enough to matter (b16 decode, ``BENCH_r02.json``:
+"Blocked(1), Blocked(1), Blocked(1), Blocked(64) ... in memory space None").
+Scalar prefetch puts lengths (and the paged block tables) in SMEM where the
+index maps and ``@pl.when`` guards can consume them, which is also exactly
+what continuous batching needs: every batch row decodes at its OWN length.
 """
 
 from __future__ import annotations
@@ -40,8 +55,14 @@ from .flash_attention import NEG_INF, _interpret
 
 def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
                    *, sm_scale: float, block_k: int, num_blocks: int):
+    """One (batch row, head, K/V tile) step of the online softmax.
+
+    ``len_ref`` is the scalar-prefetched [B] lengths vector in SMEM; the
+    paged and contiguous callers share this body (they differ only in how
+    the k/v BlockSpecs address the tile)."""
+    b = pl.program_id(0)
     ki = pl.program_id(2)
-    cur = len_ref[0, 0]
+    cur = len_ref[b]
 
     @pl.when(ki == 0)
     def _init():
@@ -73,11 +94,23 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
         o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
 
 
+def _as_lengths(cur_len, batch: int) -> jnp.ndarray:
+    """Accept the legacy scalar (one length for the whole batch) or a [B]
+    per-request vector (continuous batching: every slot at its own length)."""
+    lens = jnp.asarray(cur_len, jnp.int32)
+    if lens.ndim == 0:
+        return jnp.broadcast_to(lens, (batch,))
+    if lens.shape != (batch,):
+        raise ValueError(f"cur_len must be a scalar or [batch]={batch} vector, "
+                         f"got shape {lens.shape}")
+    return lens
+
+
 def decode_attention(
     q: jnp.ndarray,  # [B, 1, H, Dh] — the new token's query
     k_cache: jnp.ndarray,  # [B, H, S, Dh]
     v_cache: jnp.ndarray,
-    cur_len: jnp.ndarray,  # scalar int32: valid cache entries INCLUDING the new token
+    cur_len: jnp.ndarray,  # int32 scalar or [B]: valid entries INCLUDING the new token
     softmax_scale: Optional[float] = None,
     block_k: int = 512,
 ) -> jnp.ndarray:
@@ -92,26 +125,136 @@ def decode_attention(
         block_k //= 2
     num_blocks = S // block_k
     scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(Dh)
-    lens = jnp.broadcast_to(jnp.asarray(cur_len, jnp.int32), (1, 1))
+    lens = _as_lengths(cur_len, B)
     qh = q.transpose(0, 2, 1, 3)  # [B, H, 1, Dh] — heads lead, like the cache
 
-    out = pl.pallas_call(
-        functools.partial(_decode_kernel, sm_scale=scale, block_k=block_k,
-                          num_blocks=num_blocks),
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,  # lens -> SMEM, readable by index maps + body
         grid=(B, H, num_blocks),
         in_specs=[
-            pl.BlockSpec((1, 1), lambda b, h, ki: (0, 0)),
-            pl.BlockSpec((1, 1, 1, Dh), lambda b, h, ki: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, block_k, Dh), lambda b, h, ki: (b, h, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, Dh), lambda b, h, ki: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, 1, Dh), lambda b, h, ki, lens: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, Dh),
+                         lambda b, h, ki, lens: (b, h, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, Dh),
+                         lambda b, h, ki, lens: (b, h, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, 1, Dh), lambda b, h, ki: (b, h, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, 1, Dh), q.dtype),
+        out_specs=pl.BlockSpec((1, 1, 1, Dh), lambda b, h, ki, lens: (b, h, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((1, Dh), jnp.float32),
             pltpu.VMEM((1, 1), jnp.float32),
             pltpu.VMEM((1, 1), jnp.float32),
         ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, sm_scale=scale, block_k=block_k,
+                          num_blocks=num_blocks),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, 1, Dh), q.dtype),
         interpret=_interpret(),
     )(lens, qh, k_cache, v_cache)
     return out.transpose(0, 2, 1, 3)  # back to [B, 1, H, Dh]
+
+
+# ------------------------------------------------------------------ paged path
+def paged_decode_attention(
+    q: jnp.ndarray,           # [B, 1, H, Dh]
+    k_pages: jnp.ndarray,     # [H, P, page_size, Dh] — shared page pool
+    v_pages: jnp.ndarray,
+    lengths: jnp.ndarray,     # [B] int32: valid tokens INCLUDING the new one
+    block_tables: jnp.ndarray,  # [B, pages_per_seq] int32 page ids (pad: 0)
+    softmax_scale: Optional[float] = None,
+    impl: Optional[str] = None,  # None=auto | "kernel" | "gather"
+) -> jnp.ndarray:
+    """Decode attention reading K/V through a block table.
+
+    Each request's cache is a list of fixed-size pages scattered through the
+    pool; the kernel's innermost grid axis walks ``block_tables[b]`` and the
+    K/V ``index_map`` resolves the page id from SMEM — HBM traffic is exactly
+    the pages the request owns, regardless of pool fragmentation. Table slots
+    past a request's length must hold a VALID page id (the allocator reserves
+    page 0 as that sink); their tiles are masked, never read into the sum.
+
+    ``impl``: "kernel" forces the Pallas path (Mosaic on TPU, interpret
+    elsewhere), "gather" the XLA fallback; auto follows the backend like the
+    other Pallas ops.
+    """
+    B, one, H, Dh = q.shape
+    assert one == 1
+    page_size = k_pages.shape[2]
+    pages_per_seq = block_tables.shape[1]
+    scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(Dh)
+    lens = _as_lengths(lengths, B)
+    tables = jnp.asarray(block_tables, jnp.int32)
+    if impl is None:
+        impl = "kernel" if jax.default_backend() == "tpu" else "gather"
+    if impl == "gather":
+        return _paged_gather_attention(q, k_pages, v_pages, lens, tables, scale)
+    if impl != "kernel":
+        raise ValueError(f"impl must be None, 'kernel' or 'gather': {impl!r}")
+
+    qh = q.transpose(0, 2, 1, 3)  # [B, H, 1, Dh]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # (lens, tables) -> SMEM
+        grid=(B, H, pages_per_seq),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, Dh),
+                         lambda b, h, i, lens, tbl: (b, h, 0, 0)),
+            # the paged gather IS this index_map: tile i of row b lives in
+            # pool slot tables[b, i]
+            pl.BlockSpec((1, 1, page_size, Dh),
+                         lambda b, h, i, lens, tbl: (h, tbl[b, i], 0, 0)),
+            pl.BlockSpec((1, 1, page_size, Dh),
+                         lambda b, h, i, lens, tbl: (h, tbl[b, i], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, 1, Dh),
+                               lambda b, h, i, lens, tbl: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, Dh), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+            pltpu.VMEM((1, 1), jnp.float32),
+        ],
+    )
+    # k/v page pools enter with a leading dummy batch-of-heads axis folded
+    # away by the (1, 1, ps, Dh) blocks over [H, P, ps, Dh]
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel, sm_scale=scale, page_size=page_size,
+                          num_pages=pages_per_seq),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, 1, Dh), q.dtype),
+        interpret=_interpret(),
+    )(lens, tables, qh, k_pages, v_pages)
+    return out.transpose(0, 2, 1, 3)
+
+
+def _paged_kernel(len_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *, sm_scale: float, page_size: int,
+                  num_pages: int):
+    del tbl_ref  # consumed by the index maps; the body only needs lengths
+    _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+                   sm_scale=sm_scale, block_k=page_size, num_blocks=num_pages)
+
+
+def _paged_gather_attention(q, k_pages, v_pages, lens, tables, scale):
+    """XLA fallback: materialize each request's pages contiguously (one
+    gather), then the same masked softmax the dense reference computes — the
+    value stream is arithmetically identical to attending over a contiguous
+    cache holding the same tokens, so tests check it BITWISE against the
+    dense path."""
+    B = q.shape[0]
+
+    # [H, B, pages, ps, Dh] -> [B, H, pages*ps, Dh]
+    def gather(pages):
+        g = pages[:, tables]          # [H, B, n, ps, Dh]
+        g = g.transpose(1, 0, 2, 3, 4)
+        return g.reshape(B, g.shape[1], -1, g.shape[-1])
+
+    k = gather(k_pages)
+    v = gather(v_pages)
+    s = jnp.einsum("bthd,bhsd->bhts", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    S = k.shape[2]
+    mask = jnp.arange(S)[None, None, None, :] < lens[:, None, None, None]
+    s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhts,bhsd->bthd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)

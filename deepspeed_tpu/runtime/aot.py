@@ -664,6 +664,32 @@ def find_max_decode_batch(
             "report": best}
 
 
+def serving_admission_limit(
+    model: str,
+    *,
+    lo: int = 1,
+    hi: int = 64,
+    safety_margin: float = 1.0,
+    **report_kwargs: Any,
+) -> Dict[str, Any]:
+    """The continuous-batching admission limit, from the AOT fit ladder.
+
+    :func:`find_max_decode_batch` binary-searches the largest decode batch
+    whose compiled program fits the topology; the serving scheduler
+    (``inference/serving``) uses that verdict as its decode SLOT count — the
+    number of requests allowed in the decode phase simultaneously. The paged
+    pool then re-divides the same KV HBM into pages, so admission control is
+    two-tier: slots bound compute/peak-HBM (this verdict), pages bound
+    resident tokens (the allocator). ``safety_margin`` scales the verdict
+    down (e.g. 0.9) to leave headroom for the prefill scratch cache."""
+    r = find_max_decode_batch(model, lo=lo, hi=hi, **report_kwargs)
+    slots = int(r["max_batch"] * safety_margin)
+    fit = (r.get("report") or {}).get("fit")
+    return {"model": model, "max_slots": slots,
+            "max_decode_batch": r["max_batch"], "fit": fit,
+            "trace": r["trace"]}
+
+
 def sd_program_report(
     *,
     topology: str = "v5e:2x2",

@@ -61,6 +61,32 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
 fi
 grep -aE '^[0-9]+ passed' /tmp/_t1_overlap.log || true
 
+# --- serving gate (docs/SERVING.md) ---------------------------------------
+# the continuous-batching stack must stay green even when the full suite
+# hits its budget mid-run: decode-kernel batch regression (the b16 BlockSpec
+# crash class), paged allocator/equivalence, scheduler mechanics, and the
+# serving dslint rule.
+if ! timeout -k 10 420 env JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_serving.py tests/test_paged_kv.py \
+        tests/test_decode_attention.py -q -m 'not slow' \
+        -p no:cacheprovider -p no:randomly > /tmp/_t1_serving.log 2>&1; then
+    echo "verify_tier1: FAIL — serving/paged-KV tests:" >&2
+    tail -30 /tmp/_t1_serving.log >&2
+    exit 1
+fi
+grep -aE '^[0-9]+ passed' /tmp/_t1_serving.log || true
+
+# the CPU-fallback scheduler smoke: admit/evict/finish a mixed-length
+# request stream end to end (paged prefill/decode, preemption, eos,
+# greedy-equivalence vs generate) — the serving contract in one script.
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        python scripts/serving_smoke.py > /tmp/_t1_serving_smoke.log 2>&1; then
+    echo "verify_tier1: FAIL — serving smoke (scripts/serving_smoke.py):" >&2
+    tail -30 /tmp/_t1_serving_smoke.log >&2
+    exit 1
+fi
+grep -a "serving_smoke: PASS" /tmp/_t1_serving_smoke.log || true
+
 # --- fault-injection smoke (docs/RESILIENCE.md) ---------------------------
 # two heal cycles on the CPU mesh: SIGKILL mid-checkpoint + auto-resume
 # (crash consistency), and injected NaN -> divergence rollback -> poisoned

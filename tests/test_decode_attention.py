@@ -32,6 +32,109 @@ def test_decode_matches_dense(rng, cur_len):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4)
 
 
+@pytest.mark.parametrize("batch", [1, 8, 16, 32])
+def test_decode_wide_batch(rng, batch):
+    """Regression for the b16 BlockSpec/index_map Mosaic rejection
+    (BENCH_r02.json): the (b, h, ki) grid must run at every batch width.
+    The scalar length operand now rides scalar prefetch (SMEM), not a
+    memory-space-less VMEM block."""
+    S, H, Dh = 64, 4, 16
+    q = jnp.asarray(rng.normal(size=(batch, 1, H, Dh)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(batch, H, S, Dh)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(batch, H, S, Dh)), jnp.float32)
+    out = decode_attention(q, k, v, jnp.int32(40), block_k=16)
+    ref = _dense_decode(q, k, v, 40)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_decode_per_row_lengths(rng):
+    """Continuous batching: every batch row decodes at its OWN cache length
+    (a [B] lengths vector instead of the legacy scalar)."""
+    B, S, H, Dh = 16, 64, 4, 16
+    q = jnp.asarray(rng.normal(size=(B, 1, H, Dh)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, H, S, Dh)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, H, S, Dh)), jnp.float32)
+    lens = jnp.asarray(rng.integers(1, S + 1, size=(B,)), jnp.int32)
+    out = np.asarray(decode_attention(q, k, v, lens, block_k=16))
+    for b in range(B):
+        ref = _dense_decode(q[b:b + 1], k[b:b + 1], v[b:b + 1],
+                            int(lens[b]))
+        np.testing.assert_allclose(out[b:b + 1], np.asarray(ref),
+                                   atol=2e-5, rtol=1e-4)
+    with pytest.raises(ValueError, match="scalar or"):
+        decode_attention(q, k, v, lens[: B // 2], block_k=16)
+
+
+def _scatter_pool(rng, k, v, page_size, num_pages):
+    """Place a contiguous [B, H, S, Dh] cache into a shuffled page pool;
+    returns (k_pages [H, P, ps, Dh], v_pages, tables [B, S/ps])."""
+    B, H, S, Dh = k.shape
+    per_seq = S // page_size
+    assert B * per_seq <= num_pages - 1
+    ids = list(range(1, num_pages))
+    rng.shuffle(ids)
+    k_pages = np.zeros((H, num_pages, page_size, Dh), np.float32)
+    v_pages = np.zeros((H, num_pages, page_size, Dh), np.float32)
+    tables = np.zeros((B, per_seq), np.int32)
+    for b in range(B):
+        for i in range(per_seq):
+            pg = ids.pop()
+            tables[b, i] = pg
+            sl = slice(i * page_size, (i + 1) * page_size)
+            k_pages[:, pg] = k[b, :, sl, :]
+            v_pages[:, pg] = v[b, :, sl, :]
+    return jnp.asarray(k_pages), jnp.asarray(v_pages), jnp.asarray(tables)
+
+
+@pytest.mark.parametrize("impl", ["kernel", "gather"])
+def test_paged_decode_matches_dense(rng, impl):
+    """The block-table gather (kernel index_map or XLA fallback) must be
+    invisible: paged output == dense contiguous-cache attention at mixed
+    per-row lengths."""
+    from deepspeed_tpu.ops.pallas.decode_attention import \
+        paged_decode_attention
+
+    B, S, H, Dh, ps = 8, 64, 4, 16, 16
+    q = jnp.asarray(rng.normal(size=(B, 1, H, Dh)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, H, S, Dh)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, H, S, Dh)), jnp.float32)
+    lens = jnp.asarray(rng.integers(1, S + 1, size=(B,)), jnp.int32)
+    k_pages, v_pages, tables = _scatter_pool(rng, np.asarray(k),
+                                             np.asarray(v), ps, 64)
+    out = paged_decode_attention(q, k_pages, v_pages, lens, tables,
+                                 impl=impl)
+    ref = _dense_decode(q, k, v, lens.reshape(B, 1, 1, 1))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_paged_gather_fallback_bitwise_vs_dense(rng):
+    """The XLA fallback is the same arithmetic as attending over a
+    contiguous cache holding the same tokens — BITWISE, not just close
+    (the paged layout must introduce zero numerical drift off-TPU)."""
+    from deepspeed_tpu.ops.pallas.decode_attention import \
+        _paged_gather_attention
+
+    B, S, H, Dh, ps = 4, 32, 2, 8, 8
+    q = jnp.asarray(rng.normal(size=(B, 1, H, Dh)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, H, S, Dh)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, H, S, Dh)), jnp.float32)
+    lens = jnp.asarray(rng.integers(1, S + 1, size=(B,)), jnp.int32)
+    k_pages, v_pages, tables = _scatter_pool(rng, np.asarray(k),
+                                             np.asarray(v), ps, 32)
+    scale = 1.0 / np.sqrt(Dh)
+    paged = _paged_gather_attention(q, k_pages, v_pages, lens, tables, scale)
+    # identity layout: a contiguous pool whose table is [0, 1, 2, ...]
+    ident_k = jnp.asarray(np.asarray(k).transpose(1, 0, 2, 3).reshape(
+        H, B * S // ps, ps, Dh))
+    ident_v = jnp.asarray(np.asarray(v).transpose(1, 0, 2, 3).reshape(
+        H, B * S // ps, ps, Dh))
+    ident_t = jnp.arange(B * (S // ps), dtype=jnp.int32).reshape(B, S // ps)
+    dense = _paged_gather_attention(q, ident_k, ident_v, lens, ident_t, scale)
+    np.testing.assert_array_equal(np.asarray(paged), np.asarray(dense))
+
+
 def test_decode_length_is_traced(rng):
     """One compiled kernel must serve every decode step (length as data)."""
     B, S, H, Dh = 1, 16, 2, 8

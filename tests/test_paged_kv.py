@@ -1,0 +1,219 @@
+"""Paged KV cache: allocator free-list properties, prompt-KV scatter
+semantics, and paged-vs-dense logits equivalence at mixed lengths."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.serving.paging import (PageAllocator,
+                                                    RESERVED_PAGE, pages_for)
+from deepspeed_tpu.models import gpt as G
+
+CFG = G.GPTConfig(vocab_size=64, d_model=32, n_layer=2, n_head=4,
+                  max_seq_len=128)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return G.init_params(CFG, jax.random.PRNGKey(0))
+
+
+# ---------------------------------------------------------------- allocator
+def test_pages_for():
+    assert pages_for(0, 8) == 0
+    assert pages_for(1, 8) == 1
+    assert pages_for(8, 8) == 1
+    assert pages_for(9, 8) == 2
+
+
+def test_allocator_never_double_allocates():
+    """Property test: random alloc/free interleavings never hand out a page
+    twice, never lose a page, and never touch the reserved sink."""
+    rng = np.random.default_rng(0)
+    alloc = PageAllocator(64)
+    held = []  # lists of page ids we own
+    for _ in range(2000):
+        if held and rng.random() < 0.45:
+            pages = held.pop(rng.integers(len(held)))
+            alloc.free(pages)
+        else:
+            n = int(rng.integers(1, 6))
+            pages = alloc.alloc(n)
+            if pages is None:
+                assert alloc.free_pages < n  # refusal only under pressure
+                continue
+            assert len(pages) == n
+            held.append(pages)
+        outstanding = [p for ps in held for p in ps]
+        assert len(outstanding) == len(set(outstanding)), "double allocation"
+        assert RESERVED_PAGE not in outstanding
+        assert alloc.free_pages + len(outstanding) == 63  # conservation
+    for ps in held:
+        alloc.free(ps)
+    assert alloc.free_pages == 63
+    assert alloc.allocated_pages == 0
+
+
+def test_allocator_free_is_checked():
+    alloc = PageAllocator(8)
+    pages = alloc.alloc(3)
+    alloc.free(pages)
+    with pytest.raises(ValueError, match="double-free"):
+        alloc.free(pages)
+    with pytest.raises(ValueError, match="reserved"):
+        alloc.free([RESERVED_PAGE])
+    with pytest.raises(ValueError):
+        PageAllocator(1)  # nothing left after the sink
+
+
+def test_allocator_all_or_nothing():
+    alloc = PageAllocator(6)  # 5 usable
+    assert alloc.alloc(7) is None
+    assert alloc.free_pages == 5  # a failed alloc takes nothing
+    got = alloc.alloc(5)
+    assert got is not None and alloc.free_pages == 0
+
+
+# ---------------------------------------------------------------- scatter
+def test_write_prompt_kv_drops_padding_and_respects_tables(params):
+    """Bucket padding past `length` must not touch the pool; valid tokens
+    land exactly in the pages the table names."""
+    ps, P = 8, 16
+    paged = G.init_paged_cache(CFG, P, ps, jnp.float32)
+    dense = G.init_cache(CFG, 1, 32, jnp.float32)
+    ids = jnp.asarray(np.arange(32, dtype=np.int32)[None] % 64)
+    _, dense = G.forward_with_cache(CFG, params, ids, dense)
+    table = jnp.asarray(np.array([3, 9, 0, 0], np.int32))
+    length = 11  # pages 3 (8 tokens) + 9 (3 tokens)
+    out = G.write_prompt_kv(paged, dense, table, jnp.int32(length))
+    k_pages = np.asarray(out["k_pages"])  # [L, H, P, ps, Dh]
+    k_dense = np.asarray(dense["k"])      # [L, 1, H, S, Dh]
+    np.testing.assert_array_equal(k_pages[:, :, 3], k_dense[:, 0, :, :8])
+    np.testing.assert_array_equal(k_pages[:, :, 9, :3], k_dense[:, 0, :, 8:11])
+    # everything else (including rest of page 9 and the whole pool) untouched
+    assert (k_pages[:, :, 9, 3:] == 0).all()
+    mask = np.ones(16, bool)
+    mask[[3, 9]] = False
+    assert (k_pages[:, :, mask] == 0).all()
+
+
+# ------------------------------------------------------ paged == dense logits
+@pytest.mark.parametrize("rotary", [False, True])
+def test_paged_decode_logits_match_dense_cache(params, rotary, rng):
+    """The paged decode step must reproduce the contiguous-cache decode
+    logits at mixed sequence lengths — per row, to fp tolerance."""
+    cfg = CFG if not rotary else G.GPTConfig(
+        vocab_size=64, d_model=32, n_layer=2, n_head=4, max_seq_len=128,
+        rotary=True, rotary_pct=0.5)
+    p = params if not rotary else G.init_params(cfg, jax.random.PRNGKey(0))
+    B, ps, MP, P = 3, 8, 4, 16
+    prompt_lens = [5, 9, 3]
+    paged = G.init_paged_cache(cfg, P, ps, jnp.float32)
+    tables = np.zeros((B, MP), np.int32)
+    free = list(range(1, P))
+    lengths = np.zeros(B, np.int32)
+    prompts = [rng.integers(0, 64, (n,)).astype(np.int32)
+               for n in prompt_lens]
+    for b in range(B):
+        ids = np.zeros((1, 16), np.int32)
+        ids[0, :prompt_lens[b]] = prompts[b]
+        dense = G.init_cache(cfg, 1, 16, jnp.float32)
+        _, dense = G.forward_with_cache(cfg, p, jnp.asarray(ids), dense)
+        for i in range(pages_for(prompt_lens[b] + 4, ps)):
+            tables[b, i] = free.pop()
+        paged = G.write_prompt_kv(paged, dense, jnp.asarray(tables[b]),
+                                  jnp.int32(prompt_lens[b]))
+        lengths[b] = prompt_lens[b]
+
+    toks = rng.integers(0, 64, (B, 3)).astype(np.int32)
+    paged_logits = []
+    for t in range(3):
+        lg, paged = G.paged_decode_step(cfg, p, jnp.asarray(toks[:, t]),
+                                        paged, jnp.asarray(tables),
+                                        jnp.asarray(lengths), impl="gather")
+        paged_logits.append(np.asarray(lg))
+        lengths += 1
+
+    for b in range(B):
+        dense = G.init_cache(cfg, 1, 32, jnp.float32)
+        _, dense = G.forward_with_cache(cfg, p, jnp.asarray(prompts[b][None]),
+                                        dense)
+        for t in range(3):
+            lg, dense = G.forward_with_cache(
+                cfg, p, jnp.asarray(toks[b:b + 1, t:t + 1]), dense)
+            np.testing.assert_allclose(paged_logits[t][b],
+                                       np.asarray(lg)[0, 0],
+                                       atol=2e-4, rtol=2e-3)
+
+
+def test_paged_decode_rejects_alibi():
+    cfg = G.GPTConfig(vocab_size=32, d_model=16, n_layer=1, n_head=2,
+                      alibi=True)
+    p = G.init_params(cfg, jax.random.PRNGKey(0))
+    paged = G.init_paged_cache(cfg, 4, 8, jnp.float32)
+    with pytest.raises(ValueError, match="alibi"):
+        G.paged_decode_step(cfg, p, jnp.zeros(2, jnp.int32), paged,
+                            jnp.zeros((2, 2), jnp.int32),
+                            jnp.zeros(2, jnp.int32))
+
+
+def test_paged_decode_quantized_stack(params, rng):
+    """The int8 weight stack (decode's weight-bandwidth lever) must flow
+    through the paged step exactly like the contiguous one: quantized paged
+    logits == quantized dense-cache logits."""
+    qparams = G.quantize_for_inference(CFG, params, bits=8, group_size=128)
+    assert G._is_qleaf(qparams["blocks"]["qkv_w"])  # the stack did quantize
+    B, ps, P = 2, 8, 16
+    prompts = [rng.integers(0, 64, (6,)).astype(np.int32) for _ in range(B)]
+    paged = G.init_paged_cache(CFG, P, ps, jnp.float32)
+    tables = np.zeros((B, 4), np.int32)
+    free = list(range(1, P))
+    for b in range(B):
+        ids = np.zeros((1, 8), np.int32)
+        ids[0, :6] = prompts[b]
+        dense = G.init_cache(CFG, 1, 8, jnp.float32)
+        _, dense = G.forward_with_cache(CFG, qparams, jnp.asarray(ids), dense)
+        tables[b, 0] = free.pop()
+        paged = G.write_prompt_kv(paged, dense, jnp.asarray(tables[b]),
+                                  jnp.int32(6))
+    lengths = np.full(B, 6, np.int32)
+    tok = rng.integers(0, 64, (B,)).astype(np.int32)
+    lg, _ = G.paged_decode_step(CFG, qparams, jnp.asarray(tok), paged,
+                                jnp.asarray(tables), jnp.asarray(lengths),
+                                impl="gather")
+    for b in range(B):
+        dense = G.init_cache(CFG, 1, 16, jnp.float32)
+        _, dense = G.forward_with_cache(CFG, qparams,
+                                        jnp.asarray(prompts[b][None]), dense)
+        ref, _ = G.forward_with_cache(CFG, qparams,
+                                      jnp.asarray(tok[b:b + 1][None]), dense)
+        np.testing.assert_allclose(np.asarray(lg)[b], np.asarray(ref)[0, 0],
+                                   atol=2e-4, rtol=2e-3)
+
+
+def test_batch_scatter_matches_serial(params, rng):
+    """write_prompt_kv_batch == per-row write_prompt_kv (the admission-batch
+    prefill path must place identical bytes)."""
+    ps, P, F, S = 8, 32, 3, 16
+    dense = G.init_cache(CFG, F, S, jnp.float32)
+    ids = jnp.asarray(rng.integers(0, 64, (F, S)).astype(np.int32))
+    _, dense = G.forward_with_cache(CFG, params, ids, dense)
+    lengths = np.array([5, 16, 1], np.int32)
+    tables = np.zeros((F, 2), np.int32)
+    free = list(range(1, P))
+    for f in range(F):
+        for i in range(pages_for(int(lengths[f]), ps)):
+            tables[f, i] = free.pop()
+    batch = G.write_prompt_kv_batch(
+        G.init_paged_cache(CFG, P, ps, jnp.float32), dense,
+        jnp.asarray(tables), jnp.asarray(lengths))
+    serial = G.init_paged_cache(CFG, P, ps, jnp.float32)
+    for f in range(F):
+        serial = G.write_prompt_kv(serial, dense, jnp.asarray(tables[f]),
+                                   jnp.int32(lengths[f]), row=f)
+    np.testing.assert_array_equal(np.asarray(batch["k_pages"]),
+                                  np.asarray(serial["k_pages"]))
+    np.testing.assert_array_equal(np.asarray(batch["v_pages"]),
+                                  np.asarray(serial["v_pages"]))

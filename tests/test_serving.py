@@ -1,0 +1,309 @@
+"""Continuous-batching serving: scheduler mechanics (device-free), the
+ServingEngine end-to-end greedy equivalence, shape buckets, compile-event
+logging, and the serving dslint rule."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from deepspeed_tpu.inference.serving import (ContinuousBatchingScheduler,
+                                             Request, RequestState,
+                                             bucket_for, default_buckets)
+from deepspeed_tpu.models import gpt as G
+
+
+class FakeExecutor:
+    """Deterministic device-free executor: prefill answers last+1, decode
+    answers prev+1 (mod 97). Lets the scheduler be tested alone."""
+
+    def __init__(self):
+        self.prefills = []
+        self.decode_calls = 0
+
+    def prefill(self, slot, tokens, table_row):
+        self.prefills.append((slot, len(tokens)))
+        return (int(tokens[-1]) + 1) % 97
+
+    def decode(self, tokens, tables, lengths, active, steps=1):
+        self.decode_calls += 1
+        return np.stack([(tokens + k + 1) % 97 for k in range(steps)])
+
+
+def _sched(ex=None, num_slots=2, num_pages=16, page_size=4,
+           pages_per_seq=8, decode_block=1):
+    return ContinuousBatchingScheduler(
+        ex or FakeExecutor(), num_slots=num_slots, num_pages=num_pages,
+        page_size=page_size, pages_per_seq=pages_per_seq,
+        decode_block=decode_block)
+
+
+# ---------------------------------------------------------------- scheduler
+def test_mixed_stream_admit_evict_finish():
+    s = _sched(num_slots=2)
+    reqs = [Request(prompt=np.arange(n, dtype=np.int32), max_new_tokens=m)
+            for n, m in [(3, 4), (7, 2), (2, 6), (5, 3), (1, 1)]]
+    for r in reqs:
+        s.submit(r)
+    s.run_to_completion()
+    assert all(r.state is RequestState.FINISHED for r in reqs)
+    assert [len(r.tokens) for r in reqs] == [4, 2, 6, 3, 1]
+    # FIFO: earlier submissions never finish after strictly-later ones start
+    assert all(r.t_first_token is not None and r.t_done is not None
+               for r in reqs)
+    assert s.allocator.allocated_pages == 0  # every page returned
+    assert s.idle
+
+
+def test_deterministic_token_stream():
+    """The fake decode chain is prev+1: generated tokens must be the exact
+    arithmetic continuation regardless of which slot/step served them."""
+    s = _sched(num_slots=3)
+    r = Request(prompt=np.array([10, 20], np.int32), max_new_tokens=5)
+    s.submit(r)
+    s.run_to_completion()
+    assert r.tokens == [21, 22, 23, 24, 25]
+
+
+def test_preemption_requeues_and_completes():
+    """Pool pressure mid-decode preempts the newest slot; the preempted
+    request re-prefills with its kept tokens and still finishes with the
+    right continuation."""
+    ex = FakeExecutor()
+    # 7 usable pages, page_size 2: two long requests cannot both hold their
+    # full contexts — growth must preempt
+    s = _sched(ex, num_slots=2, num_pages=8, page_size=2, pages_per_seq=8)
+    a = Request(prompt=np.array([1, 2, 3], np.int32), max_new_tokens=8)
+    b = Request(prompt=np.array([50, 51, 52], np.int32), max_new_tokens=8)
+    s.submit(a)
+    s.submit(b)
+    s.run_to_completion(max_steps=200)
+    assert a.tokens == [(4 + i) % 97 for i in range(8)]
+    assert b.tokens == [(53 + i) % 97 for i in range(8)]
+    assert a.preemptions + b.preemptions >= 1
+    # newest-admitted yields first: the OLDER request is never the victim
+    # while a younger active slot exists
+    assert a.preemptions == 0 and b.preemptions >= 1
+    assert s.allocator.allocated_pages == 0
+
+
+def test_admission_rejects_oversized_request():
+    s = _sched(pages_per_seq=2, page_size=4)  # capacity: 8 tokens
+    with pytest.raises(ValueError, match="exceeds"):
+        s.submit(Request(prompt=np.zeros(6, np.int32), max_new_tokens=4))
+
+
+def test_admission_rejects_request_larger_than_pool():
+    """A request needing more pages than EXIST must be rejected at submit —
+    admitted, it would head-of-line-block forever (or self-preempt in an
+    infinite recompute loop once it outgrew the pool)."""
+    s = _sched(num_pages=3, page_size=4, pages_per_seq=8)  # pool: 2 pages
+    with pytest.raises(ValueError, match="pool"):
+        s.submit(Request(prompt=np.zeros(8, np.int32), max_new_tokens=4))
+    # a fitting request still serves
+    r = Request(prompt=np.zeros(4, np.int32), max_new_tokens=3)
+    s.submit(r)
+    s.run_to_completion()
+    assert len(r.tokens) == 3
+
+
+def test_eos_finishes_early_and_frees_slot():
+    ex = FakeExecutor()
+    s = _sched(ex, num_slots=1)
+    # prefill returns 1; decode chain 2, 3, ... eos=4 cuts at 4 tokens
+    r = Request(prompt=np.zeros(1, np.int32), max_new_tokens=20,
+                eos_token_id=4)
+    s.submit(r)
+    s.run_to_completion()
+    assert r.tokens[-1] == 4 and len(r.tokens) == 4
+    assert s.allocator.allocated_pages == 0
+
+
+def test_decode_block_batches_steps_without_changing_tokens():
+    ex1, ex4 = FakeExecutor(), FakeExecutor()
+    out = []
+    for ex, block in ((ex1, 1), (ex4, 4)):
+        s = _sched(ex, num_slots=2, decode_block=block)
+        reqs = [Request(prompt=np.arange(3, dtype=np.int32),
+                        max_new_tokens=9) for _ in range(2)]
+        for r in reqs:
+            s.submit(r)
+        s.run_to_completion()
+        out.append([r.tokens for r in reqs])
+    assert out[0] == out[1]
+    assert ex4.decode_calls < ex1.decode_calls  # blocks actually batched
+
+
+def test_scheduler_uses_prefill_many_when_available():
+    class BatchExec(FakeExecutor):
+        def __init__(self):
+            super().__init__()
+            self.batches = []
+
+        def prefill_many(self, items):
+            self.batches.append([slot for slot, _, _ in items])
+            return {slot: (int(t[-1]) + 1) % 97 for slot, t, _ in items}
+
+    ex = BatchExec()
+    s = _sched(ex, num_slots=3)
+    for i in range(3):
+        s.submit(Request(prompt=np.array([i], np.int32), max_new_tokens=2))
+    s.step()
+    assert ex.batches and len(ex.batches[0]) == 3  # one batched admission
+    assert not ex.prefills  # serial path unused
+
+
+# ---------------------------------------------------------------- buckets
+def test_buckets():
+    assert default_buckets(32, 256) == (32, 64, 128, 256)
+    assert default_buckets(32, 200) == (32, 64, 128, 256)
+    assert bucket_for(1, (32, 64)) == 32
+    assert bucket_for(33, (32, 64)) == 64
+    with pytest.raises(ValueError, match="exceeds"):
+        bucket_for(65, (32, 64))
+
+
+# ------------------------------------------------------------- end to end
+CFG = G.GPTConfig(vocab_size=64, d_model=32, n_layer=2, n_head=4,
+                  max_seq_len=128)
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    from deepspeed_tpu.inference.serving import ServingConfig, ServingEngine
+
+    params = G.init_params(CFG, jax.random.PRNGKey(0))
+    return ServingEngine(CFG, params, ServingConfig(
+        num_slots=3, page_size=8, max_model_len=64, prefill_chunk=16,
+        dtype="float32", decode_block=4)), params
+
+
+def test_serving_greedy_matches_generate(tiny_engine):
+    """Continuous batching must be invisible in the outputs: every request's
+    greedy tokens == InferenceEngine.generate on the same prompt (covers
+    paged attention, batched/chunked prefill, decode blocks, admission)."""
+    from deepspeed_tpu.inference import (DeepSpeedInferenceConfig,
+                                         InferenceEngine)
+    from deepspeed_tpu.inference.engine import for_gpt
+    from deepspeed_tpu.inference.serving import (make_open_loop_workload,
+                                                 run_continuous)
+
+    eng, params = tiny_engine
+    wl = make_open_loop_workload(6, rate_rps=1e4, prompt_len=(3, 30),
+                                 max_new=(2, 8), vocab_size=64, seed=3)
+    # one multi-chunk prompt (> prefill_chunk) for the serial chunked path
+    wl.append(Request(prompt=np.arange(20, dtype=np.int32) + 1,
+                      max_new_tokens=4))
+    rep = run_continuous(eng, wl)
+    assert rep["finished"] == len(wl)
+    ie = InferenceEngine(for_gpt(CFG, params), DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=64))
+    for r in wl:
+        ref = np.asarray(ie.generate(np.asarray(r.prompt)[None],
+                                     max_new_tokens=r.max_new_tokens))
+        np.testing.assert_array_equal(ref[0, len(r.prompt):],
+                                      np.asarray(r.tokens[:r.max_new_tokens]))
+
+
+def test_warmup_covers_unaligned_final_chunk_buckets():
+    """A bucket only reachable through a capped remainder (prefill_chunk + b
+    > max_model_len) must still warm — a legal long prompt's final chunk
+    must never pay a mid-traffic compile."""
+    from deepspeed_tpu.inference.serving import ServingConfig, ServingEngine
+
+    params = G.init_params(CFG, jax.random.PRNGKey(0))
+    eng = ServingEngine(CFG, params, ServingConfig(
+        num_slots=2, page_size=8, max_model_len=100, prefill_chunk=64,
+        dtype="float32", decode_block=2))
+    eng.warmup()
+    before = len(eng.compile_log)
+    # remainder 36 -> bucket 64, whose natural warm length 64+64 > 100
+    eng.prefill(0, np.zeros(100, np.int32), np.zeros(13, np.int32))
+    assert len(eng.compile_log) == before, eng.compile_log[before:]
+
+    # non-power-of-two prefill_chunk: the top bucket exceeds prefill_chunk,
+    # but short prompts still take the fused path — warmup must have
+    # compiled it (regression: the warm probe used to overshoot into the
+    # chunked path and skip the fused program)
+    params = G.init_params(CFG, jax.random.PRNGKey(0))
+    eng2 = ServingEngine(CFG, params, ServingConfig(
+        num_slots=2, page_size=8, max_model_len=64, prefill_chunk=24,
+        dtype="float32", decode_block=2))
+    eng2.warmup()
+    before = len(eng2.compile_log)
+    eng2.prefill(0, np.zeros(20, np.int32), np.zeros(8, np.int32))
+    assert len(eng2.compile_log) == before, eng2.compile_log[before:]
+
+
+def test_serving_compile_log_is_bounded(tiny_engine):
+    """After warmup, serving traffic must hit only cached programs."""
+    from deepspeed_tpu.inference.serving import (make_open_loop_workload,
+                                                 run_continuous)
+
+    eng, _ = tiny_engine
+    eng.warmup()
+    before = len(eng.compile_log)
+    run_continuous(eng, make_open_loop_workload(
+        5, 1e4, (3, 30), (2, 8), 64, seed=11))
+    assert len(eng.compile_log) == before, eng.compile_log[before:]
+
+
+# ---------------------------------------------------------------- dslint
+def test_unbucketed_decode_rule_fires_and_stays_silent(tiny_engine):
+    from deepspeed_tpu.analysis import analyze_compile_log
+
+    broken = [{"kind": "decode", "shape": (1, 5 + i)} for i in range(5)]
+    errs = analyze_compile_log(broken).errors()
+    assert errs and errs[0].rule_id == "serving/unbucketed-decode-shape"
+    # a stride change mid-stream starts a NEW run from that pair: the creep
+    # (6,7,8) after the +2 pair (4,6) must fire without a 5th compile
+    mixed = [{"kind": "decode", "shape": (1, n)} for n in (4, 6, 7, 8)]
+    assert analyze_compile_log(mixed).errors()
+    # bucketed shape sets (powers of two) never fire
+    ok = [{"kind": "generate", "shape": (2, 4, b)} for b in (8, 16, 32, 64)]
+    assert not analyze_compile_log(ok).findings
+    # the live serving engine's log is clean
+    eng, _ = tiny_engine
+    assert not analyze_compile_log(eng).findings
+
+
+def test_inference_engine_decode_buckets_and_log():
+    from deepspeed_tpu.inference import (DeepSpeedInferenceConfig,
+                                         InferenceEngine)
+    from deepspeed_tpu.inference.engine import for_gpt
+
+    cfg = G.GPTConfig(vocab_size=64, d_model=32, n_layer=1, n_head=2,
+                      max_seq_len=128)
+    params = G.init_params(cfg, jax.random.PRNGKey(0))
+    eng = InferenceEngine(for_gpt(cfg, params), DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=64, decode_buckets=[8, 16]))
+    ids = np.zeros((2, 4), np.int32)
+    o5 = eng.generate(ids, max_new_tokens=5)
+    o7 = eng.generate(ids, max_new_tokens=7)  # same bucket: cache hit
+    assert o5.shape == (2, 9) and o7.shape == (2, 11)
+    assert len(eng.compile_log) == 1
+    np.testing.assert_array_equal(o5, o7[:, :9])  # greedy prefix stable
+    events = []
+
+    class Sink:
+        def write_events(self, evs):
+            events.extend(evs)
+
+    eng.set_monitor(Sink())
+    eng.generate(ids, max_new_tokens=12)  # bucket 16: new compile, logged
+    assert len(eng.compile_log) == 2
+    assert events and events[0][0] == "Inference/compile_events"
+
+
+def test_serving_admission_limit_plumbing(monkeypatch):
+    from deepspeed_tpu.runtime import aot
+
+    monkeypatch.setattr(aot, "find_max_decode_batch",
+                        lambda model, lo=1, hi=64, **kw: {
+                            "model": model, "max_batch": 12,
+                            "trace": [{"batch": 1, "fits": True}],
+                            "report": {"fit": {"confidence": "fits"}}})
+    lim = aot.serving_admission_limit("gpt2-350m", safety_margin=0.75)
+    assert lim["max_slots"] == 9
+    assert lim["max_decode_batch"] == 12
+    assert lim["fit"] == {"confidence": "fits"}
