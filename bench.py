@@ -320,6 +320,7 @@ def _worker(cfg: dict) -> None:
         jax.config.update("jax_platforms", "cpu")
     fn = {"train": _worker_train, "inference": _worker_infer,
           "serving": _worker_serving,
+          "serving_overload": _worker_serving_overload,
           "moe_train": _worker_moe_train,
           "kernels": _worker_kernels, "diffusion": _worker_diffusion,
           "pipeline_aot": _worker_pipeline_aot,
@@ -898,6 +899,83 @@ def _worker_serving(cfg: dict) -> dict:
         "continuous": cont, "static": static,
     }
     return out
+
+
+def _worker_serving_overload(cfg: dict) -> dict:
+    """Overload A/B at 2x saturation (docs/SERVING.md "Overload & failure"):
+    calibrate the server's closed-loop saturation rate, then drive the SAME
+    2x-rate Poisson workload through (a) an overload-CONTROLLED scheduler
+    (bounded queue, token backpressure, deadlines = the SLO) and (b) an
+    uncontrolled one (the unsafe default). Both score against the same
+    evaluation SLO, so the row shows what admission control buys: bounded
+    p99 TTFT of *accepted* requests and higher goodput, versus a baseline
+    whose queue — and tail — grows for as long as the load lasts."""
+    import jax
+
+    from deepspeed_tpu.inference.serving import (ContinuousBatchingScheduler,
+                                                 ServingConfig, ServingEngine,
+                                                 estimate_saturation_rps,
+                                                 make_open_loop_workload,
+                                                 run_continuous)
+    from deepspeed_tpu.models import gpt as gpt_mod
+
+    platform = jax.devices()[0].platform
+    mcfg = gpt_mod.PRESETS[cfg["model"]]
+    params = gpt_mod.init_params(mcfg, jax.random.PRNGKey(0))
+    slots = int(cfg.get("slots", 8))
+    page_size = int(cfg.get("page_size", 16))
+    max_len = int(cfg.get("max_model_len", 128))
+    prompt_rng = tuple(cfg.get("prompt_range", (8, 32)))
+    gen_rng = tuple(cfg.get("gen_range", (8, 32)))
+    n_req = int(cfg.get("requests", 24))
+    slo_s = float(cfg.get("slo_s", 2.0))
+
+    eng = ServingEngine(mcfg, params, ServingConfig(
+        num_slots=slots, page_size=page_size, max_model_len=max_len,
+        prefill_chunk=int(cfg.get("prefill_chunk", 32)),
+        dtype=cfg.get("dtype", "float32")))
+    eng.warmup()
+    sat_rps = estimate_saturation_rps(eng, prompt_rng, gen_rng,
+                                      mcfg.vocab_size)
+    rate = float(cfg.get("overload_factor", 2.0)) * sat_rps
+
+    def workload():
+        return make_open_loop_workload(n_req, rate, prompt_rng, gen_rng,
+                                       mcfg.vocab_size,
+                                       seed=int(cfg.get("seed", 5)))
+
+    def sched(controlled: bool) -> ContinuousBatchingScheduler:
+        kw = {}
+        if controlled:
+            kw = dict(max_queue=slots,
+                      max_queued_tokens=eng.hbm_token_slots(),
+                      ttft_deadline_s=slo_s / 2, deadline_s=slo_s)
+        return ContinuousBatchingScheduler(
+            executor=eng, num_slots=eng.num_slots, num_pages=eng.num_pages,
+            page_size=page_size, pages_per_seq=eng.serving.pages_per_seq,
+            decode_block=eng.serving.decode_block, max_context=max_len, **kw)
+
+    wall = float(cfg.get("max_wall_s", 120.0))
+    on = run_continuous(eng, workload(), max_wall_s=wall, slo_s=slo_s,
+                        scheduler=sched(True))
+    off = run_continuous(eng, workload(), max_wall_s=wall, slo_s=slo_s,
+                         scheduler=sched(False))
+    return {
+        "config": cfg["name"], "kind": "serving_overload",
+        "platform": platform, "model": cfg["model"], "num_slots": slots,
+        "saturation_rps": round(sat_rps, 3), "rate_rps": round(rate, 3),
+        "slo_s": slo_s, "requests": n_req,
+        "goodput_tokens_per_sec": on["goodput_tokens_per_sec"],
+        "shed_rate": on["shed_rate"],
+        "deadline_miss_rate": on["deadline_miss_rate"],
+        "accepted_ttft_p99_ms": on["ttft_p99_ms"],
+        "pool_audit_ok": on["pool_audit_ok"] and off["pool_audit_ok"],
+        "uncontrolled_goodput_tokens_per_sec":
+            off["goodput_tokens_per_sec"],
+        "uncontrolled_ttft_p99_ms": off["ttft_p99_ms"],
+        "uncontrolled_deadline_miss_rate": off["deadline_miss_rate"],
+        "controlled": on, "uncontrolled": off,
+    }
 
 
 def _worker_diffusion(cfg: dict) -> dict:
@@ -1518,6 +1596,15 @@ def cpu_fallback_configs() -> list:
          "slots": 8, "page_size": 16, "max_model_len": 128,
          "prefill_chunk": 64, "requests": 12, "rate_rps": 50.0,
          "hbm_tokens": 640, "prompt_range": (8, 48), "gen_range": (2, 48),
+         "dtype": "float32", "force_cpu": True, "timeout": 900},
+    ] + [
+        # overload A/B at 2x saturation: with admission control ON, p99
+        # TTFT of accepted requests stays bounded and goodput holds; the
+        # uncontrolled baseline's queue (and tail) grows with the load
+        {"kind": "serving_overload", "name": "cpu-serving-overload",
+         "model": "gpt2-125m", "slots": 4, "page_size": 16,
+         "max_model_len": 96, "prefill_chunk": 32, "requests": 16,
+         "slo_s": 3.0, "prompt_range": (8, 24), "gen_range": (8, 24),
          "dtype": "float32", "force_cpu": True, "timeout": 900},
     ] + [{"kind": "inference", "name": "cpu-fallback-decode", "model": "gpt2-125m",
           "batch": 1, "prompt": 32, "gen": 16, "reps": 3, "force_cpu": True},

@@ -1,4 +1,5 @@
-"""Serving rules: decode hot paths that recompile per step.
+"""Serving rules: decode hot paths that recompile per step, and admission
+configs that accept unbounded work.
 
 XLA compiles per input shape. A decode loop that feeds the growing context
 back as a fresh shape ("cache" sliced to the valid length, prompt+generated
@@ -96,5 +97,50 @@ class UnbucketedDecodeShapeRule(Rule):
                 run_stride = None
 
 
+class UnboundedAdmissionRule(Rule):
+    """A serving config armed with no admission bound (``max_queue`` /
+    ``max_queued_tokens``) and no deadlines — the overload-unsafe default.
+
+    Under sustained open-loop load ``submit()`` then accepts every request:
+    the queue grows host RAM without limit, queued requests age past any
+    client timeout before their first token, and the eventual collapse is a
+    process OOM instead of a typed rejection at the front door
+    (docs/SERVING.md "Overload & failure"). The check reads the engine's
+    ``ServingConfig`` (``engine.serving``) — any one of the four knobs armed
+    silences it, because each bounds accepted work in SOME dimension (depth,
+    token backlog, or time)."""
+
+    rule_id = "serving/unbounded-admission"
+    default_severity = Severity.WARNING
+    description = "serving admission has no queue bound and no deadlines"
+
+    def check_context(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        cfg = getattr(ctx.engine, "serving", None) \
+            if ctx.engine is not None else None
+        if cfg is None or not hasattr(cfg, "max_queue"):
+            return  # not a serving engine (or a pre-overload-control one)
+        armed = getattr(cfg, "overload_armed", None)
+        if armed is None:  # duck-typed config without the property
+            armed = any(
+                getattr(cfg, k, None) is not None
+                for k in ("max_queue", "max_queued_tokens",
+                          "ttft_deadline_s", "request_deadline_s"))
+        if armed:
+            return
+        yield self.finding(
+            "serving admission is unbounded: no max_queue, no "
+            "max_queued_tokens, and no TTFT/end-to-end deadlines — under "
+            "sustained overload submit() accepts work the pool can never "
+            "serve in time (host-RAM queue growth, unbounded tail latency, "
+            "eventual OOM instead of a typed rejection)",
+            location="ServingConfig",
+            suggestion="set max_queue (queue depth) and/or "
+                       "max_queued_tokens (token-budget backpressure), and "
+                       "arm ttft_deadline_s/request_deadline_s so expired "
+                       "work is evicted — see docs/SERVING.md "
+                       "'Overload & failure'",
+        )
+
+
 def serving_rules() -> List[Rule]:
-    return [UnbucketedDecodeShapeRule()]
+    return [UnbucketedDecodeShapeRule(), UnboundedAdmissionRule()]

@@ -14,15 +14,18 @@ See ``docs/SERVING.md``. Layering:
 from .buckets import bucket_for, default_buckets
 from .engine import ServingConfig, ServingEngine
 from .paging import PageAllocator, RESERVED_PAGE, pages_for
-from .scheduler import ContinuousBatchingScheduler, Request, RequestState
-from .bench import (make_open_loop_workload, percentile, run_continuous,
-                    run_static_baseline)
+from .scheduler import (AdmissionVerdict, ContinuousBatchingScheduler,
+                        Request, RequestState, SHED_POLICIES,
+                        ServingFaultError)
+from .bench import (estimate_saturation_rps, make_open_loop_workload,
+                    percentile, run_continuous, run_static_baseline)
 
 __all__ = [
     "PageAllocator", "RESERVED_PAGE", "pages_for",
     "bucket_for", "default_buckets",
-    "ContinuousBatchingScheduler", "Request", "RequestState",
+    "AdmissionVerdict", "ContinuousBatchingScheduler", "Request",
+    "RequestState", "SHED_POLICIES", "ServingFaultError",
     "ServingConfig", "ServingEngine",
-    "make_open_loop_workload", "percentile", "run_continuous",
-    "run_static_baseline",
+    "estimate_saturation_rps", "make_open_loop_workload", "percentile",
+    "run_continuous", "run_static_baseline",
 ]

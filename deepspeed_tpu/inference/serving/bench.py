@@ -28,7 +28,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from .scheduler import ContinuousBatchingScheduler, Request
+from .scheduler import ContinuousBatchingScheduler, Request, RequestState
 
 
 def percentile(xs: Sequence[float], p: float) -> float:
@@ -61,14 +61,29 @@ def make_open_loop_workload(n_requests: int, rate_rps: float,
 
 
 def _report(requests: Sequence[Request], t0: float, t_end: float,
-            mode: str, extra: Optional[Dict] = None) -> Dict:
+            mode: str, extra: Optional[Dict] = None,
+            slo_s: Optional[float] = None) -> Dict:
+    """Shared report schema. ``slo_s`` is an EVALUATION deadline (arrival ->
+    completion) applied identically to every run — it lets an uncontrolled
+    baseline (which enforces nothing) be scored against the same SLO a
+    controlled run enforces, so goodput/deadline-miss numbers are an honest
+    A/B. TTFT percentiles cover accepted requests only (a shed request has
+    no first token by construction — mixing it in as +inf would charge
+    admission control for the latency it avoided)."""
     ttft, per_tok, total_tokens = [], [], 0
+    goodput_tokens = 0
+    late = 0
     for r in requests:
         arrive = t0 + r.arrival_time
         if r.t_first_token is not None:
             ttft.append(r.t_first_token - arrive)
         n = min(len(r.tokens), r.max_new_tokens)
         total_tokens += n
+        if r.t_done is not None:
+            if slo_s is None or r.t_done - arrive <= slo_s:
+                goodput_tokens += n
+            else:
+                late += 1
         # run-to-completion baselines deliver every token at once
         # (t_done == t_first): per-token cadence is undefined there, not 0
         if (r.t_done is not None and n > 1
@@ -78,6 +93,21 @@ def _report(requests: Sequence[Request], t0: float, t_end: float,
     def ms(x, nd=2):
         return None if x != x else round(x * 1e3, nd)  # NaN -> JSON null
 
+    shed = [r for r in requests if r.state is RequestState.REJECTED]
+    expired = [r for r in requests if r.state is RequestState.EXPIRED]
+    accepted = len(requests) - len(shed)
+    # accepted requests still unfinished at run end are the WORST outcomes
+    # of an overloaded run — when an SLO is being scored and theirs already
+    # lapsed, they count as misses, not as silent omissions (an uncontrolled
+    # baseline hitting the wall cap would otherwise look artificially good)
+    unfinished = [r for r in requests
+                  if r.state not in (RequestState.REJECTED,
+                                     RequestState.EXPIRED)
+                  and r.t_done is None]
+    if slo_s is not None:
+        late += sum(1 for r in unfinished
+                    if t_end - (t0 + r.arrival_time) > slo_s)
+    misses = len(expired) + late
     wall = max(t_end - t0, 1e-9)
     row = {
         "mode": mode,
@@ -90,39 +120,76 @@ def _report(requests: Sequence[Request], t0: float, t_end: float,
         "ttft_p99_ms": ms(percentile(ttft, 99)),
         "per_token_p50_ms": ms(percentile(per_tok, 50), 3),
         "per_token_p99_ms": ms(percentile(per_tok, 99), 3),
+        # overload/SLO accounting (docs/SERVING.md "Overload & failure")
+        "shed": len(shed),
+        "shed_rate": round(len(shed) / max(len(requests), 1), 4),
+        "unfinished": len(unfinished),
+        "deadline_misses": misses,
+        "deadline_miss_rate": round(misses / max(accepted, 1), 4),
+        "goodput_tokens_per_sec": round(goodput_tokens / wall, 2),
     }
+    if slo_s is not None:
+        row["slo_s"] = slo_s
     if extra:
         row.update(extra)
     return row
 
 
 def run_continuous(engine, workload: Sequence[Request],
-                   max_wall_s: float = 600.0) -> Dict:
-    """Drive the scheduler under the workload's arrival clock."""
-    sched: ContinuousBatchingScheduler = engine.make_scheduler()
+                   max_wall_s: float = 600.0, slo_s: Optional[float] = None,
+                   scheduler: Optional[ContinuousBatchingScheduler] = None
+                   ) -> Dict:
+    """Drive the scheduler under the workload's arrival clock. Rejected
+    submissions (typed :class:`AdmissionVerdict`) are terminal — the driver
+    does not retry them; they score as shed in the report. Pass
+    ``scheduler`` to drive a hand-built one (the overload A/B constructs a
+    capped and an uncapped scheduler over the same engine)."""
+    sched = scheduler if scheduler is not None else engine.make_scheduler()
     pending = sorted(workload, key=lambda r: r.arrival_time)
     t0 = time.monotonic()
     i = 0
-    while i < len(pending) or not sched.idle:
-        now = time.monotonic() - t0
-        if now > max_wall_s:
-            break
-        while i < len(pending) and pending[i].arrival_time <= now:
-            sched.submit(pending[i])
-            i += 1
-        if sched.idle:
-            if i < len(pending):  # nothing in flight: sleep to next arrival
-                time.sleep(min(max(pending[i].arrival_time - now, 0.0), 0.25))
-            continue
-        sched.step()
+    try:
+        while i < len(pending) or not sched.idle:
+            now = time.monotonic() - t0
+            if now > max_wall_s:
+                break
+            while i < len(pending) and pending[i].arrival_time <= now:
+                sched.submit(pending[i])
+                i += 1
+            if sched.idle:
+                if i < len(pending):  # nothing in flight: sleep to arrival
+                    time.sleep(min(max(pending[i].arrival_time - now, 0.0),
+                                   0.25))
+                continue
+            sched.step()
+    finally:
+        sched.close()
     t_end = time.monotonic()
-    return _report(workload, t0, t_end, "continuous", extra={
+    return _report(workload, t0, t_end, "continuous", slo_s=slo_s, extra={
         "decode_steps": sched.steps,
         "preemptions": sum(r.preemptions for r in workload),
         "num_slots": sched.num_slots,
         "hbm_token_slots": engine.hbm_token_slots(),
         "compiled_programs": len(engine.compile_log),
+        "recovery_counters": dict(sched.counters),
+        "pool_audit_ok": bool(sched.audit()["ok"]),
     })
+
+
+def estimate_saturation_rps(engine, prompt_len: tuple, max_new: tuple,
+                            vocab_size: int, n_requests: int = 8,
+                            seed: int = 1234) -> float:
+    """Calibrate the server's saturation point: drive a short CLOSED-loop
+    batch (every request present at t=0 — the scheduler is never idle) and
+    convert its aggregate tokens/s into requests/s at the workload's mean
+    generation length. The overload bench row arrives at 2x this rate —
+    open-loop load the server provably cannot keep up with."""
+    wl = make_open_loop_workload(n_requests, rate_rps=1e9,
+                                 prompt_len=prompt_len, max_new=max_new,
+                                 vocab_size=vocab_size, seed=seed)
+    rep = run_continuous(engine, wl)
+    mean_gen = float(np.mean([r.max_new_tokens for r in wl]))
+    return float(rep["tokens_per_sec"]) / max(mean_gen, 1.0)
 
 
 def run_static_baseline(infer_engine, workload: Sequence[Request],

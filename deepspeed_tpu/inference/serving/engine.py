@@ -60,10 +60,38 @@ class ServingConfig:
     kernel_impl: Optional[str] = None   # None=auto | "kernel" | "gather"
     eos_token_id: Optional[int] = None
     model_name: Optional[str] = None    # for num_slots="auto"
+    # ---- overload control + deadlines (docs/SERVING.md "Overload &
+    # failure"). All default OFF — the overload-unsafe default the dslint
+    # rule `serving/unbounded-admission` warns about; production configs
+    # should arm max_queue (and usually deadlines).
+    max_queue: Optional[int] = None          # admission queue depth cap
+    max_queued_tokens: Optional[int] = None  # queued-work token budget
+    shed_policy: str = "reject_newest"       # or "reject_largest"
+    ttft_deadline_s: Optional[float] = None  # default per-request deadlines
+    request_deadline_s: Optional[float] = None
+    # ---- dispatch fault recovery
+    dispatch_retries: int = 2
+    quarantine_after: int = 2                # failures before a decode block
+    #                                          shape is quarantined
+    dispatch_failure_budget: int = 8         # consecutive failed episodes
+    #                                          before ServingFaultError
+    prefill_deadline_s: Optional[float] = None  # watchdog phase deadlines
+    decode_deadline_s: Optional[float] = None
+    watchdog_poll_s: float = 0.25
+    stacks_dir: Optional[str] = None         # stall stack dumps land here
 
     @property
     def pages_per_seq(self) -> int:
         return pages_for(self.max_model_len, self.page_size)
+
+    @property
+    def overload_armed(self) -> bool:
+        """Whether ANY admission bound or deadline protects this config —
+        what the ``serving/unbounded-admission`` rule checks."""
+        return (self.max_queue is not None
+                or self.max_queued_tokens is not None
+                or self.ttft_deadline_s is not None
+                or self.request_deadline_s is not None)
 
 
 class ServingEngine:
@@ -359,14 +387,51 @@ class ServingEngine:
         return len(self.compile_log)
 
     # -------------------------------------------------------------- assembly
-    def make_scheduler(self, clock=time.monotonic
+    def make_scheduler(self, clock=time.monotonic, recovery_log=None
                        ) -> ContinuousBatchingScheduler:
-        return ContinuousBatchingScheduler(
+        """Assemble the scheduler with the config's overload/deadline/fault
+        knobs. ``recovery_log`` (a
+        :class:`~deepspeed_tpu.resilience.events.RecoveryLog`) receives the
+        serving recovery trail; when omitted and a monitor is attached, a
+        monitor-only log is created so ``Serving/*`` scalars still flow. A
+        watchdog is created (and owned by the scheduler — ``close()`` stops
+        it) when either serving phase deadline is armed."""
+        s = self.serving
+        if recovery_log is None and self.monitor is not None:
+            from ...resilience.events import RecoveryLog
+
+            recovery_log = RecoveryLog(monitor=self.monitor, role="serving",
+                                       prefix="Serving")
+        watchdog = None
+        owns = False
+        if s.prefill_deadline_s or s.decode_deadline_s:
+            from ...resilience.watchdog import HealthWatchdog
+
+            deadlines = {}
+            if s.prefill_deadline_s:
+                deadlines["serving_prefill"] = float(s.prefill_deadline_s)
+            if s.decode_deadline_s:
+                deadlines["serving_decode"] = float(s.decode_deadline_s)
+            watchdog = HealthWatchdog(
+                deadlines, poll_interval=s.watchdog_poll_s,
+                recovery_log=recovery_log,
+                stacks_dir=s.stacks_dir).start()
+            owns = True
+        sched = ContinuousBatchingScheduler(
             executor=self, num_slots=self.num_slots,
-            num_pages=self.num_pages, page_size=self.serving.page_size,
-            pages_per_seq=self.serving.pages_per_seq,
-            decode_block=self.serving.decode_block,
-            max_context=self.serving.max_model_len, clock=clock)
+            num_pages=self.num_pages, page_size=s.page_size,
+            pages_per_seq=s.pages_per_seq,
+            decode_block=s.decode_block,
+            max_context=s.max_model_len, clock=clock,
+            max_queue=s.max_queue, max_queued_tokens=s.max_queued_tokens,
+            shed_policy=s.shed_policy, ttft_deadline_s=s.ttft_deadline_s,
+            deadline_s=s.request_deadline_s,
+            dispatch_retries=s.dispatch_retries,
+            quarantine_after=s.quarantine_after,
+            dispatch_failure_budget=s.dispatch_failure_budget,
+            recovery_log=recovery_log, watchdog=watchdog)
+        sched._owns_watchdog = owns
+        return sched
 
     def hbm_token_slots(self) -> int:
         """Token capacity of the pool (page 0 excluded) — the "equal HBM

@@ -11,13 +11,35 @@ Allocation is all-or-nothing (a request either gets every page it asked for
 or none), frees are checked (double-free and foreign pages raise), and the
 free list is LIFO so recently-touched pages — still warm in whatever cache
 level applies — are reused first.
+
+Two robustness hooks (docs/SERVING.md "Overload & failure"):
+
+- :meth:`PageAllocator.audit` — the conservation invariant (free + allocated
+  == total, no duplicates, no reserved-page escapes). The scheduler runs it
+  after every recovery action (dispatch failure, deadline eviction, shed):
+  a page leak under fault handling must be loud, not a slow HBM bleed.
+- chaos: an armed :class:`~deepspeed_tpu.resilience.chaos.FaultPlan` with
+  ``alloc_fail_at`` makes the Nth ``alloc`` call report pool exhaustion
+  (return None) — admission/growth paths must degrade exactly as they do
+  under real pool pressure.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from typing import Dict, FrozenSet, List, Optional, Sequence
 
 RESERVED_PAGE = 0
+
+
+def _alloc_fault_armed(index: int) -> bool:
+    """Whether an armed FaultPlan wants alloc call ``index`` to fail (lazy
+    import: the allocator must stay importable without the resilience
+    package fully loaded, e.g. from setup-time tooling)."""
+    try:
+        from ...resilience.chaos import serving_alloc_fault
+    except ImportError:  # partial install / doc builds
+        return False
+    return serving_alloc_fault(index)
 
 
 def pages_for(tokens: int, page_size: int) -> int:
@@ -39,6 +61,7 @@ class PageAllocator:
         self.num_pages = int(num_pages)
         self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
         self._allocated = set()
+        self._alloc_calls = 0  # chaos injection index (alloc_fail_at)
 
     @property
     def free_pages(self) -> int:
@@ -47,6 +70,12 @@ class PageAllocator:
     @property
     def allocated_pages(self) -> int:
         return len(self._allocated)
+
+    @property
+    def allocated_ids(self) -> FrozenSet[int]:
+        """The allocator's ledger of outstanding pages — what the scheduler
+        cross-checks its slot page lists against in :meth:`audit`."""
+        return frozenset(self._allocated)
 
     def can_alloc(self, n: int) -> bool:
         return n <= len(self._free)
@@ -57,11 +86,44 @@ class PageAllocator:
         preempting."""
         if n < 0:
             raise ValueError(f"alloc({n})")
+        idx = self._alloc_calls
+        self._alloc_calls += 1
+        if _alloc_fault_armed(idx):
+            return None  # chaos: report exhaustion through the normal path
         if n > len(self._free):
             return None
         pages = [self._free.pop() for _ in range(n)]
         self._allocated.update(pages)
         return pages
+
+    def audit(self) -> Dict[str, object]:
+        """Conservation invariant over the pool: every page id 1..N-1 is in
+        exactly one of {free list, allocated set}, with no duplicates and no
+        reserved-page escapes. Returns ``{"ok", "free", "allocated",
+        "total", "errors"}`` — ``errors`` names each violated invariant.
+        Run by the scheduler after every recovery action; a non-clean audit
+        there is a page leak in the fault-handling path."""
+        errors: List[str] = []
+        free_set = set(self._free)
+        if len(free_set) != len(self._free):
+            errors.append("duplicate ids in the free list")
+        overlap = free_set & self._allocated
+        if overlap:
+            errors.append(f"pages both free and allocated: {sorted(overlap)}")
+        if RESERVED_PAGE in free_set or RESERVED_PAGE in self._allocated:
+            errors.append("reserved sink page 0 escaped into the pool")
+        bad = [p for p in free_set | self._allocated
+               if not (1 <= p < self.num_pages)]
+        if bad:
+            errors.append(f"page ids outside the pool: {sorted(bad)}")
+        total = self.num_pages - 1
+        if len(free_set) + len(self._allocated) != total:
+            errors.append(
+                f"conservation broken: free {len(free_set)} + allocated "
+                f"{len(self._allocated)} != total {total}")
+        return {"ok": not errors, "free": len(free_set),
+                "allocated": len(self._allocated), "total": total,
+                "errors": errors}
 
     def free(self, pages: Sequence[int]) -> None:
         for p in pages:

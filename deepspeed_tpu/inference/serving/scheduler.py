@@ -30,6 +30,24 @@ tests drive a fake):
 - ``decode(tokens, tables, lengths, active, steps=1) -> [steps, num_slots]``
   — ``steps`` fixed-shape decode steps over every slot as one dispatch
   (a flat ``[num_slots]`` return is accepted only for ``steps == 1``).
+
+Production hardening (docs/SERVING.md "Overload & failure"):
+
+- **overload control** — ``submit`` returns a typed
+  :class:`AdmissionVerdict`; past ``max_queue`` / ``max_queued_tokens`` the
+  configured shed policy rejects the newest request (default) or sheds the
+  largest queued one to make room. No unbounded host-RAM queue, no
+  accepting work the pool can never serve in time.
+- **deadlines** — per-request TTFT and end-to-end deadlines (defaults from
+  the scheduler) are checked every step: expired requests are evicted,
+  their pages freed, and a ``deadline_miss`` recovery event recorded.
+- **dispatch fault recovery** — every executor call is bracketed by the
+  resilience watchdog's serving phases and the chaos plan's dispatch
+  injectors, retried on the shared ``backoff_delay`` curve, and — when a
+  whole episode fails — healed by preempt-and-requeue (kept-token
+  semantics) with the offending decode block shape quarantined after K
+  failures. Every recovery path ends in a :meth:`audit` pass: page
+  conservation is an enforced invariant, not a hope.
 """
 
 from __future__ import annotations
@@ -39,10 +57,13 @@ import enum
 import itertools
 import time
 from collections import deque
-from typing import Any, Deque, List, Optional
+from contextlib import nullcontext
+from typing import Any, Deque, Dict, List, Optional, Set
 
 import numpy as np
 
+from ...resilience.chaos import serving_dispatch_fault
+from ...resilience.retry import backoff_delay
 from .paging import PageAllocator, pages_for
 
 
@@ -50,6 +71,44 @@ class RequestState(enum.Enum):
     QUEUED = "queued"
     RUNNING = "running"
     FINISHED = "finished"
+    REJECTED = "rejected"   # shed at/after submit (overload or unservable)
+    EXPIRED = "expired"     # missed its deadline; evicted, pages freed
+
+
+class ServingFaultError(RuntimeError):
+    """The executor failed ``dispatch_failure_budget`` consecutive dispatch
+    episodes (each already retried) — the serving process is sick beyond
+    what preempt-and-requeue can heal; the supervisor should recycle it."""
+
+
+class _DispatchFailure(RuntimeError):
+    """Internal: one dispatch episode (all retry attempts) failed."""
+
+    def __init__(self, kind: str, attempts: int, last: BaseException):
+        super().__init__(f"{kind} dispatch failed after {attempts} attempts: "
+                         f"{last!r}")
+        self.kind = kind
+        self.attempts = attempts
+        self.last = last
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionVerdict:
+    """The typed result of :meth:`ContinuousBatchingScheduler.submit`.
+
+    ``reason``: ``admitted`` | ``unservable`` (prompt+max_new can never fit
+    the serving bound — a caller bug, not load) | ``queue_full`` |
+    ``token_backlog`` (the admission queue's token-budget backpressure
+    estimate is exhausted). ``shed_rid``: under the ``reject_largest``
+    policy, the rid of the queued request evicted to make room."""
+
+    admitted: bool
+    reason: str = "admitted"
+    detail: str = ""
+    shed_rid: Optional[int] = None
+
+    def __bool__(self) -> bool:
+        return self.admitted
 
 
 _rid = itertools.count()
@@ -63,6 +122,11 @@ class Request:
     max_new_tokens: int
     eos_token_id: Optional[int] = None
     arrival_time: float = 0.0           # offset into the workload (open loop)
+    # deadlines, seconds from t_submit (None -> the scheduler's defaults):
+    # TTFT is enforced while queued (first token lands at admission), the
+    # end-to-end deadline for the whole lifetime
+    ttft_deadline_s: Optional[float] = None
+    deadline_s: Optional[float] = None
     rid: int = dataclasses.field(default_factory=lambda: next(_rid))
 
     # lifecycle (filled by the scheduler)
@@ -72,6 +136,7 @@ class Request:
     t_first_token: Optional[float] = None
     t_done: Optional[float] = None
     preemptions: int = 0
+    reject_reason: Optional[str] = None  # set when REJECTED/EXPIRED
 
     @property
     def context_len(self) -> int:
@@ -84,13 +149,37 @@ class Request:
                 or (self.eos_token_id is not None and self.tokens
                     and self.tokens[-1] == self.eos_token_id))
 
+    @property
+    def work_tokens(self) -> int:
+        """Remaining worst-case token work: what the backpressure estimate
+        charges this request against ``max_queued_tokens`` (prompt KV to
+        prefill + tokens still to decode)."""
+        return len(self.prompt) + self.max_new_tokens - len(self.tokens)
+
+
+SHED_POLICIES = ("reject_newest", "reject_largest")
+
 
 class ContinuousBatchingScheduler:
     def __init__(self, executor: Any, num_slots: int, num_pages: int,
                  page_size: int, pages_per_seq: int, decode_block: int = 1,
-                 max_context: Optional[int] = None, clock=time.monotonic):
+                 max_context: Optional[int] = None, clock=time.monotonic,
+                 max_queue: Optional[int] = None,
+                 max_queued_tokens: Optional[int] = None,
+                 shed_policy: str = "reject_newest",
+                 ttft_deadline_s: Optional[float] = None,
+                 deadline_s: Optional[float] = None,
+                 dispatch_retries: int = 2,
+                 retry_base_delay: float = 0.02,
+                 retry_max_delay: float = 0.25,
+                 quarantine_after: int = 2,
+                 dispatch_failure_budget: int = 8,
+                 recovery_log: Any = None, watchdog: Any = None):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
+        if shed_policy not in SHED_POLICIES:
+            raise ValueError(f"shed_policy {shed_policy!r} not in "
+                             f"{SHED_POLICIES}")
         self.executor = executor
         self.num_slots = int(num_slots)
         self.page_size = int(page_size)
@@ -105,6 +194,22 @@ class ContinuousBatchingScheduler:
                                else pages_per_seq * page_size)
         self.allocator = PageAllocator(num_pages)
         self.clock = clock
+        # overload control / deadlines
+        self.max_queue = None if max_queue is None else int(max_queue)
+        self.max_queued_tokens = (None if max_queued_tokens is None
+                                  else int(max_queued_tokens))
+        self.shed_policy = shed_policy
+        self.ttft_deadline_s = ttft_deadline_s
+        self.deadline_s = deadline_s
+        # dispatch fault recovery
+        self.dispatch_retries = int(dispatch_retries)
+        self.retry_base_delay = float(retry_base_delay)
+        self.retry_max_delay = float(retry_max_delay)
+        self.quarantine_after = int(quarantine_after)
+        self.dispatch_failure_budget = int(dispatch_failure_budget)
+        self.recovery_log = recovery_log
+        self.watchdog = watchdog
+        self._owns_watchdog = False  # set by ServingEngine.make_scheduler
         self.queue: Deque[Request] = deque()
         self.slots: List[Optional[Request]] = [None] * self.num_slots
         self._slot_pages: List[List[int]] = [[] for _ in range(self.num_slots)]
@@ -114,7 +219,18 @@ class ContinuousBatchingScheduler:
         self.lengths = np.zeros(self.num_slots, np.int32)
         self.next_input = np.zeros(self.num_slots, np.int32)
         self.finished: List[Request] = []
+        self.shed: List[Request] = []      # REJECTED (submit-time or policy)
+        self.expired: List[Request] = []   # EXPIRED (deadline misses)
+        self.counters: Dict[str, int] = {}
         self.steps = 0
+        self._dispatch_count = 0           # chaos injection index
+        # failed dispatch EPISODES in a row, per kind: a healthy prefill
+        # path must not mask a dead decode path (or vice versa) — the
+        # admit/fail/requeue cycle would spin forever against a shared
+        # counter that every successful prefill resets
+        self._consecutive_failures: Dict[str, int] = {}
+        self._block_failures: Dict[int, int] = {}
+        self._quarantined_blocks: Set[int] = set()
 
     # ------------------------------------------------------------ bookkeeping
     @property
@@ -125,7 +241,34 @@ class ContinuousBatchingScheduler:
     def idle(self) -> bool:
         return not self.queue and not self.active_slots
 
-    def submit(self, req: Request) -> None:
+    @property
+    def queued_tokens(self) -> int:
+        """The admission queue's token-backpressure estimate: worst-case
+        tokens of work (prompt KV + remaining generation) the queue already
+        holds. What ``max_queued_tokens`` bounds."""
+        return sum(r.work_tokens for r in self.queue)
+
+    def _record(self, event: str, value: float = 1.0, **fields: Any) -> None:
+        self.counters[event] = self.counters.get(event, 0) + 1
+        if self.recovery_log is not None:
+            try:
+                self.recovery_log.record(event, value=value, step=self.steps,
+                                         **fields)
+            except Exception:  # event export must never fail serving
+                pass
+
+    def _mark_shed(self, req: Request, reason: str, detail: str = "") -> None:
+        req.state = RequestState.REJECTED
+        req.reject_reason = reason
+        self.shed.append(req)
+        self._record("request_shed", rid=req.rid, reason=reason,
+                     work_tokens=req.work_tokens, detail=detail[:200])
+
+    def submit(self, req: Request) -> AdmissionVerdict:
+        """Admission control. Returns a typed verdict — the caller sees WHY
+        a request was turned away (unservable vs overload) instead of a
+        silently growing queue. A rejected request is marked
+        ``RequestState.REJECTED`` and never enters the queue."""
         worst = len(req.prompt) + req.max_new_tokens
         pool = self.allocator.num_pages - 1  # page 0 reserved
         if (worst > self.max_context
@@ -135,16 +278,72 @@ class ContinuousBatchingScheduler:
             # EXIST can never admit (queue head-of-line spins forever) and,
             # admitted mid-way, would self-preempt in an infinite
             # recompute loop once it outgrows the pool
-            raise ValueError(
+            detail = (
                 f"request {req.rid}: prompt+max_new={worst} tokens exceeds "
                 f"the serving bound (max_context={self.max_context}, "
                 f"pages_per_seq={self.pages_per_seq} x page_size="
                 f"{self.page_size}, pool={pool} pages) — reject at the "
                 f"front door, not mid-decode")
+            self._mark_shed(req, "unservable", detail)
+            return AdmissionVerdict(False, "unservable", detail)
+        # overload control: queue-depth cap, then the token-budget estimate
+        verdict = self._admission_control(req)
+        if not verdict.admitted:
+            return verdict
+        if req.ttft_deadline_s is None:
+            req.ttft_deadline_s = self.ttft_deadline_s
+        if req.deadline_s is None:
+            req.deadline_s = self.deadline_s
         req.state = RequestState.QUEUED
         if req.t_submit is None:
             req.t_submit = self.clock()
         self.queue.append(req)
+        return verdict
+
+    def _admission_control(self, req: Request) -> AdmissionVerdict:
+        def over(queued: List[Request]) -> bool:
+            depth = (self.max_queue is not None
+                     and len(queued) >= self.max_queue)
+            tokens = (self.max_queued_tokens is not None
+                      and sum(r.work_tokens for r in queued)
+                      + req.work_tokens > self.max_queued_tokens)
+            return depth or tokens
+
+        if not over(list(self.queue)):
+            return AdmissionVerdict(True)
+        if self.shed_policy == "reject_largest":
+            # plan the shed set FIRST: the largest queued request(s) — the
+            # cheapest goodput to sacrifice per freed token — each strictly
+            # larger than the incoming one (shedding down trades goodput
+            # away). Victims are only actually sacrificed if the incoming
+            # request then fits; otherwise nobody dies for a rejection.
+            sim = list(self.queue)
+            victims: List[Request] = []
+            while sim and over(sim):
+                v = max(sim, key=lambda r: r.work_tokens)
+                if v.work_tokens <= req.work_tokens:
+                    break
+                sim.remove(v)
+                victims.append(v)
+            if not over(sim):
+                for v in victims:
+                    self.queue.remove(v)
+                    self._mark_shed(v, "shed_for_smaller",
+                                    f"shed for request {req.rid}")
+                return AdmissionVerdict(
+                    True, shed_rid=victims[-1].rid if victims else None)
+        over_depth = (self.max_queue is not None
+                      and len(self.queue) >= self.max_queue)
+        reason = "queue_full" if over_depth else "token_backlog"
+        detail = (
+            f"request {req.rid} rejected ({reason}): queue depth "
+            f"{len(self.queue)}" + (f"/{self.max_queue}" if self.max_queue
+                                    is not None else "")
+            + f", queued work {self.queued_tokens} tokens"
+            + (f"/{self.max_queued_tokens}" if self.max_queued_tokens
+               is not None else ""))
+        self._mark_shed(req, reason, detail)
+        return AdmissionVerdict(False, reason, detail)
 
     def _release(self, slot: int) -> None:
         self.allocator.free(self._slot_pages[slot])
@@ -170,6 +369,151 @@ class ContinuousBatchingScheduler:
         req.state = RequestState.QUEUED
         self._release(slot)
         self.queue.appendleft(req)
+
+    # ------------------------------------------------------------- deadlines
+    def _expire(self, req: Request, where: str, now: float) -> None:
+        req.state = RequestState.EXPIRED
+        req.reject_reason = f"deadline_{where}"
+        self.expired.append(req)
+        t0 = req.t_submit if req.t_submit is not None else now
+        self._record("deadline_miss", value=now - t0,
+                     rid=req.rid, where=where,
+                     tokens_done=len(req.tokens))
+
+    def _sweep_deadlines(self) -> int:
+        """Evict expired requests (queued: TTFT or e2e deadline already
+        blown; running: e2e deadline blown — pages freed). Returns the
+        number evicted; any eviction is a recovery action, so the page
+        audit runs."""
+        now = self.clock()
+        evicted = 0
+        for req in [r for r in self.queue]:
+            t0 = req.t_submit if req.t_submit is not None else now
+            # TTFT only applies while the first token is still owed — a
+            # preempted request back in the queue has already delivered it
+            miss_ttft = (req.ttft_deadline_s is not None
+                         and req.t_first_token is None
+                         and now - t0 > req.ttft_deadline_s)
+            miss_e2e = (req.deadline_s is not None
+                        and now - t0 > req.deadline_s)
+            if miss_ttft or miss_e2e:
+                self.queue.remove(req)
+                self._expire(req, "queued", now)
+                evicted += 1
+        for slot in self.active_slots:
+            req = self.slots[slot]
+            t0 = req.t_submit if req.t_submit is not None else now
+            if req.deadline_s is not None and now - t0 > req.deadline_s:
+                self._release(slot)
+                self._expire(req, "running", now)
+                evicted += 1
+        if evicted:
+            self._audit_after_recovery("deadline_sweep")
+        return evicted
+
+    # ------------------------------------------------------ dispatch bracket
+    def _phase(self, kind: str):
+        if self.watchdog is None:
+            return nullcontext()
+        return self.watchdog.phase(f"serving_{kind}")
+
+    def _dispatch(self, kind: str, fn, *args: Any, **kw: Any) -> Any:
+        """One dispatch episode: chaos injection + watchdog phase bracket +
+        bounded retry on the shared backoff curve. The chaos hook fires
+        INSIDE the phase (an injected stall is observed by the deadline
+        machinery) and BEFORE the executor call (an injected raise never
+        tears device state, so the in-place retry is sound)."""
+        attempts = self.dispatch_retries + 1
+        last: Optional[BaseException] = None
+        for attempt in range(1, attempts + 1):
+            idx = self._dispatch_count
+            self._dispatch_count += 1
+            try:
+                with self._phase(kind):
+                    serving_dispatch_fault(kind, idx)
+                    out = fn(*args, **kw)
+                self._consecutive_failures[kind] = 0
+                return out
+            except Exception as e:
+                last = e
+                self._record("dispatch_error", kind=kind, attempt=attempt,
+                             error=f"{type(e).__name__}: {e}"[:200])
+                if attempt < attempts:
+                    time.sleep(backoff_delay(attempt, self.retry_base_delay,
+                                             self.retry_max_delay))
+        raise _DispatchFailure(kind, attempts, last)
+
+    def _on_dispatch_episode_failed(self, fail: _DispatchFailure,
+                                    affected: List[int],
+                                    block: Optional[int] = None) -> None:
+        """A whole dispatch episode (all retries) failed: quarantine the
+        decode block shape after K failures, preempt-and-requeue the
+        affected slots (kept-token semantics — greedy re-prefill reproduces
+        the exact state), audit the pool, and give up loudly once the
+        consecutive-failure budget is spent."""
+        if block is not None and block > 1:
+            n = self._block_failures.get(block, 0) + 1
+            self._block_failures[block] = n
+            if (n >= self.quarantine_after
+                    and block not in self._quarantined_blocks):
+                self._quarantined_blocks.add(block)
+                self._record("block_quarantined", value=block, block=block,
+                             failures=n)
+        # newest-admitted first keeps the requeue order FIFO-consistent:
+        # appendleft of newest..oldest leaves the oldest at the queue head
+        for slot in sorted(affected, key=lambda s: self._admit_seq[s],
+                           reverse=True):
+            if self.slots[slot] is not None:
+                self._preempt(slot)
+        n = self._consecutive_failures.get(fail.kind, 0) + 1
+        self._consecutive_failures[fail.kind] = n
+        self._record("dispatch_failed", kind=fail.kind,
+                     attempts=fail.attempts, consecutive=n,
+                     error=f"{type(fail.last).__name__}: {fail.last}"[:200])
+        self._audit_after_recovery(f"dispatch_failed[{fail.kind}]")
+        if n >= self.dispatch_failure_budget:
+            raise ServingFaultError(
+                f"{n} consecutive {fail.kind} dispatch episodes failed "
+                f"(budget {self.dispatch_failure_budget}); last: "
+                f"{fail}") from fail.last
+
+    # ----------------------------------------------------------- page audit
+    def audit(self) -> Dict[str, Any]:
+        """The allocator conservation invariant plus the scheduler-side
+        cross-check: the union of slot page lists must be EXACTLY the
+        allocator's outstanding-page ledger, with no page owned twice."""
+        rep = self.allocator.audit()
+        owned = [p for ps in self._slot_pages for p in ps]
+        errors: List[str] = list(rep["errors"])
+        if len(owned) != len(set(owned)):
+            errors.append("a page appears in two slot page lists")
+        if set(owned) != self.allocator.allocated_ids:
+            leaked = sorted(self.allocator.allocated_ids - set(owned))
+            foreign = sorted(set(owned) - self.allocator.allocated_ids)
+            if leaked:
+                errors.append(f"pages allocated but owned by no slot "
+                              f"(leak): {leaked}")
+            if foreign:
+                errors.append(f"slot-held pages unknown to the allocator: "
+                              f"{foreign}")
+        rep["errors"] = errors
+        rep["ok"] = not errors
+        return rep
+
+    def _audit_after_recovery(self, context: str) -> None:
+        rep = self.audit()
+        if not rep["ok"]:
+            self._record("page_audit_failed", context=context,
+                         errors="; ".join(rep["errors"])[:400])
+            raise RuntimeError(
+                f"page conservation broken after {context}: {rep['errors']}")
+
+    def close(self) -> None:
+        """Stop a watchdog the engine created for this scheduler (no-op for
+        caller-owned or absent watchdogs)."""
+        if self._owns_watchdog and self.watchdog is not None:
+            self.watchdog.stop()
+            self.watchdog = None
 
     # ------------------------------------------------------------ admission
     def _admit(self) -> int:
@@ -204,13 +548,22 @@ class ContinuousBatchingScheduler:
             return 0
         # phase 2: prefill the whole admission cycle — batched when the
         # executor supports it (one [num_slots, chunk] dispatch instead of
-        # one per request)
-        if hasattr(self.executor, "prefill_many"):
-            results = self.executor.prefill_many(
-                [(slot, toks, self.tables[slot]) for slot, toks in batch])
-        else:
-            results = {slot: int(self.executor.prefill(
-                slot, toks, self.tables[slot])) for slot, toks in batch}
+        # one per request). A failed episode (retries exhausted) unwinds the
+        # WHOLE admission cycle back to the queue: no request has appended a
+        # token yet, so requeue-with-kept-tokens is exact.
+        try:
+            if hasattr(self.executor, "prefill_many"):
+                results = self._dispatch(
+                    "prefill", self.executor.prefill_many,
+                    [(slot, toks, self.tables[slot]) for slot, toks in batch])
+            else:
+                results = {slot: int(self._dispatch(
+                    "prefill", self.executor.prefill, slot, toks,
+                    self.tables[slot])) for slot, toks in batch}
+        except _DispatchFailure as fail:
+            self._on_dispatch_episode_failed(fail,
+                                             [slot for slot, _ in batch])
+            return 0
         for slot, _ in batch:
             req = self.slots[slot]
             first = int(results[slot])
@@ -259,11 +612,15 @@ class ContinuousBatchingScheduler:
         k = 1
         while k * 2 <= min(remaining, self.decode_block):
             k *= 2
+        while k > 1 and k in self._quarantined_blocks:
+            k //= 2  # shapes that keep failing dispatch are off the menu
         return k
 
     def step(self) -> int:
-        """Admit what fits, then run one decode step (or one safe decode
-        BLOCK) over the slot array. Returns tokens produced."""
+        """Expire blown deadlines, admit what fits, then run one decode step
+        (or one safe decode BLOCK) over the slot array. Returns tokens
+        produced."""
+        self._sweep_deadlines()
         self._admit()
         if not self.active_slots:
             return 0
@@ -288,9 +645,16 @@ class ContinuousBatchingScheduler:
         block = min(block, self._block_size())  # preemption may shrink it
         mask = np.zeros(self.num_slots, bool)
         mask[active] = True
-        out = np.asarray(self.executor.decode(
-            self.next_input.copy(), self.tables.copy(),
-            self.lengths.copy(), mask, steps=block))
+        try:
+            out = np.asarray(self._dispatch(
+                "decode", self.executor.decode, self.next_input.copy(),
+                self.tables.copy(), self.lengths.copy(), mask, steps=block))
+        except _DispatchFailure as fail:
+            # no token from this episode was observed: every active slot
+            # requeues with exactly the tokens it had, so the healed rerun
+            # is greedy-identical to a fault-free one
+            self._on_dispatch_episode_failed(fail, active, block=block)
+            return 0
         if out.ndim == 1:  # simple executors may return a flat SINGLE step
             if block != 1:
                 raise ValueError(

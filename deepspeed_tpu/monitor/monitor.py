@@ -83,20 +83,32 @@ class JSONLMonitor:
     object per line. TPU-native addition for the resilience layer: unlike the
     CSV/TB writers it is crash-tolerant by construction (a torn final line is
     skipped by readers) and trivially mergeable across process generations —
-    the recovery-event trail (``Resilience/*`` events) survives any number of
-    preemptions and restarts."""
+    the recovery-event trail (``Resilience/*``/``Serving/*`` events) survives
+    any number of preemptions and restarts. The file rotates by size
+    (``max_bytes``/``keep``, shared :func:`rotate_jsonl` machinery with the
+    recovery-event sink) so week-long serving runs cannot grow host disk
+    without bound."""
 
-    def __init__(self, output_path: str, job_name: str = "DeepSpeedJobName"):
+    def __init__(self, output_path: str, job_name: str = "DeepSpeedJobName",
+                 max_bytes: Optional[int] = None, keep: int = 3):
         import time as _time
+
+        from ..resilience.events import DEFAULT_ROTATE_BYTES
 
         self._time = _time
         d = os.path.join(output_path or "jsonl_out", job_name)
         os.makedirs(d, exist_ok=True)
         self.path = os.path.join(d, "events.jsonl")
+        self.max_bytes = (DEFAULT_ROTATE_BYTES if max_bytes is None
+                          else int(max_bytes))
+        self.keep = int(keep)
 
     def write_events(self, events: Sequence[Event]) -> None:
         import json
 
+        from ..resilience.events import rotate_jsonl
+
+        rotate_jsonl(self.path, self.max_bytes, self.keep)
         with open(self.path, "a") as f:
             for name, value, step in events:
                 f.write(json.dumps(
@@ -178,8 +190,11 @@ class MonitorMaster:
                 _SafeBackend(CSVMonitor(cs.output_path, cs.job_name)))
         jl = getattr(monitor_config, "jsonl", None)
         if jl is not None and jl.enabled:
-            self.backends.append(
-                _SafeBackend(JSONLMonitor(jl.output_path, jl.job_name)))
+            rotate_mb = float(getattr(jl, "rotate_mb", 0.0) or 0.0)
+            self.backends.append(_SafeBackend(JSONLMonitor(
+                jl.output_path, jl.job_name,
+                max_bytes=int(rotate_mb * 2**20) if rotate_mb > 0 else None,
+                keep=int(getattr(jl, "rotate_keep", 3)))))
 
     @property
     def degraded(self) -> bool:

@@ -32,13 +32,17 @@ that must never acquire the accelerator) uses the same machinery.
 from .chaos import (
     FAULT_PLAN_ENV,
     FaultPlan,
+    InjectedDispatchError,
+    ServingFault,
     TrainingFaults,
     fault_point,
     get_fault_plan,
     install_plan,
+    serving_alloc_fault,
+    serving_dispatch_fault,
     training_faults,
 )
-from .events import EVENTS_FILENAME, RecoveryLog, read_events
+from .events import EVENTS_FILENAME, RecoveryLog, read_events, rotate_jsonl
 from .manifest import (
     CHECKSUMS,
     COMMIT_NAME,
@@ -71,6 +75,7 @@ from .rollback import (
     WireDemotionController,
 )
 from .watchdog import (
+    SERVING_PHASES,
     STACKS_FILENAME,
     HealthWatchdog,
     allgather_host_stats,
@@ -79,10 +84,12 @@ from .watchdog import (
 
 __all__ = [
     "CheckpointCorruptionError", "UncommittedTagError",
-    "FaultPlan", "TrainingFaults", "FAULT_PLAN_ENV", "fault_point",
+    "FaultPlan", "TrainingFaults", "ServingFault", "InjectedDispatchError",
+    "FAULT_PLAN_ENV", "fault_point",
     "get_fault_plan", "install_plan", "training_faults",
+    "serving_dispatch_fault", "serving_alloc_fault",
     "HealthWatchdog", "identify_stragglers", "allgather_host_stats",
-    "STACKS_FILENAME",
+    "STACKS_FILENAME", "SERVING_PHASES", "rotate_jsonl",
     "SpikeDetector", "HealthController", "WireDemotionController",
     "DivergenceError",
     "PreemptionGuard", "PREEMPTED_EXIT_CODE",

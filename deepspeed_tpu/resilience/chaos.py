@@ -76,6 +76,25 @@ class FaultPlan:
       (repeated overflow -> fp32 wire) without having to construct a real
       error-feedback blow-up. The in-program overflow handling itself
       (skip + EF residual reset) is exercised by the real overflow tests.
+
+    Serving-path injectors (docs/SERVING.md "Overload & failure"; consumed
+    by the continuous-batching scheduler at the 2.5-method executor protocol
+    boundary — BEFORE the device call, so a fired fault never tears donated
+    device state and a retry starts clean):
+
+    - ``dispatch_raise_at`` (+ ``dispatch_raise_times``): executor dispatches
+      (prefill or decode, each retry attempt counts) with 0-based index in
+      ``[at, at + times)`` raise :class:`InjectedDispatchError`. ``times`` =
+      1 exercises the in-place retry; ``times`` >= the scheduler's attempt
+      budget forces a whole dispatch episode to fail — preempt-and-requeue,
+      block-shape quarantine, and the page-conservation audit all fire.
+    - ``dispatch_stall_at`` + ``dispatch_stall_seconds``: one dispatch
+      sleeps host-side before executing — the hang the serving watchdog
+      phases (``serving_prefill``/``serving_decode`` deadlines) must flag.
+    - ``alloc_fail_at`` (+ ``alloc_fail_times``): the Nth
+      ``PageAllocator.alloc`` call reports pool exhaustion (returns None) —
+      admission must queue (head-of-line) and growth must preempt, exactly
+      as under real pool pressure.
     """
 
     kill_at_phase: Optional[str] = None
@@ -90,6 +109,13 @@ class FaultPlan:
     stall_collective: float = 0.0
     stall_collective_at_step: int = 1
     ef_overflow_steps: int = 0
+    # serving-path injectors
+    dispatch_raise_at: Optional[int] = None
+    dispatch_raise_times: int = 1
+    dispatch_stall_at: Optional[int] = None
+    dispatch_stall_seconds: float = 0.0
+    alloc_fail_at: Optional[int] = None
+    alloc_fail_times: int = 1
 
     # runtime counters (not part of the plan spec)
     _save_index: int = dataclasses.field(default=-1, repr=False)
@@ -190,6 +216,29 @@ class FaultPlan:
                 f"{cursor} ({self._ef_overflows_left} more)")
         return TrainingFaults(nan_loss=nan, stall_s=stall, ef_overflow=ef)
 
+    def serving_dispatch(self, index: int) -> "ServingFault":
+        """Resolve the serving-dispatch injections armed for executor
+        dispatch ``index`` (0-based; every attempt — including retries —
+        advances the index, so a one-shot raise heals on the retry and a
+        ``times`` >= attempt-budget raise fails the whole episode)."""
+        raise_error = (
+            self.dispatch_raise_at is not None
+            and int(self.dispatch_raise_at) <= index
+            < int(self.dispatch_raise_at) + max(1, int(self.dispatch_raise_times)))
+        stall = 0.0
+        if (self.dispatch_stall_at is not None
+                and index == int(self.dispatch_stall_at)
+                and self.dispatch_stall_seconds > 0):
+            stall = float(self.dispatch_stall_seconds)
+        return ServingFault(raise_error=raise_error, stall_s=stall)
+
+    def serving_alloc(self, index: int) -> bool:
+        """Whether ``PageAllocator.alloc`` call ``index`` should report pool
+        exhaustion."""
+        return (self.alloc_fail_at is not None
+                and int(self.alloc_fail_at) <= index
+                < int(self.alloc_fail_at) + max(1, int(self.alloc_fail_times)))
+
     def on_io(self, what: str) -> None:
         """Called by RetryingWriter before each I/O attempt."""
         self._io_calls += 1
@@ -210,6 +259,20 @@ class TrainingFaults:
     nan_loss: bool = False
     stall_s: float = 0.0
     ef_overflow: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingFault:
+    """Injections resolved for one serving dispatch (all off when no plan)."""
+
+    raise_error: bool = False
+    stall_s: float = 0.0
+
+
+class InjectedDispatchError(RuntimeError):
+    """The chaos plan's synthetic executor-dispatch failure. A distinct type
+    so tests (and the dispatch-recovery path's logs) can tell an injected
+    fault from a genuine executor bug."""
 
 
 # ------------------------------------------------------------------ global plan
@@ -262,5 +325,40 @@ def training_faults(cursor: int) -> TrainingFaults:
     return plan.training_faults(cursor)
 
 
-__all__ = ["FaultPlan", "TrainingFaults", "FAULT_PLAN_ENV", "install_plan",
-           "get_fault_plan", "fault_point", "training_faults"]
+def serving_dispatch_fault(kind: str, index: int) -> None:
+    """Fire the serving-dispatch injections armed for dispatch ``index``:
+    stall first (a slow dispatch), then raise (a failing one). Called by the
+    scheduler's dispatch wrapper BEFORE the executor call — inside the
+    serving watchdog phase, so an injected stall is observed by the same
+    deadline machinery a real hang would trip."""
+    plan = get_fault_plan()
+    if plan is None:
+        return
+    f = plan.serving_dispatch(index)
+    if f.stall_s > 0:
+        logger.warning(f"chaos: stalling serving {kind} dispatch #{index} "
+                       f"for {f.stall_s}s")
+        time.sleep(f.stall_s)
+    if f.raise_error:
+        logger.warning(f"chaos: raising on serving {kind} dispatch #{index}")
+        raise InjectedDispatchError(
+            f"chaos: injected failure on serving {kind} dispatch #{index}")
+
+
+def serving_alloc_fault(index: int) -> bool:
+    """Whether the armed plan wants ``PageAllocator.alloc`` call ``index``
+    to report exhaustion (False when no plan is installed)."""
+    plan = get_fault_plan()
+    if plan is None:
+        return False
+    fired = plan.serving_alloc(index)
+    if fired:
+        logger.warning(f"chaos: failing page alloc call #{index} "
+                       f"(simulated pool exhaustion)")
+    return fired
+
+
+__all__ = ["FaultPlan", "TrainingFaults", "ServingFault",
+           "InjectedDispatchError", "FAULT_PLAN_ENV", "install_plan",
+           "get_fault_plan", "fault_point", "training_faults",
+           "serving_dispatch_fault", "serving_alloc_fault"]

@@ -6,7 +6,16 @@ Two sinks, both optional:
   supervisor (``DSElasticAgent``) and the engine both append here, so one
   file tells the whole preemption story across process generations;
 - the training run's :class:`~deepspeed_tpu.monitor.monitor.MonitorMaster`
-  (TensorBoard/CSV/WandB), as ``Resilience/<event>`` scalar events.
+  (TensorBoard/CSV/WandB), as ``<prefix>/<event>`` scalar events —
+  ``Resilience/*`` for the training machinery, ``Serving/*`` for the
+  continuous-batching scheduler's recovery trail.
+
+Long runs append forever, so the JSONL sink rotates by size
+(:func:`rotate_jsonl`, shared with the JSONL monitor backend): when the file
+crosses ``max_bytes`` it shifts to ``<path>.1`` (older generations ``.2`` ..
+``.keep``, oldest dropped) and a fresh file starts. :func:`read_events`
+reads the rotated generations oldest-first, so counters and chaos
+assertions see the whole surviving history.
 
 This module must stay importable without jax: the elastic agent is a
 supervisor process that must never acquire the accelerator.
@@ -23,33 +32,78 @@ from ..utils.logging import logger
 
 EVENTS_FILENAME = "recovery_events.jsonl"
 
+#: Default rotation threshold for the recovery-event sink. Generous — at
+#: ~200 bytes/event this is ~150k events per generation — but bounded: a
+#: flapping fault source can no longer grow host disk without limit.
+DEFAULT_ROTATE_BYTES = 32 << 20
+DEFAULT_ROTATE_KEEP = 3
+
+
+def rotate_jsonl(path: str, max_bytes: Optional[int],
+                 keep: int = DEFAULT_ROTATE_KEEP) -> bool:
+    """Size-based rotation for an append-only JSONL sink: when ``path`` is at
+    or past ``max_bytes``, shift ``path`` -> ``path.1`` -> ... -> ``path.keep``
+    (the oldest generation drops). Returns True when a rotation happened.
+    ``max_bytes`` None/<=0 disables. Failures are logged and swallowed —
+    rotation must never take down the event producer (the same contract as
+    the event write itself)."""
+    if not max_bytes or max_bytes <= 0 or keep < 1:
+        return False
+    try:
+        if not os.path.exists(path) or os.path.getsize(path) < max_bytes:
+            return False
+        for i in range(keep - 1, 0, -1):
+            src = f"{path}.{i}"
+            if os.path.exists(src):
+                os.replace(src, f"{path}.{i + 1}")
+        os.replace(path, f"{path}.1")
+        return True
+    except OSError as e:
+        logger.warning(f"jsonl rotation failed for {path}: {e}")
+        return False
+
 
 class RecoveryLog:
-    """Append-only recovery event log with counter rollups."""
+    """Append-only recovery event log with counter rollups.
+
+    ``prefix`` names the monitor scalar family (``<prefix>/<event>``):
+    ``Resilience`` for the training machinery, ``Serving`` for the
+    continuous-batching scheduler. ``max_bytes``/``keep`` bound the JSONL
+    sink via :func:`rotate_jsonl` (None ``max_bytes`` -> the default cap;
+    pass 0 to disable rotation)."""
 
     def __init__(self, path: Optional[str] = None, monitor: Any = None,
-                 role: str = "engine"):
+                 role: str = "engine", prefix: str = "Resilience",
+                 max_bytes: Optional[int] = None,
+                 keep: int = DEFAULT_ROTATE_KEEP):
         self.path = path
         self.monitor = monitor  # MonitorMaster-compatible (write_events)
         self.role = role
+        self.prefix = prefix
+        self.max_bytes = (DEFAULT_ROTATE_BYTES if max_bytes is None
+                          else int(max_bytes))
+        self.keep = int(keep)
         self.counters: Dict[str, int] = {}
 
     @classmethod
     def for_dir(cls, save_dir: str, monitor: Any = None,
-                role: str = "engine") -> "RecoveryLog":
+                role: str = "engine", **kw: Any) -> "RecoveryLog":
         os.makedirs(save_dir, exist_ok=True)
         return cls(os.path.join(save_dir, EVENTS_FILENAME), monitor=monitor,
-                   role=role)
+                   role=role, **kw)
 
     def record(self, event: str, value: float = 1.0, step: int = 0,
                **fields: Any) -> None:
         """``event``: e.g. ``preemption_survived``, ``resume_latency_s``,
-        ``tag_quarantined``, ``worker_restart``, ``emergency_save``."""
+        ``tag_quarantined``, ``worker_restart``, ``emergency_save``;
+        serving: ``request_shed``, ``deadline_miss``, ``dispatch_error``,
+        ``dispatch_failed``, ``block_quarantined``."""
         self.counters[event] = self.counters.get(event, 0) + 1
         entry = {"unix_time": time.time(), "role": self.role, "event": event,
                  "value": float(value), "step": int(step), **fields}
         if self.path is not None:
             try:
+                rotate_jsonl(self.path, self.max_bytes, self.keep)
                 with open(self.path, "a") as f:
                     f.write(json.dumps(entry, sort_keys=True, default=str)
                             + "\n")
@@ -58,7 +112,7 @@ class RecoveryLog:
         if self.monitor is not None:
             try:
                 self.monitor.write_events(
-                    [(f"Resilience/{event}", float(value), int(step))])
+                    [(f"{self.prefix}/{event}", float(value), int(step))])
             except Exception as e:
                 logger.warning(f"recovery event not exported to monitor: {e}")
 
@@ -66,25 +120,29 @@ class RecoveryLog:
         return self.counters.get(event, 0)
 
 
-def read_events(save_dir_or_path: str) -> list:
+def read_events(save_dir_or_path: str,
+                keep: int = DEFAULT_ROTATE_KEEP) -> list:
     """Parse a recovery log (dir containing the default filename, or a direct
-    path). Tolerates a torn trailing line (crash mid-append)."""
+    path), including rotated generations oldest-first. Tolerates a torn
+    trailing line (crash mid-append)."""
     path = save_dir_or_path
     if os.path.isdir(path):
         path = os.path.join(path, EVENTS_FILENAME)
-    if not os.path.exists(path):
-        return []
     out = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                out.append(json.loads(line))
-            except ValueError:
-                pass  # torn tail
+    for p in [f"{path}.{i}" for i in range(keep, 0, -1)] + [path]:
+        if not os.path.exists(p):
+            continue
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    pass  # torn tail
     return out
 
 
-__all__ = ["RecoveryLog", "read_events", "EVENTS_FILENAME"]
+__all__ = ["RecoveryLog", "read_events", "rotate_jsonl", "EVENTS_FILENAME",
+           "DEFAULT_ROTATE_BYTES", "DEFAULT_ROTATE_KEEP"]

@@ -54,6 +54,15 @@ STACKS_FILENAME = "watchdog_stacks.txt"
 #: distinguish a slow dataloader from a finished run.
 PHASES = ("compile", "step", "collective", "checkpoint")
 
+#: Serving phases (docs/SERVING.md "Overload & failure"): the
+#: continuous-batching scheduler brackets every executor dispatch with one
+#: of these, each with its own deadline (prefill is a multi-chunk forward,
+#: decode a fixed-slot step/block — very different time scales). A stalled
+#: dispatch gets the same treatment a stalled training collective does:
+#: stack dump, wire-ledger log, ``watchdog_stall`` recovery event,
+#: escalation callback.
+SERVING_PHASES = ("serving_prefill", "serving_decode")
+
 
 class HealthWatchdog:
     """Deadline monitor over the engine's step-loop phases.
@@ -262,4 +271,4 @@ def allgather_host_stats(duration_s: float) -> Optional[List[dict]]:
 
 
 __all__ = ["HealthWatchdog", "identify_stragglers", "allgather_host_stats",
-           "PHASES", "STACKS_FILENAME"]
+           "PHASES", "SERVING_PHASES", "STACKS_FILENAME"]

@@ -133,11 +133,16 @@ class WandbConfig(DeepSpeedConfigModel):
 class JSONLConfig(DeepSpeedConfigModel):
     """TPU-native crash-tolerant monitor backend
     (:class:`~deepspeed_tpu.monitor.monitor.JSONLMonitor`): append-only
-    events.jsonl that survives preemption/restart cycles intact."""
+    events.jsonl that survives preemption/restart cycles intact.
+    ``rotate_mb``/``rotate_keep`` bound the sink by size-based rotation
+    (0 = the shipped default cap; rotation keeps the last ``rotate_keep``
+    generations)."""
 
     enabled: bool = False
     output_path: str = ""
     job_name: str = "DeepSpeedJobName"
+    rotate_mb: float = 0.0
+    rotate_keep: int = 3
 
 
 class MonitorConfig(DeepSpeedConfigModel):

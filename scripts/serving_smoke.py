@@ -11,6 +11,14 @@ preemption, plus an eos-terminated request. Asserts:
    for the same prompts (continuous batching must be invisible to results);
 3. the ``serving/unbucketed-decode-shape`` dslint rule stays silent on the
    serving loop's compile log and fires on a synthetic per-step recompile.
+
+``--chaos`` (docs/SERVING.md "Overload & failure") runs the recovery
+contract against the REAL engine instead: one injected dispatch-failure
+episode (every retry raises -> preempt-and-requeue -> heal) and one request
+deadline expiry under load, asserting greedy outputs stay IDENTICAL to
+``InferenceEngine.generate``, the page-conservation audit is clean, and the
+recovery events (``dispatch_error``/``dispatch_failed``/``deadline_miss``)
+were recorded.
 """
 
 import os
@@ -40,10 +48,12 @@ def main() -> int:
     cfg = G.GPTConfig(vocab_size=64, d_model=32, n_layer=2, n_head=4,
                       max_seq_len=128)
     params = G.init_params(cfg, jax.random.PRNGKey(0))
-    # pool deliberately too small for all slots to max out -> preemption
+    # pool deliberately too small for all slots to max out -> preemption;
+    # max_queue armed = the overload-safe config (and what keeps the
+    # serving/unbounded-admission rule silent below)
     eng = ServingEngine(cfg, params, ServingConfig(
         num_slots=3, page_size=8, max_model_len=64, prefill_chunk=16,
-        num_pages=12, dtype="float32", decode_block=4))
+        num_pages=12, dtype="float32", decode_block=4, max_queue=32))
     eng.warmup()
 
     wl = make_open_loop_workload(8, rate_rps=500.0, prompt_len=(3, 30),
@@ -97,5 +107,128 @@ def main() -> int:
     return 0
 
 
+def chaos_main() -> int:
+    """End-to-end recovery on the real engine: an injected dispatch-failure
+    episode and a deadline expiry, both healing with zero page leaks and
+    generate-identical outputs for every surviving request."""
+    from deepspeed_tpu.resilience import FaultPlan, RecoveryLog, install_plan
+
+    cfg = G.GPTConfig(vocab_size=64, d_model=32, n_layer=2, n_head=4,
+                      max_seq_len=128)
+    params = G.init_params(cfg, jax.random.PRNGKey(0))
+    eng = ServingEngine(cfg, params, ServingConfig(
+        num_slots=3, page_size=8, max_model_len=64, prefill_chunk=16,
+        dtype="float32", decode_block=4, max_queue=32, dispatch_retries=2))
+    eng.warmup()
+    ie = InferenceEngine(for_gpt(cfg, params), DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=64))
+
+    def assert_generate_identical(requests):
+        for r in requests:
+            ref = np.asarray(ie.generate(
+                np.asarray(r.prompt)[None],
+                max_new_tokens=r.max_new_tokens))[0, len(r.prompt):]
+            got = np.asarray(r.tokens[:r.max_new_tokens])
+            assert np.array_equal(ref, got), (r.rid, ref, got)
+
+    # 1) dispatch-failure episode: dispatches 3..5 raise — with 2 retries
+    #    (3 attempts) one whole episode fails, so the recovery path is the
+    #    full preempt-and-requeue + audit, not just an in-place retry
+    log = RecoveryLog(role="serving", prefix="Serving")
+    wl = make_open_loop_workload(6, rate_rps=500.0, prompt_len=(3, 20),
+                                 max_new=(4, 12), vocab_size=64, seed=13)
+    install_plan(FaultPlan(dispatch_raise_at=3, dispatch_raise_times=3))
+    try:
+        sched = eng.make_scheduler(recovery_log=log)
+        for r in wl:
+            assert sched.submit(r), r.rid
+        sched.run_to_completion()
+    finally:
+        install_plan(None)
+    assert log.count("dispatch_error") == 3, log.counters
+    assert log.count("dispatch_failed") == 1, log.counters
+    rep = sched.audit()
+    assert rep["ok"] and sched.allocator.allocated_pages == 0, rep
+    assert_generate_identical(wl)
+    print(f"[chaos] dispatch-failure episode healed "
+          f"({log.count('dispatch_error')} errors, 1 failed episode, "
+          f"{sum(r.preemptions for r in wl)} requeues), outputs identical, "
+          f"pool audit clean")
+
+    # 2) deadline expiry under load: a zero-deadline request expires at the
+    #    first scheduler step; its neighbors finish untouched
+    log2 = RecoveryLog(role="serving", prefix="Serving")
+    sched2 = eng.make_scheduler(recovery_log=log2)
+    doomed = Request(prompt=np.arange(1, 6, dtype=np.int32) % 64,
+                     max_new_tokens=30, deadline_s=0.0)
+    survivors = [Request(prompt=np.arange(1, 8, dtype=np.int32) % 64,
+                         max_new_tokens=8) for _ in range(2)]
+    assert sched2.submit(doomed)
+    for r in survivors:
+        assert sched2.submit(r)
+    sched2.run_to_completion()
+    from deepspeed_tpu.inference.serving import RequestState
+
+    assert doomed.state is RequestState.EXPIRED, doomed.state
+    assert log2.count("deadline_miss") == 1, log2.counters
+    rep2 = sched2.audit()
+    assert rep2["ok"] and sched2.allocator.allocated_pages == 0, rep2
+    assert all(r.state is RequestState.FINISHED for r in survivors)
+    assert_generate_identical(survivors)
+    print("[chaos] deadline expiry evicted the doomed request, pages "
+          "drained, survivors identical to generate")
+
+    # 3) stalled dispatch: an injected 0.3s stall inside a serving phase
+    #    must trip the armed watchdog deadline (stall + recovery recorded)
+    #    while the run completes unharmed
+    log3 = RecoveryLog(role="serving", prefix="Serving")
+    eng.serving.prefill_deadline_s = 0.08
+    eng.serving.decode_deadline_s = 0.08
+    eng.serving.watchdog_poll_s = 0.02
+    install_plan(FaultPlan(dispatch_stall_at=1, dispatch_stall_seconds=0.3))
+    try:
+        sched3 = eng.make_scheduler(recovery_log=log3)
+        wl3 = make_open_loop_workload(3, rate_rps=500.0, prompt_len=(3, 10),
+                                      max_new=(4, 8), vocab_size=64, seed=17)
+        for r in wl3:
+            assert sched3.submit(r)
+        sched3.run_to_completion()
+        sched3.close()
+    finally:
+        install_plan(None)
+        eng.serving.prefill_deadline_s = None
+        eng.serving.decode_deadline_s = None
+    assert log3.count("watchdog_stall") == 1, log3.counters
+    assert log3.count("watchdog_recovered") == 1, log3.counters
+    rep3 = sched3.audit()
+    assert rep3["ok"] and sched3.allocator.allocated_pages == 0, rep3
+    assert_generate_identical(wl3)
+    print("[chaos] stalled dispatch flagged by the serving watchdog "
+          "(stall + recovery events), outputs identical, pool audit clean")
+
+    # 4) pool-pressure overload: a pool too small for every slot forces
+    #    recompute-preemption; the audit must stay clean through it
+    eng2 = ServingEngine(cfg, params, ServingConfig(
+        num_slots=3, page_size=8, max_model_len=64, prefill_chunk=16,
+        num_pages=12, dtype="float32", decode_block=4, max_queue=32))
+    eng2.warmup()
+    sched4 = eng2.make_scheduler()
+    wl4 = make_open_loop_workload(6, rate_rps=500.0, prompt_len=(10, 30),
+                                  max_new=(8, 16), vocab_size=64, seed=19)
+    for r in wl4:
+        assert sched4.submit(r)
+    sched4.run_to_completion()
+    assert sum(r.preemptions for r in wl4) >= 1, "pool pressure never bit"
+    rep4 = sched4.audit()
+    assert rep4["ok"] and sched4.allocator.allocated_pages == 0, rep4
+    assert_generate_identical(wl4)
+    print(f"[chaos] pool-pressure overload healed by recompute-preemption "
+          f"({sum(r.preemptions for r in wl4)} preemptions), outputs "
+          f"identical, pool audit clean")
+
+    print("serving_smoke[chaos]: PASS")
+    return 0
+
+
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(chaos_main() if "--chaos" in sys.argv[1:] else main())

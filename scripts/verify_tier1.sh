@@ -67,7 +67,8 @@ grep -aE '^[0-9]+ passed' /tmp/_t1_overlap.log || true
 # crash class), paged allocator/equivalence, scheduler mechanics, and the
 # serving dslint rule.
 if ! timeout -k 10 420 env JAX_PLATFORMS=cpu \
-        python -m pytest tests/test_serving.py tests/test_paged_kv.py \
+        python -m pytest tests/test_serving.py tests/test_serving_chaos.py \
+        tests/test_paged_kv.py \
         tests/test_decode_attention.py -q -m 'not slow' \
         -p no:cacheprovider -p no:randomly > /tmp/_t1_serving.log 2>&1; then
     echo "verify_tier1: FAIL — serving/paged-KV tests:" >&2
@@ -86,6 +87,20 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 grep -a "serving_smoke: PASS" /tmp/_t1_serving_smoke.log || true
+
+# the serving chaos smoke (docs/SERVING.md "Overload & failure"): one
+# injected dispatch-failure episode (preempt-and-requeue heal) and one
+# deadline expiry against the REAL engine, asserting generate-identical
+# outputs and a clean page-conservation audit after each recovery.
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        python scripts/serving_smoke.py --chaos \
+        > /tmp/_t1_serving_chaos.log 2>&1; then
+    echo "verify_tier1: FAIL — serving chaos smoke" \
+         "(scripts/serving_smoke.py --chaos):" >&2
+    tail -30 /tmp/_t1_serving_chaos.log >&2
+    exit 1
+fi
+grep -a "serving_smoke\[chaos\]: PASS" /tmp/_t1_serving_chaos.log || true
 
 # --- fault-injection smoke (docs/RESILIENCE.md) ---------------------------
 # two heal cycles on the CPU mesh: SIGKILL mid-checkpoint + auto-resume

@@ -76,6 +76,38 @@ def test_allocator_all_or_nothing():
     assert got is not None and alloc.free_pages == 0
 
 
+def test_allocator_audit_conservation():
+    """audit() is clean through arbitrary alloc/free churn, and names the
+    violated invariant when the ledger is corrupted."""
+    rng = np.random.default_rng(3)
+    alloc = PageAllocator(32)
+    held = []
+    for _ in range(300):
+        if held and rng.random() < 0.5:
+            alloc.free(held.pop(rng.integers(len(held))))
+        else:
+            pages = alloc.alloc(int(rng.integers(1, 4)))
+            if pages is not None:
+                held.append(pages)
+        rep = alloc.audit()
+        assert rep["ok"], rep
+        assert rep["free"] + rep["allocated"] == rep["total"] == 31
+    assert alloc.allocated_ids == frozenset(p for ps in held for p in ps)
+
+    # corruptions the audit must name: a page leaked out of both sets,
+    # a duplicate in the free list, and a page in both sets at once
+    a = PageAllocator(8)
+    a._allocated.discard(a.alloc(2)[0])
+    rep = a.audit()
+    assert not rep["ok"] and any("conservation" in e for e in rep["errors"])
+    b = PageAllocator(8)
+    b._free.append(b._free[0])
+    assert any("duplicate" in e for e in b.audit()["errors"])
+    c = PageAllocator(8)
+    c._allocated.add(c._free[0])
+    assert any("both free and allocated" in e for e in c.audit()["errors"])
+
+
 # ---------------------------------------------------------------- scatter
 def test_write_prompt_kv_drops_padding_and_respects_tables(params):
     """Bucket padding past `length` must not touch the pool; valid tokens

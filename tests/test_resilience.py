@@ -111,6 +111,35 @@ def test_fault_plan_io_stall(tmp_path):
     assert time.monotonic() - t0 >= 0.2
 
 
+def test_recovery_log_rotates_by_size(tmp_path):
+    """The JSONL recovery sink must not grow without bound: past max_bytes
+    it shifts to .1/.2/... (keep last N), read_events merges generations
+    oldest-first, and a torn tail in any generation is tolerated."""
+    from deepspeed_tpu.resilience import RecoveryLog
+
+    path = str(tmp_path / "recovery_events.jsonl")
+    log = RecoveryLog(path, role="engine", max_bytes=2048, keep=2)
+    for i in range(200):  # each entry ~100 bytes -> several rotations
+        log.record("tick", value=i, seq=i)
+    assert os.path.exists(path + ".1") and os.path.exists(path + ".2")
+    assert not os.path.exists(path + ".3")  # keep=2 drops older generations
+    assert os.path.getsize(path) < 2048 + 256  # post-rotation file is fresh
+    events = read_events(str(tmp_path), keep=2)
+    seqs = [e["seq"] for e in events if e["event"] == "tick"]
+    assert seqs == sorted(seqs) and seqs[-1] == 199  # oldest-first, no loss
+    assert len(seqs) < 200  # the oldest generation really dropped
+    # a Serving-prefixed log routes scalars to Serving/* on the monitor
+    seen = []
+
+    class Mon:
+        def write_events(self, evs):
+            seen.extend(evs)
+
+    RecoveryLog(monitor=Mon(), role="serving",
+                prefix="Serving").record("request_shed")
+    assert seen and seen[0][0] == "Serving/request_shed"
+
+
 def _mk_tag(save_dir, name="global_step1", payload=b"A" * 100):
     tag_dir = os.path.join(str(save_dir), name)
     os.makedirs(os.path.join(tag_dir, "state", "arrays"), exist_ok=True)

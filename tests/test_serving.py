@@ -89,8 +89,11 @@ def test_preemption_requeues_and_completes():
 
 def test_admission_rejects_oversized_request():
     s = _sched(pages_per_seq=2, page_size=4)  # capacity: 8 tokens
-    with pytest.raises(ValueError, match="exceeds"):
-        s.submit(Request(prompt=np.zeros(6, np.int32), max_new_tokens=4))
+    r = Request(prompt=np.zeros(6, np.int32), max_new_tokens=4)
+    v = s.submit(r)
+    assert not v and v.reason == "unservable" and "exceeds" in v.detail
+    assert r.state is RequestState.REJECTED
+    assert not s.queue  # never enqueued
 
 
 def test_admission_rejects_request_larger_than_pool():
@@ -98,11 +101,11 @@ def test_admission_rejects_request_larger_than_pool():
     admitted, it would head-of-line-block forever (or self-preempt in an
     infinite recompute loop once it outgrew the pool)."""
     s = _sched(num_pages=3, page_size=4, pages_per_seq=8)  # pool: 2 pages
-    with pytest.raises(ValueError, match="pool"):
-        s.submit(Request(prompt=np.zeros(8, np.int32), max_new_tokens=4))
+    v = s.submit(Request(prompt=np.zeros(8, np.int32), max_new_tokens=4))
+    assert not v and v.reason == "unservable" and "pool" in v.detail
     # a fitting request still serves
     r = Request(prompt=np.zeros(4, np.int32), max_new_tokens=3)
-    s.submit(r)
+    assert s.submit(r)
     s.run_to_completion()
     assert len(r.tokens) == 3
 
@@ -173,9 +176,11 @@ def tiny_engine():
     from deepspeed_tpu.inference.serving import ServingConfig, ServingEngine
 
     params = G.init_params(CFG, jax.random.PRNGKey(0))
+    # max_queue armed: the overload-safe configuration every production
+    # config should use (and the unbounded-admission rule stays silent on)
     return ServingEngine(CFG, params, ServingConfig(
         num_slots=3, page_size=8, max_model_len=64, prefill_chunk=16,
-        dtype="float32", decode_block=4)), params
+        dtype="float32", decode_block=4, max_queue=64)), params
 
 
 def test_serving_greedy_matches_generate(tiny_engine):
@@ -265,6 +270,31 @@ def test_unbucketed_decode_rule_fires_and_stays_silent(tiny_engine):
     # the live serving engine's log is clean
     eng, _ = tiny_engine
     assert not analyze_compile_log(eng).findings
+
+
+def test_unbounded_admission_rule_fires_and_stays_silent():
+    """WARNING on a serving config with no admission bound and no deadlines
+    (the overload-unsafe default); silent the moment ANY of the four knobs
+    is armed, and silent on non-serving engines / raw compile logs."""
+    from deepspeed_tpu.analysis import analyze_compile_log
+    from deepspeed_tpu.inference.serving import ServingConfig
+
+    class Eng:  # duck-typed: the rule only reads .serving (+ compile_log)
+        compile_log = []
+
+        def __init__(self, cfg):
+            self.serving = cfg
+
+    naked = analyze_compile_log(Eng(ServingConfig())).findings
+    assert [f.rule_id for f in naked] == ["serving/unbounded-admission"]
+    assert naked[0].severity.name == "WARNING"
+    for armed in (dict(max_queue=8), dict(max_queued_tokens=4096),
+                  dict(ttft_deadline_s=1.0), dict(request_deadline_s=30.0)):
+        assert not analyze_compile_log(Eng(ServingConfig(**armed))).findings, \
+            armed
+    # non-serving contexts: raw log lists never fire
+    assert not analyze_compile_log(
+        [{"kind": "decode", "shape": (2, 4)}]).findings
 
 
 def test_inference_engine_decode_buckets_and_log():
