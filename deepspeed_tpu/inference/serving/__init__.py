@@ -13,7 +13,8 @@ See ``docs/SERVING.md``. Layering:
 
 from .buckets import bucket_for, default_buckets
 from .engine import ServingConfig, ServingEngine
-from .paging import PageAllocator, RESERVED_PAGE, pages_for
+from .paging import (PageAllocator, PrefixIndex, RESERVED_PAGE, pages_for,
+                     prefix_chain_hashes)
 from .scheduler import (AdmissionVerdict, ContinuousBatchingScheduler,
                         Request, RequestState, SHED_POLICIES,
                         ServingFaultError)
@@ -21,7 +22,8 @@ from .bench import (estimate_saturation_rps, make_open_loop_workload,
                     percentile, run_continuous, run_static_baseline)
 
 __all__ = [
-    "PageAllocator", "RESERVED_PAGE", "pages_for",
+    "PageAllocator", "PrefixIndex", "RESERVED_PAGE", "pages_for",
+    "prefix_chain_hashes",
     "bucket_for", "default_buckets",
     "AdmissionVerdict", "ContinuousBatchingScheduler", "Request",
     "RequestState", "SHED_POLICIES", "ServingFaultError",

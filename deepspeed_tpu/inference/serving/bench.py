@@ -165,6 +165,7 @@ def run_continuous(engine, workload: Sequence[Request],
     finally:
         sched.close()
     t_end = time.monotonic()
+    stats = dict(sched.page_stats)
     return _report(workload, t0, t_end, "continuous", slo_s=slo_s, extra={
         "decode_steps": sched.steps,
         "preemptions": sum(r.preemptions for r in workload),
@@ -173,6 +174,12 @@ def run_continuous(engine, workload: Sequence[Request],
         "compiled_programs": len(engine.compile_log),
         "recovery_counters": dict(sched.counters),
         "pool_audit_ok": bool(sched.audit()["ok"]),
+        # copy-on-write prefix reuse: physical/logical < 1 means shared
+        # prompt prefixes actually collapsed into the same physical pages
+        "page_stats": stats,
+        "physical_logical_page_ratio": round(
+            stats["physical"] / stats["logical"], 4)
+        if stats["logical"] else None,
     })
 
 

@@ -50,6 +50,15 @@ class ServingConfig:
     max_model_len: int = 1024           # prompt + generation bound
     num_pages: Optional[int] = None     # default: every slot can max out
     prefill_chunk: int = 128
+    # quantized KV pages (docs/SERVING.md "KV quantization & prefix
+    # caching"): 8 or 4 stores the pools int8/int4 with per-(head, page)
+    # scales, dequantized inside the decode kernel — 2x/4x the token
+    # capacity at fixed HBM vs bf16 pools (4x/8x vs fp32). None = dense.
+    kv_bits: Optional[int] = None
+    # copy-on-write shared-prefix page reuse: requests whose prompts begin
+    # with the same page-aligned token blocks share physical pages through
+    # the allocator refcounts + PrefixIndex hash chains
+    enable_prefix_cache: bool = False
     # decode block: when no scheduling event (admission, page growth, eos,
     # slot finish) can occur within the next K steps, the scheduler runs K
     # decode steps as ONE compiled scan — K-1 host round-trips saved per
@@ -123,7 +132,10 @@ class ServingEngine:
         self.params = jax.tree_util.tree_map(_cast, params,
                                              is_leaf=gpt_mod._is_qleaf)
         self.paged_cache = gpt_mod.init_paged_cache(
-            cfg, self.num_pages, s.page_size, self.dtype)
+            cfg, self.num_pages, s.page_size, self.dtype,
+            kv_bits=s.kv_bits)
+        self.last_scheduler = None  # most recent make_scheduler product —
+        # the capacity-pressure evidence dslint's dense-kv-at-capacity reads
         # prefill's contiguous scratch cache: chunks append at chunk-aligned
         # positions, so it must cover the bucket-padded context
         chunks = -(-s.max_model_len // s.prefill_chunk)
@@ -148,9 +160,13 @@ class ServingEngine:
                              "fit ladder")
         from ...runtime.aot import serving_admission_limit
 
+        # kv_bits reaches the fit ladder: the compiled probe serves from
+        # quantized pools, so "auto" sizes slots from the KV bytes the pool
+        # ACTUALLY holds (a dense-page ladder under-admits ~2x at int8)
         limit = serving_admission_limit(
             s.model_name, prompt=min(128, s.max_model_len),
-            gen=min(128, s.max_model_len))
+            gen=min(128, s.max_model_len), kv_bits=s.kv_bits or 0,
+            page_size=s.page_size)
         if limit["max_slots"] < 1:
             raise ValueError(
                 f"AOT fit ladder found no decode batch that fits for "
@@ -182,11 +198,15 @@ class ServingEngine:
         if chunk not in self._prefill_fused_fns:
             self._log_compile("serving_prefill_fused", (1, chunk))
 
-            def fn(params, ids, paged, table, length):
+            def fn(params, ids, paged, table, length, start):
                 cache = gpt_mod.init_cache(self.cfg, 1, chunk, self.dtype)
                 logits, cache = gpt_mod.forward_with_cache(
                     self.cfg, params, ids, cache)
-                paged = gpt_mod.write_prompt_kv(paged, cache, table, length)
+                # start > 0: shared prefix pages already hold [0, start) —
+                # never write a borrowed page (start is traced, so shared
+                # and unshared admissions hit the same compiled program)
+                paged = gpt_mod.write_prompt_kv(paged, cache, table, length,
+                                                start=start)
                 last = jax.lax.dynamic_index_in_dim(logits[0], length - 1, 0,
                                                     keepdims=False)
                 return jnp.argmax(last).astype(jnp.int32), paged
@@ -203,13 +223,13 @@ class ServingEngine:
             self._log_compile("serving_prefill_batch",
                               (self.num_slots, chunk))
 
-            def fn(params, ids, paged, tables, lengths):
+            def fn(params, ids, paged, tables, lengths, starts):
                 cache = gpt_mod.init_cache(self.cfg, self.num_slots, chunk,
                                            self.dtype)
                 logits, cache = gpt_mod.forward_with_cache(
                     self.cfg, params, ids, cache)
                 paged = gpt_mod.write_prompt_kv_batch(paged, cache, tables,
-                                                      lengths)
+                                                      lengths, starts=starts)
                 idx = jnp.maximum(lengths - 1, 0)[:, None, None]
                 last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
                 return jnp.argmax(last, axis=-1).astype(jnp.int32), paged
@@ -251,17 +271,21 @@ class ServingEngine:
         if self._scatter_fn is None:
             self._log_compile("serving_scatter", (self._dense_S,))
 
-            def fn(paged, dense, table, length):
-                return gpt_mod.write_prompt_kv(paged, dense, table, length)
+            def fn(paged, dense, table, length, start):
+                return gpt_mod.write_prompt_kv(paged, dense, table, length,
+                                               start=start)
 
             self._scatter_fn = jax.jit(fn, donate_argnums=(0,))
         return self._scatter_fn
 
     # -------------------------------------------------------------- executor
     def prefill(self, slot: int, tokens: np.ndarray,
-                table_row: np.ndarray) -> int:
+                table_row: np.ndarray, start: int = 0) -> int:
         """Chunked prefill of one request's context; writes its KV into the
-        slot's pages; returns the greedy next token."""
+        slot's pages; returns the greedy next token. ``start`` > 0 skips the
+        scatter of positions [0, start) — those live in shared prefix pages
+        the request only borrows (the forward still computes the full
+        context; sharing saves pages, not prefill FLOPs)."""
         del slot  # pages are named by table_row; the slot id is host-side
         s = self.serving
         tokens = np.asarray(tokens, np.int32)
@@ -275,7 +299,8 @@ class ServingEngine:
             ids[0, :T] = tokens
             tok, self.paged_cache = self._get_prefill_fused(chunk)(
                 self.params, jnp.asarray(ids), self.paged_cache,
-                jnp.asarray(table_row, jnp.int32), jnp.int32(T))
+                jnp.asarray(table_row, jnp.int32), jnp.int32(T),
+                jnp.int32(start))
             return int(tok)
         cache = gpt_mod.init_cache(self.cfg, 1, self._dense_S, self.dtype)
         pos = 0
@@ -292,41 +317,45 @@ class ServingEngine:
             pos += chunk
         self.paged_cache = self._get_scatter()(
             self.paged_cache, cache, jnp.asarray(table_row, jnp.int32),
-            jnp.int32(T))
+            jnp.int32(T), jnp.int32(start))
         return int(jnp.argmax(logits[0, last_idx]))
 
     def prefill_many(self, items) -> dict:
         """Prefill one admission cycle's requests: short prompts (<= one
         chunk) batch into a single dispatch; longer prompts take the serial
-        chunked path. ``items``: [(slot, tokens, table_row)]; returns
-        {slot: first_token}."""
+        chunked path. ``items``: [(slot, tokens, table_row)] or
+        [(slot, tokens, table_row, start)] (shared-prefix admissions);
+        returns {slot: first_token}."""
         s = self.serving
         out = {}
-        short = [(slot, np.asarray(t, np.int32), row) for slot, t, row in items
-                 if len(t) <= s.prefill_chunk]
-        for slot, t, row in items:
+        items = [(it[0], np.asarray(it[1], np.int32), it[2],
+                  int(it[3]) if len(it) > 3 else 0) for it in items]
+        short = [it for it in items if len(it[1]) <= s.prefill_chunk]
+        for slot, t, row, start in items:
             if len(t) > s.prefill_chunk:
-                out[slot] = self.prefill(slot, t, row)
+                out[slot] = self.prefill(slot, t, row, start)
         if not short:
             return out
         if len(short) == 1:  # no batching win; reuse the fused single path
-            slot, t, row = short[0]
-            out[slot] = self.prefill(slot, t, row)
+            slot, t, row, start = short[0]
+            out[slot] = self.prefill(slot, t, row, start)
             return out
-        chunk = bucket_for(max(len(t) for _, t, _ in short),
+        chunk = bucket_for(max(len(t) for _, t, _, _ in short),
                            self._chunk_buckets)
         ids = np.zeros((self.num_slots, chunk), np.int32)
         tables = np.zeros((self.num_slots, s.pages_per_seq), np.int32)
         lengths = np.zeros(self.num_slots, np.int32)
-        for j, (slot, t, row) in enumerate(short):
+        starts = np.zeros(self.num_slots, np.int32)
+        for j, (slot, t, row, start) in enumerate(short):
             ids[j, :len(t)] = t
             tables[j] = row
             lengths[j] = len(t)
+            starts[j] = start
         toks, self.paged_cache = self._get_prefill_batch(chunk)(
             self.params, jnp.asarray(ids), self.paged_cache,
-            jnp.asarray(tables), jnp.asarray(lengths))
+            jnp.asarray(tables), jnp.asarray(lengths), jnp.asarray(starts))
         toks = np.asarray(toks)
-        for j, (slot, _, _) in enumerate(short):
+        for j, (slot, _, _, _) in enumerate(short):
             out[slot] = int(toks[j])
         return out
 
@@ -417,6 +446,11 @@ class ServingEngine:
                 recovery_log=recovery_log,
                 stacks_dir=s.stacks_dir).start()
             owns = True
+        prefix_cache = None
+        if s.enable_prefix_cache:
+            from .paging import PrefixIndex
+
+            prefix_cache = PrefixIndex(s.page_size)
         sched = ContinuousBatchingScheduler(
             executor=self, num_slots=self.num_slots,
             num_pages=self.num_pages, page_size=s.page_size,
@@ -429,11 +463,21 @@ class ServingEngine:
             dispatch_retries=s.dispatch_retries,
             quarantine_after=s.quarantine_after,
             dispatch_failure_budget=s.dispatch_failure_budget,
-            recovery_log=recovery_log, watchdog=watchdog)
+            recovery_log=recovery_log, watchdog=watchdog,
+            prefix_cache=prefix_cache)
         sched._owns_watchdog = owns
+        self.last_scheduler = sched
         return sched
 
     def hbm_token_slots(self) -> int:
         """Token capacity of the pool (page 0 excluded) — the "equal HBM
         budget" side of the static-batch A/B."""
         return (self.num_pages - 1) * self.serving.page_size
+
+    def kv_bytes_per_token(self) -> float:
+        """HBM bytes one cached token costs in THIS config's pools (payload
+        + amortized per-page scales) — the honest equal-HBM-bytes axis of
+        the dense-vs-quantized A/B."""
+        s = self.serving
+        return gpt_mod.paged_kv_bytes_per_token(
+            self.cfg, s.kv_bits, s.page_size, self.dtype)

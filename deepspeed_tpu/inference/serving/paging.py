@@ -1,4 +1,4 @@
-"""Fixed-size KV page allocator (host side).
+"""Fixed-size KV page allocator (host side), with copy-on-write sharing.
 
 The device holds one page pool per layer (``models/gpt.init_paged_cache``);
 this allocator hands out pool slot ids. Page 0 is RESERVED as the sink that
@@ -8,16 +8,37 @@ the Pallas kernel's ``index_map`` read table rows past a request's length
 without bounds checks.
 
 Allocation is all-or-nothing (a request either gets every page it asked for
-or none), frees are checked (double-free and foreign pages raise), and the
+or none), frees are checked (over-free and foreign pages raise), and the
 free list is LIFO so recently-touched pages — still warm in whatever cache
 level applies — are reused first.
 
+**Copy-on-write sharing** (docs/SERVING.md "KV quantization & prefix
+caching"): every allocated page carries a refcount. :meth:`PageAllocator.
+share` takes an extra reference (shared-prefix reuse: two requests whose
+prompts begin with the same page-aligned token blocks read the SAME physical
+page), :meth:`PageAllocator.free` drops one reference per call and only
+returns the page to the free list when the last reference dies, and
+:meth:`PageAllocator.materialize` is the write trigger — a writer holding a
+shared page trades its reference for a fresh private copy (the caller copies
+the device bytes). The scheduler's sharing discipline makes materialize a
+defensive path: only FULL prefix pages are ever shared, and the decode
+append frontier is always past them, so no write can land on a shared page
+— an invariant :meth:`ContinuousBatchingScheduler.audit` enforces.
+
+:class:`PrefixIndex` is the host-side lookup that makes sharing happen: a
+hash CHAIN over page-sized prompt token blocks (block j's key commits to
+blocks 0..j), mapping each chain hash to the physical page holding that
+block's KV. Chat-style traffic (system prompts, few-shot headers) hits the
+chain for its common prefix and admits with those pages shared instead of
+re-allocated.
+
 Two robustness hooks (docs/SERVING.md "Overload & failure"):
 
-- :meth:`PageAllocator.audit` — the conservation invariant (free + allocated
-  == total, no duplicates, no reserved-page escapes). The scheduler runs it
-  after every recovery action (dispatch failure, deadline eviction, shed):
-  a page leak under fault handling must be loud, not a slow HBM bleed.
+- :meth:`PageAllocator.audit` — the conservation invariant (free +
+  Σ(unique allocated) == total, every refcount >= 1, no duplicates, no
+  reserved-page escapes). The scheduler runs it after every recovery action
+  (dispatch failure, deadline eviction, shed): a page leak under fault
+  handling must be loud, not a slow HBM bleed.
 - chaos: an armed :class:`~deepspeed_tpu.resilience.chaos.FaultPlan` with
   ``alloc_fail_at`` makes the Nth ``alloc`` call report pool exhaustion
   (return None) — admission/growth paths must degrade exactly as they do
@@ -26,7 +47,10 @@ Two robustness hooks (docs/SERVING.md "Overload & failure"):
 
 from __future__ import annotations
 
+import hashlib
 from typing import Dict, FrozenSet, List, Optional, Sequence
+
+import numpy as np
 
 RESERVED_PAGE = 0
 
@@ -50,8 +74,8 @@ def pages_for(tokens: int, page_size: int) -> int:
 
 
 class PageAllocator:
-    """Free-list allocator over a pool of ``num_pages`` pages (ids
-    ``1 .. num_pages-1``; page 0 reserved)."""
+    """Refcounted free-list allocator over a pool of ``num_pages`` pages
+    (ids ``1 .. num_pages-1``; page 0 reserved)."""
 
     def __init__(self, num_pages: int):
         if num_pages < 2:
@@ -60,7 +84,7 @@ class PageAllocator:
                 f"got {num_pages}")
         self.num_pages = int(num_pages)
         self._free: List[int] = list(range(self.num_pages - 1, 0, -1))
-        self._allocated = set()
+        self._ref: Dict[int, int] = {}  # page id -> live references
         self._alloc_calls = 0  # chaos injection index (alloc_fail_at)
 
     @property
@@ -69,21 +93,26 @@ class PageAllocator:
 
     @property
     def allocated_pages(self) -> int:
-        return len(self._allocated)
+        """UNIQUE physical pages outstanding (a shared page counts once)."""
+        return len(self._ref)
 
     @property
     def allocated_ids(self) -> FrozenSet[int]:
         """The allocator's ledger of outstanding pages — what the scheduler
         cross-checks its slot page lists against in :meth:`audit`."""
-        return frozenset(self._allocated)
+        return frozenset(self._ref)
+
+    def refcount(self, page: int) -> int:
+        """Live references on ``page`` (0 if not allocated)."""
+        return self._ref.get(int(page), 0)
 
     def can_alloc(self, n: int) -> bool:
         return n <= len(self._free)
 
     def alloc(self, n: int) -> Optional[List[int]]:
-        """Allocate ``n`` pages, or None (and allocate NOTHING) if the pool
-        cannot cover the request — the caller decides between queueing and
-        preempting."""
+        """Allocate ``n`` pages (each at refcount 1), or None (and allocate
+        NOTHING) if the pool cannot cover the request — the caller decides
+        between queueing and preempting."""
         if n < 0:
             raise ValueError(f"alloc({n})")
         idx = self._alloc_calls
@@ -93,44 +122,182 @@ class PageAllocator:
         if n > len(self._free):
             return None
         pages = [self._free.pop() for _ in range(n)]
-        self._allocated.update(pages)
+        for p in pages:
+            self._ref[p] = 1
         return pages
+
+    def share(self, pages: Sequence[int]) -> None:
+        """Take one extra reference on each page (prefix reuse: a second
+        request now reads the same physical page). Sharing an unallocated
+        or reserved page is a caller bug and raises."""
+        pages = [int(p) for p in pages]
+        for p in pages:
+            if p == RESERVED_PAGE:
+                raise ValueError("sharing the reserved sink page 0")
+            if p not in self._ref:
+                raise ValueError(f"sharing unallocated page {p}")
+        for p in pages:
+            self._ref[p] += 1
+
+    def materialize(self, page: int) -> Optional[int]:
+        """Copy-on-write trigger: make ``page`` privately writable.
+
+        With a single reference the page is already private and is returned
+        as-is. Shared, the caller's reference is traded for a freshly
+        allocated page (the caller must copy the device bytes before
+        writing). Returns None — and keeps the original reference — when
+        the pool has no page to give."""
+        page = int(page)
+        if self._ref.get(page, 0) == 0:
+            raise ValueError(f"materializing unallocated page {page}")
+        if self._ref[page] == 1:
+            return page
+        fresh = self.alloc(1)
+        if fresh is None:
+            return None
+        self._ref[page] -= 1
+        return fresh[0]
 
     def audit(self) -> Dict[str, object]:
         """Conservation invariant over the pool: every page id 1..N-1 is in
-        exactly one of {free list, allocated set}, with no duplicates and no
-        reserved-page escapes. Returns ``{"ok", "free", "allocated",
-        "total", "errors"}`` — ``errors`` names each violated invariant.
-        Run by the scheduler after every recovery action; a non-clean audit
-        there is a page leak in the fault-handling path."""
+        exactly one of {free list, allocated set}, with no duplicates, no
+        reserved-page escapes, and every allocated page holding >= 1 live
+        reference. Returns ``{"ok", "free", "allocated", "total", "refs",
+        "errors"}`` — ``errors`` names each violated invariant. Run by the
+        scheduler after every recovery action; a non-clean audit there is a
+        page leak in the fault-handling path."""
         errors: List[str] = []
         free_set = set(self._free)
         if len(free_set) != len(self._free):
             errors.append("duplicate ids in the free list")
-        overlap = free_set & self._allocated
+        overlap = free_set & set(self._ref)
         if overlap:
             errors.append(f"pages both free and allocated: {sorted(overlap)}")
-        if RESERVED_PAGE in free_set or RESERVED_PAGE in self._allocated:
+        if RESERVED_PAGE in free_set or RESERVED_PAGE in self._ref:
             errors.append("reserved sink page 0 escaped into the pool")
-        bad = [p for p in free_set | self._allocated
+        bad = [p for p in free_set | set(self._ref)
                if not (1 <= p < self.num_pages)]
         if bad:
             errors.append(f"page ids outside the pool: {sorted(bad)}")
-        total = self.num_pages - 1
-        if len(free_set) + len(self._allocated) != total:
+        leaked_refs = sorted(p for p, c in self._ref.items() if c < 1)
+        if leaked_refs:
             errors.append(
-                f"conservation broken: free {len(free_set)} + allocated "
-                f"{len(self._allocated)} != total {total}")
+                f"allocated pages with refcount < 1 (leaked reference "
+                f"accounting): {leaked_refs}")
+        total = self.num_pages - 1
+        if len(free_set) + len(self._ref) != total:
+            errors.append(
+                f"conservation broken: free {len(free_set)} + unique "
+                f"allocated {len(self._ref)} != total {total}")
         return {"ok": not errors, "free": len(free_set),
-                "allocated": len(self._allocated), "total": total,
-                "errors": errors}
+                "allocated": len(self._ref), "total": total,
+                "refs": sum(self._ref.values()), "errors": errors}
 
-    def free(self, pages: Sequence[int]) -> None:
+    def free(self, pages: Sequence[int]) -> List[int]:
+        """Drop one reference per page. Pages whose LAST reference died are
+        returned to the free list and reported back (the caller invalidates
+        any prefix-index entries pointing at them — their bytes are about to
+        be recycled). Over-freeing (more frees than references) raises."""
+        released: List[int] = []
         for p in pages:
             p = int(p)
             if p == RESERVED_PAGE:
                 raise ValueError("freeing the reserved sink page 0")
-            if p not in self._allocated:
+            if p not in self._ref:
                 raise ValueError(f"double-free or foreign page {p}")
-            self._allocated.remove(p)
-            self._free.append(p)
+            self._ref[p] -= 1
+            if self._ref[p] == 0:
+                del self._ref[p]
+                self._free.append(p)
+                released.append(p)
+        return released
+
+
+# ---------------------------------------------------------------- prefix index
+def prefix_chain_hashes(tokens: Sequence[int], page_size: int) -> List[bytes]:
+    """Hash chain over page-sized token blocks: entry j commits to blocks
+    0..j (a block's key includes its whole prefix, so equal hashes mean
+    equal page-aligned prompt prefixes, not just equal blocks). Only FULL
+    blocks participate — a partial tail block is never shareable (its page
+    would be written at different offsets by different requests)."""
+    toks = np.asarray(tokens, np.int64)
+    out: List[bytes] = []
+    h = hashlib.blake2b(digest_size=16)
+    for j in range(len(toks) // page_size):
+        h.update(toks[j * page_size:(j + 1) * page_size].tobytes())
+        out.append(h.digest())
+    return out
+
+
+class PrefixIndex:
+    """Host-side map from prompt-prefix hash chains to the physical pages
+    holding their KV. Entries are registered AFTER a prefill writes the
+    page (first writer wins) and forgotten the moment the page's last
+    reference dies (``PageAllocator.free`` reports released pages) — a
+    recycled page can never serve stale prefix bytes."""
+
+    def __init__(self, page_size: int):
+        self.page_size = int(page_size)
+        self._by_hash: Dict[bytes, int] = {}
+        self._by_page: Dict[int, bytes] = {}
+        self.hits = 0      # pages served from the index
+        self.misses = 0    # lookup blocks not present
+
+    def __len__(self) -> int:
+        return len(self._by_hash)
+
+    def lookup(self, tokens: Sequence[int]) -> List[int]:
+        """Physical pages for the LONGEST indexed page-aligned prefix of
+        ``tokens`` (the chain stops at the first miss — later blocks commit
+        to earlier ones, so holes cannot match)."""
+        hashes = prefix_chain_hashes(tokens, self.page_size)
+        pages = self.lookup_chain(hashes)
+        self.count(hashes, pages)
+        return pages
+
+    def count(self, hashes: Sequence[bytes], pages: Sequence[int]) -> None:
+        """Record one lookup's outcome in the hit statistics (split out so
+        admission retries under head-of-line blocking count ONCE, at the
+        admission that actually succeeds)."""
+        self.hits += len(pages)
+        if len(pages) < len(hashes):
+            self.misses += 1
+
+    def lookup_chain(self, hashes: Sequence[bytes]) -> List[int]:
+        """Counter-free :meth:`lookup` over a PRECOMPUTED hash chain — the
+        scheduler caches each request's chain (prompts are immutable) and
+        retries admission every step under head-of-line blocking, so the
+        hot path must not re-hash the prompt or skew hit statistics."""
+        pages: List[int] = []
+        for h in hashes:
+            page = self._by_hash.get(h)
+            if page is None:
+                break
+            pages.append(page)
+        return pages
+
+    def register(self, tokens: Sequence[int], pages: Sequence[int]) -> int:
+        """Index the full prompt blocks of ``tokens`` against the pages
+        that hold them (``pages`` = the owning request's block-table pages
+        in order). Existing entries win (the earlier page is the one other
+        requests already share). Returns the number of NEW entries."""
+        added = 0
+        for j, h in enumerate(prefix_chain_hashes(tokens, self.page_size)):
+            if j >= len(pages):
+                break
+            page = int(pages[j])
+            if page == RESERVED_PAGE or h in self._by_hash:
+                continue
+            if page in self._by_page:
+                continue  # page already indexed under another chain
+            self._by_hash[h] = page
+            self._by_page[page] = h
+            added += 1
+        return added
+
+    def forget(self, released_pages: Sequence[int]) -> None:
+        """Invalidate entries for pages whose storage was just recycled."""
+        for p in released_pages:
+            h = self._by_page.pop(int(p), None)
+            if h is not None:
+                self._by_hash.pop(h, None)

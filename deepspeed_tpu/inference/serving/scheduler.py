@@ -48,6 +48,16 @@ Production hardening (docs/SERVING.md "Overload & failure"):
   semantics) with the offending decode block shape quarantined after K
   failures. Every recovery path ends in a :meth:`audit` pass: page
   conservation is an enforced invariant, not a hope.
+
+Copy-on-write prefix caching (docs/SERVING.md "KV quantization & prefix
+caching"): with a :class:`~.paging.PrefixIndex` attached, admission SHAREs
+the physical pages of the longest indexed page-aligned prompt prefix
+(allocator refcounts) instead of allocating them, the prefill scatter
+starts past the borrowed pages, and a successful prefill registers the
+request's own full prompt pages for later arrivals. :meth:`audit` then
+additionally proves every refcount matches its slot references and that no
+shared page can ever be written (it lies wholly below every referencing
+slot's write frontier).
 """
 
 from __future__ import annotations
@@ -64,7 +74,8 @@ import numpy as np
 
 from ...resilience.chaos import serving_dispatch_fault
 from ...resilience.retry import backoff_delay
-from .paging import PageAllocator, pages_for
+from .paging import (PageAllocator, PrefixIndex, pages_for,
+                     prefix_chain_hashes)
 
 
 class RequestState(enum.Enum):
@@ -174,7 +185,8 @@ class ContinuousBatchingScheduler:
                  retry_max_delay: float = 0.25,
                  quarantine_after: int = 2,
                  dispatch_failure_budget: int = 8,
-                 recovery_log: Any = None, watchdog: Any = None):
+                 recovery_log: Any = None, watchdog: Any = None,
+                 prefix_cache: Optional[PrefixIndex] = None):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if shed_policy not in SHED_POLICIES:
@@ -210,9 +222,22 @@ class ContinuousBatchingScheduler:
         self.recovery_log = recovery_log
         self.watchdog = watchdog
         self._owns_watchdog = False  # set by ServingEngine.make_scheduler
+        # shared-prefix page reuse (copy-on-write; None = off): admission
+        # looks the prompt's page-aligned prefix up in the index and SHAREs
+        # those physical pages instead of allocating fresh ones
+        self.prefix_cache = prefix_cache
+        # cumulative page accounting: logical = pages every admission asked
+        # for, physical = pages actually allocated, shared = pages served
+        # from the prefix index — physical/logical is the bench row's
+        # page-reuse ratio
+        self.page_stats: Dict[str, int] = {
+            "logical": 0, "physical": 0, "shared": 0}
         self.queue: Deque[Request] = deque()
         self.slots: List[Optional[Request]] = [None] * self.num_slots
         self._slot_pages: List[List[int]] = [[] for _ in range(self.num_slots)]
+        # leading pages of each slot that are BORROWED (shared prefix) —
+        # the audit's no-write-on-shared invariant is anchored here
+        self._slot_shared: List[int] = [0] * self.num_slots
         self._admit_seq: List[int] = [0] * self.num_slots  # admission order
         self._admissions = 0
         self.tables = np.zeros((self.num_slots, self.pages_per_seq), np.int32)
@@ -346,8 +371,13 @@ class ContinuousBatchingScheduler:
         return AdmissionVerdict(False, reason, detail)
 
     def _release(self, slot: int) -> None:
-        self.allocator.free(self._slot_pages[slot])
+        released = self.allocator.free(self._slot_pages[slot])
+        if self.prefix_cache is not None and released:
+            # a page whose LAST reference died is about to be recycled — it
+            # must never serve another request's prefix lookup
+            self.prefix_cache.forget(released)
         self._slot_pages[slot] = []
+        self._slot_shared[slot] = 0
         self.tables[slot] = 0
         self.lengths[slot] = 0
         self.next_input[slot] = 0
@@ -367,6 +397,8 @@ class ContinuousBatchingScheduler:
         req = self.slots[slot]
         req.preemptions += 1
         req.state = RequestState.QUEUED
+        self._record("preemption", rid=req.rid,
+                     tokens_done=len(req.tokens))
         self._release(slot)
         self.queue.appendleft(req)
 
@@ -480,24 +512,55 @@ class ContinuousBatchingScheduler:
     # ----------------------------------------------------------- page audit
     def audit(self) -> Dict[str, Any]:
         """The allocator conservation invariant plus the scheduler-side
-        cross-check: the union of slot page lists must be EXACTLY the
-        allocator's outstanding-page ledger, with no page owned twice."""
+        cross-checks. With copy-on-write sharing, conservation means free +
+        Σ(unique allocated) == total with every refcount >= 1 (allocator
+        side), each page's refcount equals the number of slot references it
+        actually has, and — the write-safety half — NO slot can ever write
+        a shared page: every page referenced by more than one slot must lie
+        entirely below each referencing slot's write frontier (a full
+        prefix page), because the next append lands at ``lengths[slot]``."""
         rep = self.allocator.audit()
-        owned = [p for ps in self._slot_pages for p in ps]
         errors: List[str] = list(rep["errors"])
-        if len(owned) != len(set(owned)):
-            errors.append("a page appears in two slot page lists")
-        if set(owned) != self.allocator.allocated_ids:
-            leaked = sorted(self.allocator.allocated_ids - set(owned))
-            foreign = sorted(set(owned) - self.allocator.allocated_ids)
+        refs: Dict[int, int] = {}
+        for s_idx, pages in enumerate(self._slot_pages):
+            if len(pages) != len(set(pages)):
+                errors.append(f"slot {s_idx} lists a page twice")
+            for p in pages:
+                refs[p] = refs.get(p, 0) + 1
+        if set(refs) != self.allocator.allocated_ids:
+            leaked = sorted(self.allocator.allocated_ids - set(refs))
+            foreign = sorted(set(refs) - self.allocator.allocated_ids)
             if leaked:
                 errors.append(f"pages allocated but owned by no slot "
                               f"(leak): {leaked}")
             if foreign:
                 errors.append(f"slot-held pages unknown to the allocator: "
                               f"{foreign}")
+        for p, n in refs.items():
+            have = self.allocator.refcount(p)
+            if have != n:
+                errors.append(f"page {p}: {n} slot reference(s) vs "
+                              f"allocator refcount {have} (leaked refcount)")
+        for s_idx, pages in enumerate(self._slot_pages):
+            frontier = int(self.lengths[s_idx])
+            # the borrowed-prefix bookkeeping must agree with reality: the
+            # slot borrowed its first _slot_shared pages, so the write
+            # frontier can never sit inside them
+            if self._slot_shared[s_idx] * self.page_size > frontier:
+                errors.append(
+                    f"slot {s_idx} records {self._slot_shared[s_idx]} "
+                    f"borrowed prefix pages but its write frontier "
+                    f"{frontier} is inside them")
+            for idx, p in enumerate(pages):
+                if (self.allocator.refcount(p) > 1
+                        and (idx + 1) * self.page_size > frontier):
+                    errors.append(
+                        f"shared page {p} (table index {idx}) reaches slot "
+                        f"{s_idx}'s write frontier {frontier} — a decode "
+                        f"append could land on a shared page")
         rep["errors"] = errors
         rep["ok"] = not errors
+        rep["page_stats"] = dict(self.page_stats)
         return rep
 
     def _audit_after_recovery(self, context: str) -> None:
@@ -516,9 +579,48 @@ class ContinuousBatchingScheduler:
             self.watchdog = None
 
     # ------------------------------------------------------------ admission
+    def _claim_pages(self, req: Request, need: int) -> Optional[tuple]:
+        """Pages for one admission: shared prefix pages from the index
+        (refcount bumped, copy-on-write) + fresh ones for the rest. Returns
+        (pages, shared_count) or None (and claims NOTHING) when the pool
+        cannot cover the unshared remainder. The shared count is always <
+        ``need``: the append frontier (position ctx, first decode write) is
+        past the page-aligned prompt prefix, so the page it lands in is
+        always privately owned.
+
+        Hot-path discipline: admission retries EVERY step while the queue
+        head is pool-blocked, so the prompt's hash chain is computed once
+        and cached on the request, the free-list is probed BEFORE any
+        refcount is taken (no share-then-unwind churn per retry), and hit
+        statistics count only the admission that proceeds."""
+        shared: List[int] = []
+        hashes = ()
+        if self.prefix_cache is not None:
+            hashes = getattr(req, "_prefix_hashes", None)
+            if hashes is None:
+                hashes = prefix_chain_hashes(np.asarray(req.prompt),
+                                             self.page_size)
+                req._prefix_hashes = hashes
+            shared = self.prefix_cache.lookup_chain(hashes)[:need]
+        if not self.allocator.can_alloc(need - len(shared)):
+            return None
+        if shared:
+            self.allocator.share(shared)
+        own = self.allocator.alloc(need - len(shared))
+        if own is None:  # chaos alloc_fail_at fires through the normal path
+            if shared:
+                self.prefix_cache.forget(self.allocator.free(shared))
+            return None
+        if self.prefix_cache is not None:
+            self.prefix_cache.count(hashes, shared)
+        self.page_stats["logical"] += need
+        self.page_stats["physical"] += len(own)
+        self.page_stats["shared"] += len(shared)
+        return shared + own, len(shared)
+
     def _admit(self) -> int:
         # phase 1: claim slots + pages for everything that fits this cycle
-        batch = []  # (slot, context tokens)
+        batch = []  # (slot, context tokens, first unshared position)
         for slot in range(self.num_slots):
             if self.slots[slot] is not None or not self.queue:
                 continue
@@ -527,11 +629,13 @@ class ContinuousBatchingScheduler:
             # +1: the first decode step appends its token's KV at position
             # ctx, which may open a fresh page
             need = pages_for(ctx + 1, self.page_size)
-            pages = self.allocator.alloc(need)
-            if pages is None:
+            claim = self._claim_pages(req, need)
+            if claim is None:
                 break  # head-of-line blocking keeps FIFO order under pressure
+            pages, shared = claim
             self.queue.popleft()
             self._slot_pages[slot] = pages
+            self._slot_shared[slot] = shared
             self.tables[slot] = 0
             self.tables[slot, :len(pages)] = pages
             tokens = np.concatenate(
@@ -543,28 +647,40 @@ class ContinuousBatchingScheduler:
             self._admissions += 1
             self._admit_seq[slot] = self._admissions
             req.state = RequestState.RUNNING
-            batch.append((slot, tokens))
+            batch.append((slot, tokens, shared * self.page_size))
         if not batch:
             return 0
         # phase 2: prefill the whole admission cycle — batched when the
         # executor supports it (one [num_slots, chunk] dispatch instead of
         # one per request). A failed episode (retries exhausted) unwinds the
         # WHOLE admission cycle back to the queue: no request has appended a
-        # token yet, so requeue-with-kept-tokens is exact.
+        # token yet, so requeue-with-kept-tokens is exact. With prefix
+        # sharing the executor additionally receives each row's first
+        # UNSHARED position — its KV scatter must never touch a borrowed
+        # page (the prefill forward still runs the full context).
         try:
             if hasattr(self.executor, "prefill_many"):
+                if self.prefix_cache is not None:
+                    items = [(slot, toks, self.tables[slot], start)
+                             for slot, toks, start in batch]
+                else:  # legacy 3-tuple protocol for start-less executors
+                    items = [(slot, toks, self.tables[slot])
+                             for slot, toks, _ in batch]
                 results = self._dispatch(
-                    "prefill", self.executor.prefill_many,
-                    [(slot, toks, self.tables[slot]) for slot, toks in batch])
+                    "prefill", self.executor.prefill_many, items)
             else:
-                results = {slot: int(self._dispatch(
-                    "prefill", self.executor.prefill, slot, toks,
-                    self.tables[slot])) for slot, toks in batch}
+                results = {}
+                for slot, toks, start in batch:
+                    args = (slot, toks, self.tables[slot])
+                    if self.prefix_cache is not None:
+                        args += (start,)
+                    results[slot] = int(self._dispatch(
+                        "prefill", self.executor.prefill, *args))
         except _DispatchFailure as fail:
             self._on_dispatch_episode_failed(fail,
-                                             [slot for slot, _ in batch])
+                                             [slot for slot, _, _ in batch])
             return 0
-        for slot, _ in batch:
+        for slot, _, _ in batch:
             req = self.slots[slot]
             first = int(results[slot])
             self.next_input[slot] = first
@@ -574,6 +690,12 @@ class ContinuousBatchingScheduler:
             req.tokens.append(first)
             if req.t_first_token is None:
                 req.t_first_token = self.clock()
+            if self.prefix_cache is not None:
+                # the slot's full prompt pages now hold canonical KV —
+                # index them so later arrivals with the same prefix share
+                # (first writer wins; entries die with the page)
+                self.prefix_cache.register(np.asarray(req.prompt),
+                                           self._slot_pages[slot])
             if req.done:
                 self._finish(slot)
         return len(batch)
@@ -594,6 +716,8 @@ class ContinuousBatchingScheduler:
                 return False
             self._slot_pages[slot].append(page[0])
             self.tables[slot, pi] = page[0]
+            self.page_stats["logical"] += 1
+            self.page_stats["physical"] += 1
         return True
 
     # ------------------------------------------------------------ one step

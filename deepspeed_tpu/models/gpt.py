@@ -1146,8 +1146,12 @@ def forward_with_cache(cfg: GPTConfig, params, input_ids: jnp.ndarray, cache):
 
 
 # ----------------------------------------------------------- paged KV decode
+KV_QMAX = {8: 127.0, 4: 7.0}
+
+
 def init_paged_cache(cfg: GPTConfig, num_pages: int, page_size: int,
-                     dtype=jnp.bfloat16) -> Dict[str, jnp.ndarray]:
+                     dtype=jnp.bfloat16,
+                     kv_bits: Optional[int] = None) -> Dict[str, jnp.ndarray]:
     """Block-allocated KV cache: one shared page pool per layer,
     [L, H, P, page_size, Dh]. Requests own pages through a *block table*
     (``inference/serving/paging.py``); HBM holds ``P * page_size`` token
@@ -1155,18 +1159,71 @@ def init_paged_cache(cfg: GPTConfig, num_pages: int, page_size: int,
     memory model, vs the contiguous :func:`init_cache` which reserves
     ``max_len`` slots per batch row whether used or not.
 
+    ``kv_bits`` (8 or 4) stores the pools QUANTIZED: int8 payloads (int4
+    nibble-packs two values per byte along Dh) plus one symmetric fp32
+    scale per (layer, head, page) in ``k_scales``/``v_scales`` —
+    2x/4x the token capacity at fixed HBM vs bf16 pools, dequantized per
+    tile inside the Pallas decode kernel. A quantized cache is recognized
+    by the presence of the scale stacks.
+
     Page 0 is the allocator's reserved sink: inactive decode slots and
     masked scatter lanes write there, so pool page ids handed to requests
     start at 1."""
-    shape = (cfg.n_layer, cfg.n_head, num_pages, page_size, cfg.head_dim)
-    return {"k_pages": jnp.zeros(shape, dtype),
-            "v_pages": jnp.zeros(shape, dtype)}
+    if kv_bits is None or kv_bits == 0:
+        shape = (cfg.n_layer, cfg.n_head, num_pages, page_size, cfg.head_dim)
+        return {"k_pages": jnp.zeros(shape, dtype),
+                "v_pages": jnp.zeros(shape, dtype)}
+    if kv_bits not in KV_QMAX:
+        raise ValueError(f"kv_bits must be 8 or 4 (or None), got {kv_bits}")
+    if kv_bits == 4 and cfg.head_dim % 2:
+        raise ValueError("int4 KV needs an even head_dim (nibble packing)")
+    dq = cfg.head_dim // 2 if kv_bits == 4 else cfg.head_dim
+    shape = (cfg.n_layer, cfg.n_head, num_pages, page_size, dq)
+    sshape = (cfg.n_layer, cfg.n_head, num_pages)
+    return {"k_pages": jnp.zeros(shape, jnp.int8),
+            "v_pages": jnp.zeros(shape, jnp.int8),
+            "k_scales": jnp.ones(sshape, jnp.float32),
+            "v_scales": jnp.ones(sshape, jnp.float32)}
+
+
+def paged_cache_bits(paged_cache, head_dim: int) -> Optional[int]:
+    """The cache's KV quantization width (None = dense pools)."""
+    if "k_scales" not in paged_cache:
+        return None
+    return 4 if paged_cache["k_pages"].shape[-1] * 2 == head_dim else 8
+
+
+def paged_kv_bytes_per_token(cfg: GPTConfig, kv_bits: Optional[int] = None,
+                             page_size: int = 64,
+                             dtype=jnp.bfloat16) -> float:
+    """HBM bytes one cached token costs in an :func:`init_paged_cache`
+    pool: dense payload at ``dtype``, or quantized payload at ``kv_bits``
+    plus the amortized fp32 per-(layer, head, page) scales. The ONE byte
+    formula shared by the AOT fit ladder, the serving engine's equal-HBM
+    A/B axis, and the bench's emulated pool sizing — a scale-layout change
+    in ``init_paged_cache`` must be priced here, once."""
+    per_tok = 2 * cfg.n_layer * cfg.n_head * cfg.head_dim
+    if not kv_bits:
+        return float(per_tok * jnp.dtype(dtype).itemsize)
+    payload = per_tok // (2 if kv_bits == 4 else 1)
+    scales = 2 * cfg.n_layer * cfg.n_head * 4 / page_size
+    return float(payload + scales)
+
+
+def _pack_kv_int4(q: jnp.ndarray) -> jnp.ndarray:
+    """Values in [-8, 7] pack two per byte along the last dim — the one
+    canonical half-split layout (``ops.pallas.int8_matmul.pack_int4``,
+    inverted by ``decode_attention.unpack_kv_int4``)."""
+    from ..ops.pallas.int8_matmul import pack_int4
+
+    return pack_int4(q)
 
 
 def write_prompt_kv_batch(paged_cache: Dict[str, jnp.ndarray],
                           dense_cache: Dict[str, jnp.ndarray],
                           block_tables: jnp.ndarray,  # [F, pages_per_seq]
                           lengths: jnp.ndarray,       # [F] valid tokens/row
+                          starts: Optional[jnp.ndarray] = None,  # [F] or 0
                           ) -> Dict[str, jnp.ndarray]:
     """Scatter a BATCH of prefilled requests' dense K/V into their pages.
 
@@ -1175,7 +1232,15 @@ def write_prompt_kv_batch(paged_cache: Dict[str, jnp.ndarray],
     K/V is then placed into the pages its block-table row names — the
     prefill/decode disaggregation boundary. Positions past a row's length
     (bucket padding, or a wholly inactive row with length 0) scatter out of
-    bounds and are dropped."""
+    bounds and are dropped. ``starts`` additionally drops positions BELOW a
+    per-row floor: a request admitted with shared prefix pages
+    (copy-on-write prefix caching) must never write the pages it only
+    borrows, so its scatter begins at the first unshared position.
+
+    Quantized pools (``init_paged_cache(kv_bits=...)``) quantize at scatter
+    time: one symmetric scale per (layer, head, page) from the absmax of
+    the tokens landing in that page, payloads rounded/clipped exactly like
+    ``ops.quantizer.quantize``."""
     k = dense_cache["k"]  # [L, F, H, S, Dh]
     v = dense_cache["v"]
     S = k.shape[3]
@@ -1185,42 +1250,112 @@ def write_prompt_kv_batch(paged_cache: Dict[str, jnp.ndarray],
     pos = jnp.broadcast_to(jnp.arange(S)[None, :], (F, S))
     tables = jnp.asarray(block_tables, jnp.int32)
     lengths = jnp.asarray(lengths, jnp.int32)
+    if starts is None:
+        starts = jnp.zeros((F,), jnp.int32)
+    else:
+        starts = jnp.broadcast_to(jnp.asarray(starts, jnp.int32), (F,))
     page_of_pos = jnp.take_along_axis(tables, pos // ps, axis=1)  # [F, S]
-    # pad positions get page id P (out of bounds) -> mode="drop" discards them
-    page = jnp.where(pos < lengths[:, None], page_of_pos, P)
+    valid = (pos >= starts[:, None]) & (pos < lengths[:, None])
+    # invalid positions get page id P (out of bounds) -> mode="drop"
+    page = jnp.where(valid, page_of_pos, P)
     off = pos % ps
-    dt = paged_cache["k_pages"].dtype
-    # k_pages[l, h, page[f, s], off[f, s], :] = k[l, f, h, s, :]
-    return {
-        "k_pages": paged_cache["k_pages"].at[:, :, page, off, :].set(
-            k.transpose(0, 2, 1, 3, 4).astype(dt), mode="drop"),
-        "v_pages": paged_cache["v_pages"].at[:, :, page, off, :].set(
-            v.transpose(0, 2, 1, 3, 4).astype(dt), mode="drop"),
-    }
+    bits = paged_cache_bits(paged_cache, k.shape[-1])
+    if bits is None:
+        dt = paged_cache["k_pages"].dtype
+        # k_pages[l, h, page[f, s], off[f, s], :] = k[l, f, h, s, :]
+        return {
+            "k_pages": paged_cache["k_pages"].at[:, :, page, off, :].set(
+                k.transpose(0, 2, 1, 3, 4).astype(dt), mode="drop"),
+            "v_pages": paged_cache["v_pages"].at[:, :, page, off, :].set(
+                v.transpose(0, 2, 1, 3, 4).astype(dt), mode="drop"),
+        }
+    qmax = KV_QMAX[bits]
+    L, _, H, _, Dh = k.shape
+    Sp = -(-S // ps) * ps  # pad S up to whole pages for the grouped absmax
+    npg = Sp // ps
+    vmask = valid
+    if Sp != S:
+        vmask = jnp.concatenate(
+            [valid, jnp.zeros((F, Sp - S), bool)], axis=1)
+    vmask_g = vmask.reshape(F, npg, ps)
+    any_valid = vmask_g.any(axis=2)  # [F, npg]
+    # page ids per (row, page-slot); unwritten pages scatter out of bounds.
+    # The dense scratch may be PADDED past the table (its S rounds up to
+    # whole prefill chunks, the table to whole pages of max_model_len) —
+    # pad the excess page slots with the drop index; they can never hold a
+    # valid token, matching the dense path's clip-then-mask semantics.
+    tbl = tables[:, :npg]
+    if tbl.shape[1] < npg:
+        tbl = jnp.concatenate(
+            [tbl, jnp.full((F, npg - tbl.shape[1]), P, jnp.int32)], axis=1)
+    pages_w = jnp.where(any_valid, tbl, P)
+
+    def quantize_side(x, pages_key, scales_key):
+        xt = x.transpose(0, 2, 1, 3, 4).astype(jnp.float32)  # [L,H,F,S,Dh]
+        if Sp != S:
+            xt = jnp.concatenate(
+                [xt, jnp.zeros(xt.shape[:3] + (Sp - S, Dh), jnp.float32)],
+                axis=3)
+        xg = xt.reshape(L, H, F, npg, ps, Dh)
+        amax = jnp.max(jnp.abs(xg) * vmask_g[None, None, :, :, :, None],
+                       axis=(4, 5))                          # [L,H,F,npg]
+        scales = jnp.where(amax > 0, amax / qmax, 1.0)
+        q = jnp.clip(jnp.round(xg / scales[..., None, None]),
+                     -qmax - 1, qmax)
+        if bits == 4:
+            q = _pack_kv_int4(q)
+        else:
+            q = q.astype(jnp.int8)
+        q = q.reshape(L, H, F, Sp, q.shape[-1])[:, :, :, :S]
+        return {
+            pages_key: paged_cache[pages_key].at[:, :, page, off, :].set(
+                q, mode="drop"),
+            # k_scales[l, h, pages_w[f, j]] = scales[l, h, f, j]
+            scales_key: paged_cache[scales_key].at[:, :, pages_w].set(
+                scales, mode="drop"),
+        }
+
+    out = quantize_side(k, "k_pages", "k_scales")
+    out.update(quantize_side(v, "v_pages", "v_scales"))
+    return out
 
 
 def write_prompt_kv(paged_cache: Dict[str, jnp.ndarray],
                     dense_cache: Dict[str, jnp.ndarray],
                     block_table: jnp.ndarray,  # [pages_per_seq] int32
                     length: jnp.ndarray,       # scalar int32: valid tokens
-                    row: int = 0) -> Dict[str, jnp.ndarray]:
+                    row: int = 0,
+                    start: jnp.ndarray = 0) -> Dict[str, jnp.ndarray]:
     """Single-request :func:`write_prompt_kv_batch` over ``dense_cache`` row
-    ``row``."""
+    ``row``. ``start`` skips positions below it (shared prefix pages)."""
     one = {"k": dense_cache["k"][:, row:row + 1],
            "v": dense_cache["v"][:, row:row + 1]}
     table = jnp.asarray(block_table, jnp.int32)[None]
     return write_prompt_kv_batch(paged_cache, one, table,
-                                 jnp.asarray(length, jnp.int32)[None])
+                                 jnp.asarray(length, jnp.int32)[None],
+                                 jnp.asarray(start, jnp.int32)[None])
 
 
 def _paged_attn_sublayer(cfg: GPTConfig, x, w, k_pages, v_pages, tables,
-                         lengths, impl=None):
+                         lengths, impl=None, k_scales=None, v_scales=None):
     """Cached self-attention over the page pool (pre-LN + residual) for ONE
-    new token per row. x: [B, 1, D]; k_pages/v_pages: [H, P, ps, Dh];
+    new token per row. x: [B, 1, D]; k_pages/v_pages: [H, P, ps, Dh] (or
+    int8 [..., Dh(/2)] with per-page ``k_scales``/``v_scales`` [H, P]);
     tables: [B, pages_per_seq]; lengths: [B] tokens already in the cache
     (the new token is appended at position ``lengths[b]``).
-    Returns (x + attn_out, k_pages, v_pages)."""
-    from ..ops.pallas.decode_attention import paged_decode_attention
+    Returns (x + attn_out, k_pages, v_pages, k_scales, v_scales).
+
+    Quantized append: a row OPENING a new page (offset 0) establishes the
+    page scale from its own token — the pool's prior value there is
+    garbage (init, or a recycled page's previous tenant). Mid-page, the
+    token quantizes against the page scale; when its absmax exceeds what
+    the scale covers, the scale GROWS and the page's existing payload
+    requantizes under it (one [ps, Dh] elementwise pass, taken via
+    ``lax.cond`` only on steps where some row actually grew — the common
+    step is a single-position write) — no clipping of outlier tokens,
+    scales only ever grow within a page's lifetime."""
+    from ..ops.pallas.decode_attention import (paged_decode_attention,
+                                               unpack_kv_int4)
 
     B, T, D = x.shape
     assert T == 1
@@ -1242,19 +1377,75 @@ def _paged_attn_sublayer(cfg: GPTConfig, x, w, k_pages, v_pages, tables,
     page = jnp.take_along_axis(tables, (lengths // ps)[:, None],
                                axis=1)[:, 0]  # [B]
     off = lengths % ps
-    dt = k_pages.dtype
-    k_pages = k_pages.at[:, page, off, :].set(
-        k_[:, 0].astype(dt).transpose(1, 0, 2))
-    v_pages = v_pages.at[:, page, off, :].set(
-        v[:, 0].astype(dt).transpose(1, 0, 2))
+    quantized = k_scales is not None
+    if not quantized:
+        dt = k_pages.dtype
+        k_pages = k_pages.at[:, page, off, :].set(
+            k_[:, 0].astype(dt).transpose(1, 0, 2))
+        v_pages = v_pages.at[:, page, off, :].set(
+            v[:, 0].astype(dt).transpose(1, 0, 2))
+    else:
+        bits = 4 if k_pages.shape[-1] * 2 == Dh else 8
+        qmax = KV_QMAX[bits]
+        # off == 0 means this row is OPENING its page: whatever scale the
+        # pool holds there is garbage (the jnp.ones init, or a previous
+        # tenant's value — the host allocator recycles pages without
+        # touching device state), so the token's own scale replaces it
+        # instead of max()-ing against it; off > 0 pages grow-only.
+        opening = (off == 0)[None, :]                     # [1, B]
+
+        def append(pages_q, scales, tok):
+            # pages_q: [H, P, ps, Dq]; scales: [H, P]; tok: [H, B, Dh]
+            s_old = scales[:, page]                       # [H, B]
+            amax = jnp.max(jnp.abs(tok), axis=-1)
+            fresh = jnp.where(amax > 0, amax / qmax, 1.0)
+            s_new = jnp.where(opening, fresh,
+                              jnp.maximum(s_old, fresh))
+            tq = jnp.clip(jnp.round(tok / s_new[..., None]), -qmax - 1, qmax)
+            if bits == 4:
+                tq = _pack_kv_int4(tq)
+            else:
+                tq = tq.astype(jnp.int8)
+
+            def token_only(pages_q):
+                # the common decode step: the page scale already covers the
+                # token — one [H, B, Dq] position write, no page rewrite
+                return pages_q.at[:, page, off, :].set(tq)
+
+            def requantize(pages_q):
+                # some mid-page row's scale GREW: rescale that page's
+                # existing payload under the new scale (opening rows just
+                # overwrite garbage), then insert the token
+                cur = pages_q[:, page]                    # [H, B, ps, Dq]
+                cur = (unpack_kv_int4(cur) if bits == 4
+                       else cur.astype(jnp.float32))
+                ratio = (s_old / s_new)[..., None, None]
+                curq = jnp.clip(jnp.round(cur * ratio), -qmax - 1, qmax)
+                curq = (_pack_kv_int4(curq) if bits == 4
+                        else curq.astype(jnp.int8))
+                curq = curq.at[:, jnp.arange(B), off, :].set(tq)
+                return pages_q.at[:, page].set(curq)
+
+            grew = jnp.any(jnp.logical_and(~opening, s_new > s_old))
+            pages_q = jax.lax.cond(grew, requantize, token_only, pages_q)
+            return pages_q, scales.at[:, page].set(s_new)
+
+        k_pages, k_scales = append(k_pages, k_scales,
+                                   k_[:, 0].transpose(1, 0, 2)
+                                   .astype(jnp.float32))
+        v_pages, v_scales = append(v_pages, v_scales,
+                                   v[:, 0].transpose(1, 0, 2)
+                                   .astype(jnp.float32))
     scale = (cfg.attention_scale if cfg.attention_scale is not None
              else 1.0 / np.sqrt(Dh))
-    attn = paged_decode_attention(q.astype(dt), k_pages, v_pages,
+    qdt = x.dtype if quantized else k_pages.dtype
+    attn = paged_decode_attention(q.astype(qdt), k_pages, v_pages,
                                   lengths + 1, tables, softmax_scale=scale,
-                                  impl=impl)
+                                  impl=impl, k_scales=k_scales,
+                                  v_scales=v_scales)
     attn = attn.reshape(B, 1, D).astype(x.dtype)
     attn = _wm(attn, w["attn_out_w"]) + w["attn_out_b"]
-    return x + attn, k_pages, v_pages
+    return x + attn, k_pages, v_pages, k_scales, v_scales
 
 
 def paged_decode_step(cfg: GPTConfig, params, input_ids: jnp.ndarray,
@@ -1271,8 +1462,9 @@ def paged_decode_step(cfg: GPTConfig, params, input_ids: jnp.ndarray,
     occupy the slots; inactive slots (lengths 0, table row all page-0) write
     to the reserved sink page and produce ignored logits. Supports the dense
     and the quantized ({"q"/"q4","s"}) layer stacks like
-    :func:`forward_with_cache`; alibi/local-attention configs are not yet
-    paged."""
+    :func:`forward_with_cache`, and dense OR quantized KV pools
+    (``init_paged_cache(kv_bits=...)`` — recognized by the scale stacks);
+    alibi/local-attention configs are not yet paged."""
     if cfg.alibi or cfg.local_attention_period > 1:
         raise ValueError("paged decode does not support alibi/local-window "
                          "attention yet (the paged kernel has no bias input)")
@@ -1290,52 +1482,59 @@ def paged_decode_step(cfg: GPTConfig, params, input_ids: jnp.ndarray,
                        cfg.layer_norm_eps)
     qkv_w = params["blocks"]["qkv_w"]
     quantized = _is_qleaf(qkv_w)
+    kv_q = "k_scales" in paged_cache
     compute_dtype = (params["lnf_scale"].dtype if quantized else qkv_w.dtype)
     x = x.astype(compute_dtype)
     x = maybe_shard(x, P(BATCH, None, None))
     blocks = params["blocks"]
 
-    def one_block(x, layer_w, k_p, v_p):
-        y, k_p, v_p = _paged_attn_sublayer(cfg, x, layer_w, k_p, v_p,
-                                           block_tables, lengths, impl=impl)
+    def one_block(x, layer_w, kv):
+        k_p, v_p = kv[0], kv[1]
+        k_s, v_s = (kv[2], kv[3]) if kv_q else (None, None)
+        y, k_p, v_p, k_s, v_s = _paged_attn_sublayer(
+            cfg, x, layer_w, k_p, v_p, block_tables, lengths, impl=impl,
+            k_scales=k_s, v_scales=v_s)
         # parallel residual (NeoX/GPT-J): the MLP reads the PRE-attention
         # stream — same composition as _block_with_cache
         mlp_in = x if cfg.parallel_residual else y
-        return y + _mlp_delta(cfg, mlp_in, layer_w), k_p, v_p
+        out_kv = (k_p, v_p, k_s, v_s) if kv_q else (k_p, v_p)
+        return y + _mlp_delta(cfg, mlp_in, layer_w), out_kv
 
+    kv_xs = ((paged_cache["k_pages"], paged_cache["v_pages"],
+              paged_cache["k_scales"], paged_cache["v_scales"]) if kv_q
+             else (paged_cache["k_pages"], paged_cache["v_pages"]))
     if quantized:
         # indexed (not scanned) weight stacks — same HBM-copy avoidance as
         # forward_with_cache's quantized branch
         def body(carry, layer_in):
             x, i = carry
-            k_p, v_p = layer_in
             layer_w = jax.tree_util.tree_map(
                 lambda a: jax.lax.dynamic_index_in_dim(a, i, 0,
                                                        keepdims=False),
                 blocks)
-            x, k_p, v_p = one_block(x, layer_w, k_p, v_p)
-            return (x, i + 1), (k_p, v_p)
+            x, kv = one_block(x, layer_w, layer_in)
+            return (x, i + 1), kv
 
-        (x, _), (new_k, new_v) = jax.lax.scan(
-            body, (x, jnp.int32(0)),
-            (paged_cache["k_pages"], paged_cache["v_pages"]))
+        (x, _), new_kv = jax.lax.scan(body, (x, jnp.int32(0)), kv_xs)
     else:
         def body(carry, layer_in):
             x, i = carry
-            layer_w, k_p, v_p = layer_in
-            x, k_p, v_p = one_block(x, layer_w, k_p, v_p)
-            return (x, i + 1), (k_p, v_p)
+            x, kv = one_block(x, layer_in[0], layer_in[1:])
+            return (x, i + 1), kv
 
-        (x, _), (new_k, new_v) = jax.lax.scan(
-            body, (x, jnp.int32(0)),
-            (blocks, paged_cache["k_pages"], paged_cache["v_pages"]))
+        (x, _), new_kv = jax.lax.scan(
+            body, (x, jnp.int32(0)), (blocks,) + kv_xs)
     x = layer_norm(x, params["lnf_scale"], params["lnf_bias"],
                    cfg.layer_norm_eps)
     head = params["wte"] if cfg.tie_embeddings else params["lm_head"]
     logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
     if cfg.lm_head_bias and not cfg.tie_embeddings:
         logits = logits + params["lm_head_b"].astype(logits.dtype)
-    return logits[:, 0, :], {"k_pages": new_k, "v_pages": new_v}
+    new_cache = {"k_pages": new_kv[0], "v_pages": new_kv[1]}
+    if kv_q:
+        new_cache["k_scales"] = new_kv[2]
+        new_cache["v_scales"] = new_kv[3]
+    return logits[:, 0, :], new_cache
 
 
 def build(cfg_or_name) -> Tuple[Module, GPTConfig]:

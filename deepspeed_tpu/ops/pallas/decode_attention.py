@@ -156,6 +156,17 @@ def decode_attention(
 
 
 # ------------------------------------------------------------------ paged path
+def unpack_kv_int4(packed: jnp.ndarray) -> jnp.ndarray:
+    """Two int4 values per int8 byte, half-split along the last dim — THE
+    ``int8_matmul.pack_int4`` layout (one canonical nibble format for
+    weights and KV; delegating keeps them from ever desynchronizing).
+    Float output, shared by the kernel body and the XLA fallback so both
+    dequantize bit-identically."""
+    from .int8_matmul import unpack_int4
+
+    return unpack_int4(packed).astype(jnp.float32)
+
+
 def paged_decode_attention(
     q: jnp.ndarray,           # [B, 1, H, Dh]
     k_pages: jnp.ndarray,     # [H, P, page_size, Dh] — shared page pool
@@ -164,6 +175,8 @@ def paged_decode_attention(
     block_tables: jnp.ndarray,  # [B, pages_per_seq] int32 page ids (pad: 0)
     softmax_scale: Optional[float] = None,
     impl: Optional[str] = None,  # None=auto | "kernel" | "gather"
+    k_scales: Optional[jnp.ndarray] = None,  # [H, P] f32: per-page scales
+    v_scales: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Decode attention reading K/V through a block table.
 
@@ -174,12 +187,29 @@ def paged_decode_attention(
     past a request's length must hold a VALID page id (the allocator reserves
     page 0 as that sink); their tiles are masked, never read into the sum.
 
+    **Quantized pools**: pass ``k_scales``/``v_scales`` ([H, P] fp32, one
+    symmetric scale per head x page) and int8 pools — either plain int8
+    ([..., Dh]) or nibble-packed int4 ([..., Dh // 2], the
+    :func:`unpack_kv_int4` layout). Scales ride scalar prefetch next to the
+    block tables, and each K/V tile dequantizes inside the online-softmax
+    body on its way out of VMEM — HBM moves 2x (int8) or 4x (int4) fewer
+    cache bytes than bf16 and no dequantized copy of the pool ever exists.
+
     ``impl``: "kernel" forces the Pallas path (Mosaic on TPU, interpret
     elsewhere), "gather" the XLA fallback; auto follows the backend like the
-    other Pallas ops.
+    other Pallas ops. The fallback dequantizes the same payload with the
+    same arithmetic, so kernel vs fallback agree to fp tolerance.
     """
     B, one, H, Dh = q.shape
     assert one == 1
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("pass both k_scales and v_scales, or neither")
+    quantized = k_scales is not None
+    packed = quantized and k_pages.shape[-1] * 2 == Dh
+    if quantized and not packed and k_pages.shape[-1] != Dh:
+        raise ValueError(
+            f"quantized pool last dim {k_pages.shape[-1]} matches neither "
+            f"int8 ({Dh}) nor packed int4 ({Dh // 2})")
     page_size = k_pages.shape[2]
     pages_per_seq = block_tables.shape[1]
     scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(Dh)
@@ -188,41 +218,51 @@ def paged_decode_attention(
     if impl is None:
         impl = "kernel" if jax.default_backend() == "tpu" else "gather"
     if impl == "gather":
-        return _paged_gather_attention(q, k_pages, v_pages, lens, tables, scale)
+        return _paged_gather_attention(q, k_pages, v_pages, lens, tables,
+                                       scale, k_scales, v_scales)
     if impl != "kernel":
         raise ValueError(f"impl must be None, 'kernel' or 'gather': {impl!r}")
 
     qh = q.transpose(0, 2, 1, 3)  # [B, H, 1, Dh]
+    Dp = k_pages.shape[-1]  # Dh, or Dh//2 nibble-packed
+    n_prefetch = 4 if quantized else 2
+    kv_spec = pl.BlockSpec(
+        (1, 1, page_size, Dp),
+        # the paged gather IS this index_map: tile i of row b lives in
+        # pool slot tbl[b, i] (args: grid ids, then every prefetch ref)
+        lambda b, h, i, lens, tbl, *_s: (h, tbl[b, i], 0, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,  # (lens, tables) -> SMEM
+        num_scalar_prefetch=n_prefetch,  # (lens, tables[, k/v scales]) -> SMEM
         grid=(B, H, pages_per_seq),
         in_specs=[
             pl.BlockSpec((1, 1, 1, Dh),
-                         lambda b, h, i, lens, tbl: (b, h, 0, 0)),
-            # the paged gather IS this index_map: tile i of row b lives in
-            # pool slot tables[b, i]
-            pl.BlockSpec((1, 1, page_size, Dh),
-                         lambda b, h, i, lens, tbl: (h, tbl[b, i], 0, 0)),
-            pl.BlockSpec((1, 1, page_size, Dh),
-                         lambda b, h, i, lens, tbl: (h, tbl[b, i], 0, 0)),
+                         lambda b, h, i, lens, tbl, *_s: (b, h, 0, 0)),
+            kv_spec,
+            kv_spec,
         ],
         out_specs=pl.BlockSpec((1, 1, 1, Dh),
-                               lambda b, h, i, lens, tbl: (b, h, 0, 0)),
+                               lambda b, h, i, lens, tbl, *_s: (b, h, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((1, Dh), jnp.float32),
             pltpu.VMEM((1, 1), jnp.float32),
             pltpu.VMEM((1, 1), jnp.float32),
         ],
     )
+    kernel = functools.partial(
+        _paged_q_kernel if quantized else _paged_kernel, sm_scale=scale,
+        page_size=page_size, num_pages=pages_per_seq,
+        **({"packed": packed} if quantized else {}))
+    operands = ((lens, tables, k_scales.astype(jnp.float32),
+                 v_scales.astype(jnp.float32), qh, k_pages, v_pages)
+                if quantized else (lens, tables, qh, k_pages, v_pages))
     # k/v page pools enter with a leading dummy batch-of-heads axis folded
-    # away by the (1, 1, ps, Dh) blocks over [H, P, ps, Dh]
+    # away by the (1, 1, ps, Dp) blocks over [H, P, ps, Dp]
     out = pl.pallas_call(
-        functools.partial(_paged_kernel, sm_scale=scale, page_size=page_size,
-                          num_pages=pages_per_seq),
+        kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, 1, Dh), q.dtype),
         interpret=_interpret(),
-    )(lens, tables, qh, k_pages, v_pages)
+    )(*operands)
     return out.transpose(0, 2, 1, 3)
 
 
@@ -234,22 +274,82 @@ def _paged_kernel(len_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref,
                    sm_scale=sm_scale, block_k=page_size, num_blocks=num_pages)
 
 
-def _paged_gather_attention(q, k_pages, v_pages, lens, tables, scale):
+def _paged_q_kernel(len_ref, tbl_ref, ks_ref, vs_ref, q_ref, k_ref, v_ref,
+                    o_ref, acc_ref, m_ref, l_ref, *, sm_scale: float,
+                    page_size: int, num_pages: int, packed: bool):
+    """Quantized-pool variant of :func:`_paged_kernel`: the K/V tile is int8
+    (or nibble-packed int4) and dequantizes against its per-(head, page)
+    scale — read from SMEM next to the block table — inside the
+    online-softmax body. Same state machine as the dense kernel."""
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    ki = pl.program_id(2)
+    cur = len_ref[b]
+    page = tbl_ref[b, ki]
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(ki * page_size < cur)
+    def _tile():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # [1, Dh]
+        kq = k_ref[0, 0]  # [ps, Dp] int8
+        vq = v_ref[0, 0]
+        if packed:
+            k = unpack_kv_int4(kq)
+            v = unpack_kv_int4(vq)
+        else:
+            k = kq.astype(jnp.float32)
+            v = vq.astype(jnp.float32)
+        k = k * ks_ref[h, page]  # per-(head, page) symmetric dequant
+        v = v * vs_ref[h, page]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [1, ps]
+        s_pos = (ki * page_size
+                 + jax.lax.broadcasted_iota(jnp.int32, (1, page_size), 1))
+        s = jnp.where(s_pos < cur, s, NEG_INF)
+
+        m_prev = m_ref[...]
+        l_prev = l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot(p, v)
+
+    @pl.when(ki == num_pages - 1)
+    def _finalize():
+        l_safe = jnp.where(l_ref[...] == 0.0, 1.0, l_ref[...])
+        o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+def _paged_gather_attention(q, k_pages, v_pages, lens, tables, scale,
+                            k_scales=None, v_scales=None):
     """XLA fallback: materialize each request's pages contiguously (one
     gather), then the same masked softmax the dense reference computes — the
     value stream is arithmetically identical to attending over a contiguous
     cache holding the same tokens, so tests check it BITWISE against the
-    dense path."""
+    dense path (dense pools) and against dequantize-then-dense (quantized
+    pools: the fallback consumes the identical int payload, so the only
+    difference from a dense cache is the quantization itself)."""
     B = q.shape[0]
+    Dh = q.shape[-1]
 
-    # [H, B, pages, ps, Dh] -> [B, H, pages*ps, Dh]
-    def gather(pages):
-        g = pages[:, tables]          # [H, B, n, ps, Dh]
+    # [H, B, pages, ps, Dp] -> [B, H, pages*ps, Dh]
+    def gather(pages, scales):
+        g = pages[:, tables]          # [H, B, n, ps, Dp]
+        if scales is not None:
+            g = (unpack_kv_int4(g) if g.shape[-1] * 2 == Dh
+                 else g.astype(jnp.float32))
+            g = g * scales[:, tables][..., None, None]
         g = g.transpose(1, 0, 2, 3, 4)
         return g.reshape(B, g.shape[1], -1, g.shape[-1])
 
-    k = gather(k_pages)
-    v = gather(v_pages)
+    k = gather(k_pages, k_scales)
+    v = gather(v_pages, v_scales)
     s = jnp.einsum("bthd,bhsd->bhts", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     S = k.shape[2]

@@ -158,8 +158,19 @@ def report_from_compiled(compiled, compile_s: float) -> Dict[str, Any]:
     refuses over-HBM programs at compile time (see :func:`oom_row`)."""
     ma = compiled.memory_analysis()
     ca = compiled.cost_analysis() or {}
+    if isinstance(ca, (list, tuple)):  # older jax: one dict per module
+        ca = ca[0] if ca else {}
     flops = float(ca.get("flops", 0.0))
-    peak_bytes = int(ma.peak_memory_in_bytes)
+    if hasattr(ma, "peak_memory_in_bytes"):
+        peak_bytes = int(ma.peak_memory_in_bytes)
+        peak_source = "xla_peak"
+    else:
+        # jaxlib builds whose CompiledMemoryStats drops the peak field:
+        # arguments + outputs + temps is the conservative resident-set
+        # bound (donation/aliasing would only lower it)
+        peak_bytes = int(ma.argument_size_in_bytes
+                         + ma.output_size_in_bytes + ma.temp_size_in_bytes)
+        peak_source = "sum(arg+out+temp)"
     fit = fit_verdict(peak_bytes)
     return {
         "compile_s": round(compile_s, 1),
@@ -168,6 +179,7 @@ def report_from_compiled(compiled, compile_s: float) -> Dict[str, Any]:
             "outputs": int(ma.output_size_in_bytes),
             "temp": int(ma.temp_size_in_bytes),
             "peak": peak_bytes,
+            "peak_source": peak_source,
             "code": int(ma.generated_code_size_in_bytes),
         },
         # margin-aware classification: a green compile inside the
@@ -326,13 +338,27 @@ def decode_program_report(
     cache_dtype: str = "bfloat16",
     quantize_bits: int = 0,
     tp: int = 1,
+    paged: bool = False,
+    kv_bits: int = 0,
+    page_size: int = 64,
 ) -> Dict[str, Any]:
     """Compile the generate-shaped program (prefill + a scan of single-token
     cached decode steps with greedy selection) for ``model`` against
     ``topology``. Reports per-device HBM (params + the [L,B,H,S,Dh] KV cache
     the fit actually hinges on) and per-token decode FLOPs. Mirrors
     InferenceEngine.generate's AOT structure (inference/engine.py) closely
-    enough that fit/FLOPs verdicts transfer."""
+    enough that fit/FLOPs verdicts transfer.
+
+    ``paged=True`` (implied by ``kv_bits``) compiles the SERVING-shaped
+    program instead: a scan of ``models/gpt.paged_decode_step`` over a page
+    pool sized so every slot can hold prompt+gen — the decode-phase fit the
+    continuous-batching admission limit actually hinges on. ``kv_bits``
+    (8/4) makes the pool quantized (int8/int4 payloads + per-page scales),
+    so the verdict prices the KV bytes the pool ACTUALLY holds — the
+    capacity lever the kv_bits serving knob buys. The paged probe uses the
+    XLA gather fallback (compile-only evidence must not hinge on Mosaic
+    int8 tiling); its per-layer gather temp slightly inflates peak vs the
+    streaming kernel, so the verdict is conservative."""
     from jax.experimental import topologies
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -341,6 +367,7 @@ def decode_program_report(
     mcfg = gpt_mod.PRESETS[model]
     total = prompt + gen + 8
     dt = jnp.bfloat16 if cache_dtype == "bfloat16" else jnp.float32
+    paged = paged or bool(kv_bits)
 
     with _env_override("DS_TPU_PALLAS_INTERPRET", "0"):
         td = topologies.get_topology_desc(platform="tpu",
@@ -348,29 +375,57 @@ def decode_program_report(
         mesh = Mesh(list(td.devices)[:tp], ("tp",))
         rep = NamedSharding(mesh, P())
 
-        def fn(params, input_ids, key):
-            cache = gpt_mod.init_cache(mcfg, batch, total, dt)
-            # cast FLOAT leaves to the compute dtype; int8 quantized stacks
-            # must stay int8 (the cached forward dequantizes per layer)
-            params = jax.tree_util.tree_map(
-                lambda x: (x.astype(dt)
-                           if jnp.issubdtype(x.dtype, jnp.floating) else x),
-                params)
-            logits, cache = gpt_mod.forward_with_cache(
-                mcfg, params, input_ids, cache)
-            next_tok = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        if paged:
+            pages_per_seq = -(-total // page_size)
+            num_pages = batch * pages_per_seq + 1
 
-            def body(carry, _):
-                cache, tok = carry
+            def fn(params, tables, lengths, tok):
+                cache = gpt_mod.init_paged_cache(
+                    mcfg, num_pages, page_size, dt,
+                    kv_bits=kv_bits or None)
+                params = jax.tree_util.tree_map(
+                    lambda x: (x.astype(dt)
+                               if jnp.issubdtype(x.dtype, jnp.floating)
+                               else x), params)
+
+                def body(carry, _):
+                    cache, tok, lengths = carry
+                    logits, cache = gpt_mod.paged_decode_step(
+                        mcfg, params, tok, cache, tables, lengths,
+                        impl="gather")
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    return (cache, nxt, lengths + 1), nxt
+
+                (_, _, _), toks = jax.lax.scan(
+                    body, (cache, tok, lengths), None, length=gen)
+                return toks.T
+        else:
+            def fn(params, input_ids, key):
+                cache = gpt_mod.init_cache(mcfg, batch, total, dt)
+                # cast FLOAT leaves to the compute dtype; int8 quantized
+                # stacks must stay int8 (the cached forward dequantizes per
+                # layer)
+                params = jax.tree_util.tree_map(
+                    lambda x: (x.astype(dt)
+                               if jnp.issubdtype(x.dtype, jnp.floating)
+                               else x), params)
                 logits, cache = gpt_mod.forward_with_cache(
-                    mcfg, params, tok[:, None], cache)
-                nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
-                return (cache, nxt), nxt
+                    mcfg, params, input_ids, cache)
+                next_tok = jnp.argmax(logits[:, -1, :],
+                                      axis=-1).astype(jnp.int32)
 
-            (_, _), toks = jax.lax.scan(
-                body, (cache, next_tok), None, length=gen - 1)
-            return jnp.concatenate(
-                [input_ids, next_tok[:, None], toks.T], axis=1)
+                def body(carry, _):
+                    cache, tok = carry
+                    logits, cache = gpt_mod.forward_with_cache(
+                        mcfg, params, tok[:, None], cache)
+                    nxt = jnp.argmax(logits[:, -1, :],
+                                     axis=-1).astype(jnp.int32)
+                    return (cache, nxt), nxt
+
+                (_, _), toks = jax.lax.scan(
+                    body, (cache, next_tok), None, length=gen - 1)
+                return jnp.concatenate(
+                    [input_ids, next_tok[:, None], toks.T], axis=1)
 
         def build_params(r):
             p = gpt_mod.init_params(mcfg, r)
@@ -396,17 +451,28 @@ def decode_program_report(
         else:
             a_params = tmap(lambda s: jax.ShapeDtypeStruct(
                 s.shape, s.dtype, sharding=rep), shapes)
-        a_ids = jax.ShapeDtypeStruct((batch, prompt), jnp.int32, sharding=rep)
-        a_key = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=rep)
-
         out: Dict[str, Any] = {
             "model": model, "topology": topology, "batch": batch,
             "prompt": prompt, "gen": gen, "cache_dtype": cache_dtype,
             "quantize_bits": quantize_bits, "tp": tp,
         }
+        if paged:
+            out.update({"paged": True, "kv_bits": kv_bits,
+                        "page_size": page_size})
+            pages_per_seq = -(-total // page_size)
+            a_tables = jax.ShapeDtypeStruct((batch, pages_per_seq),
+                                            jnp.int32, sharding=rep)
+            a_lens = jax.ShapeDtypeStruct((batch,), jnp.int32, sharding=rep)
+            a_tok = jax.ShapeDtypeStruct((batch,), jnp.int32, sharding=rep)
+            args = (a_params, a_tables, a_lens, a_tok)
+        else:
+            a_ids = jax.ShapeDtypeStruct((batch, prompt), jnp.int32,
+                                         sharding=rep)
+            a_key = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=rep)
+            args = (a_params, a_ids, a_key)
         t0 = time.perf_counter()
         try:
-            compiled = jax.jit(fn).lower(a_params, a_ids, a_key).compile()
+            compiled = jax.jit(fn).lower(*args).compile()
         except Exception as e:
             out.update(oom_row(e))
             return out
@@ -416,8 +482,18 @@ def decode_program_report(
         # decode steps dominate; per generated token (xla count — the decode
         # body is sliced per token so this one is close to truth)
         rep_fields["flops_per_token"] = round(flops / max(gen, 1))
-    kv_bytes = (2 * mcfg.n_layer * batch * mcfg.n_head * total
-                * mcfg.head_dim * (2 if cache_dtype == "bfloat16" else 4))
+    if paged:
+        # pool bytes as allocated: payload at kv_bits (+ fp32 per-page
+        # scales), page 0 included — this is the buffer the fit hinges on
+        pages_per_seq = -(-total // page_size)
+        num_pages = batch * pages_per_seq + 1
+        kv_bytes = int(round(
+            gpt_mod.paged_kv_bytes_per_token(mcfg, kv_bits or None,
+                                             page_size, dt)
+            * num_pages * page_size))
+    else:
+        kv_bytes = (2 * mcfg.n_layer * batch * mcfg.n_head * total
+                    * mcfg.head_dim * (2 if cache_dtype == "bfloat16" else 4))
     rep_fields["kv_cache_bytes"] = kv_bytes
     out.update(rep_fields)
     return out
@@ -656,7 +732,10 @@ def find_max_decode_batch(
     the topology (compile-time verdicts only — the serving-capacity analog of
     :func:`find_max_batch`; fit is KV-cache + weight bound). Marginal
     verdicts count as fitting but are flagged in the returned report's
-    ``fit`` field."""
+    ``fit`` field. Pass ``paged=True`` and/or ``kv_bits=8|4`` to ladder the
+    serving-shaped paged program instead — at int8 the KV pool halves, so
+    the same HBM fits roughly twice the decode slots (the kv_bits capacity
+    lever, measured at compile time)."""
     best_v, best, trace = _find_max(
         lambda b: decode_program_report(model, batch=b, **report_kwargs),
         "batch", lo, hi)
@@ -681,12 +760,19 @@ def serving_admission_limit(
     pool then re-divides the same KV HBM into pages, so admission control is
     two-tier: slots bound compute/peak-HBM (this verdict), pages bound
     resident tokens (the allocator). ``safety_margin`` scales the verdict
-    down (e.g. 0.9) to leave headroom for the prefill scratch cache."""
+    down (e.g. 0.9) to leave headroom for the prefill scratch cache.
+
+    ``kv_bits`` (8/4; forwarded with ``page_size`` into the probe) sizes
+    slots from QUANTIZED pools — ``ServingConfig(num_slots="auto",
+    kv_bits=8)`` resolves here, so the admission limit prices the KV bytes
+    the pool actually holds instead of dense pages (which under-admits ~2x
+    at int8)."""
     r = find_max_decode_batch(model, lo=lo, hi=hi, **report_kwargs)
     slots = int(r["max_batch"] * safety_margin)
     fit = (r.get("report") or {}).get("fit")
     return {"model": model, "max_slots": slots,
             "max_decode_batch": r["max_batch"], "fit": fit,
+            "kv_bits": int(report_kwargs.get("kv_bits", 0) or 0),
             "trace": r["trace"]}
 
 

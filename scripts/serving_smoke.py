@@ -12,6 +12,11 @@ preemption, plus an eos-terminated request. Asserts:
 3. the ``serving/unbucketed-decode-shape`` dslint rule stays silent on the
    serving loop's compile log and fires on a synthetic per-step recompile.
 
+The main smoke serves from int8 KV pages (``kv_bits=8``) — the quantized
+pools, scatter-time quantization, and fused-dequant decode path are on the
+tier-1 gate, and the greedy-equivalence assertion IS the documented
+quantization-tolerance bar (no argmax flips on this model).
+
 ``--chaos`` (docs/SERVING.md "Overload & failure") runs the recovery
 contract against the REAL engine instead: one injected dispatch-failure
 episode (every retry raises -> preempt-and-requeue -> heal) and one request
@@ -19,6 +24,12 @@ deadline expiry under load, asserting greedy outputs stay IDENTICAL to
 ``InferenceEngine.generate``, the page-conservation audit is clean, and the
 recovery events (``dispatch_error``/``dispatch_failed``/``deadline_miss``)
 were recorded.
+
+``--prefix`` (docs/SERVING.md "KV quantization & prefix caching") drives a
+chat-style mixed stream where every request opens with the same system
+prompt through a copy-on-write prefix-cache engine: physical pages
+allocated must undercut the sum of logical pages, greedy outputs must stay
+generate-identical, and the refcount audit must be clean after the drain.
 """
 
 import os
@@ -50,10 +61,14 @@ def main() -> int:
     params = G.init_params(cfg, jax.random.PRNGKey(0))
     # pool deliberately too small for all slots to max out -> preemption;
     # max_queue armed = the overload-safe config (and what keeps the
-    # serving/unbounded-admission rule silent below)
+    # serving/unbounded-admission rule silent below); kv_bits=8 = the
+    # quantized-pool config (the greedy-equivalence assert below is the
+    # documented quantization-tolerance bar, and the
+    # serving/dense-kv-at-capacity rule stays silent under pool pressure)
     eng = ServingEngine(cfg, params, ServingConfig(
         num_slots=3, page_size=8, max_model_len=64, prefill_chunk=16,
-        num_pages=12, dtype="float32", decode_block=4, max_queue=32))
+        num_pages=12, dtype="float32", decode_block=4, max_queue=32,
+        kv_bits=8))
     eng.warmup()
 
     wl = make_open_loop_workload(8, rate_rps=500.0, prompt_len=(3, 30),
@@ -63,7 +78,8 @@ def main() -> int:
                       max_new_tokens=6, arrival_time=0.01))
     rep = run_continuous(eng, wl)
     assert rep["finished"] == len(wl), rep
-    print(f"[smoke] {rep['finished']} finished, "
+    assert eng.paged_cache["k_pages"].dtype.name == "int8", "kv8 pool"
+    print(f"[smoke] {rep['finished']} finished (int8 KV pages), "
           f"{rep['preemptions']} preemptions, "
           f"{rep['compiled_programs']} compiled programs, "
           f"tokens/s={rep['tokens_per_sec']}")
@@ -230,5 +246,69 @@ def chaos_main() -> int:
     return 0
 
 
+def prefix_main() -> int:
+    """Copy-on-write prefix caching end to end (docs/SERVING.md "KV
+    quantization & prefix caching"): a mixed chat-style stream where every
+    request opens with the same system prompt must allocate FEWER physical
+    pages than the sum of logical pages, keep outputs generate-identical,
+    and drain with a clean refcount audit."""
+    cfg = G.GPTConfig(vocab_size=64, d_model=32, n_layer=2, n_head=4,
+                      max_seq_len=128)
+    params = G.init_params(cfg, jax.random.PRNGKey(0))
+    eng = ServingEngine(cfg, params, ServingConfig(
+        num_slots=3, page_size=8, max_model_len=96, prefill_chunk=64,
+        dtype="float32", decode_block=4, max_queue=32,
+        enable_prefix_cache=True))
+    eng.warmup()
+
+    # every request opens with the same 16-token system prompt (2 full
+    # pages at page_size 8) + its own suffix
+    sysp = (np.arange(16, dtype=np.int32) * 7 + 3) % 64
+    rng = np.random.default_rng(11)
+    wl, t = [], 0.0
+    for _ in range(10):
+        t += 0.002
+        n = int(rng.integers(2, 24))
+        wl.append(Request(
+            prompt=np.concatenate([sysp,
+                                   rng.integers(0, 64, (n,)).astype(np.int32)]),
+            max_new_tokens=int(rng.integers(3, 10)), arrival_time=t))
+    rep = run_continuous(eng, wl)
+    assert rep["finished"] == len(wl), rep
+    stats = rep["page_stats"]
+    assert stats["shared"] > 0, stats
+    assert stats["physical"] < stats["logical"], \
+        f"prefix caching shared nothing: {stats}"
+    print(f"[prefix] {rep['finished']} finished; physical pages "
+          f"{stats['physical']} < logical {stats['logical']} "
+          f"(ratio {rep['physical_logical_page_ratio']}, "
+          f"{stats['shared']} borrowed)")
+
+    # greedy equivalence: page sharing must be invisible in the outputs
+    ie = InferenceEngine(for_gpt(cfg, params), DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=96))
+    for r in wl:
+        ref = np.asarray(ie.generate(
+            np.asarray(r.prompt)[None],
+            max_new_tokens=r.max_new_tokens))[0, len(r.prompt):]
+        got = np.asarray(r.tokens[:r.max_new_tokens])
+        assert np.array_equal(ref, got), (r.rid, ref, got)
+    print("[prefix] greedy outputs identical to InferenceEngine.generate")
+
+    sched = eng.last_scheduler
+    rep_audit = sched.audit()
+    assert rep_audit["ok"], rep_audit
+    assert sched.allocator.allocated_pages == 0, "pages leaked"
+    assert len(sched.prefix_cache) == 0, "index entries outlived their pages"
+    print("[prefix] refcount audit clean, pool drained, index empty")
+
+    print("serving_smoke[prefix]: PASS")
+    return 0
+
+
 if __name__ == "__main__":
-    sys.exit(chaos_main() if "--chaos" in sys.argv[1:] else main())
+    if "--chaos" in sys.argv[1:]:
+        sys.exit(chaos_main())
+    if "--prefix" in sys.argv[1:]:
+        sys.exit(prefix_main())
+    sys.exit(main())

@@ -88,6 +88,20 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
 fi
 grep -a "serving_smoke: PASS" /tmp/_t1_serving_smoke.log || true
 
+# the prefix-caching smoke (docs/SERVING.md "KV quantization & prefix
+# caching"): a shared-system-prompt stream through the copy-on-write
+# prefix cache — physical pages < sum of logical pages, greedy outputs
+# generate-identical, refcount audit clean after the drain.
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        python scripts/serving_smoke.py --prefix \
+        > /tmp/_t1_serving_prefix.log 2>&1; then
+    echo "verify_tier1: FAIL — serving prefix-cache smoke" \
+         "(scripts/serving_smoke.py --prefix):" >&2
+    tail -30 /tmp/_t1_serving_prefix.log >&2
+    exit 1
+fi
+grep -a "serving_smoke\[prefix\]: PASS" /tmp/_t1_serving_prefix.log || true
+
 # the serving chaos smoke (docs/SERVING.md "Overload & failure"): one
 # injected dispatch-failure episode (preempt-and-requeue heal) and one
 # deadline expiry against the REAL engine, asserting generate-identical

@@ -88,6 +88,28 @@ def test_decode_report():
 
 
 @pytest.mark.slow
+def test_decode_report_paged_kv8():
+    """The serving-shaped paged probe: the quantized pool's bytes are the
+    payload actually allocated (int8 + fp32 per-page scales), roughly half
+    the dense bf16 pool — what lets the kv-aware ladder admit ~2x."""
+    from deepspeed_tpu.runtime.aot import decode_program_report
+
+    rd = decode_program_report("tiny", batch=4, prompt=32, gen=8,
+                               page_size=16, paged=True)
+    r8 = decode_program_report("tiny", batch=4, prompt=32, gen=8,
+                               page_size=16, kv_bits=8)
+    assert rd["paged"] and r8["paged"] and r8["kv_bits"] == 8
+    assert rd["fits_v5e_hbm"] and r8["fits_v5e_hbm"]
+    pages = 4 * (-(-(32 + 8 + 8) // 16)) + 1
+    per_tok = 2 * 2 * 4 * 16  # 2 tensors * L * H * Dh (tiny: 2/4/16)
+    assert rd["kv_cache_bytes"] == per_tok * pages * 16 * 2  # bf16
+    assert r8["kv_cache_bytes"] == (per_tok * pages * 16
+                                    + 2 * 2 * 4 * 4 * pages)  # int8+scales
+    assert r8["kv_cache_bytes"] < 0.6 * rd["kv_cache_bytes"]
+    json.dumps(rd), json.dumps(r8)
+
+
+@pytest.mark.slow
 def test_find_max_batch_ladder():
     from deepspeed_tpu.runtime.aot import find_max_batch
 

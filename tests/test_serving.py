@@ -6,23 +6,26 @@ import numpy as np
 import pytest
 
 import jax
+import jax.numpy as jnp
 
 from deepspeed_tpu.inference.serving import (ContinuousBatchingScheduler,
-                                             Request, RequestState,
-                                             bucket_for, default_buckets)
+                                             PrefixIndex, Request,
+                                             RequestState, bucket_for,
+                                             default_buckets)
 from deepspeed_tpu.models import gpt as G
 
 
 class FakeExecutor:
     """Deterministic device-free executor: prefill answers last+1, decode
-    answers prev+1 (mod 97). Lets the scheduler be tested alone."""
+    answers prev+1 (mod 97). Lets the scheduler be tested alone. ``start``
+    is only passed by prefix-cache schedulers (borrowed-page admissions)."""
 
     def __init__(self):
         self.prefills = []
         self.decode_calls = 0
 
-    def prefill(self, slot, tokens, table_row):
-        self.prefills.append((slot, len(tokens)))
+    def prefill(self, slot, tokens, table_row, start=0):
+        self.prefills.append((slot, len(tokens), int(start)))
         return (int(tokens[-1]) + 1) % 97
 
     def decode(self, tokens, tables, lengths, active, steps=1):
@@ -31,11 +34,11 @@ class FakeExecutor:
 
 
 def _sched(ex=None, num_slots=2, num_pages=16, page_size=4,
-           pages_per_seq=8, decode_block=1):
+           pages_per_seq=8, decode_block=1, **kw):
     return ContinuousBatchingScheduler(
         ex or FakeExecutor(), num_slots=num_slots, num_pages=num_pages,
         page_size=page_size, pages_per_seq=pages_per_seq,
-        decode_block=decode_block)
+        decode_block=decode_block, **kw)
 
 
 # ---------------------------------------------------------------- scheduler
@@ -154,6 +157,143 @@ def test_scheduler_uses_prefill_many_when_available():
     s.step()
     assert ex.batches and len(ex.batches[0]) == 3  # one batched admission
     assert not ex.prefills  # serial path unused
+
+
+# ------------------------------------------------ copy-on-write prefix reuse
+PREFIX = (np.arange(8, dtype=np.int32) + 1)  # 2 full pages at page_size=4
+
+
+def _prefix_reqs(n=3, max_new=4):
+    return [Request(prompt=np.concatenate(
+        [PREFIX, np.array([40 + i], np.int32)]), max_new_tokens=max_new)
+        for i in range(n)]
+
+
+def test_prefix_sharing_reuses_physical_pages_and_keeps_outputs():
+    """Requests sharing a page-aligned prompt prefix must reuse the first
+    writer's physical pages (physical < logical, shared counted), pass the
+    borrowed-page count to the executor as the scatter start, and produce
+    byte-identical outputs to a no-sharing run."""
+    a = _prefix_reqs()
+    s1 = _sched(num_slots=3, num_pages=32)
+    for r in a:
+        s1.submit(r)
+    s1.run_to_completion()
+    assert s1.page_stats["physical"] == s1.page_stats["logical"]
+
+    ex = FakeExecutor()
+    s2 = _sched(ex, num_slots=3, num_pages=32,
+                prefix_cache=PrefixIndex(4))
+    b = _prefix_reqs()
+    s2.submit(b[0])
+    s2.step()  # first writer admits alone -> its prefix pages register
+    for r in b[1:]:
+        s2.submit(r)
+    s2.run_to_completion()
+    assert [r.tokens for r in a] == [r.tokens for r in b]
+    # requests 2 and 3 each borrowed the 2 full prefix pages
+    assert s2.page_stats["shared"] == 4
+    assert s2.page_stats["physical"] < s2.page_stats["logical"]
+    # sharers scatter from position 8 (2 borrowed pages x page_size 4)
+    assert sorted(st for _, _, st in ex.prefills) == [0, 8, 8]
+    rep = s2.audit()
+    assert rep["ok"], rep
+    assert s2.allocator.allocated_pages == 0  # all refs drained
+    assert len(s2.prefix_cache) == 0          # entries died with the pages
+
+
+def test_prefix_sharing_preemption_keeps_audit_clean_and_outputs():
+    """Pool pressure preempting a request that HOLDS shared prefix pages:
+    the shared refcounts unwind correctly (audit clean after every step),
+    re-admission re-shares, and outputs equal the no-sharing run."""
+    def run(prefix_cache):
+        reqs = _prefix_reqs(n=2, max_new=16)
+        # 8 usable pages vs ~12 of joint peak demand (a 25-token context
+        # holds 6): BOTH runs must preempt — in the sharing run the victim
+        # is a request holding borrowed prefix pages, exactly the unwind
+        # the refcount audit has to survive
+        s = _sched(FakeExecutor(), num_slots=2, num_pages=9,
+                   prefix_cache=prefix_cache)
+        s.submit(reqs[0])
+        s.step()
+        s.submit(reqs[1])
+        for _ in range(200):
+            if s.idle:
+                break
+            s.step()
+            rep = s.audit()
+            assert rep["ok"], rep
+        assert s.idle
+        assert all(r.state is RequestState.FINISHED for r in reqs)
+        assert s.allocator.allocated_pages == 0
+        return [r.tokens for r in reqs], s, reqs
+
+    out_plain, s_plain, r_plain = run(None)
+    out_shared, s_shared, r_shared = run(PrefixIndex(4))
+    assert out_plain == out_shared
+    assert s_shared.page_stats["shared"] > 0
+    # both runs hit pool pressure; the sharing run preempted a request
+    # that was HOLDING shared prefix pages and still unwound cleanly
+    assert sum(r.preemptions for r in r_plain) >= 1
+    assert sum(r.preemptions for r in r_shared) >= 1
+    # sharing holds fewer physical pages, so pressure preempts no MORE
+    assert (sum(r.preemptions for r in r_shared)
+            <= sum(r.preemptions for r in r_plain))
+
+
+def test_prefix_sharing_deadline_evict_frees_borrowed_pages():
+    """A deadline-evicted request holding shared prefix pages must drop
+    only ITS references: the first writer keeps serving from the same
+    physical pages and the audit stays clean."""
+    t = {"now": 0.0}
+    s = _sched(FakeExecutor(), num_slots=2, num_pages=32,
+               prefix_cache=PrefixIndex(4), clock=lambda: t["now"])
+    keeper = Request(prompt=np.concatenate([PREFIX, np.array([40], np.int32)]),
+                     max_new_tokens=12)
+    s.submit(keeper)
+    s.step()
+    doomed = Request(prompt=np.concatenate([PREFIX, np.array([41], np.int32)]),
+                     max_new_tokens=12, deadline_s=0.5)
+    s.submit(doomed)
+    s.step()  # doomed admits, borrowing the 2 prefix pages
+    assert s.page_stats["shared"] == 2
+    shared_pages = s.prefix_cache.lookup(PREFIX)
+    assert all(s.allocator.refcount(p) == 2 for p in shared_pages)
+    t["now"] = 1.0  # past the e2e deadline
+    s.step()
+    assert doomed.state is RequestState.EXPIRED
+    rep = s.audit()
+    assert rep["ok"], rep
+    # the keeper still holds exactly one reference on the prefix pages
+    assert all(s.allocator.refcount(p) == 1 for p in shared_pages)
+    s.run_to_completion()
+    assert keeper.state is RequestState.FINISHED
+    assert len(keeper.tokens) == 12
+    assert s.allocator.allocated_pages == 0
+
+
+def test_prefix_sharing_never_blocks_pool_exhaustion_unwind():
+    """When the UNSHARED remainder cannot be allocated, the claimed shared
+    references must unwind (no refcount leak) and admission head-of-line
+    blocks as before."""
+    s = _sched(FakeExecutor(), num_slots=2, num_pages=6,  # 5 usable
+               prefix_cache=PrefixIndex(4))
+    big = Request(prompt=np.concatenate([PREFIX, np.arange(7, dtype=np.int32)]),
+                  max_new_tokens=2)  # 15+1 tokens -> 4 pages
+    s.submit(big)
+    s.step()  # running, 4 pages held, prefix registered
+    second = Request(prompt=np.concatenate([PREFIX,
+                                            np.arange(8, dtype=np.int32)]),
+                     max_new_tokens=4)  # needs 5 pages, 2 shared + 3 own
+    s.submit(second)
+    s.step()  # only 1 free page: claim must fail and fully unwind
+    rep = s.audit()
+    assert rep["ok"], rep
+    shared_pages = s.prefix_cache.lookup(PREFIX)
+    assert all(s.allocator.refcount(p) == 1 for p in shared_pages)
+    s.run_to_completion()
+    assert second.state is RequestState.FINISHED
+    assert s.allocator.allocated_pages == 0
 
 
 # ---------------------------------------------------------------- buckets
@@ -297,6 +437,82 @@ def test_unbounded_admission_rule_fires_and_stays_silent():
         [{"kind": "decode", "shape": (2, 4)}]).findings
 
 
+def test_dense_kv_at_capacity_rule_fires_and_stays_silent():
+    """WARNING when a serving config runs dense KV pages while either the
+    weight stacks are quantized or the last run showed pool-capacity
+    pressure; silent once kv_bits is set, and silent with no evidence."""
+    from deepspeed_tpu.analysis import analyze_compile_log
+    from deepspeed_tpu.inference.serving import ServingConfig
+
+    class Sched:
+        def __init__(self, **counters):
+            self.counters = counters
+
+    class Eng:  # duck-typed: the rule reads .serving/.params/.last_scheduler
+        compile_log = []
+
+        def __init__(self, cfg, params=None, sched=None):
+            self.serving = cfg
+            self.params = params or {"blocks": {"qkv_w": object()}}
+            self.last_scheduler = sched
+
+    q_params = {"blocks": {"qkv_w": {"q": 0, "s": 0}}}
+    safe = dict(max_queue=8)  # keep unbounded-admission out of the frame
+
+    # fires: quantized weights, dense KV
+    f = analyze_compile_log(Eng(ServingConfig(**safe), q_params)).findings
+    assert [x.rule_id for x in f] == ["serving/dense-kv-at-capacity"]
+    assert f[0].severity.name == "WARNING"
+    # fires: pool pressure evidence (preemptions / sheds) on dense KV
+    for counters in (dict(preemption=3), dict(request_shed=2)):
+        f = analyze_compile_log(
+            Eng(ServingConfig(**safe), None, Sched(**counters))).findings
+        assert [x.rule_id for x in f] == ["serving/dense-kv-at-capacity"], \
+            counters
+    # silent: kv_bits armed (either evidence kind present)
+    assert not analyze_compile_log(
+        Eng(ServingConfig(kv_bits=8, **safe), q_params,
+            Sched(preemption=5))).findings
+    # silent: dense weights, no pressure
+    assert not analyze_compile_log(
+        Eng(ServingConfig(**safe), None, Sched())).findings
+    # silent: non-serving contexts
+    assert not analyze_compile_log(
+        [{"kind": "decode", "shape": (2, 4)}]).findings
+
+
+def test_serving_kv8_greedy_matches_generate():
+    """int8 KV pages end-to-end through the serving stack: every request's
+    greedy tokens == InferenceEngine.generate on DENSE caches (the
+    documented per-page quantization tolerance does not flip any argmax on
+    this model/seed — the serving A/B's equivalence bar)."""
+    from deepspeed_tpu.inference import (DeepSpeedInferenceConfig,
+                                         InferenceEngine)
+    from deepspeed_tpu.inference.engine import for_gpt
+    from deepspeed_tpu.inference.serving import (ServingConfig, ServingEngine,
+                                                 make_open_loop_workload,
+                                                 run_continuous)
+
+    params = G.init_params(CFG, jax.random.PRNGKey(0))
+    eng = ServingEngine(CFG, params, ServingConfig(
+        num_slots=3, page_size=8, max_model_len=64, prefill_chunk=16,
+        dtype="float32", decode_block=4, max_queue=64, kv_bits=8))
+    assert eng.paged_cache["k_pages"].dtype == jnp.int8
+    assert eng.kv_bytes_per_token() < 4 * CFG.n_layer * CFG.n_head \
+        * CFG.head_dim  # < half the fp32 dense bytes
+    wl = make_open_loop_workload(6, rate_rps=1e4, prompt_len=(3, 30),
+                                 max_new=(2, 8), vocab_size=64, seed=3)
+    rep = run_continuous(eng, wl)
+    assert rep["finished"] == len(wl)
+    ie = InferenceEngine(for_gpt(CFG, params), DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=64))
+    for r in wl:
+        ref = np.asarray(ie.generate(np.asarray(r.prompt)[None],
+                                     max_new_tokens=r.max_new_tokens))
+        np.testing.assert_array_equal(
+            ref[0, len(r.prompt):], np.asarray(r.tokens[:r.max_new_tokens]))
+
+
 def test_inference_engine_decode_buckets_and_log():
     from deepspeed_tpu.inference import (DeepSpeedInferenceConfig,
                                          InferenceEngine)
@@ -328,12 +544,45 @@ def test_inference_engine_decode_buckets_and_log():
 def test_serving_admission_limit_plumbing(monkeypatch):
     from deepspeed_tpu.runtime import aot
 
-    monkeypatch.setattr(aot, "find_max_decode_batch",
-                        lambda model, lo=1, hi=64, **kw: {
-                            "model": model, "max_batch": 12,
-                            "trace": [{"batch": 1, "fits": True}],
-                            "report": {"fit": {"confidence": "fits"}}})
+    seen = {}
+
+    def fake_ladder(model, lo=1, hi=64, **kw):
+        seen.update(kw)
+        return {"model": model, "max_batch": 12,
+                "trace": [{"batch": 1, "fits": True}],
+                "report": {"fit": {"confidence": "fits"}}}
+
+    monkeypatch.setattr(aot, "find_max_decode_batch", fake_ladder)
     lim = aot.serving_admission_limit("gpt2-350m", safety_margin=0.75)
     assert lim["max_slots"] == 9
     assert lim["max_decode_batch"] == 12
     assert lim["fit"] == {"confidence": "fits"}
+    assert lim["kv_bits"] == 0
+    # kv_bits + page_size flow through to the compiled probe, so "auto"
+    # slots are sized from QUANTIZED pool bytes, not dense pages
+    lim = aot.serving_admission_limit("gpt2-350m", kv_bits=8, page_size=32)
+    assert seen["kv_bits"] == 8 and seen["page_size"] == 32
+    assert lim["kv_bits"] == 8
+
+
+def test_num_slots_auto_uses_quantized_ladder(monkeypatch):
+    """ServingConfig(num_slots='auto', kv_bits=8) must resolve through the
+    kv-aware fit ladder (the dense ladder under-admits ~2x at int8)."""
+    from deepspeed_tpu.inference.serving import ServingConfig, ServingEngine
+    from deepspeed_tpu.runtime import aot
+
+    seen = {}
+
+    def fake_limit(model, **kw):
+        seen.update(kw, model=model)
+        return {"max_slots": 2, "max_decode_batch": 2, "fit": None,
+                "kv_bits": kw.get("kv_bits", 0), "trace": []}
+
+    monkeypatch.setattr(aot, "serving_admission_limit", fake_limit)
+    params = G.init_params(CFG, jax.random.PRNGKey(0))
+    eng = ServingEngine(CFG, params, ServingConfig(
+        num_slots="auto", model_name="tiny", page_size=8, max_model_len=64,
+        prefill_chunk=16, dtype="float32", max_queue=8, kv_bits=8))
+    assert eng.num_slots == 2
+    assert seen["kv_bits"] == 8 and seen["page_size"] == 8
+    assert seen["model"] == "tiny"
