@@ -322,6 +322,7 @@ def _worker(cfg: dict) -> None:
           "serving": _worker_serving,
           "serving_overload": _worker_serving_overload,
           "serving_lever": _worker_serving_lever,
+          "serving_fleet": _worker_serving_fleet,
           "moe_train": _worker_moe_train,
           "kernels": _worker_kernels, "diffusion": _worker_diffusion,
           "pipeline_aot": _worker_pipeline_aot,
@@ -1125,6 +1126,167 @@ def _worker_serving_lever(cfg: dict) -> dict:
     }
 
 
+def _worker_serving_fleet(cfg: dict) -> dict:
+    """Fleet overload A/B at 2x saturation (docs/SERVING.md "Fleet"):
+    ``replicas`` router-fronted replica WORKER PROCESSES of ``slots``
+    slots each versus ONE engine with the same total slots, pool pages,
+    and admission bounds, on the same 2x-calibrated-saturation Poisson
+    workload scored against one SLO. Each replica owns its compute (a
+    process here, a chip allocation in production), and the router's
+    two-phase pump runs their steps concurrently — so one replica's
+    prefill never stalls another's decode, where the single engine
+    serializes every prefill against all of its running slots. The chaos
+    variant replays the same workload and SIGKILLs one replica
+    mid-stream: the row reports survivor page audits, re-route counts,
+    and the greedy match rate of surviving requests against the
+    fault-free fleet run. ``replica_env`` ({name: value-with-{i}}) pins
+    per-replica devices on multi-chip hosts."""
+    import dataclasses as _dc
+    from concurrent.futures import ThreadPoolExecutor
+
+    import jax
+
+    from deepspeed_tpu.inference.fleet import (FleetConfig, ReplicaRouter,
+                                               SubprocessReplica, run_fleet)
+    from deepspeed_tpu.inference.serving import (ServingConfig, ServingEngine,
+                                                 estimate_saturation_rps,
+                                                 make_open_loop_workload,
+                                                 run_continuous)
+    from deepspeed_tpu.models import gpt as gpt_mod
+
+    platform = jax.devices()[0].platform
+    mcfg = gpt_mod.PRESETS[cfg["model"]]
+    params = gpt_mod.init_params(mcfg, jax.random.PRNGKey(0))
+    n_rep = int(cfg.get("replicas", 2))
+    slots = int(cfg.get("slots", 2))          # per replica
+    page_size = int(cfg.get("page_size", 16))
+    max_len = int(cfg.get("max_model_len", 96))
+    prompt_rng = tuple(cfg.get("prompt_range", (8, 32)))
+    gen_rng = tuple(cfg.get("gen_range", (8, 24)))
+    n_req = int(cfg.get("requests", 24))
+    slo_s = float(cfg.get("slo_s", 3.0))
+    dtype = cfg.get("dtype", "float32")
+    pages_per_seq = -(-max_len // page_size)
+    # per-replica pool, overcommitted so capacity binds at 2x saturation
+    pool = int(cfg.get("pool_pages",
+                       max(pages_per_seq + 1, slots * pages_per_seq // 2)))
+
+    def serving_kw(num_slots, pages):
+        # queues deep enough that the TTFT deadline — not the depth cap —
+        # is the binding overload control: the A/B compares deadline
+        # behavior, and a shallow cap would shed everything first
+        return dict(
+            num_slots=num_slots, num_pages=pages + 1, page_size=page_size,
+            max_model_len=max_len,
+            prefill_chunk=int(cfg.get("prefill_chunk", 32)), dtype=dtype,
+            max_queue=int(cfg.get("queue_per_slot", 4)) * num_slots,
+            ttft_deadline_s=slo_s / 2, request_deadline_s=slo_s)
+
+    def build_engine(num_slots, pages):
+        eng = ServingEngine(mcfg, params,
+                            ServingConfig(**serving_kw(num_slots, pages)))
+        eng.warmup()
+        return eng
+
+    model_dict = _dc.asdict(mcfg)
+
+    def spawn(i):
+        env = {k: str(v).format(i=i)
+               for k, v in (cfg.get("replica_env") or {}).items()}
+        return SubprocessReplica(f"r{i}", model_dict,
+                                 serving_kw(slots, pool), seed=0,
+                                 env=env or None)
+
+    def build_fleet():
+        # spawn concurrently: each ctor blocks on its worker's warmup
+        with ThreadPoolExecutor(n_rep) as ex:
+            reps = list(ex.map(spawn, range(n_rep)))
+        return ReplicaRouter(reps, FleetConfig(
+            reroute_budget=2, heartbeat_deadline_s=120.0))
+
+    # equal-resources baseline: one scheduler over ALL the slots and pages
+    single_eng = build_engine(n_rep * slots, n_rep * pool)
+    sat = estimate_saturation_rps(single_eng, prompt_rng, gen_rng,
+                                  mcfg.vocab_size)
+    rate = float(cfg.get("overload_factor", 2.0)) * sat
+    seed = int(cfg.get("seed", 5))
+
+    def workload():
+        return make_open_loop_workload(n_req, rate, prompt_rng, gen_rng,
+                                       mcfg.vocab_size, seed=seed)
+
+    wall = float(cfg.get("max_wall_s", 120.0))
+    wl_single = workload()
+    single = run_continuous(single_eng, wl_single, max_wall_s=wall,
+                            slo_s=slo_s)
+
+    router = build_fleet()
+    wl_fleet = workload()
+    fleet = run_fleet(router, wl_fleet, max_wall_s=wall, slo_s=slo_s)
+    router.close()
+
+    # chaos variant: identical workload, one replica killed mid-stream
+    chaos_router = build_fleet()
+    wl_chaos = workload()
+    killed = {"done": False}
+    kill_after = int(cfg.get("kill_after_tokens", 40))
+
+    def on_step(rt, produced_total):
+        if not killed["done"] and produced_total >= kill_after:
+            victim = rt.replica("r0")
+            if victim is not None and victim.alive:
+                victim.kill()
+                killed["done"] = True
+
+    chaos = run_fleet(chaos_router, wl_chaos, max_wall_s=wall, slo_s=slo_s,
+                      on_step=on_step)
+    chaos_audit = chaos_router.audit_survivors()
+    chaos_drained = all(r["allocated"] == 0
+                        for r in chaos_audit["replicas"].values())
+    chaos_router.close()
+    # surviving (finished in both the fault-free fleet run and the
+    # killed-replica run) requests must be greedy-IDENTICAL: failover is
+    # recompute, not approximation
+    pairs = [(a, b) for a, b in zip(wl_fleet, wl_chaos)
+             if a.t_done is not None and b.t_done is not None]
+    match = sum(a.tokens[:a.max_new_tokens] == b.tokens[:b.max_new_tokens]
+                for a, b in pairs)
+
+    return {
+        "config": cfg["name"], "kind": "serving_fleet",
+        "platform": platform, "model": cfg["model"],
+        "replicas": n_rep, "slots_per_replica": slots,
+        "total_slots": n_rep * slots, "pool_pages_per_replica": pool,
+        "saturation_rps": round(sat, 3), "rate_rps": round(rate, 3),
+        "slo_s": slo_s, "requests": n_req,
+        "goodput_tokens_per_sec": fleet["goodput_tokens_per_sec"],
+        "deadline_miss_rate": fleet["deadline_miss_rate"],
+        "ttft_p50_ms": fleet["ttft_p50_ms"],
+        "ttft_p99_ms": fleet["ttft_p99_ms"],
+        "shed_rate": fleet["shed_rate"],
+        "single_goodput_tokens_per_sec": single["goodput_tokens_per_sec"],
+        "single_deadline_miss_rate": single["deadline_miss_rate"],
+        "single_ttft_p50_ms": single["ttft_p50_ms"],
+        "single_ttft_p99_ms": single["ttft_p99_ms"],
+        "single_shed_rate": single["shed_rate"],
+        "fleet_beats_single_goodput":
+            fleet["goodput_tokens_per_sec"]
+            > single["goodput_tokens_per_sec"],
+        "fleet_beats_single_miss_rate":
+            fleet["deadline_miss_rate"] < single["deadline_miss_rate"],
+        "fleet_audit_ok": fleet["fleet_audit_ok"],
+        # chaos: replica r0 killed mid-stream
+        "chaos_killed": killed["done"],
+        "chaos_reroutes": chaos["reroutes"],
+        "chaos_survivor_audit_ok": bool(chaos_audit["ok"]),
+        "chaos_survivor_pools_drained": bool(chaos_drained),
+        "chaos_goodput_tokens_per_sec": chaos["goodput_tokens_per_sec"],
+        "greedy_match_rate": round(match / max(len(pairs), 1), 4),
+        "greedy_pairs_compared": len(pairs),
+        "fleet_run": fleet, "single_run": single, "chaos_run": chaos,
+    }
+
+
 def _worker_diffusion(cfg: dict) -> dict:
     """Stable-Diffusion latent inference (BASELINE.json config #5) on the
     FAITHFUL SD-1.x architecture (CrossAttn UNet + AutoencoderKL decoder):
@@ -1672,6 +1834,18 @@ def tpu_core_configs() -> list:
          "max_model_len": 512, "prefill_chunk": 128, "requests": 32,
          "slo_s": 6.0, "prompt_range": (32, 160), "gen_range": (8, 128),
          "dtype": "bfloat16", "timeout": 2700},
+        # fleet flagship: 2 router-fronted replica processes vs one engine
+        # at equal total slots at 2x saturation + the replica-kill chaos
+        # variant — graceful degradation a single replica cannot produce.
+        # Prefill-heavy (TTFT-bound) shape; replica_env pins one chip per
+        # worker so replicas own their compute (two processes cannot share
+        # one TPU runtime)
+        {"kind": "serving_fleet", "name": f"{model}-serving-fleet",
+         "model": model, "replicas": 2, "slots": 8, "page_size": 128,
+         "max_model_len": 512, "prefill_chunk": 128, "requests": 32,
+         "slo_s": 6.0, "prompt_range": (128, 384), "gen_range": (8, 32),
+         "replica_env": {"TPU_VISIBLE_DEVICES": "{i}"},
+         "dtype": "bfloat16", "timeout": 2700},
         {"kind": "diffusion", "name": "sd-ddim20", "latent": 32,
          "ddim_steps": 20, "timeout": 2700},
         # measured MoE row (VERDICT r4 next #5): single-chip expert bank,
@@ -1778,6 +1952,22 @@ def cpu_fallback_configs() -> list:
          "requests": 16, "slo_s": 3.0, "prefix_len": 32,
          "prompt_range": (4, 16), "gen_range": (8, 24),
          "dtype": "float32", "force_cpu": True, "timeout": 900},
+    ] + [
+        # fleet overload A/B at 2x saturation (docs/SERVING.md "Fleet"):
+        # 2 router-fronted replica PROCESSES vs one engine at equal total
+        # slots — the fleet must beat the single scheduler on goodput AND
+        # deadline-miss rate, and the replica-kill chaos variant must show
+        # zero survivor page leaks with greedy_match_rate 1.0. The
+        # workload is prefill-heavy (long prompts, short gens — the
+        # TTFT-bound chat shape): that is where per-replica compute bites,
+        # because a single engine serializes every prefill against all of
+        # its running slots while replicas prefill concurrently
+        {"kind": "serving_fleet", "name": "cpu-serving-fleet",
+         "model": "gpt2-125m", "replicas": 2, "slots": 2, "page_size": 16,
+         "max_model_len": 128, "prefill_chunk": 64, "pool_pages": 16,
+         "requests": 48, "slo_s": 4.0, "prompt_range": (64, 112),
+         "gen_range": (4, 8), "dtype": "float32", "force_cpu": True,
+         "timeout": 1200},
     ] + [{"kind": "inference", "name": "cpu-fallback-decode", "model": "gpt2-125m",
           "batch": 1, "prompt": 32, "gen": 16, "reps": 3, "force_cpu": True},
          # real-TPU-compiler evidence even when the tunnel is down

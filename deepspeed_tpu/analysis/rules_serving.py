@@ -203,6 +203,60 @@ class DenseKVAtCapacityRule(Rule):
         )
 
 
+class FleetWithoutFailoverRule(Rule):
+    """A fleet config running >= 2 replicas with NO failure detection
+    armed: neither a heartbeat deadline (hung-replica eviction) nor a
+    re-route budget (dead-replica work recovery).
+
+    A single replica dying loses its own in-flight work — painful but
+    bounded, and the supervisor restarts it. A FLEET exists precisely so
+    replica death is survivable; with both knobs off, the router keeps a
+    dead or wedged replica in the placement set forever (every request
+    routed there is silently lost, a hung replica never trips anything)
+    and re-routes nothing — multi-replica cost, single-replica
+    availability. The check reads a router-shaped object
+    (``inference/fleet.ReplicaRouter``: a ``replicas`` sequence plus a
+    ``config`` with the failover pair) handed to the analyzer as the
+    engine, e.g. ``analyze_compile_log(router)``."""
+
+    rule_id = "serving/fleet-without-failover"
+    default_severity = Severity.WARNING
+    description = "multi-replica fleet with no heartbeat or re-route armed"
+
+    def check_context(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        obj = ctx.engine
+        cfg = getattr(obj, "config", None) if obj is not None else None
+        replicas = getattr(obj, "replicas", None)
+        if (replicas is None or cfg is None
+                or not hasattr(cfg, "reroute_budget")):
+            return  # not a fleet router
+        try:
+            n = len(replicas)
+        except TypeError:
+            return
+        if n < 2:
+            return  # one replica: death is the supervisor's problem
+        armed = getattr(cfg, "failover_armed", None)
+        if armed is None:  # duck-typed config without the property
+            armed = (getattr(cfg, "heartbeat_deadline_s", None) is not None
+                     or (getattr(cfg, "reroute_budget", 0) or 0) >= 1)
+        if armed:
+            return
+        yield self.finding(
+            f"fleet runs {n} replicas with no failover armed: "
+            f"heartbeat_deadline_s is unset (a hung replica is never "
+            f"evicted from placement) and reroute_budget < 1 (a dead "
+            f"replica's in-flight and queued requests are dropped instead "
+            f"of re-issued to survivors) — multi-replica cost with "
+            f"single-replica availability",
+            location="FleetConfig",
+            suggestion="arm FleetConfig(heartbeat_deadline_s=...) so hung "
+                       "replicas fail over, and/or reroute_budget >= 1 so "
+                       "a dead replica's accepted work re-routes with kept "
+                       "tokens — see docs/SERVING.md 'Fleet'",
+        )
+
+
 def serving_rules() -> List[Rule]:
     return [UnbucketedDecodeShapeRule(), UnboundedAdmissionRule(),
-            DenseKVAtCapacityRule()]
+            DenseKVAtCapacityRule(), FleetWithoutFailoverRule()]

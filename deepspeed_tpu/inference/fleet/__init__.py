@@ -1,0 +1,34 @@
+"""Fleet-scale serving: a replica router over N scheduler+engine pairs.
+
+See ``docs/SERVING.md`` "Fleet". Layering:
+
+- :mod:`.replica` — the process-boundary-shaped replica protocol and the
+  in-process :class:`LocalReplica` handle.
+- :mod:`.worker` — the same replica behind stdin/stdout JSON lines
+  (:class:`SubprocessReplica` + the ``python -m ...fleet.worker`` main);
+  a SIGKILL'd worker surfaces as :class:`ReplicaDeadError`.
+- :mod:`.router` — placement (least-loaded + session affinity with
+  spill), backpressure shed-to-sibling over typed admission verdicts,
+  heartbeat/failure-budget death detection, re-route with kept tokens,
+  drain-then-retire.
+- :mod:`.autoscale` — :class:`AutoscalePolicy` over the merged
+  ``Serving/*`` event stream; replica sizing stays with the AOT fit
+  ladder (``runtime/aot.serving_admission_limit`` /
+  ``fleet_replica_plan``).
+- :mod:`.bench` — the open-loop fleet driver sharing the serving bench
+  report schema.
+"""
+
+from .autoscale import AutoscalePolicy, FleetAutoscaler, summarize_events
+from .bench import run_fleet
+from .replica import LocalReplica, ReplicaDeadError, request_spec
+from .router import FleetConfig, ReplicaRouter
+from .worker import SubprocessReplica
+
+__all__ = [
+    "AutoscalePolicy", "FleetAutoscaler", "summarize_events",
+    "run_fleet",
+    "LocalReplica", "ReplicaDeadError", "request_spec",
+    "FleetConfig", "ReplicaRouter",
+    "SubprocessReplica",
+]

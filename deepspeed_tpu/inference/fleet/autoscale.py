@@ -1,0 +1,169 @@
+"""Admission-driven autoscaling: capacity decisions from the merged
+``Serving/*`` event stream.
+
+The fleet's load truth is already flowing: every replica's scheduler emits
+typed admission verdicts, shed/deadline-miss/preemption events, and the
+router adds fleet-level rejections and re-routes. :class:`AutoscalePolicy`
+is the pure decision function over a trailing window of that merged stream
+(plus the router's slot-occupancy snapshot):
+
+- **scale up** when the fleet is refusing work it was asked to do — the
+  fleet-level rejection rate crosses ``shed_rate_up``, or deadline misses
+  are both present and TRENDING up across the window (the leading edge of
+  the Gemma-paper capacity-vs-SLO degradation curve a single replica
+  cannot flatten);
+- **scale down** when the window shows no rejections and no misses AND the
+  fleet's remaining work would fit the surviving replicas with headroom
+  (occupancy below ``down_occupancy`` of the post-retire fleet) — executed
+  as drain-then-retire, never an abrupt close;
+- **hold** otherwise, and always inside ``cooldown_s`` of the last action
+  (capacity changes must observe their own effect before the next one).
+
+Replica SIZING is not decided here: a new replica's slot count comes from
+the AOT fit ladder (``runtime/aot.serving_admission_limit`` /
+``fleet_replica_plan`` — compile-time verdicts, ``ServingConfig(
+num_slots="auto")``); the policy only decides HOW MANY such replicas run.
+
+:class:`FleetAutoscaler` binds a policy to a router and a
+``replica_factory``; drivers call :meth:`FleetAutoscaler.tick` at their own
+cadence (the event window, not the tick rate, sets the reaction speed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+from .router import ReplicaRouter
+
+
+def summarize_events(events: Iterable[Dict[str, Any]], now: float,
+                     window_s: float) -> Dict[str, Any]:
+    """Reduce a merged fleet event stream to the window aggregates the
+    policy consumes. ``events`` are dicts with ``unix_time``/``event``
+    (the router's in-memory window, or :func:`~...resilience.events.
+    read_events` over per-replica logs). ``miss_trend`` is late-half minus
+    early-half deadline misses — positive means the SLO is degrading
+    *within* the window, not just loaded."""
+    lo = now - float(window_s)
+    mid = now - float(window_s) / 2.0
+    routed = rejected = misses_early = misses_late = reroutes = 0
+    for e in events:
+        t = float(e.get("unix_time", 0.0))
+        if t < lo or t > now:
+            continue
+        ev = e.get("event")
+        if ev == "request_routed":
+            routed += 1
+        elif ev == "fleet_reject":
+            rejected += 1
+        elif ev == "deadline_miss":
+            if t >= mid:
+                misses_late += 1
+            else:
+                misses_early += 1
+        elif ev == "request_rerouted":
+            reroutes += 1
+    submitted = routed + rejected
+    misses = misses_early + misses_late
+    return {
+        "window_s": float(window_s),
+        "submitted": submitted,
+        "routed": routed,
+        "rejected": rejected,
+        "shed_rate": rejected / submitted if submitted else 0.0,
+        "deadline_misses": misses,
+        "miss_trend": misses_late - misses_early,
+        "reroutes": reroutes,
+    }
+
+
+@dataclasses.dataclass
+class AutoscalePolicy:
+    """Pure scale decision over one window summary (module docstring)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 8
+    window_s: float = 10.0
+    cooldown_s: float = 10.0
+    #: fleet-level rejection rate that demands more capacity
+    shed_rate_up: float = 0.05
+    #: deadline misses below this floor never trigger a scale-up (tiny
+    #: absolute counts trend noisily)
+    miss_floor: int = 2
+    #: scale down only when current occupancy would still fit the
+    #: POST-RETIRE fleet below this utilization
+    down_occupancy: float = 0.7
+
+    def decide(self, summary: Dict[str, Any], num_replicas: int,
+               occupancy: float, now: float,
+               last_action_t: Optional[float] = None) -> str:
+        """-> ``"scale_up"`` | ``"scale_down"`` | ``"hold"``."""
+        if last_action_t is not None and now - last_action_t < self.cooldown_s:
+            return "hold"
+        overloaded = (
+            summary.get("shed_rate", 0.0) > self.shed_rate_up
+            or (summary.get("deadline_misses", 0) >= self.miss_floor
+                and summary.get("miss_trend", 0) > 0))
+        if overloaded and num_replicas < self.max_replicas:
+            return "scale_up"
+        quiet = (summary.get("rejected", 0) == 0
+                 and summary.get("deadline_misses", 0) == 0)
+        if quiet and num_replicas > self.min_replicas:
+            # would the work fit n-1 replicas with headroom?
+            projected = occupancy * num_replicas / max(num_replicas - 1, 1)
+            if projected < self.down_occupancy:
+                return "scale_down"
+        return "hold"
+
+
+class FleetAutoscaler:
+    """Apply :class:`AutoscalePolicy` decisions to a router.
+
+    ``replica_factory(replica_id) -> handle`` builds a new replica (the
+    factory owns sizing — typically ``ServingConfig(num_slots="auto")``,
+    which resolves through ``runtime/aot.serving_admission_limit``).
+    Scale-down picks the least-loaded live replica and drains it; the
+    router retires it once its accepted work finishes.
+    """
+
+    def __init__(self, router: ReplicaRouter, policy: AutoscalePolicy,
+                 replica_factory: Callable[[str], Any],
+                 clock=time.time):
+        self.router = router
+        self.policy = policy
+        self.replica_factory = replica_factory
+        self.clock = clock
+        self._last_action_t: Optional[float] = None
+        self._spawned = 0
+        self.decisions: List[Dict[str, Any]] = []
+
+    def tick(self, now: Optional[float] = None) -> str:
+        now = self.clock() if now is None else now
+        summary = summarize_events(self.router.events, now,
+                                   self.policy.window_s)
+        live = self.router.live_replicas
+        decision = self.policy.decide(summary, len(live),
+                                      self.router.occupancy(), now,
+                                      self._last_action_t)
+        if decision == "scale_up":
+            self._spawned += 1
+            rep = self.replica_factory(f"scale{self._spawned}")
+            self.router.add_replica(rep)
+            self._last_action_t = now
+        elif decision == "scale_down":
+            victim = min(live, key=lambda r:
+                         (self.router._load_score(r), r.replica_id))
+            self.router.retire(victim.replica_id)
+            self._last_action_t = now
+        self.decisions.append({"t": now, "decision": decision,
+                               "summary": summary,
+                               "replicas": len(self.router.live_replicas)})
+        if decision != "hold":
+            self.router._record("autoscale_decision", decision=decision,
+                                replicas=len(self.router.live_replicas))
+        return decision
+
+
+__all__ = ["AutoscalePolicy", "FleetAutoscaler", "summarize_events"]

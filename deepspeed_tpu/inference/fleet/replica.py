@@ -1,0 +1,251 @@
+"""Replica handles: one serving replica behind a process-boundary-shaped
+protocol.
+
+A *replica* is one ``ServingEngine`` + ``ContinuousBatchingScheduler`` pair
+(one copy of the weights, one page pool, one admission limit). The fleet
+router (:mod:`.router`) never touches those objects directly — every
+interaction goes through a handle whose inputs and outputs are
+JSON-serializable dicts, so the same router code drives an in-process
+:class:`LocalReplica` and a :class:`~.worker.SubprocessReplica` living in
+another process (and, later, on another host). The protocol:
+
+- ``submit(spec) -> verdict dict`` — admission-control one request
+  (``spec`` from :func:`request_spec`: prompt, max_new, deadlines, KEPT
+  tokens from a previous replica, and the request's age so deadline clocks
+  survive a re-route). The dict mirrors
+  :class:`~..serving.scheduler.AdmissionVerdict`.
+- ``pump(max_steps) -> snapshot dict`` — run up to ``max_steps`` scheduler
+  steps and report progress: per-request token streams (FULL lists — the
+  router's kept-token ledger is exactly what it has absorbed, which is what
+  re-routing preserves when this replica dies mid-block), newly
+  finished/expired/shed rids, scheduler counters, and a load snapshot.
+- ``load() -> dict`` — placement signals (queue depth, queued work tokens,
+  active slots, total slots, free pages).
+- ``heartbeat_age() -> float`` — seconds since the replica last proved
+  liveness; the router's hung-replica deadline reads this.
+- ``drain() / drained / draining`` — graceful scale-down
+  (``ContinuousBatchingScheduler.drain``: admit nothing new, finish
+  accepted work).
+- ``audit() -> dict`` — the page-conservation audit, run by the router
+  after every fleet recovery action.
+- ``close()`` (graceful) / ``kill()`` (hard stop). A dead handle raises
+  :class:`ReplicaDeadError` from every call — the router's signal to
+  re-route the replica's assigned requests to survivors.
+
+Token-stream discipline: a replica only reports a token AFTER the decode
+step that produced it completed, and the router only trusts what it
+absorbed. A replica killed mid-decode-block therefore leaves the router
+holding a *prefix* of the true greedy sequence — re-prefilling
+prompt+kept-tokens on a survivor recomputes the identical continuation
+(greedy decode is deterministic and every replica serves the same weights),
+which is the whole re-route correctness story.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ..serving.scheduler import Request, RequestState
+
+
+class ReplicaDeadError(RuntimeError):
+    """The replica behind this handle is gone (killed, crashed, or its
+    process stopped answering) — callers must re-route its work."""
+
+
+def request_spec(req: Request, age_s: float = 0.0) -> Dict[str, Any]:
+    """The JSON-safe wire form of one request, kept tokens included."""
+    return {
+        "rid": int(req.rid),
+        "prompt": [int(t) for t in np.asarray(req.prompt).tolist()],
+        "max_new_tokens": int(req.max_new_tokens),
+        "eos_token_id": (None if req.eos_token_id is None
+                         else int(req.eos_token_id)),
+        "tokens": [int(t) for t in req.tokens],
+        "ttft_deadline_s": req.ttft_deadline_s,
+        "deadline_s": req.deadline_s,
+        "session_id": req.session_id,
+        "age_s": float(max(age_s, 0.0)),
+    }
+
+
+def _verdict_dict(v) -> Dict[str, Any]:
+    return {"admitted": bool(v.admitted), "reason": v.reason,
+            "detail": v.detail,
+            "shed_rid": None if v.shed_rid is None else int(v.shed_rid)}
+
+
+class LocalReplica:
+    """In-process replica: the protocol above over a real scheduler.
+
+    Build from a :class:`~..serving.engine.ServingEngine` (the scheduler is
+    assembled via ``make_scheduler`` with a replica-stamped
+    :class:`~...resilience.events.RecoveryLog`) or hand a prebuilt
+    scheduler in directly (device-free tests drive a fake executor).
+    """
+
+    def __init__(self, replica_id: str, engine=None, scheduler=None,
+                 recovery_log=None, clock=time.monotonic):
+        if (engine is None) == (scheduler is None):
+            raise ValueError("pass exactly one of engine= or scheduler=")
+        self.replica_id = str(replica_id)
+        self.engine = engine
+        self.clock = clock
+        if recovery_log is None:
+            from ...resilience.events import RecoveryLog
+
+            recovery_log = RecoveryLog(role="serving", prefix="Serving",
+                                       replica_id=self.replica_id)
+        self.recovery_log = recovery_log
+        if scheduler is None:
+            scheduler = engine.make_scheduler(clock=clock,
+                                              recovery_log=recovery_log)
+        elif scheduler.recovery_log is None:
+            scheduler.recovery_log = recovery_log
+        self.sched = scheduler
+        self._alive = True
+        self._reqs: Dict[int, Request] = {}
+        self._reported_len: Dict[int, int] = {}
+        self._last_beat = clock()
+
+    # ----------------------------------------------------------- liveness
+    @property
+    def alive(self) -> bool:
+        return self._alive
+
+    def _check_alive(self) -> None:
+        if not self._alive:
+            raise ReplicaDeadError(f"replica {self.replica_id} is dead")
+
+    def heartbeat_age(self) -> float:
+        """Seconds since the last completed pump (a pump that returns —
+        even with zero tokens — proves the replica is making scheduling
+        progress)."""
+        return self.clock() - self._last_beat
+
+    # ----------------------------------------------------------- protocol
+    def submit(self, spec: Dict[str, Any]) -> Dict[str, Any]:
+        self._check_alive()
+        req = Request(
+            prompt=np.asarray(spec["prompt"], np.int32),
+            max_new_tokens=int(spec["max_new_tokens"]),
+            eos_token_id=spec.get("eos_token_id"),
+            ttft_deadline_s=spec.get("ttft_deadline_s"),
+            deadline_s=spec.get("deadline_s"),
+            session_id=spec.get("session_id"),
+            rid=int(spec["rid"]),
+        )
+        req.tokens = [int(t) for t in spec.get("tokens", ())]
+        # deadline clocks measure the request's LIFETIME: a re-routed
+        # request arrives pre-aged, not freshly submitted
+        req.t_submit = self.clock() - float(spec.get("age_s", 0.0))
+        if req.tokens:
+            # the first token was already delivered (by a previous replica
+            # or before a preemption) — TTFT must not re-arm
+            req.t_first_token = req.t_submit
+        verdict = self.sched.submit(req)
+        if verdict.admitted:
+            self._reqs[req.rid] = req
+            self._reported_len[req.rid] = len(req.tokens)
+        return _verdict_dict(verdict)
+
+    def pump(self, max_steps: int = 1) -> Dict[str, Any]:
+        """Run up to ``max_steps`` scheduler steps. Exceptions from the
+        scheduler (``ServingFaultError``, a failed page audit) propagate —
+        the router treats any raising pump as a replica failure."""
+        self._check_alive()
+        produced = 0
+        for _ in range(int(max_steps)):
+            if self.sched.idle:
+                break
+            produced += self.sched.step()
+        return self._snapshot(produced)
+
+    def _snapshot(self, produced: int) -> Dict[str, Any]:
+        tokens: Dict[int, List[int]] = {}
+        finished: List[int] = []
+        expired: List[int] = []
+        shed: List[int] = []
+        for rid, req in list(self._reqs.items()):
+            if len(req.tokens) > self._reported_len.get(rid, 0):
+                tokens[rid] = [int(t) for t in req.tokens]
+                self._reported_len[rid] = len(req.tokens)
+            if req.state is RequestState.FINISHED:
+                finished.append(rid)
+            elif req.state is RequestState.EXPIRED:
+                expired.append(rid)
+            elif req.state is RequestState.REJECTED:
+                # post-admission policy shed (reject_largest victim, or a
+                # drain rejecting re-queued work) — the router may re-place
+                shed.append(rid)
+        for rid in finished + expired + shed:
+            self._reqs.pop(rid, None)
+            self._reported_len.pop(rid, None)
+        self._last_beat = self.clock()
+        return {
+            "replica_id": self.replica_id,
+            "produced": int(produced),
+            "tokens": tokens,
+            "finished": finished,
+            "expired": expired,
+            "shed": shed,
+            "counters": dict(self.sched.counters),
+            "load": self.load(),
+            "draining": self.sched.draining,
+            "drained": self.sched.drained,
+        }
+
+    def load(self) -> Dict[str, Any]:
+        self._check_alive()
+        s = self.sched
+        running = [s.slots[i] for i in s.active_slots]
+        work = s.queued_tokens + sum(
+            r.max_new_tokens - len(r.tokens) for r in running)
+        return {
+            "replica_id": self.replica_id,
+            "queue_depth": len(s.queue),
+            "queued_tokens": int(s.queued_tokens),
+            "active": len(running),
+            "num_slots": int(s.num_slots),
+            "free_pages": int(s.allocator.free_pages),
+            "work_tokens": int(work),
+            "draining": s.draining,
+        }
+
+    @property
+    def draining(self) -> bool:
+        return self._alive and self.sched.draining
+
+    @property
+    def drained(self) -> bool:
+        return self._alive and self.sched.drained
+
+    def drain(self) -> None:
+        self._check_alive()
+        self.sched.drain()
+
+    def audit(self) -> Dict[str, Any]:
+        self._check_alive()
+        rep = self.sched.audit()
+        return {"ok": bool(rep["ok"]), "errors": list(rep["errors"]),
+                "free": int(rep["free"]), "allocated": int(rep["allocated"]),
+                "total": int(rep["total"]),
+                "page_stats": dict(rep.get("page_stats", {}))}
+
+    def close(self) -> None:
+        """Graceful stop (the caller drained first, or accepts the loss)."""
+        if self._alive:
+            self._alive = False
+            self.sched.close()
+
+    def kill(self) -> None:
+        """Hard stop — the SIGKILL analog. The scheduler's watchdog thread
+        is still stopped (it is OUR process), but no draining happens and
+        every subsequent call raises :class:`ReplicaDeadError`."""
+        self.close()
+
+
+__all__ = ["LocalReplica", "ReplicaDeadError", "request_spec"]

@@ -1,0 +1,527 @@
+"""Replica router: fleet-level placement, backpressure, and failover.
+
+One scheduler+engine pair is one replica; this router fronts N of them
+(docs/SERVING.md "Fleet"). Responsibilities, in the order they matter:
+
+- **placement** — least-loaded scoring over each replica's ``load()``
+  snapshot (queued + running work tokens, refreshed on every pump), with
+  **session affinity**: requests sharing a ``session_id`` stick to the
+  replica that served the session last, so its copy-on-write prefix pages
+  (PR 8) stay hot. Affinity *spills on pressure*: a sticky replica
+  answering ``queue_full``/``token_backlog`` loses the request (and the
+  session re-sticks wherever it lands) instead of queueing behind its own
+  backlog.
+- **backpressure shed-to-sibling** — a replica's typed
+  :class:`~..serving.scheduler.AdmissionVerdict` is a live load signal,
+  not a terminal answer: the router walks siblings in load order and only
+  returns a fleet-level rejection when EVERY placement-eligible replica
+  refused (``unservable`` is the exception — the request can never fit any
+  same-shaped replica, so it rejects immediately).
+- **failure-driven re-routing** — a replica that raises from a dispatch
+  (``ServingFaultError`` after the scheduler's failure budget), whose
+  process dies (:class:`~.replica.ReplicaDeadError`), or whose heartbeat
+  age exceeds ``heartbeat_deadline_s`` is removed from the fleet and its
+  assigned requests re-submitted to survivors with their absorbed tokens
+  KEPT (greedy re-prefill reproduces the exact continuation). Each request
+  carries a ``reroute_budget``; exhausting it is a loud typed rejection,
+  not a silent loop. Every failure handling pass ends with a survivor-wide
+  page-conservation audit.
+- **drain-then-retire** — ``retire()`` drains a replica
+  (:meth:`~..serving.scheduler.ContinuousBatchingScheduler.drain`), keeps
+  pumping it until its accepted work finished, then closes and removes it:
+  the autoscaler's scale-down path drops capacity without dropping work.
+
+The router is host-pure and replica-agnostic: everything it knows about a
+replica arrives through the :mod:`.replica` protocol dicts, so in-process
+and subprocess replicas mix freely. Fleet events (``replica_dead``,
+``request_rerouted``, ``fleet_reject``, ...) go to the router's own
+replica-stamped :class:`~...resilience.events.RecoveryLog` and to an
+in-memory window (:attr:`ReplicaRouter.events`) that
+:class:`~.autoscale.AutoscalePolicy` consumes merged with the per-replica
+counter deltas mirrored off every pump.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional
+
+from ..serving.scheduler import AdmissionVerdict, Request, RequestState
+from .replica import ReplicaDeadError, request_spec
+
+#: Replica-level events mirrored into the router's merged in-memory window
+#: (for autoscaling trends) off each pump's counter deltas. Kept small on
+#: purpose: these are the capacity/SLO signals, not the whole recovery
+#: vocabulary.
+MIRRORED_COUNTERS = ("deadline_miss", "request_shed", "preemption",
+                     "dispatch_failed")
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Fleet-level knobs. The failover pair — ``heartbeat_deadline_s`` and
+    ``reroute_budget`` — is what the ``serving/fleet-without-failover``
+    dslint rule checks: a multi-replica fleet with neither armed silently
+    loses every in-flight request of the first replica that dies."""
+
+    #: seconds of heartbeat silence before a replica is declared hung and
+    #: failed over (None = never — the rule-flagged default)
+    heartbeat_deadline_s: Optional[float] = None
+    #: how many times one request may be re-routed off a failed replica
+    #: before the fleet gives up on it (0 = never re-route)
+    reroute_budget: int = 2
+    #: same-session requests stick to their last replica (prefix-cache
+    #: locality); spill-on-pressure still applies
+    session_affinity: bool = True
+    #: walk siblings on queue_full/token_backlog before rejecting
+    spill: bool = True
+    #: scheduler steps per replica per router step
+    pump_steps: int = 1
+    #: in-memory fleet event window (entries, for autoscale trends)
+    event_window: int = 4096
+
+    @property
+    def failover_armed(self) -> bool:
+        return self.heartbeat_deadline_s is not None or self.reroute_budget >= 1
+
+
+class ReplicaRouter:
+    """Front N replica handles with placement, backpressure, and failover
+    (module docstring). ``replicas``: handles implementing the
+    :mod:`.replica` protocol. ``recovery_log``: fleet-level event sink
+    (optional; an in-memory window is always kept)."""
+
+    def __init__(self, replicas, config: Optional[FleetConfig] = None,
+                 recovery_log=None, clock=time.monotonic):
+        self.replicas = list(replicas)   # placement-eligible or draining
+        self.dead: List[Any] = []
+        self.retired: List[Any] = []
+        self.config = config or FleetConfig()
+        self.recovery_log = recovery_log
+        self.clock = clock
+        self.counters: Dict[str, int] = {}
+        self.events: Deque[Dict[str, Any]] = deque(
+            maxlen=self.config.event_window)
+        self._requests: Dict[int, Request] = {}
+        self._assignment: Dict[int, str] = {}   # rid -> replica_id
+        self._reroutes: Dict[int, int] = {}
+        self._affinity: Dict[str, str] = {}     # session_id -> replica_id
+        self._last_load: Dict[str, Dict[str, Any]] = {}
+        self._last_counters: Dict[str, Dict[str, int]] = {}
+        # bounded: a long-lived router must not grow with total requests
+        # served (terminal requests are dropped from the ledgers above the
+        # moment they finalize; callers keep their own Request objects)
+        self.finished: Deque[Request] = deque(
+            maxlen=self.config.event_window)
+
+    # ------------------------------------------------------------- events
+    def _record(self, event: str, persist: bool = True,
+                **fields: Any) -> None:
+        self.counters[event] = self.counters.get(event, 0) + 1
+        entry = {"unix_time": time.time(), "event": event, **fields}
+        self.events.append(entry)
+        if persist and self.recovery_log is not None:
+            try:
+                self.recovery_log.record(event, **fields)
+            except Exception:  # event export must never fail routing
+                pass
+
+    def _mirror_counters(self, replica_id: str,
+                         counters: Dict[str, int]) -> None:
+        """Turn per-replica counter deltas into window events so autoscale
+        trend math sees the MERGED fleet stream without double-writing the
+        replicas' own recovery logs."""
+        prev = self._last_counters.get(replica_id, {})
+        for name in MIRRORED_COUNTERS:
+            for _ in range(counters.get(name, 0) - prev.get(name, 0)):
+                self._record(name, persist=False, replica_id=replica_id)
+        self._last_counters[replica_id] = dict(counters)
+
+    # ---------------------------------------------------------- placement
+    @property
+    def live_replicas(self) -> List[Any]:
+        """Placement-eligible replicas (alive and not draining)."""
+        return [r for r in self.replicas if r.alive and not r.draining]
+
+    def replica(self, replica_id: str):
+        for r in self.replicas:
+            if r.replica_id == replica_id:
+                return r
+        return None
+
+    def _load_score(self, rep) -> int:
+        load = self._last_load.get(rep.replica_id)
+        if load is None:
+            try:
+                load = rep.load()
+            except ReplicaDeadError:
+                return 1 << 30
+            self._last_load[rep.replica_id] = load
+        return int(load.get("work_tokens", 0))
+
+    def _placement_order(self, req: Request) -> List[Any]:
+        live = self.live_replicas
+        order = sorted(live, key=lambda r: (self._load_score(r),
+                                            r.replica_id))
+        if self.config.session_affinity and req.session_id is not None:
+            sticky = self._affinity.get(req.session_id)
+            for i, r in enumerate(order):
+                if r.replica_id == sticky and i > 0:
+                    order.insert(0, order.pop(i))
+                    break
+        return order
+
+    def _place(self, req: Request, pending: List[Request]
+               ) -> AdmissionVerdict:
+        """Try every eligible replica in placement order. ``pending``
+        collects requests orphaned by replicas that die DURING placement
+        (the caller keeps re-routing them — no recursion)."""
+        now = self.clock()
+        age = 0.0 if req.t_submit is None else now - req.t_submit
+        last: Optional[Dict[str, Any]] = None
+        tried = 0
+        for rep in self._placement_order(req):
+            tried += 1
+            try:
+                verdict = rep.submit(request_spec(req, age_s=age))
+            except ReplicaDeadError as e:
+                pending.extend(self._fail_replica(rep, e))
+                continue
+            if verdict["admitted"]:
+                self._requests[req.rid] = req
+                self._assignment[req.rid] = rep.replica_id
+                load = self._last_load.get(rep.replica_id)
+                if load is not None:  # keep the score fresh between pumps
+                    load["work_tokens"] = (load.get("work_tokens", 0)
+                                           + req.work_tokens)
+                if req.session_id is not None and self.config.session_affinity:
+                    prev = self._affinity.get(req.session_id)
+                    if prev is not None and prev != rep.replica_id:
+                        self._record("session_spilled", persist=False,
+                                     session_id=req.session_id,
+                                     from_replica=prev,
+                                     replica_id=rep.replica_id)
+                    self._affinity[req.session_id] = rep.replica_id
+                self._record("request_routed", persist=False, rid=req.rid,
+                             replica_id=rep.replica_id)
+                return AdmissionVerdict(
+                    True, detail=f"replica {rep.replica_id}",
+                    shed_rid=verdict.get("shed_rid"))
+            last = verdict
+            if verdict["reason"] == "unservable":
+                # the bound is structural (prompt+max_new vs the serving
+                # shape) — no same-shaped sibling can do better
+                break
+            if not self.config.spill:
+                break
+        reason = last["reason"] if last else "no_replicas"
+        detail = (f"{tried} replica(s) refused; last: "
+                  f"{last['detail'] if last else 'no live replicas'}")
+        req.state = RequestState.REJECTED
+        req.reject_reason = reason
+        self._record("fleet_reject", rid=req.rid, reason=reason)
+        self._forget(req.rid)
+        return AdmissionVerdict(False, reason, detail)
+
+    def submit(self, req: Request) -> AdmissionVerdict:
+        """Fleet admission: place on the sticky/least-loaded replica,
+        spilling across siblings on backpressure; a rejection here means
+        the whole fleet refused."""
+        if req.t_submit is None:
+            req.t_submit = self.clock()
+        pending: List[Request] = []
+        verdict = self._place(req, pending)
+        self._drain_pending(pending)
+        return verdict
+
+    # ------------------------------------------------------------ failover
+    def _fail_replica(self, rep, err: BaseException) -> List[Request]:
+        """Remove a dead/hung replica and return the requests it held."""
+        if rep in self.dead:
+            return []
+        if rep in self.replicas:
+            self.replicas.remove(rep)
+        self.dead.append(rep)
+        try:
+            rep.kill()
+        except Exception:
+            pass
+        self._last_load.pop(rep.replica_id, None)
+        # a supervisor-restarted replacement may reuse the replica_id: its
+        # counter deltas must not be diffed against the dead one's totals
+        self._last_counters.pop(rep.replica_id, None)
+        for session, target in list(self._affinity.items()):
+            if target == rep.replica_id:
+                del self._affinity[session]
+        victims = [self._requests[rid]
+                   for rid, owner in list(self._assignment.items())
+                   if owner == rep.replica_id]
+        for req in victims:
+            del self._assignment[req.rid]
+        self._record("replica_dead", replica_id=rep.replica_id,
+                     error=f"{type(err).__name__}: {err}"[:200],
+                     in_flight=len(victims))
+        return victims
+
+    def _drain_pending(self, pending: List[Request]) -> None:
+        """Re-route every orphaned request (kept tokens preserved) until
+        the list is empty; replicas dying mid-re-route just extend it."""
+        audited = False
+        while pending:
+            req = pending.pop(0)
+            if req.state in (RequestState.FINISHED, RequestState.REJECTED,
+                             RequestState.EXPIRED):
+                continue
+            n = self._reroutes.get(req.rid, 0)
+            if n >= self.config.reroute_budget:
+                req.state = RequestState.REJECTED
+                req.reject_reason = "reroute_budget"
+                self._record("reroute_budget_exhausted", rid=req.rid,
+                             reroutes=n)
+                self._forget(req.rid)
+                continue
+            self._reroutes[req.rid] = n + 1
+            self._record("request_rerouted", rid=req.rid,
+                         kept_tokens=len(req.tokens), attempt=n + 1)
+            self._place(req, pending)
+            audited = True
+        if audited:
+            self.audit_survivors(raise_on_error=True)
+
+    def _handle_failure(self, rep, err: BaseException) -> None:
+        self._drain_pending(self._fail_replica(rep, err))
+        self.audit_survivors(raise_on_error=True)
+
+    def _check_heartbeats(self) -> None:
+        deadline = self.config.heartbeat_deadline_s
+        if deadline is None:
+            return
+        for rep in list(self.replicas):
+            if not rep.alive:
+                continue
+            try:
+                age = rep.heartbeat_age()
+            except Exception:
+                age = float("inf")
+            if age > deadline:
+                self._record("replica_hung", replica_id=rep.replica_id,
+                             age_s=round(age, 3), deadline_s=deadline)
+                self._handle_failure(rep, TimeoutError(
+                    f"heartbeat age {age:.2f}s > deadline {deadline}s"))
+
+    def audit_survivors(self, raise_on_error: bool = False
+                        ) -> Dict[str, Any]:
+        """Run the page-conservation audit on every live replica. Fleet
+        recovery must never leak pages on a SURVIVOR — a dead replica's
+        pool died with its process; the ones still serving must balance."""
+        reports: Dict[str, Any] = {}
+        ok = True
+        for rep in self.replicas:
+            if not rep.alive:
+                continue
+            try:
+                r = rep.audit()
+            except ReplicaDeadError:
+                continue
+            reports[rep.replica_id] = r
+            ok = ok and bool(r["ok"])
+        if not ok:
+            self._record("fleet_audit_failed", detail=str({
+                k: v["errors"] for k, v in reports.items()
+                if not v["ok"]})[:400])
+            if raise_on_error:
+                raise RuntimeError(
+                    f"fleet recovery broke page conservation on a "
+                    f"survivor: {reports}")
+        return {"ok": ok, "replicas": reports}
+
+    # ------------------------------------------------------------- stepping
+    def step(self) -> int:
+        """Pump every live replica once (``pump_steps`` scheduler steps
+        each), absorb their progress, check heartbeats, and retire drained
+        replicas. Returns tokens produced fleet-wide.
+
+        Handles exposing the two-phase ``pump_begin``/``pump_end`` pair
+        (subprocess replicas) are all STARTED before any response is
+        collected, so replicas that own their own compute run their steps
+        concurrently — one replica's prefill no longer stalls another's
+        decode, which is the wall-clock point of a fleet. Failures are
+        collected and handled only after every pending response is read:
+        re-routing mid-collection would interleave a ``submit`` into a
+        stream still owing a pump response."""
+        failures: List[tuple] = []
+        begun: List[Any] = []
+        ready: List[tuple] = []
+        for rep in list(self.replicas):
+            if not rep.alive:
+                # a handle that reports dead while still in the placement
+                # set (e.g. an in-process kill between pumps) must fail
+                # over NOW — skipping it would strand its assigned work
+                failures.append((rep, ReplicaDeadError(
+                    f"replica {rep.replica_id} reports dead")))
+                continue
+            begin = getattr(rep, "pump_begin", None)
+            if begin is None:
+                try:
+                    ready.append((rep, rep.pump(self.config.pump_steps)))
+                except Exception as e:
+                    failures.append((rep, e))
+                continue
+            try:
+                begin(self.config.pump_steps)
+                begun.append(rep)
+            except Exception as e:
+                failures.append((rep, e))
+        for rep in begun:
+            try:
+                ready.append((rep, rep.pump_end()))
+            except Exception as e:
+                failures.append((rep, e))
+        produced = 0
+        for rep, out in ready:
+            produced += self._absorb(rep, out)
+        if failures:
+            # remove EVERY failed replica from the placement set before
+            # re-routing any victim: handling serially would re-place the
+            # first failure's requests onto a sibling that is already
+            # known-sick, burning reroute budget while healthy survivors
+            # exist
+            pending: List[Request] = []
+            for rep, err in failures:
+                pending.extend(self._fail_replica(rep, err))
+            self._drain_pending(pending)
+            self.audit_survivors(raise_on_error=True)
+        self._check_heartbeats()
+        self._retire_drained()
+        return produced
+
+    def _absorb(self, rep, out: Dict[str, Any]) -> int:
+        now = self.clock()
+        self._last_load[rep.replica_id] = out.get("load") or {}
+        self._mirror_counters(rep.replica_id, out.get("counters") or {})
+        reroute: List[Request] = []
+        for rid, toks in (out.get("tokens") or {}).items():
+            rid = int(rid)  # JSON object keys arrive as strings
+            req = self._requests.get(rid)
+            if req is None or self._assignment.get(rid) != rep.replica_id:
+                continue  # stale stream from before a re-route
+            if len(toks) > len(req.tokens):
+                req.tokens = [int(t) for t in toks]
+                if req.t_first_token is None:
+                    req.t_first_token = now
+        for rid in out.get("finished") or ():
+            req = self._finalize(int(rid), rep.replica_id)
+            if req is not None:
+                req.state = RequestState.FINISHED
+                req.t_done = now
+                self.finished.append(req)
+                self._forget(req.rid)
+        for rid in out.get("expired") or ():
+            req = self._finalize(int(rid), rep.replica_id)
+            if req is not None:
+                req.state = RequestState.EXPIRED
+                if req.reject_reason is None:
+                    req.reject_reason = "deadline"
+                self._forget(req.rid)
+        for rid in out.get("shed") or ():
+            # the replica shed an ACCEPTED request post-admission
+            # (reject_largest victim / drain) — backpressure, so give the
+            # siblings a chance before the fleet gives up on it
+            req = self._finalize(int(rid), rep.replica_id)
+            if req is not None:
+                req.state = RequestState.QUEUED
+                reroute.append(req)
+        self._drain_pending(reroute)
+        return int(out.get("produced", 0))
+
+    def _finalize(self, rid: int, replica_id: str) -> Optional[Request]:
+        if self._assignment.get(rid) != replica_id:
+            return None
+        del self._assignment[rid]
+        return self._requests.get(rid)
+
+    def _forget(self, rid: int) -> None:
+        """Drop a TERMINAL request from the router's ledgers (the caller's
+        Request object is the canonical record; keeping every served
+        request would grow memory with total traffic)."""
+        self._requests.pop(rid, None)
+        self._reroutes.pop(rid, None)
+
+    # -------------------------------------------------- add/retire capacity
+    def add_replica(self, rep) -> None:
+        self.replicas.append(rep)
+        self._record("replica_added", replica_id=rep.replica_id)
+
+    def retire(self, replica_id: str) -> bool:
+        """Begin drain-then-retire on one replica: it stops admitting,
+        keeps being pumped until its accepted work finished, then is
+        closed and removed (see :meth:`_retire_drained`)."""
+        rep = self.replica(replica_id)
+        if rep is None or not rep.alive:
+            return False
+        try:
+            rep.drain()
+        except ReplicaDeadError as e:
+            self._handle_failure(rep, e)
+            return False
+        self._record("replica_draining", replica_id=replica_id)
+        return True
+
+    def _retire_drained(self) -> None:
+        for rep in list(self.replicas):
+            try:
+                done = rep.alive and rep.drained
+            except ReplicaDeadError:
+                continue
+            if done:
+                rep.close()
+                self.replicas.remove(rep)
+                self.retired.append(rep)
+                self._last_load.pop(rep.replica_id, None)
+                self._last_counters.pop(rep.replica_id, None)
+                for session, target in list(self._affinity.items()):
+                    if target == rep.replica_id:
+                        del self._affinity[session]
+                self._record("replica_retired", replica_id=rep.replica_id)
+
+    # ------------------------------------------------------------- queries
+    @property
+    def idle(self) -> bool:
+        """No accepted request is still assigned to a replica."""
+        return not self._assignment
+
+    def occupancy(self) -> float:
+        """Fraction of the fleet's decode slots currently running work —
+        the autoscaler's scale-down signal."""
+        active = total = 0
+        for rep in self.replicas:
+            load = self._last_load.get(rep.replica_id)
+            if load is None:
+                try:
+                    load = rep.load()
+                except ReplicaDeadError:
+                    continue
+                self._last_load[rep.replica_id] = load
+            active += int(load.get("active", 0)) + int(
+                load.get("queue_depth", 0))
+            total += int(load.get("num_slots", 0))
+        return active / total if total else 0.0
+
+    def run_to_completion(self, max_steps: int = 100_000) -> None:
+        for _ in range(max_steps):
+            if self.idle:
+                return
+            self.step()
+        raise RuntimeError(f"fleet did not drain in {max_steps} steps")
+
+    def close(self) -> None:
+        for rep in self.replicas:
+            try:
+                rep.close()
+            except Exception:
+                pass
+
+
+__all__ = ["FleetConfig", "ReplicaRouter", "MIRRORED_COUNTERS"]

@@ -110,8 +110,10 @@ class AdmissionVerdict:
     ``reason``: ``admitted`` | ``unservable`` (prompt+max_new can never fit
     the serving bound — a caller bug, not load) | ``queue_full`` |
     ``token_backlog`` (the admission queue's token-budget backpressure
-    estimate is exhausted). ``shed_rid``: under the ``reject_largest``
-    policy, the rid of the queued request evicted to make room."""
+    estimate is exhausted) | ``draining`` (the scheduler is in a graceful
+    drain — finishing accepted work, admitting nothing new). ``shed_rid``:
+    under the ``reject_largest`` policy, the rid of the queued request
+    evicted to make room."""
 
     admitted: bool
     reason: str = "admitted"
@@ -138,6 +140,10 @@ class Request:
     # end-to-end deadline for the whole lifetime
     ttft_deadline_s: Optional[float] = None
     deadline_s: Optional[float] = None
+    # multi-turn affinity key (inference/fleet): requests sharing a
+    # session_id are routed to the same replica so its prefix-cache pages
+    # stay hot; a lone scheduler ignores it
+    session_id: Optional[str] = None
     rid: int = dataclasses.field(default_factory=lambda: next(_rid))
 
     # lifecycle (filled by the scheduler)
@@ -248,6 +254,7 @@ class ContinuousBatchingScheduler:
         self.expired: List[Request] = []   # EXPIRED (deadline misses)
         self.counters: Dict[str, int] = {}
         self.steps = 0
+        self._draining = False
         self._dispatch_count = 0           # chaos injection index
         # failed dispatch EPISODES in a row, per kind: a healthy prefill
         # path must not mask a dead decode path (or vice versa) — the
@@ -265,6 +272,29 @@ class ContinuousBatchingScheduler:
     @property
     def idle(self) -> bool:
         return not self.queue and not self.active_slots
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def drained(self) -> bool:
+        """A drain was requested and every accepted request has since left
+        the system (finished, expired, or shed by policy) — the point at
+        which ``close()`` loses no work."""
+        return self._draining and self.idle
+
+    def drain(self) -> None:
+        """Graceful, idempotent drain: stop admitting NEW submissions
+        (``submit`` returns a typed ``draining`` rejection) while queued and
+        running requests keep stepping to completion. The autoscaler's
+        scale-down path is ``drain()`` -> ``step()`` until :attr:`drained`
+        -> ``close()`` — accepted work is never dropped, where an abrupt
+        ``close()`` would strand every in-flight request."""
+        if not self._draining:
+            self._draining = True
+            self._record("drain_started", queued=len(self.queue),
+                         active=len(self.active_slots))
 
     @property
     def queued_tokens(self) -> int:
@@ -294,6 +324,12 @@ class ContinuousBatchingScheduler:
         a request was turned away (unservable vs overload) instead of a
         silently growing queue. A rejected request is marked
         ``RequestState.REJECTED`` and never enters the queue."""
+        if self._draining:
+            detail = (f"request {req.rid} rejected: scheduler is draining "
+                      f"({len(self.queue)} queued + "
+                      f"{len(self.active_slots)} running to finish)")
+            self._mark_shed(req, "draining", detail)
+            return AdmissionVerdict(False, "draining", detail)
         worst = len(req.prompt) + req.max_new_tokens
         pool = self.allocator.num_pages - 1  # page 0 reserved
         if (worst > self.max_context

@@ -70,16 +70,23 @@ class RecoveryLog:
     ``Resilience`` for the training machinery, ``Serving`` for the
     continuous-batching scheduler. ``max_bytes``/``keep`` bound the JSONL
     sink via :func:`rotate_jsonl` (None ``max_bytes`` -> the default cap;
-    pass 0 to disable rotation)."""
+    pass 0 to disable rotation).
+
+    ``replica_id`` stamps every event with the serving replica that
+    produced it (``inference/fleet``): N replicas writing the same event
+    names stay distinguishable after :func:`read_events` merges their logs.
+    An explicit ``replica_id=`` field passed to :meth:`record` wins."""
 
     def __init__(self, path: Optional[str] = None, monitor: Any = None,
                  role: str = "engine", prefix: str = "Resilience",
                  max_bytes: Optional[int] = None,
-                 keep: int = DEFAULT_ROTATE_KEEP):
+                 keep: int = DEFAULT_ROTATE_KEEP,
+                 replica_id: Optional[str] = None):
         self.path = path
         self.monitor = monitor  # MonitorMaster-compatible (write_events)
         self.role = role
         self.prefix = prefix
+        self.replica_id = replica_id
         self.max_bytes = (DEFAULT_ROTATE_BYTES if max_bytes is None
                           else int(max_bytes))
         self.keep = int(keep)
@@ -101,6 +108,8 @@ class RecoveryLog:
         self.counters[event] = self.counters.get(event, 0) + 1
         entry = {"unix_time": time.time(), "role": self.role, "event": event,
                  "value": float(value), "step": int(step), **fields}
+        if self.replica_id is not None:
+            entry.setdefault("replica_id", self.replica_id)
         if self.path is not None:
             try:
                 rotate_jsonl(self.path, self.max_bytes, self.keep)
@@ -120,11 +129,44 @@ class RecoveryLog:
         return self.counters.get(event, 0)
 
 
-def read_events(save_dir_or_path: str,
-                keep: int = DEFAULT_ROTATE_KEEP) -> list:
+def _fallback_replica_id(path: str, index: int) -> str:
+    """A stable stamp for events from a log that predates replica ids: the
+    log's directory name (each replica keeps its own save dir), falling back
+    to the merge position when the path carries no usable name."""
+    parent = os.path.basename(os.path.dirname(os.path.abspath(path)))
+    return parent or f"replica{index}"
+
+
+def read_events(save_dir_or_path,
+                keep: int = DEFAULT_ROTATE_KEEP,
+                replica_id: Optional[str] = None) -> list:
     """Parse a recovery log (dir containing the default filename, or a direct
     path), including rotated generations oldest-first. Tolerates a torn
-    trailing line (crash mid-append)."""
+    trailing line (crash mid-append).
+
+    Multi-replica merge (``inference/fleet``): pass a sequence of paths —
+    or ``(replica_id, path)`` pairs — to read every replica's log and merge
+    the events in ``unix_time`` order. Every merged event carries a
+    ``replica_id``: the one the producer stamped
+    (``RecoveryLog(replica_id=...)``) wins; events from pre-fleet logs are
+    stamped from the pair, the log's directory name, or the merge position,
+    so two replicas emitting the same event names stay distinguishable.
+    ``replica_id`` on a single-path call stamps unstamped events the same
+    way."""
+    if isinstance(save_dir_or_path, (list, tuple)):
+        merged = []
+        for i, item in enumerate(save_dir_or_path):
+            if isinstance(item, (list, tuple)):
+                rid, p = item
+            else:
+                rid, p = None, item
+            if rid is None:
+                rid = _fallback_replica_id(
+                    p if not os.path.isdir(p)
+                    else os.path.join(p, EVENTS_FILENAME), i)
+            merged.extend(read_events(p, keep=keep, replica_id=str(rid)))
+        merged.sort(key=lambda e: e.get("unix_time", 0.0))
+        return merged
     path = save_dir_or_path
     if os.path.isdir(path):
         path = os.path.join(path, EVENTS_FILENAME)
@@ -138,9 +180,12 @@ def read_events(save_dir_or_path: str,
                 if not line:
                     continue
                 try:
-                    out.append(json.loads(line))
+                    ev = json.loads(line)
                 except ValueError:
-                    pass  # torn tail
+                    continue  # torn tail
+                if replica_id is not None and isinstance(ev, dict):
+                    ev.setdefault("replica_id", replica_id)
+                out.append(ev)
     return out
 
 

@@ -776,6 +776,34 @@ def serving_admission_limit(
             "trace": r["trace"]}
 
 
+def fleet_replica_plan(
+    model: str,
+    *,
+    target_total_slots: int,
+    max_replicas: int = 64,
+    safety_margin: float = 1.0,
+    lo: int = 1,
+    hi: int = 64,
+    **report_kwargs: Any,
+) -> Dict[str, Any]:
+    """Size a serving fleet from the AOT fit ladder: per-replica slots are
+    one :func:`serving_admission_limit` verdict (one replica = one chip
+    allocation = one compiled decode program), and the replica count is
+    what covers ``target_total_slots`` of aggregate admission capacity.
+    The ``inference/fleet`` router and autoscaler consume this plan —
+    the policy decides HOW MANY replicas run, never how big one is
+    (that is a compile-time fact, not a load signal)."""
+    limit = serving_admission_limit(model, safety_margin=safety_margin,
+                                    lo=lo, hi=hi, **report_kwargs)
+    per = int(limit["max_slots"])
+    if per < 1:
+        return {"model": model, "slots_per_replica": 0, "replicas": 0,
+                "total_slots": 0, "admission": limit}
+    n = min(int(max_replicas), -(-int(target_total_slots) // per))
+    return {"model": model, "slots_per_replica": per, "replicas": n,
+            "total_slots": n * per, "admission": limit}
+
+
 def sd_program_report(
     *,
     topology: str = "v5e:2x2",

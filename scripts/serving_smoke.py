@@ -30,6 +30,13 @@ chat-style mixed stream where every request opens with the same system
 prompt through a copy-on-write prefix-cache engine: physical pages
 allocated must undercut the sum of logical pages, greedy outputs must stay
 generate-identical, and the refcount audit must be clean after the drain.
+
+``--fleet`` (docs/SERVING.md "Fleet") runs TWO real-engine replicas as
+separate worker PROCESSES behind the fleet router and SIGKILLs one of them
+mid-stream: the router must detect the death (pipe EOF), re-route the dead
+replica's in-flight requests to the survivor with their streamed tokens
+kept, finish every request generate-identical, and leave the survivor's
+page-conservation audit clean.
 """
 
 import os
@@ -306,9 +313,98 @@ def prefix_main() -> int:
     return 0
 
 
+def fleet_main() -> int:
+    """Fleet failover end to end (docs/SERVING.md "Fleet"): two real-engine
+    replica processes, one SIGKILL'd mid-stream. The router re-routes the
+    dead replica's requests (kept tokens preserved), every request finishes
+    generate-identical, and the survivor's page audit is clean."""
+    import signal
+
+    from deepspeed_tpu.inference.fleet import (FleetConfig, ReplicaRouter,
+                                               SubprocessReplica)
+    from deepspeed_tpu.inference.serving import RequestState
+
+    model = dict(vocab_size=64, d_model=32, n_layer=2, n_head=4,
+                 max_seq_len=128)
+    serving = dict(num_slots=2, page_size=8, max_model_len=64,
+                   prefill_chunk=16, dtype="float32", decode_block=4,
+                   max_queue=32)
+    replicas = [SubprocessReplica(f"r{i}", model, serving, seed=0)
+                for i in range(2)]
+    router = ReplicaRouter(replicas, FleetConfig(reroute_budget=2,
+                                                 heartbeat_deadline_s=60.0))
+    print(f"[fleet] 2 worker processes up "
+          f"(pids {[r.pid for r in replicas]})")
+
+    rng = np.random.default_rng(23)
+    wl = [Request(prompt=rng.integers(0, 64, (int(rng.integers(4, 24)),))
+                  .astype(np.int32),
+                  max_new_tokens=int(rng.integers(8, 16)))
+          for _ in range(6)]
+    for r in wl:
+        assert router.submit(r), r.rid
+    assert len({router._assignment[r.rid] for r in wl}) == 2, \
+        "placement used only one replica"
+
+    # step until the doomed replica holds in-flight work with streamed
+    # tokens, then SIGKILL it — a preempted host, not a graceful exit
+    doomed = replicas[0]
+    for _ in range(200):
+        router.step()
+        held = [r for r in wl
+                if router._assignment.get(r.rid) == doomed.replica_id]
+        if held and any(len(r.tokens) >= 2 for r in held):
+            break
+    else:
+        raise AssertionError("doomed replica never held streaming work")
+    kept_at_kill = {r.rid: len(r.tokens) for r in held}
+    os.kill(doomed.pid, signal.SIGKILL)
+    print(f"[fleet] SIGKILL'd replica r0 (pid {doomed.pid}) holding "
+          f"{len(held)} request(s), kept tokens {kept_at_kill}")
+
+    router.run_to_completion()
+    assert router.counters.get("replica_dead") == 1, router.counters
+    assert router.counters.get("request_rerouted", 0) >= len(held), \
+        router.counters
+    rerouted_kept = [e for e in router.events
+                     if e["event"] == "request_rerouted"
+                     and e.get("kept_tokens", 0) > 0]
+    assert rerouted_kept, "no re-route preserved streamed tokens"
+    assert all(r.state is RequestState.FINISHED for r in wl), \
+        [r.state for r in wl]
+
+    audit = router.audit_survivors()
+    assert audit["ok"], audit
+    assert audit["replicas"]["r1"]["allocated"] == 0, audit
+    print(f"[fleet] fleet drained on the survivor "
+          f"({router.counters['request_rerouted']} re-routes, "
+          f"{len(rerouted_kept)} with kept tokens), audit clean")
+
+    # greedy equivalence: failover must be invisible in the outputs (the
+    # parent holds its own jax runtime for the reference engine)
+    cfg = G.GPTConfig(**model)
+    params = G.init_params(cfg, jax.random.PRNGKey(0))
+    ie = InferenceEngine(for_gpt(cfg, params), DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=64))
+    for r in wl:
+        ref = np.asarray(ie.generate(
+            np.asarray(r.prompt)[None],
+            max_new_tokens=r.max_new_tokens))[0, len(r.prompt):]
+        got = np.asarray(r.tokens[:r.max_new_tokens])
+        assert np.array_equal(ref, got), (r.rid, ref, got)
+    print("[fleet] greedy outputs identical to InferenceEngine.generate "
+          "across the replica kill")
+
+    router.close()
+    print("serving_smoke[fleet]: PASS")
+    return 0
+
+
 if __name__ == "__main__":
     if "--chaos" in sys.argv[1:]:
         sys.exit(chaos_main())
     if "--prefix" in sys.argv[1:]:
         sys.exit(prefix_main())
+    if "--fleet" in sys.argv[1:]:
+        sys.exit(fleet_main())
     sys.exit(main())

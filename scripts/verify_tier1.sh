@@ -68,7 +68,7 @@ grep -aE '^[0-9]+ passed' /tmp/_t1_overlap.log || true
 # serving dslint rule.
 if ! timeout -k 10 420 env JAX_PLATFORMS=cpu \
         python -m pytest tests/test_serving.py tests/test_serving_chaos.py \
-        tests/test_paged_kv.py \
+        tests/test_paged_kv.py tests/test_fleet.py \
         tests/test_decode_attention.py -q -m 'not slow' \
         -p no:cacheprovider -p no:randomly > /tmp/_t1_serving.log 2>&1; then
     echo "verify_tier1: FAIL — serving/paged-KV tests:" >&2
@@ -115,6 +115,20 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 grep -a "serving_smoke\[chaos\]: PASS" /tmp/_t1_serving_chaos.log || true
+
+# the fleet failover smoke (docs/SERVING.md "Fleet"): two real-engine
+# replica PROCESSES behind the router, one SIGKILL'd mid-stream — the
+# dead replica's requests must re-route to the survivor with kept tokens,
+# finish generate-identical, and leave the survivor's page audit clean.
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        python scripts/serving_smoke.py --fleet \
+        > /tmp/_t1_serving_fleet.log 2>&1; then
+    echo "verify_tier1: FAIL — serving fleet smoke" \
+         "(scripts/serving_smoke.py --fleet):" >&2
+    tail -30 /tmp/_t1_serving_fleet.log >&2
+    exit 1
+fi
+grep -a "serving_smoke\[fleet\]: PASS" /tmp/_t1_serving_fleet.log || true
 
 # --- fault-injection smoke (docs/RESILIENCE.md) ---------------------------
 # two heal cycles on the CPU mesh: SIGKILL mid-checkpoint + auto-resume

@@ -1,0 +1,510 @@
+"""Fleet router tests (docs/SERVING.md "Fleet") — device-free.
+
+Replicas are real ``ContinuousBatchingScheduler``s over the deterministic
+arithmetic fake executor (prefill answers last+1, decode prev+1 mod 97),
+wrapped in ``LocalReplica`` handles, so every fleet behavior — placement
+scoring, session affinity + spill, backpressure shed-to-sibling,
+kill-mid-decode re-route, drain-then-retire, autoscaling — is exercised
+against the true scheduler/page machinery with outputs directly comparable
+to a fault-free single-scheduler run.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.analysis import analyze_compile_log
+from deepspeed_tpu.inference.fleet import (AutoscalePolicy, FleetAutoscaler,
+                                           FleetConfig, LocalReplica,
+                                           ReplicaDeadError, ReplicaRouter,
+                                           run_fleet, summarize_events)
+from deepspeed_tpu.inference.serving import (ContinuousBatchingScheduler,
+                                             Request, RequestState)
+from deepspeed_tpu.resilience.events import RecoveryLog, read_events
+
+
+class FakeExecutor:
+    """prefill -> last+1, decode -> prev+1 (mod 97): greedy outputs are a
+    pure function of the prompt, so healed and fault-free runs compare."""
+
+    def prefill(self, slot, tokens, table_row):
+        return (int(tokens[-1]) + 1) % 97
+
+    def decode(self, tokens, tables, lengths, active, steps=1):
+        return np.stack([(tokens + k + 1) % 97 for k in range(steps)])
+
+
+def mk_sched(num_slots=2, num_pages=32, page_size=4, pages_per_seq=8, **kw):
+    return ContinuousBatchingScheduler(
+        FakeExecutor(), num_slots=num_slots, num_pages=num_pages,
+        page_size=page_size, pages_per_seq=pages_per_seq, **kw)
+
+
+def mk_replica(rid, **sched_kw):
+    return LocalReplica(rid, scheduler=mk_sched(**sched_kw))
+
+
+SPEC = ((3, 6), (5, 4), (2, 8), (4, 3))
+
+
+def workload(spec=SPEC, **kw):
+    return [Request(prompt=np.arange(1, n + 1, dtype=np.int32),
+                    max_new_tokens=m, **kw) for n, m in spec]
+
+
+def reference_tokens(spec=SPEC):
+    sched = mk_sched(num_slots=4)
+    reqs = workload(spec)
+    for r in reqs:
+        sched.submit(r)
+    sched.run_to_completion(max_steps=500)
+    return [list(r.tokens) for r in reqs]
+
+
+class KillableReplica(LocalReplica):
+    """Dies AFTER making internal decode progress it never reports — the
+    SIGKILL-mid-decode-block model: the router's kept-token ledger is a
+    strict prefix of the replica's private truth."""
+
+    def __init__(self, *a, die_after_pumps=None, **kw):
+        super().__init__(*a, **kw)
+        self.die_after_pumps = die_after_pumps
+        self.pumps = 0
+
+    def pump(self, max_steps=1):
+        self.pumps += 1
+        if (self.die_after_pumps is not None
+                and self.pumps > self.die_after_pumps):
+            super().pump(max_steps)  # progress happens, report never lands
+            self._alive = False
+            raise ReplicaDeadError("killed mid-decode")
+        return super().pump(max_steps)
+
+
+# --------------------------------------------------------------- placement
+def test_least_loaded_placement():
+    """Requests land on the replica with the least queued+running work."""
+    r0, r1 = mk_replica("r0"), mk_replica("r1")
+    router = ReplicaRouter([r0, r1])
+    a, b, c = workload(((4, 10), (4, 2), (4, 2)))
+    router.submit(a)               # both empty -> r0 (id tie-break)
+    assert router._assignment[a.rid] == "r0"
+    router.submit(b)               # r0 now holds work -> r1
+    assert router._assignment[b.rid] == "r1"
+    router.submit(c)               # r0 carries 10 tokens vs r1's 2 -> r1
+    assert router._assignment[c.rid] == "r1"
+    router.run_to_completion()
+    assert [r.state for r in (a, b, c)] == [RequestState.FINISHED] * 3
+
+
+def test_placement_skips_draining_replica():
+    r0, r1 = mk_replica("r0"), mk_replica("r1")
+    router = ReplicaRouter([r0, r1])
+    router.retire("r0")
+    req = workload(((3, 4),))[0]
+    assert router.submit(req)
+    assert router._assignment[req.rid] == "r1"
+
+
+# ---------------------------------------------------------------- affinity
+def test_session_affinity_sticks():
+    """Same session_id keeps landing on the same replica even when a
+    sibling is less loaded."""
+    r0, r1 = mk_replica("r0", num_slots=4), mk_replica("r1", num_slots=4)
+    router = ReplicaRouter([r0, r1])
+    first = workload(((4, 8),), session_id="chat-1")[0]
+    router.submit(first)
+    home = router._assignment[first.rid]
+    # pile neutral load onto the OTHER replica's sibling... submit enough
+    # sessionless work that the home replica is strictly more loaded
+    for r in workload(((4, 2), (4, 2))):
+        router.submit(r)
+    nxt = workload(((6, 4),), session_id="chat-1")[0]
+    router.submit(nxt)
+    assert router._assignment[nxt.rid] == home
+
+
+def test_session_affinity_spills_on_pressure_and_resticks():
+    """A sticky replica answering queue_full loses the request to a
+    sibling, and the session re-sticks there."""
+    # r0: 1 slot, 1-deep queue -> the second same-session request cannot
+    # be admitted while the first still sits in r0's queue
+    r0 = mk_replica("r0", num_slots=1, max_queue=1)
+    r1 = mk_replica("r1", num_slots=1, max_queue=4)
+    router = ReplicaRouter([r0, r1])
+    first = workload(((4, 12),), session_id="s")[0]
+    router.submit(first)
+    assert router._assignment[first.rid] == "r0"
+    second = workload(((4, 4),), session_id="s")[0]
+    verdict = router.submit(second)
+    assert verdict.admitted
+    assert router._assignment[second.rid] == "r1"     # spilled
+    assert router._affinity["s"] == "r1"              # re-stuck
+    assert router.counters.get("session_spilled") == 1
+    router.run_to_completion()
+
+
+# ------------------------------------------------------------ backpressure
+def test_backpressure_sheds_to_sibling_before_fleet_rejects():
+    """queue_full on the least-loaded replica is a spill signal: the
+    request lands on the sibling; only ALL replicas refusing is a
+    fleet-level reject."""
+    r0 = mk_replica("r0", num_slots=1, max_queue=1)
+    r1 = mk_replica("r1", num_slots=1, max_queue=2)
+    router = ReplicaRouter([r0, r1])
+    small = workload(((4, 8),))[0]
+    big = workload(((4, 28),))[0]
+    router.submit(small)                       # -> r0 (tie-break)
+    router.submit(big)                         # -> r1 (least-loaded)
+    assert router._assignment[small.rid] == "r0"
+    assert router._assignment[big.rid] == "r1"
+    spilled = workload(((4, 8),))[0]
+    verdict = router.submit(spilled)
+    # r0 (less loaded) is probed first but its queue is full -> the
+    # verdict is backpressure, and r1 takes the request
+    assert verdict.admitted
+    assert router._assignment[spilled.rid] == "r1"
+    assert r0.sched.counters.get("request_shed", 0) == 1
+    rejected = workload(((4, 8),))[0]
+    verdict = router.submit(rejected)          # now everyone is full
+    assert not verdict.admitted
+    assert verdict.reason == "queue_full"
+    assert rejected.state is RequestState.REJECTED
+    assert router.counters["fleet_reject"] == 1
+    router.run_to_completion()
+    assert all(r.state is RequestState.FINISHED
+               for r in (small, big, spilled))
+
+
+def test_unservable_rejects_immediately_without_spill():
+    r0, r1 = mk_replica("r0"), mk_replica("r1")
+    router = ReplicaRouter([r0, r1])
+    huge = Request(prompt=np.arange(1, 100, dtype=np.int32),
+                   max_new_tokens=100)
+    verdict = router.submit(huge)
+    assert not verdict.admitted and verdict.reason == "unservable"
+    # only ONE replica was probed: the bound is structural
+    shed_counts = [r.sched.counters.get("request_shed", 0) for r in (r0, r1)]
+    assert sorted(shed_counts) == [0, 1]
+
+
+# ---------------------------------------------------------------- failover
+def test_kill_mid_decode_reroutes_with_kept_tokens():
+    """A replica dying mid-decode (progress made, never reported) loses
+    nothing: its requests re-route with the router's absorbed tokens and
+    finish greedy-identical to a fault-free run; survivors audit clean."""
+    clean = reference_tokens()
+    reps = [KillableReplica("r0", scheduler=mk_sched(), die_after_pumps=2),
+            mk_replica("r1")]
+    router = ReplicaRouter(reps, FleetConfig(reroute_budget=2))
+    reqs = workload()
+    for r in reqs:
+        router.submit(r)
+    router.run_to_completion()
+    assert [list(r.tokens) for r in reqs] == clean
+    assert all(r.state is RequestState.FINISHED for r in reqs)
+    assert router.counters["replica_dead"] == 1
+    assert router.counters["request_rerouted"] >= 1
+    rep = router.audit_survivors()
+    assert rep["ok"], rep
+    assert reps[1].sched.allocator.allocated_pages == 0
+
+
+def test_simultaneous_failures_reroute_to_healthy_survivor():
+    """Two replicas failing in the SAME step must both leave the placement
+    set before any victim is re-routed: serial handling would re-place the
+    first failure's requests onto the second known-sick replica and burn
+    their whole reroute budget with a healthy survivor standing by."""
+
+    class SickReplica(LocalReplica):
+        """Stays alive and keeps accepting submissions, but every pump
+        after the first raises — the ServingFaultError shape."""
+
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self.pumps = 0
+
+        def pump(self, max_steps=1):
+            self.pumps += 1
+            if self.pumps > 1:
+                raise RuntimeError("wedged executor")
+            return super().pump(max_steps)
+
+    reps = [SickReplica("r0", scheduler=mk_sched()),
+            SickReplica("r1", scheduler=mk_sched()),
+            mk_replica("r2")]
+    router = ReplicaRouter(reps, FleetConfig(reroute_budget=1))
+    reqs = workload(((3, 6), (5, 4)))
+    for r in reqs:
+        router.submit(r)
+    assert {router._assignment[r.rid] for r in reqs} == {"r0", "r1"}
+    router.run_to_completion()
+    assert router.counters["replica_dead"] == 2
+    assert all(r.state is RequestState.FINISHED for r in reqs), \
+        [(r.state, r.reject_reason) for r in reqs]
+    assert [list(r.tokens) for r in reqs] \
+        == reference_tokens(((3, 6), (5, 4)))
+
+
+def test_reroute_budget_exhaustion_is_typed():
+    """Every replica dying faster than the budget allows ends in a typed
+    rejection, not an infinite loop."""
+    reps = [KillableReplica(f"r{i}", scheduler=mk_sched(),
+                            die_after_pumps=0) for i in range(3)]
+    router = ReplicaRouter(reps, FleetConfig(reroute_budget=1))
+    req = workload(((4, 6),))[0]
+    router.submit(req)
+    for _ in range(10):
+        if router.idle:
+            break
+        router.step()
+    assert req.state is RequestState.REJECTED
+    assert req.reject_reason in ("reroute_budget", "no_replicas")
+    assert router.counters["replica_dead"] >= 1
+
+
+def test_hung_replica_fails_over_on_heartbeat():
+    """A replica that answers pumps but reports a stale heartbeat is
+    evicted and its work re-routed."""
+
+    class HungReplica(LocalReplica):
+        def heartbeat_age(self):
+            return 999.0
+
+    reps = [HungReplica("r0", scheduler=mk_sched()), mk_replica("r1")]
+    router = ReplicaRouter(reps, FleetConfig(heartbeat_deadline_s=1.0,
+                                             reroute_budget=2))
+    reqs = workload(((3, 6), (5, 4)))
+    for r in reqs:
+        router.submit(r)
+    router.run_to_completion()
+    assert router.counters["replica_hung"] == 1
+    assert router.counters["replica_dead"] == 1
+    assert all(r.state is RequestState.FINISHED for r in reqs)
+    assert [list(r.tokens) for r in reqs] \
+        == reference_tokens(((3, 6), (5, 4)))
+
+
+# ------------------------------------------------------- drain-then-retire
+def test_scheduler_drain_is_idempotent_and_finishes_accepted_work():
+    sched = mk_sched()
+    reqs = workload(((3, 6), (5, 4)))
+    for r in reqs:
+        assert sched.submit(r)
+    sched.step()
+    sched.drain()
+    sched.drain()  # idempotent: one drain_started event
+    assert sched.counters["drain_started"] == 1
+    late = workload(((2, 3),))[0]
+    verdict = sched.submit(late)
+    assert not verdict.admitted and verdict.reason == "draining"
+    assert late.state is RequestState.REJECTED
+    assert not sched.drained  # accepted work still in flight
+    sched.run_to_completion(max_steps=200)
+    assert sched.drained
+    assert all(r.state is RequestState.FINISHED for r in reqs)
+    assert sched.allocator.allocated_pages == 0
+
+
+def test_router_drain_then_retire():
+    """retire(): the replica admits nothing new, finishes its accepted
+    work, then is closed and removed — zero dropped requests."""
+    reps = [mk_replica("r0"), mk_replica("r1")]
+    router = ReplicaRouter(reps)
+    reqs = workload()
+    for r in reqs:
+        router.submit(r)
+    assert any(owner == "r0" for owner in router._assignment.values())
+    assert router.retire("r0")
+    router.run_to_completion()
+    assert all(r.state is RequestState.FINISHED for r in reqs)
+    assert [list(r.tokens) for r in reqs] == reference_tokens()
+    assert [r.replica_id for r in router.retired] == ["r0"]
+    assert not reps[0].alive
+    assert router.counters["replica_retired"] == 1
+    # retiring an already-gone replica is a no-op, not an error
+    assert not router.retire("r0")
+
+
+# --------------------------------------------------------------- autoscale
+def _events(now, spec):
+    """Synthesize a window event stream: spec = [(event, t_offset), ...]."""
+    return [{"unix_time": now + dt, "event": ev} for ev, dt in spec]
+
+
+def test_autoscale_scale_up_on_shed_rate():
+    pol = AutoscalePolicy(window_s=10.0, shed_rate_up=0.1, max_replicas=4)
+    now = 1000.0
+    evs = _events(now, [("request_routed", -i) for i in range(1, 7)]
+                  + [("fleet_reject", -1), ("fleet_reject", -2)])
+    s = summarize_events(evs, now, pol.window_s)
+    assert s["shed_rate"] == pytest.approx(0.25)
+    assert pol.decide(s, num_replicas=2, occupancy=0.9, now=now) \
+        == "scale_up"
+    # clamped at max_replicas
+    assert pol.decide(s, num_replicas=4, occupancy=0.9, now=now) == "hold"
+
+
+def test_autoscale_scale_up_on_deadline_miss_trend():
+    pol = AutoscalePolicy(window_s=10.0, miss_floor=2)
+    now = 1000.0
+    rising = _events(now, [("deadline_miss", -1), ("deadline_miss", -2),
+                           ("deadline_miss", -8)])
+    s = summarize_events(rising, now, pol.window_s)
+    assert s["miss_trend"] > 0
+    assert pol.decide(s, 2, 0.9, now) == "scale_up"
+    falling = _events(now, [("deadline_miss", -8), ("deadline_miss", -9),
+                            ("deadline_miss", -1)])
+    s2 = summarize_events(falling, now, pol.window_s)
+    assert pol.decide(s2, 2, 0.9, now) == "hold"  # loaded but improving
+
+
+def test_autoscale_scale_down_needs_quiet_and_headroom():
+    pol = AutoscalePolicy(window_s=10.0, down_occupancy=0.7,
+                          min_replicas=1)
+    now = 1000.0
+    quiet = summarize_events(
+        _events(now, [("request_routed", -1)]), now, pol.window_s)
+    assert pol.decide(quiet, 2, occupancy=0.2, now=now) == "scale_down"
+    # projected post-retire occupancy too high -> hold
+    assert pol.decide(quiet, 2, occupancy=0.5, now=now) == "hold"
+    # min_replicas clamp
+    assert pol.decide(quiet, 1, occupancy=0.0, now=now) == "hold"
+    # a single miss in the window blocks scale-down
+    busy = summarize_events(
+        _events(now, [("deadline_miss", -1)]), now, pol.window_s)
+    assert pol.decide(busy, 2, occupancy=0.2, now=now) == "hold"
+
+
+def test_autoscale_cooldown():
+    pol = AutoscalePolicy(window_s=10.0, cooldown_s=30.0)
+    now = 1000.0
+    quiet = summarize_events([], now, pol.window_s)
+    assert pol.decide(quiet, 2, 0.1, now, last_action_t=now - 5) == "hold"
+    assert pol.decide(quiet, 2, 0.1, now, last_action_t=now - 60) \
+        == "scale_down"
+
+
+def test_fleet_autoscaler_applies_decisions():
+    """scale_up spawns through the factory; scale_down drains the
+    least-loaded replica and the router retires it once empty."""
+    reps = [mk_replica("r0"), mk_replica("r1")]
+    router = ReplicaRouter(reps)
+    pol = AutoscalePolicy(window_s=5.0, cooldown_s=0.0, min_replicas=1,
+                          max_replicas=3, shed_rate_up=0.1)
+    made = []
+
+    def factory(rid):
+        made.append(rid)
+        return mk_replica(rid)
+
+    scaler = FleetAutoscaler(router, pol, factory)
+    # overload the window: mostly rejections
+    for _ in range(4):
+        router._record("fleet_reject", persist=False)
+    router._record("request_routed", persist=False)
+    assert scaler.tick() == "scale_up"
+    assert made == ["scale1"]
+    assert len(router.replicas) == 3
+    # quiet + idle -> drain one
+    router.events.clear()
+    router._record("request_routed", persist=False)
+    assert scaler.tick() == "scale_down"
+    assert sum(r.draining for r in router.replicas) == 1
+    router.step()  # idle drained replica retires on the next step
+    assert len(router.retired) == 1
+    assert len(router.live_replicas) == 2
+
+
+# ------------------------------------------------------------- dslint rule
+def test_fleet_without_failover_rule_fires_and_stays_silent():
+    unsafe = ReplicaRouter([mk_replica("r0"), mk_replica("r1")],
+                           FleetConfig(heartbeat_deadline_s=None,
+                                       reroute_budget=0))
+    findings = analyze_compile_log(unsafe).findings
+    assert any(f.rule_id == "serving/fleet-without-failover"
+               for f in findings), findings
+    # reroute budget armed -> silent
+    safe = ReplicaRouter([mk_replica("a"), mk_replica("b")],
+                         FleetConfig(reroute_budget=2))
+    assert not analyze_compile_log(safe).findings
+    # heartbeat armed (budget 0) -> silent
+    hb = ReplicaRouter([mk_replica("c"), mk_replica("d")],
+                       FleetConfig(heartbeat_deadline_s=5.0,
+                                   reroute_budget=0))
+    assert not analyze_compile_log(hb).findings
+    # single replica -> silent even with nothing armed
+    solo = ReplicaRouter([mk_replica("e")],
+                         FleetConfig(reroute_budget=0))
+    assert not analyze_compile_log(solo).findings
+
+
+# ----------------------------------------------------- events + merge + aot
+def test_recovery_log_stamps_replica_id(tmp_path):
+    log = RecoveryLog(str(tmp_path / "ev.jsonl"), role="serving",
+                      prefix="Serving", replica_id="r7")
+    log.record("request_shed", rid=3)
+    log.record("deadline_miss", replica_id="override")
+    evs = read_events(str(tmp_path / "ev.jsonl"))
+    assert evs[0]["replica_id"] == "r7"
+    assert evs[1]["replica_id"] == "override"  # explicit field wins
+
+
+def test_read_events_merges_multi_replica_logs(tmp_path):
+    """Two replicas emitting the SAME event names stay distinguishable
+    after the merge, and ordering is by time across logs."""
+    dirs = []
+    for i, rid in enumerate(("r0", "r1")):
+        d = tmp_path / rid
+        d.mkdir()
+        log = RecoveryLog.for_dir(str(d), role="serving",
+                                  replica_id=rid if i == 0 else None)
+        log.record("request_shed", rid=i)
+        time.sleep(0.01)
+        dirs.append(str(d))
+    merged = read_events(dirs)
+    assert [e["event"] for e in merged] == ["request_shed"] * 2
+    # r0 stamped by the producer; r1's pre-fleet log stamped from its dir
+    assert merged[0]["replica_id"] == "r0"
+    assert merged[1]["replica_id"] == "r1"
+    times = [e["unix_time"] for e in merged]
+    assert times == sorted(times)
+    # explicit (replica_id, path) pairs override the fallback
+    merged2 = read_events([("east", dirs[1])])
+    assert merged2[0]["replica_id"] == "east"
+
+
+def test_fleet_replica_plan_from_admission_ladder(monkeypatch):
+    from deepspeed_tpu.runtime import aot
+
+    monkeypatch.setattr(
+        aot, "serving_admission_limit",
+        lambda model, **kw: {"model": model, "max_slots": 6,
+                             "max_decode_batch": 6, "fit": "fits",
+                             "kv_bits": int(kw.get("kv_bits", 0) or 0),
+                             "trace": []})
+    plan = aot.fleet_replica_plan("gpt2-125m", target_total_slots=20)
+    assert plan["slots_per_replica"] == 6
+    assert plan["replicas"] == 4          # ceil(20/6)
+    assert plan["total_slots"] == 24
+    monkeypatch.setattr(
+        aot, "serving_admission_limit",
+        lambda model, **kw: {"model": model, "max_slots": 0,
+                             "max_decode_batch": 0, "fit": None,
+                             "trace": []})
+    plan0 = aot.fleet_replica_plan("gpt2-125m", target_total_slots=20)
+    assert plan0["replicas"] == 0
+
+
+# ------------------------------------------------------------ fleet driver
+def test_run_fleet_report_schema():
+    reps = [mk_replica("r0"), mk_replica("r1")]
+    router = ReplicaRouter(reps)
+    wl = workload()
+    rep = run_fleet(router, wl, max_wall_s=30.0, slo_s=5.0)
+    assert rep["mode"] == "fleet"
+    assert rep["finished"] == len(wl)
+    assert rep["fleet_audit_ok"]
+    assert rep["replicas_live"] == 2 and rep["replicas_dead"] == 0
+    assert rep["deadline_misses"] == 0
