@@ -257,6 +257,58 @@ class FleetWithoutFailoverRule(Rule):
         )
 
 
+class SpeculationWithoutGreedyGateRule(Rule):
+    """A speculative drafter is armed while the acceptance path is NOT
+    greedy/temperature-0 — and no equivalence harness is flagged to catch
+    the drift.
+
+    Longest-prefix acceptance is output-preserving ONLY under greedy
+    decoding: the verifier's argmax at position i is what a non-speculative
+    step would have produced, so accepting drafts that match it provably
+    changes nothing. With sampled acceptance (``sampling_temperature`` != 0,
+    or a non-"greedy" ``spec_acceptance``) that proof evaporates — correct
+    sampled speculation needs rejection sampling against the draft
+    distribution, which this stack does not implement, so the config is
+    silently changing the output distribution. Setting
+    ``spec_equivalence_harness`` declares that an external A/B harness
+    asserts ``greedy_match_rate == 1.0`` itself (the bench lever rows do),
+    which silences the rule."""
+
+    rule_id = "serving/speculation-without-greedy-gate"
+    default_severity = Severity.WARNING
+    description = "speculative drafter armed without a greedy acceptance gate"
+
+    def check_context(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        cfg = getattr(ctx.engine, "serving", None) \
+            if ctx.engine is not None else None
+        if cfg is None or not hasattr(cfg, "spec_drafter"):
+            return  # not a serving engine (or a pre-speculation one)
+        drafter = getattr(cfg, "spec_drafter", None)
+        if not drafter:
+            return  # no speculation armed
+        temp = getattr(cfg, "sampling_temperature", 0.0) or 0.0
+        acceptance = getattr(cfg, "spec_acceptance", "greedy")
+        if temp == 0.0 and acceptance == "greedy":
+            return  # the output-preserving configuration
+        if getattr(cfg, "spec_equivalence_harness", False):
+            return  # an external harness owns the equivalence proof
+        yield self.finding(
+            f"drafter '{drafter}' is armed but the acceptance path is not "
+            f"greedy (sampling_temperature={temp}, "
+            f"spec_acceptance={acceptance!r}) and no equivalence harness "
+            f"flag is set — longest-prefix acceptance only preserves "
+            f"outputs under temperature-0 decoding; this config silently "
+            f"changes the output distribution",
+            location="ServingConfig.spec_drafter",
+            suggestion="serve greedily (sampling_temperature=0.0, "
+                       "spec_acceptance='greedy'), or set "
+                       "spec_equivalence_harness=True only when an A/B "
+                       "harness asserts greedy_match_rate == 1.0 itself — "
+                       "see docs/SERVING.md 'Speculative decoding'",
+        )
+
+
 def serving_rules() -> List[Rule]:
     return [UnbucketedDecodeShapeRule(), UnboundedAdmissionRule(),
-            DenseKVAtCapacityRule(), FleetWithoutFailoverRule()]
+            DenseKVAtCapacityRule(), FleetWithoutFailoverRule(),
+            SpeculationWithoutGreedyGateRule()]

@@ -49,6 +49,8 @@ def summarize_events(events: Iterable[Dict[str, Any]], now: float,
     lo = now - float(window_s)
     mid = now - float(window_s) / 2.0
     routed = rejected = misses_early = misses_late = reroutes = 0
+    spec_windows = spec_drafted = spec_accepted = 0
+    spec_tokens = 0.0
     for e in events:
         t = float(e.get("unix_time", 0.0))
         if t < lo or t > now:
@@ -65,9 +67,17 @@ def summarize_events(events: Iterable[Dict[str, Any]], now: float,
                 misses_early += 1
         elif ev == "request_rerouted":
             reroutes += 1
+        elif ev == "spec_window":
+            # per-step speculation ledger rows from every replica's
+            # scheduler — merged here so the fleet-level accept rate and
+            # multi-token multiplier are autoscaler inputs like shed rate
+            spec_windows += 1
+            spec_drafted += int(e.get("drafted", 0))
+            spec_accepted += int(e.get("accepted", 0))
+            spec_tokens += float(e.get("value", 0.0))
     submitted = routed + rejected
     misses = misses_early + misses_late
-    return {
+    out = {
         "window_s": float(window_s),
         "submitted": submitted,
         "routed": routed,
@@ -77,6 +87,11 @@ def summarize_events(events: Iterable[Dict[str, Any]], now: float,
         "miss_trend": misses_late - misses_early,
         "reroutes": reroutes,
     }
+    if spec_windows:
+        out["spec_windows"] = spec_windows
+        out["spec_accept_rate"] = spec_accepted / max(spec_drafted, 1)
+        out["spec_tokens_per_dispatch"] = spec_tokens / spec_windows
+    return out
 
 
 @dataclasses.dataclass

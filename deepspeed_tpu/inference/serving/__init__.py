@@ -18,6 +18,8 @@ from .paging import (PageAllocator, PrefixIndex, RESERVED_PAGE, pages_for,
 from .scheduler import (AdmissionVerdict, ContinuousBatchingScheduler,
                         Request, RequestState, SHED_POLICIES,
                         ServingFaultError)
+from .speculate import (AdaptiveSpecK, DraftModelDrafter, NGramDrafter,
+                        spec_k_ladder)
 from .bench import (estimate_saturation_rps, make_open_loop_workload,
                     percentile, run_continuous, run_static_baseline)
 
@@ -28,6 +30,7 @@ __all__ = [
     "AdmissionVerdict", "ContinuousBatchingScheduler", "Request",
     "RequestState", "SHED_POLICIES", "ServingFaultError",
     "ServingConfig", "ServingEngine",
+    "AdaptiveSpecK", "DraftModelDrafter", "NGramDrafter", "spec_k_ladder",
     "estimate_saturation_rps", "make_open_loop_workload", "percentile",
     "run_continuous", "run_static_baseline",
 ]

@@ -166,7 +166,7 @@ def run_continuous(engine, workload: Sequence[Request],
         sched.close()
     t_end = time.monotonic()
     stats = dict(sched.page_stats)
-    return _report(workload, t0, t_end, "continuous", slo_s=slo_s, extra={
+    extra = {
         "decode_steps": sched.steps,
         "preemptions": sum(r.preemptions for r in workload),
         "num_slots": sched.num_slots,
@@ -180,7 +180,20 @@ def run_continuous(engine, workload: Sequence[Request],
         "physical_logical_page_ratio": round(
             stats["physical"] / stats["logical"], 4)
         if stats["logical"] else None,
-    })
+    }
+    if sched.drafter is not None:
+        # the speculation ledger: accept rate + the multi-token multiplier
+        # (docs/SERVING.md "Speculative decoding" — how to read the A/B row)
+        ss = dict(sched.spec_stats)
+        ss["accept_rate"] = round(
+            ss["accepted"] / max(ss["drafted"], 1), 4)
+        # the multi-token multiplier: tokens a verify dispatch produced,
+        # averaged over windows (1.0 == no better than plain decode)
+        ss["tokens_per_dispatch"] = round(
+            ss["committed_tokens"] / max(ss["windows"], 1), 3)
+        extra["spec"] = ss
+    return _report(workload, t0, t_end, "continuous", slo_s=slo_s,
+                   extra=extra)
 
 
 def estimate_saturation_rps(engine, prompt_len: tuple, max_new: tuple,

@@ -65,6 +65,28 @@ class ServingConfig:
     # block. Must be <= page_size (inactive slots park on the sink page for
     # at most one page worth of steps).
     decode_block: int = 4
+    # ---- speculative decoding (docs/SERVING.md "Speculative decoding"):
+    # a drafter proposes up to spec_k tokens per slot; one paged verify
+    # dispatch scores k+1 positions; longest-prefix GREEDY acceptance
+    # commits only the confirmed prefix, so speculation provably never
+    # changes outputs. spec_k is the ladder CEILING — the adaptive
+    # controller collapses k toward 1 when drafts stop landing.
+    spec_drafter: Optional[str] = None       # None | "ngram" | "draft_model"
+    spec_k: int = 4
+    spec_adaptive: bool = True
+    spec_ngram: int = 3                      # max suffix n-gram order
+    spec_draft_model: Optional[str] = None   # PRESETS name: num_slots="auto"
+    #                                          HBM accounting + default draft
+    # the equivalence-harness flag: set when an A/B run asserts
+    # greedy_match_rate == 1.0 itself — silences the
+    # serving/speculation-without-greedy-gate rule for non-greedy configs
+    spec_equivalence_harness: bool = False
+    # acceptance path: the engine implements greedy (temperature-0) only —
+    # the invariant that makes longest-prefix acceptance output-preserving.
+    # A nonzero temperature with a drafter armed is the misconfiguration
+    # the dslint rule flags (sampled acceptance needs rejection sampling,
+    # which nothing here implements).
+    sampling_temperature: float = 0.0
     dtype: str = "bfloat16"
     kernel_impl: Optional[str] = None   # None=auto | "kernel" | "gather"
     eos_token_id: Optional[int] = None
@@ -94,6 +116,16 @@ class ServingConfig:
         return pages_for(self.max_model_len, self.page_size)
 
     @property
+    def spec_k_set(self) -> tuple:
+        """The bounded draft-length ladder (compile one verify program per
+        entry; empty when no drafter is configured)."""
+        if not self.spec_drafter:
+            return ()
+        from .speculate import spec_k_ladder
+
+        return spec_k_ladder(self.spec_k)
+
+    @property
     def overload_armed(self) -> bool:
         """Whether ANY admission bound or deadline protects this config —
         what the ``serving/unbounded-admission`` rule checks."""
@@ -107,16 +139,28 @@ class ServingEngine:
     """Executor over a GPT config + params (see module docstring)."""
 
     def __init__(self, cfg: gpt_mod.GPTConfig, params,
-                 serving: Optional[ServingConfig] = None, monitor=None):
+                 serving: Optional[ServingConfig] = None, monitor=None,
+                 draft=None):
         self.cfg = cfg
         self.serving = serving or ServingConfig()
         self.monitor = monitor
         self.compile_log: List[dict] = []
+        # draft-model speculation: (GPTConfig, params) of the SMALL model
+        # that proposes tokens for this engine's target (speculate.py)
+        self.draft = draft
         s = self.serving
         if s.max_model_len > cfg.max_seq_len and not (cfg.rotary or cfg.alibi):
             raise ValueError(
                 f"max_model_len {s.max_model_len} exceeds the model's learned "
                 f"position table ({cfg.max_seq_len})")
+        if s.sampling_temperature:
+            raise NotImplementedError(
+                "serving programs sample greedily (temperature 0) — the "
+                "invariant speculative acceptance relies on; "
+                f"sampling_temperature={s.sampling_temperature} is not "
+                "implemented")
+        if s.spec_drafter and not (1 <= s.spec_k <= 16):
+            raise ValueError(f"spec_k {s.spec_k} outside [1, 16]")
         self.num_slots = self._resolve_slots()
         self.num_pages = (s.num_pages if s.num_pages is not None
                           else self.num_slots * s.pages_per_seq + 1)
@@ -149,6 +193,7 @@ class ServingEngine:
         self._prefill_fused_fns = {}
         self._prefill_batch_fns = {}
         self._decode_fns = {}
+        self._verify_fns = {}
         self._scatter_fn = None
 
     def _resolve_slots(self) -> int:
@@ -162,11 +207,22 @@ class ServingEngine:
 
         # kv_bits reaches the fit ladder: the compiled probe serves from
         # quantized pools, so "auto" sizes slots from the KV bytes the pool
-        # ACTUALLY holds (a dense-page ladder under-admits ~2x at int8)
+        # ACTUALLY holds (a dense-page ladder under-admits ~2x at int8).
+        # With speculation armed, the drafter's HBM (draft params + dense
+        # draft cache + k-token verify activations) is charged against the
+        # same budget so "auto" stays honest (aot.speculation_hbm_bytes).
+        draft_model = None
+        if s.spec_drafter:
+            # price the draft model that will ACTUALLY be resident: an
+            # explicit draft=(cfg, params) pair wins over the preset name
+            draft_model = (self.draft[0] if self.draft is not None
+                           else s.spec_draft_model)
         limit = serving_admission_limit(
             s.model_name, prompt=min(128, s.max_model_len),
             gen=min(128, s.max_model_len), kv_bits=s.kv_bits or 0,
-            page_size=s.page_size)
+            page_size=s.page_size, draft_model=draft_model,
+            spec_k=(s.spec_k if s.spec_drafter else 0),
+            spec_max_len=s.max_model_len)
         if limit["max_slots"] < 1:
             raise ValueError(
                 f"AOT fit ladder found no decode batch that fits for "
@@ -266,6 +322,47 @@ class ServingEngine:
 
             self._decode_fns[steps] = jax.jit(fn, donate_argnums=(1,))
         return self._decode_fns[steps]
+
+    def _get_verify(self, W: int):
+        """The speculative verification program for a ``W``-token window
+        (W = k+1, k from the bounded ``spec_k_set`` ladder — at most
+        log2(spec_k)+1 shapes ever compile). One dispatch: score every
+        window position over the paged pool
+        (``models/gpt.paged_verify_step``), greedy longest-prefix
+        acceptance (truncated at a per-row eos and the remaining max_new
+        budget) computed IN-program, and the accepted prefix's KV committed
+        with sequential-append semantics (``commit_window_kv``). Returns
+        (outputs [slots, W], n_accept [slots]) — n_accept counts both the
+        tokens to append and the cache-length advance (they are equal by
+        construction)."""
+        if W not in self._verify_fns:
+            self._log_compile("serving_verify", (W, self.num_slots))
+            impl = self.serving.kernel_impl
+
+            def fn(params, cache, toks, tables, lengths, eos, budget):
+                logits, win_k, win_v = gpt_mod.paged_verify_step(
+                    self.cfg, params, toks, cache, tables, lengths,
+                    impl=impl)
+                outs = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                # longest-prefix greedy acceptance: draft i (toks[:, i+1])
+                # survives iff it equals the target's output at position i
+                # AND every earlier draft survived
+                agree = (toks[:, 1:] == outs[:, :-1]).astype(jnp.int32)
+                n = 1 + jnp.sum(jnp.cumprod(agree, axis=1), axis=1)
+                # an accepted eos ends the request AT that token
+                is_eos = (outs == eos[:, None]) & (eos[:, None] >= 0)
+                eos_pos = jnp.argmax(is_eos, axis=1)
+                n = jnp.where(jnp.any(is_eos, axis=1),
+                              jnp.minimum(n, eos_pos + 1), n)
+                # never accept past max_new (budget 0 = inactive slot:
+                # nothing commits, nothing is written anywhere)
+                n = jnp.clip(n, 0, jnp.maximum(budget, 0))
+                cache = gpt_mod.commit_window_kv(cache, win_k, win_v,
+                                                 tables, lengths, n)
+                return outs, n, cache
+
+            self._verify_fns[W] = jax.jit(fn, donate_argnums=(1,))
+        return self._verify_fns[W]
 
     def _get_scatter(self):
         if self._scatter_fn is None:
@@ -371,6 +468,21 @@ class ServingEngine:
             jnp.asarray(tables, jnp.int32), jnp.asarray(lengths, jnp.int32))
         return np.asarray(out)
 
+    def verify(self, tokens: np.ndarray, tables: np.ndarray,
+               lengths: np.ndarray, active: np.ndarray, eos: np.ndarray,
+               budget: np.ndarray):
+        """Speculative verification executor call: ``tokens`` [slots, W]
+        windows (verified input + drafts), per-slot ``eos`` (-1 = none) and
+        remaining-budget vectors. Returns (outputs [slots, W],
+        n_accept [slots]); the accepted prefix's KV is already committed."""
+        del active  # the program runs all slots; masking rides budget == 0
+        W = int(np.asarray(tokens).shape[1])
+        outs, n, self.paged_cache = self._get_verify(W)(
+            self.params, self.paged_cache, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(tables, jnp.int32), jnp.asarray(lengths, jnp.int32),
+            jnp.asarray(eos, jnp.int32), jnp.asarray(budget, jnp.int32))
+        return np.asarray(outs), np.asarray(n)
+
     def warmup(self) -> int:
         """Compile every serving program shape before traffic arrives:
         fused prefill per chunk bucket, the chunked long-prompt path (+
@@ -413,6 +525,12 @@ class ServingEngine:
             steps_set.add(k)
         for steps in sorted(steps_set):
             self.decode(zeros, tables, zeros, mask, steps=steps)
+        # every verify window shape in the spec ladder (budget all-zero:
+        # nothing commits, every write is masked to nowhere)
+        for k in s.spec_k_set:
+            self.verify(np.zeros((self.num_slots, k + 1), np.int32), tables,
+                        zeros, mask, np.full(self.num_slots, -1, np.int32),
+                        zeros)
         return len(self.compile_log)
 
     # -------------------------------------------------------------- assembly
@@ -441,6 +559,11 @@ class ServingEngine:
                 deadlines["serving_prefill"] = float(s.prefill_deadline_s)
             if s.decode_deadline_s:
                 deadlines["serving_decode"] = float(s.decode_deadline_s)
+                # a speculative verify window is one decode-analog dispatch
+                # (k+1 positions, weights read once) — it rides the decode
+                # deadline so arming spec never silently disarms the PR 7
+                # stall ladder
+                deadlines["serving_verify"] = float(s.decode_deadline_s)
             watchdog = HealthWatchdog(
                 deadlines, poll_interval=s.watchdog_poll_s,
                 recovery_log=recovery_log,
@@ -451,6 +574,11 @@ class ServingEngine:
             from .paging import PrefixIndex
 
             prefix_cache = PrefixIndex(s.page_size)
+        drafter = None
+        if s.spec_drafter:
+            from .speculate import make_drafter
+
+            drafter = make_drafter(self, s)
         sched = ContinuousBatchingScheduler(
             executor=self, num_slots=self.num_slots,
             num_pages=self.num_pages, page_size=s.page_size,
@@ -464,7 +592,8 @@ class ServingEngine:
             quarantine_after=s.quarantine_after,
             dispatch_failure_budget=s.dispatch_failure_budget,
             recovery_log=recovery_log, watchdog=watchdog,
-            prefix_cache=prefix_cache)
+            prefix_cache=prefix_cache, drafter=drafter, spec_k=s.spec_k,
+            spec_adaptive=s.spec_adaptive)
         sched._owns_watchdog = owns
         self.last_scheduler = sched
         return sched

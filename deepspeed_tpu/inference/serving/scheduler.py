@@ -76,6 +76,7 @@ from ...resilience.chaos import serving_dispatch_fault
 from ...resilience.retry import backoff_delay
 from .paging import (PageAllocator, PrefixIndex, pages_for,
                      prefix_chain_hashes)
+from .speculate import AdaptiveSpecK, spec_k_ladder
 
 
 class RequestState(enum.Enum):
@@ -154,6 +155,10 @@ class Request:
     t_done: Optional[float] = None
     preemptions: int = 0
     reject_reason: Optional[str] = None  # set when REJECTED/EXPIRED
+    # per-request speculation ledger (draft positions offered to the
+    # verifier / confirmed by it — the request-level accept-rate row)
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
     @property
     def context_len(self) -> int:
@@ -192,7 +197,9 @@ class ContinuousBatchingScheduler:
                  quarantine_after: int = 2,
                  dispatch_failure_budget: int = 8,
                  recovery_log: Any = None, watchdog: Any = None,
-                 prefix_cache: Optional[PrefixIndex] = None):
+                 prefix_cache: Optional[PrefixIndex] = None,
+                 drafter: Any = None, spec_k: int = 4,
+                 spec_adaptive: bool = True):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if shed_policy not in SHED_POLICIES:
@@ -263,6 +270,24 @@ class ContinuousBatchingScheduler:
         self._consecutive_failures: Dict[str, int] = {}
         self._block_failures: Dict[int, int] = {}
         self._quarantined_blocks: Set[int] = set()
+        # speculative decoding (docs/SERVING.md "Speculative decoding"):
+        # a drafter proposes up to k tokens per slot, ONE verify dispatch
+        # scores k+1 positions, longest-prefix greedy acceptance commits
+        # only the confirmed prefix — rejected suffixes were never written
+        self.drafter = drafter
+        self._spec_ctl = (AdaptiveSpecK(spec_k_ladder(spec_k),
+                                        adaptive=spec_adaptive)
+                          if drafter is not None else None)
+        self.spec_stats: Dict[str, Any] = {
+            "drafter": getattr(drafter, "kind", None),
+            "windows": 0,           # verify dispatches
+            "drafted": 0,           # draft positions offered (k x slots)
+            "accepted": 0,          # draft positions confirmed
+            "committed_tokens": 0,  # tokens produced by verify windows
+            "full_accept_windows": 0,   # slot-windows: every real draft hit
+            "full_reject_windows": 0,   # slot-windows: real drafts, none hit
+            "fallback_steps": 0,    # steps with no drafts -> plain decode
+        }
 
     # ------------------------------------------------------------ bookkeeping
     @property
@@ -407,6 +432,11 @@ class ContinuousBatchingScheduler:
         return AdmissionVerdict(False, reason, detail)
 
     def _release(self, slot: int) -> None:
+        if self.drafter is not None:
+            try:
+                self.drafter.release(slot)
+            except Exception:  # drafter state is advisory, never fatal
+                pass
         released = self.allocator.free(self._slot_pages[slot])
         if self.prefix_cache is not None and released:
             # a page whose LAST reference died is about to be recycled — it
@@ -778,12 +808,132 @@ class ContinuousBatchingScheduler:
 
     def step(self) -> int:
         """Expire blown deadlines, admit what fits, then run one decode step
-        (or one safe decode BLOCK) over the slot array. Returns tokens
+        (or one safe decode BLOCK, or — with a drafter armed — one
+        speculative verify window) over the slot array. Returns tokens
         produced."""
         self._sweep_deadlines()
         self._admit()
         if not self.active_slots:
             return 0
+        if self.drafter is not None:
+            produced = self._spec_step()
+            if produced is not None:
+                return produced
+            # no slot had a draftable history this step: fall back to the
+            # plain decode path (speculation must never cost a step)
+            self.spec_stats["fallback_steps"] += 1
+        return self._decode_step()
+
+    def _spec_step(self) -> Optional[int]:
+        """One speculation window: draft up to k tokens per active slot,
+        verify k+1 positions in ONE dispatch (in-program longest-prefix
+        greedy acceptance + accepted-prefix KV commit), apply the accepted
+        tokens. Returns tokens produced, or None when no slot produced a
+        draft (caller falls back to plain decode)."""
+        k = self._spec_ctl.k
+        W = k + 1
+        drafts: Dict[int, np.ndarray] = {}
+        for slot in self.active_slots:
+            req = self.slots[slot]
+            try:
+                d = np.asarray(self.drafter.draft(
+                    slot, req.rid, np.asarray(req.prompt, np.int32),
+                    req.tokens, k), np.int32)[:k]
+            except Exception as e:  # a broken drafter must not stop serving
+                self._record("drafter_error",
+                             error=f"{type(e).__name__}: {e}"[:200])
+                d = np.empty(0, np.int32)
+            drafts[slot] = d
+        if not any(len(d) for d in drafts.values()):
+            return None
+        # page growth for each slot's commit horizon (never beyond its
+        # remaining budget — commits are budget-truncated in-program),
+        # preempting newest-first under pool pressure like the block path
+        for slot in list(self.active_slots):
+            req = self.slots[slot]
+            if req is None:
+                continue
+            horizon = max(min(W, req.max_new_tokens - len(req.tokens)), 1)
+            while not self._ensure_page(slot, horizon=horizon):
+                victim = max(self.active_slots,
+                             key=lambda s: self._admit_seq[s])
+                self._preempt(victim)
+                if victim == slot:
+                    break
+        active = self.active_slots
+        if not active:
+            return 0
+        win = np.zeros((self.num_slots, W), np.int32)
+        eos = np.full(self.num_slots, -1, np.int32)
+        budget = np.zeros(self.num_slots, np.int32)
+        offered: Dict[int, int] = {}
+        for slot in active:
+            req = self.slots[slot]
+            win[slot, 0] = self.next_input[slot]
+            d = drafts.get(slot, np.empty(0, np.int32))
+            win[slot, 1:1 + len(d)] = d
+            offered[slot] = len(d)
+            if req.eos_token_id is not None:
+                eos[slot] = req.eos_token_id
+            budget[slot] = req.max_new_tokens - len(req.tokens)
+        mask = np.zeros(self.num_slots, bool)
+        mask[active] = True
+        try:
+            outs, n_acc = self._dispatch(
+                "verify", self.executor.verify, win, self.tables.copy(),
+                self.lengths.copy(), mask, eos, budget)
+        except _DispatchFailure as fail:
+            # nothing was committed (the injected raise fires before the
+            # executor call): every slot requeues with exactly its tokens,
+            # the healed rerun is greedy-identical — mid-window preemption
+            # is the same kept-token contract as mid-block
+            self._on_dispatch_episode_failed(fail, active)
+            return 0
+        outs = np.asarray(outs)
+        n_acc = np.asarray(n_acc)
+        self.steps += 1
+        produced = 0
+        step_offered = step_accepted = 0
+        for slot in active:
+            req = self.slots[slot]
+            if req is None or req.state is not RequestState.RUNNING:
+                continue
+            n = int(n_acc[slot])
+            self.lengths[slot] += n   # the n accepted inputs' KV is cached
+            acc_drafts = max(n - 1, 0)
+            dr = offered[slot]
+            req.spec_drafted += dr
+            req.spec_accepted += min(acc_drafts, dr)
+            step_offered += dr
+            step_accepted += min(acc_drafts, dr)
+            if dr:
+                if acc_drafts >= dr:
+                    self.spec_stats["full_accept_windows"] += 1
+                elif acc_drafts == 0:
+                    self.spec_stats["full_reject_windows"] += 1
+            for i in range(n):
+                req.tokens.append(int(outs[slot, i]))
+                produced += 1
+            if n:
+                self.next_input[slot] = req.tokens[-1]
+            if req.done:
+                self._finish(slot)
+        self.spec_stats["windows"] += 1
+        self.spec_stats["drafted"] += step_offered
+        self.spec_stats["accepted"] += step_accepted
+        self.spec_stats["committed_tokens"] += produced
+        self._spec_ctl.observe(step_offered, step_accepted)
+        # the per-step ledger row the fleet autoscaler's summarize_events
+        # merges: accept_rate + tokens_per_dispatch + drafter kind
+        self._record(
+            "spec_window", value=float(produced), k=k,
+            drafted=step_offered, accepted=step_accepted,
+            accept_rate=round(step_accepted / max(step_offered, 1), 4),
+            tokens_per_dispatch=produced,
+            drafter=self.spec_stats["drafter"])
+        return produced
+
+    def _decode_step(self) -> int:
         block = self._block_size()
         # page growth for the block horizon, preempting newest-first under
         # pool pressure

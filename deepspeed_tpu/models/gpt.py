@@ -1336,6 +1336,63 @@ def write_prompt_kv(paged_cache: Dict[str, jnp.ndarray],
                                  jnp.asarray(start, jnp.int32)[None])
 
 
+def _append_kv_token(pages_q: jnp.ndarray, scales: jnp.ndarray,
+                     tok: jnp.ndarray, page: jnp.ndarray, off: jnp.ndarray,
+                     bits: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """THE sequential quantized-pool append: one token per batch row into its
+    tail page. ``pages_q``: [H, P, ps, Dq]; ``scales``: [H, P]; ``tok``:
+    [H, B, Dh] float32; ``page``/``off``: [B].
+
+    A row OPENING a page (offset 0) re-establishes the page scale from its
+    own token (the pool's prior value there is garbage — init, or a recycled
+    page's previous tenant); mid-page the scale grows monotonically and, on
+    the rare step where some row's scale actually grew, the page's existing
+    payload requantizes under it via ``lax.cond`` (ratio 1.0 rows round-trip
+    bit-identically). Shared by the single-token decode step AND the
+    speculative commit scatter (:func:`commit_window_kv`) so the two paths
+    cannot drift — committing n accepted tokens reproduces n sequential
+    appends of the same values (payloads bitwise; scales to the last ULP,
+    where XLA may compile the ``amax / qmax`` divide as a reciprocal
+    multiply in one program and not the other)."""
+    from ..ops.pallas.decode_attention import unpack_kv_int4
+
+    qmax = KV_QMAX[bits]
+    B = tok.shape[1]
+    opening = (off == 0)[None, :]                     # [1, B]
+    s_old = scales[:, page]                           # [H, B]
+    amax = jnp.max(jnp.abs(tok), axis=-1)
+    fresh = jnp.where(amax > 0, amax / qmax, 1.0)
+    s_new = jnp.where(opening, fresh, jnp.maximum(s_old, fresh))
+    tq = jnp.clip(jnp.round(tok / s_new[..., None]), -qmax - 1, qmax)
+    if bits == 4:
+        tq = _pack_kv_int4(tq)
+    else:
+        tq = tq.astype(jnp.int8)
+
+    def token_only(pages_q):
+        # the common decode step: the page scale already covers the
+        # token — one [H, B, Dq] position write, no page rewrite
+        return pages_q.at[:, page, off, :].set(tq)
+
+    def requantize(pages_q):
+        # some mid-page row's scale GREW: rescale that page's existing
+        # payload under the new scale (opening rows just overwrite
+        # garbage), then insert the token
+        cur = pages_q[:, page]                        # [H, B, ps, Dq]
+        cur = (unpack_kv_int4(cur) if bits == 4
+               else cur.astype(jnp.float32))
+        ratio = (s_old / s_new)[..., None, None]
+        curq = jnp.clip(jnp.round(cur * ratio), -qmax - 1, qmax)
+        curq = (_pack_kv_int4(curq) if bits == 4
+                else curq.astype(jnp.int8))
+        curq = curq.at[:, jnp.arange(B), off, :].set(tq)
+        return pages_q.at[:, page].set(curq)
+
+    grew = jnp.any(jnp.logical_and(~opening, s_new > s_old))
+    pages_q = jax.lax.cond(grew, requantize, token_only, pages_q)
+    return pages_q, scales.at[:, page].set(s_new)
+
+
 def _paged_attn_sublayer(cfg: GPTConfig, x, w, k_pages, v_pages, tables,
                          lengths, impl=None, k_scales=None, v_scales=None):
     """Cached self-attention over the page pool (pre-LN + residual) for ONE
@@ -1354,8 +1411,7 @@ def _paged_attn_sublayer(cfg: GPTConfig, x, w, k_pages, v_pages, tables,
     ``lax.cond`` only on steps where some row actually grew — the common
     step is a single-position write) — no clipping of outlier tokens,
     scales only ever grow within a page's lifetime."""
-    from ..ops.pallas.decode_attention import (paged_decode_attention,
-                                               unpack_kv_int4)
+    from ..ops.pallas.decode_attention import paged_decode_attention
 
     B, T, D = x.shape
     assert T == 1
@@ -1386,56 +1442,14 @@ def _paged_attn_sublayer(cfg: GPTConfig, x, w, k_pages, v_pages, tables,
             v[:, 0].astype(dt).transpose(1, 0, 2))
     else:
         bits = 4 if k_pages.shape[-1] * 2 == Dh else 8
-        qmax = KV_QMAX[bits]
-        # off == 0 means this row is OPENING its page: whatever scale the
-        # pool holds there is garbage (the jnp.ones init, or a previous
-        # tenant's value — the host allocator recycles pages without
-        # touching device state), so the token's own scale replaces it
-        # instead of max()-ing against it; off > 0 pages grow-only.
-        opening = (off == 0)[None, :]                     # [1, B]
-
-        def append(pages_q, scales, tok):
-            # pages_q: [H, P, ps, Dq]; scales: [H, P]; tok: [H, B, Dh]
-            s_old = scales[:, page]                       # [H, B]
-            amax = jnp.max(jnp.abs(tok), axis=-1)
-            fresh = jnp.where(amax > 0, amax / qmax, 1.0)
-            s_new = jnp.where(opening, fresh,
-                              jnp.maximum(s_old, fresh))
-            tq = jnp.clip(jnp.round(tok / s_new[..., None]), -qmax - 1, qmax)
-            if bits == 4:
-                tq = _pack_kv_int4(tq)
-            else:
-                tq = tq.astype(jnp.int8)
-
-            def token_only(pages_q):
-                # the common decode step: the page scale already covers the
-                # token — one [H, B, Dq] position write, no page rewrite
-                return pages_q.at[:, page, off, :].set(tq)
-
-            def requantize(pages_q):
-                # some mid-page row's scale GREW: rescale that page's
-                # existing payload under the new scale (opening rows just
-                # overwrite garbage), then insert the token
-                cur = pages_q[:, page]                    # [H, B, ps, Dq]
-                cur = (unpack_kv_int4(cur) if bits == 4
-                       else cur.astype(jnp.float32))
-                ratio = (s_old / s_new)[..., None, None]
-                curq = jnp.clip(jnp.round(cur * ratio), -qmax - 1, qmax)
-                curq = (_pack_kv_int4(curq) if bits == 4
-                        else curq.astype(jnp.int8))
-                curq = curq.at[:, jnp.arange(B), off, :].set(tq)
-                return pages_q.at[:, page].set(curq)
-
-            grew = jnp.any(jnp.logical_and(~opening, s_new > s_old))
-            pages_q = jax.lax.cond(grew, requantize, token_only, pages_q)
-            return pages_q, scales.at[:, page].set(s_new)
-
-        k_pages, k_scales = append(k_pages, k_scales,
-                                   k_[:, 0].transpose(1, 0, 2)
-                                   .astype(jnp.float32))
-        v_pages, v_scales = append(v_pages, v_scales,
-                                   v[:, 0].transpose(1, 0, 2)
-                                   .astype(jnp.float32))
+        # shared sequential append semantics (opening / grow / requantize):
+        # _append_kv_token, also the speculative commit scatter's writer
+        k_pages, k_scales = _append_kv_token(
+            k_pages, k_scales,
+            k_[:, 0].transpose(1, 0, 2).astype(jnp.float32), page, off, bits)
+        v_pages, v_scales = _append_kv_token(
+            v_pages, v_scales,
+            v[:, 0].transpose(1, 0, 2).astype(jnp.float32), page, off, bits)
     scale = (cfg.attention_scale if cfg.attention_scale is not None
              else 1.0 / np.sqrt(Dh))
     qdt = x.dtype if quantized else k_pages.dtype
@@ -1535,6 +1549,211 @@ def paged_decode_step(cfg: GPTConfig, params, input_ids: jnp.ndarray,
         new_cache["k_scales"] = new_kv[2]
         new_cache["v_scales"] = new_kv[3]
     return logits[:, 0, :], new_cache
+
+
+# ------------------------------------------------- speculative verification
+def _paged_verify_sublayer(cfg: GPTConfig, x, w, k_pages, v_pages, tables,
+                           lengths, impl=None, k_scales=None, v_scales=None):
+    """Cached self-attention over the page pool for a ``W``-token
+    speculation window per row (pre-LN + residual). x: [B, W, D]; window
+    position ``i`` sits at absolute position ``lengths[b] + i`` and attends
+    pool history + the window's causal prefix (the window K/V stay DENSE —
+    nothing is written to the pool; the accepted prefix commits later via
+    :func:`commit_window_kv`). Returns (x + attn_out, win_k, win_v) with
+    win_k/win_v [B, W, H, Dh] post-rope in the compute dtype — exactly the
+    values a sequential decode step would have appended."""
+    from ..ops.pallas.decode_attention import paged_verify_attention
+
+    B, W, D = x.shape
+    H, Dh = cfg.n_head, cfg.head_dim
+    h = layer_norm(x, w["ln1_scale"], w["ln1_bias"], cfg.layer_norm_eps)
+    qkv = _wm(h, w["qkv_w"]) + w["qkv_b"]
+    q, k_, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, W, H, Dh)
+    k_ = k_.reshape(B, W, H, Dh)
+    v = v.reshape(B, W, H, Dh)
+    positions = lengths[:, None] + jnp.arange(W)[None, :]   # [B, W]
+    if cfg.rotary:
+        rd = int(cfg.rotary_pct * Dh)
+        rd -= rd % 2
+        q = _rope(q, positions, rd, cfg.rotary_interleaved)
+        k_ = _rope(k_, positions, rd, cfg.rotary_interleaved)
+    scale = (cfg.attention_scale if cfg.attention_scale is not None
+             else 1.0 / np.sqrt(Dh))
+    quantized = k_scales is not None
+    qdt = x.dtype if quantized else k_pages.dtype
+    attn = paged_verify_attention(q.astype(qdt), k_pages, v_pages, lengths,
+                                  tables, k_, v, softmax_scale=scale,
+                                  impl=impl, k_scales=k_scales,
+                                  v_scales=v_scales)
+    attn = attn.reshape(B, W, D).astype(x.dtype)
+    attn = _wm(attn, w["attn_out_w"]) + w["attn_out_b"]
+    return x + attn, k_, v
+
+
+def paged_verify_step(cfg: GPTConfig, params, window_ids: jnp.ndarray,
+                      paged_cache: Dict[str, jnp.ndarray],
+                      block_tables: jnp.ndarray, lengths: jnp.ndarray,
+                      impl: Optional[str] = None
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Score a speculation window — ``window_ids`` [B, W] per slot: the
+    verified next input token followed by up to W-1 drafted tokens — in ONE
+    dispatch over the paged cache. Returns (logits [B, W, V], win_k, win_v)
+    where win_k/win_v [L, B, W, H, Dh] are the window's per-layer post-rope
+    K/V in the compute dtype.
+
+    The weight-bound speculative-decoding bet: every weight matrix is read
+    ONCE for W positions, where W sequential :func:`paged_decode_step`
+    dispatches read it W times — verifying k drafted tokens costs barely
+    more than one token. The pool is READ-ONLY here: window K/V stay dense
+    so the rejected suffix needs no undo, and :func:`commit_window_kv`
+    afterwards appends exactly the accepted prefix with sequential-append
+    semantics (what spec-off decode would have written, to XLA
+    reduction-tiling noise — argmax-stable, gated at
+    greedy_match_rate == 1.0). One caveat: over QUANTIZED pools the window
+    attends its own in-window context at dense precision while spec-off
+    would read those positions int8/int4-round-tripped from the pool —
+    spec-on == spec-off there is quantization-tolerance-gated (measured
+    1.0 on the tested configs, same bar as the kv8 serving rows), not
+    reduction-noise-exact like dense pools. Same model
+    support matrix as :func:`paged_decode_step` (dense/quantized weight
+    stacks, dense/int8/int4 KV pools; alibi/local attention rejected)."""
+    if cfg.alibi or cfg.local_attention_period > 1:
+        raise ValueError("paged verification does not support alibi/"
+                         "local-window attention yet (same bound as "
+                         "paged_decode_step)")
+    ids = jnp.asarray(window_ids)
+    B, W = ids.shape
+    lengths = jnp.asarray(lengths, jnp.int32)
+    positions = lengths[:, None] + jnp.arange(W)[None, :]
+    x = jnp.take(params["wte"], ids, axis=0)
+    if not cfg.rotary and not cfg.alibi:
+        x = x + jnp.take(params["wpe"], positions + cfg.pos_offset, axis=0)
+    if cfg.embed_layernorm:
+        x = layer_norm(x, params["emb_ln_scale"], params["emb_ln_bias"],
+                       cfg.layer_norm_eps)
+    qkv_w = params["blocks"]["qkv_w"]
+    quantized = _is_qleaf(qkv_w)
+    kv_q = "k_scales" in paged_cache
+    compute_dtype = (params["lnf_scale"].dtype if quantized else qkv_w.dtype)
+    x = x.astype(compute_dtype)
+    x = maybe_shard(x, P(BATCH, None, None))
+    blocks = params["blocks"]
+
+    def one_block(x, layer_w, kv):
+        k_p, v_p = kv[0], kv[1]
+        k_s, v_s = (kv[2], kv[3]) if kv_q else (None, None)
+        y, wk, wv = _paged_verify_sublayer(
+            cfg, x, layer_w, k_p, v_p, block_tables, lengths, impl=impl,
+            k_scales=k_s, v_scales=v_s)
+        mlp_in = x if cfg.parallel_residual else y
+        return y + _mlp_delta(cfg, mlp_in, layer_w), (wk, wv)
+
+    kv_xs = ((paged_cache["k_pages"], paged_cache["v_pages"],
+              paged_cache["k_scales"], paged_cache["v_scales"]) if kv_q
+             else (paged_cache["k_pages"], paged_cache["v_pages"]))
+    if quantized:
+        def body(carry, layer_in):
+            x, i = carry
+            layer_w = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, i, 0,
+                                                       keepdims=False),
+                blocks)
+            x, win = one_block(x, layer_w, layer_in)
+            return (x, i + 1), win
+
+        (x, _), (win_k, win_v) = jax.lax.scan(body, (x, jnp.int32(0)), kv_xs)
+    else:
+        def body(carry, layer_in):
+            x, i = carry
+            x, win = one_block(x, layer_in[0], layer_in[1:])
+            return (x, i + 1), win
+
+        (x, _), (win_k, win_v) = jax.lax.scan(
+            body, (x, jnp.int32(0)), (blocks,) + kv_xs)
+    x = layer_norm(x, params["lnf_scale"], params["lnf_bias"],
+                   cfg.layer_norm_eps)
+    head = params["wte"] if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("btd,vd->btv", x, head.astype(x.dtype))
+    if cfg.lm_head_bias and not cfg.tie_embeddings:
+        logits = logits + params["lm_head_b"].astype(logits.dtype)
+    return logits, win_k, win_v
+
+
+def commit_window_kv(paged_cache: Dict[str, jnp.ndarray],
+                     win_k: jnp.ndarray,  # [L, B, W, H, Dh]
+                     win_v: jnp.ndarray,
+                     block_tables: jnp.ndarray,   # [B, pages_per_seq]
+                     lengths: jnp.ndarray,        # [B]: pool tokens pre-window
+                     n_commit: jnp.ndarray,       # [B]: accepted writes (0..W)
+                     ) -> Dict[str, jnp.ndarray]:
+    """Append each row's ACCEPTED window prefix — ``n_commit[b]`` tokens at
+    positions ``lengths[b] .. lengths[b] + n_commit[b] - 1`` — into the
+    paged pool, exactly as ``n_commit[b]`` sequential decode steps would
+    have: one :func:`_append_kv_token` per window step, so quantized page
+    scales keep the monotone-per-lifetime semantics (opening offsets
+    re-establish, mid-page grows requantize) and the committed pool state
+    reproduces the spec-off path's (payloads bitwise given the same
+    values; see :func:`_append_kv_token` for the last-ULP scale caveat).
+    Window positions past the accepted frontier are NEVER written (their
+    rows redirect to the reserved sink page 0) — rejected-suffix rollback
+    is the absence of a write, not an undo."""
+    kv_q = "k_scales" in paged_cache
+    ps = paged_cache["k_pages"].shape[3]
+    L, B, W, H, Dh = win_k.shape
+    tables = jnp.asarray(block_tables, jnp.int32)
+    lengths = jnp.asarray(lengths, jnp.int32)
+    n_commit = jnp.asarray(n_commit, jnp.int32)
+    bits = paged_cache_bits(paged_cache, Dh)
+
+    def layer_commit(layer_in):
+        if kv_q:
+            k_p, v_p, k_s, v_s, wk, wv = layer_in
+        else:
+            k_p, v_p, wk, wv = layer_in
+            k_s = v_s = None
+
+        def step(carry, i):
+            k_p, v_p, k_s, v_s = carry
+            pos = lengths + i
+            write = i < n_commit
+            pidx = jnp.clip(pos // ps, 0, tables.shape[1] - 1)
+            page = jnp.where(
+                write, jnp.take_along_axis(tables, pidx[:, None],
+                                           axis=1)[:, 0], 0)
+            off = pos % ps
+            tok_k = wk[:, i].transpose(1, 0, 2)   # [H, B, Dh]
+            tok_v = wv[:, i].transpose(1, 0, 2)
+            if bits is None:
+                dt = k_p.dtype
+                k_p = k_p.at[:, page, off, :].set(tok_k.astype(dt))
+                v_p = v_p.at[:, page, off, :].set(tok_v.astype(dt))
+            else:
+                k_p, k_s = _append_kv_token(k_p, k_s,
+                                            tok_k.astype(jnp.float32),
+                                            page, off, bits)
+                v_p, v_s = _append_kv_token(v_p, v_s,
+                                            tok_v.astype(jnp.float32),
+                                            page, off, bits)
+            return (k_p, v_p, k_s, v_s), None
+
+        (k_p, v_p, k_s, v_s), _ = jax.lax.scan(
+            step, (k_p, v_p, k_s, v_s), jnp.arange(W))
+        return (k_p, v_p, k_s, v_s) if kv_q else (k_p, v_p)
+
+    def body(_, layer_in):
+        return None, layer_commit(layer_in)
+
+    xs = ((paged_cache["k_pages"], paged_cache["v_pages"],
+           paged_cache["k_scales"], paged_cache["v_scales"], win_k, win_v)
+          if kv_q else
+          (paged_cache["k_pages"], paged_cache["v_pages"], win_k, win_v))
+    _, out = jax.lax.scan(body, None, xs)
+    new_cache = {"k_pages": out[0], "v_pages": out[1]}
+    if kv_q:
+        new_cache["k_scales"] = out[2]
+        new_cache["v_scales"] = out[3]
+    return new_cache
 
 
 def build(cfg_or_name) -> Tuple[Module, GPTConfig]:

@@ -326,6 +326,243 @@ def _paged_q_kernel(len_ref, tbl_ref, ks_ref, vs_ref, q_ref, k_ref, v_ref,
         o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
 
 
+# ---------------------------------------------------------- multi-token verify
+def paged_verify_attention(
+    q: jnp.ndarray,           # [B, W, H, Dh] — the speculation window's queries
+    k_pages: jnp.ndarray,     # [H, P, page_size, Dh] (or int8/int4 quantized)
+    v_pages: jnp.ndarray,
+    lengths: jnp.ndarray,     # [B] int32: tokens already in the POOL (the
+    #                           window is NOT in the pool — it rides win_k/v)
+    block_tables: jnp.ndarray,  # [B, pages_per_seq] int32
+    win_k: jnp.ndarray,       # [B, W, H, Dh] dense post-rope window keys
+    win_v: jnp.ndarray,
+    softmax_scale: Optional[float] = None,
+    impl: Optional[str] = None,
+    k_scales: Optional[jnp.ndarray] = None,
+    v_scales: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Speculative-decoding verification attention: score a ``W``-token
+    window (the verified last token + k drafted tokens) in ONE pass.
+
+    Window position ``i`` sits at absolute position ``lengths[b] + i`` and
+    attends to the pool history (positions ``< lengths[b]``, read through the
+    block table and dequantized exactly like :func:`paged_decode_attention`)
+    plus window positions ``0..i`` (causal within the window). The window's
+    K/V never touch the pool here — they arrive DENSE as ``win_k``/``win_v``
+    and are committed separately, only up to the accepted prefix
+    (``models/gpt.commit_window_kv``), which is what makes rejected-suffix
+    rollback a no-op instead of an undo.
+
+    The XLA ``gather`` fallback scatters the window K/V into the gathered
+    pool copy at their true absolute positions and then runs EXACTLY the
+    single-token fallback's masked softmax per window position — for dense
+    pools the position-``i`` value stream is structurally identical to what
+    ``i`` sequential :func:`paged_decode_attention` fallback calls would
+    compute: the same values at the same positions reduced over the same
+    axis, differing only by how XLA tiles the reduction for a different
+    ``W`` (observed <=1e-7 on fp32 — argmax-stable, which is what the
+    spec-on == spec-off greedy-equivalence gate measures at 1.0). The
+    Pallas kernel streams pool pages like the single-token kernel and
+    handles the window as one extra (causal) tile on the same online-softmax
+    state; kernel vs fallback agree to fp tolerance (tested).
+    """
+    B, W, H, Dh = q.shape
+    if win_k.shape != (B, W, H, Dh) or win_v.shape != (B, W, H, Dh):
+        raise ValueError(
+            f"win_k/win_v must be [B, W, H, Dh]={(B, W, H, Dh)}, got "
+            f"{win_k.shape} / {win_v.shape}")
+    if (k_scales is None) != (v_scales is None):
+        raise ValueError("pass both k_scales and v_scales, or neither")
+    quantized = k_scales is not None
+    packed = quantized and k_pages.shape[-1] * 2 == Dh
+    if quantized and not packed and k_pages.shape[-1] != Dh:
+        raise ValueError(
+            f"quantized pool last dim {k_pages.shape[-1]} matches neither "
+            f"int8 ({Dh}) nor packed int4 ({Dh // 2})")
+    page_size = k_pages.shape[2]
+    pages_per_seq = block_tables.shape[1]
+    scale = softmax_scale if softmax_scale is not None else 1.0 / np.sqrt(Dh)
+    lens = _as_lengths(lengths, B)
+    tables = jnp.asarray(block_tables, jnp.int32)
+    if not quantized:
+        # mirror the sequential append's pool cast, so the fallback reads
+        # the same bits a committed-then-read window token would have
+        win_k = win_k.astype(k_pages.dtype)
+        win_v = win_v.astype(v_pages.dtype)
+    if impl is None:
+        impl = "kernel" if jax.default_backend() == "tpu" else "gather"
+    if impl == "gather":
+        return _paged_verify_gather(q, k_pages, v_pages, lens, tables,
+                                    win_k, win_v, scale, k_scales, v_scales)
+    if impl != "kernel":
+        raise ValueError(f"impl must be None, 'kernel' or 'gather': {impl!r}")
+
+    qh = q.transpose(0, 2, 1, 3)        # [B, H, W, Dh]
+    wkh = win_k.transpose(0, 2, 1, 3)   # [B, H, W, Dh]
+    wvh = win_v.transpose(0, 2, 1, 3)
+    Dp = k_pages.shape[-1]
+    n_prefetch = 4 if quantized else 2
+    # grid walks the table's pages, then ONE extra step for the window tile;
+    # the pool index_map clamps at the last table slot for that step (its
+    # fetch is unused — the body only reads the window operands there)
+    kv_spec = pl.BlockSpec(
+        (1, 1, page_size, Dp),
+        lambda b, h, i, lens, tbl, *_s: (
+            h, tbl[b, jnp.minimum(i, pages_per_seq - 1)], 0, 0))
+    win_spec = pl.BlockSpec((1, 1, W, Dh),
+                            lambda b, h, i, lens, tbl, *_s: (b, h, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=n_prefetch,
+        grid=(B, H, pages_per_seq + 1),
+        in_specs=[win_spec, kv_spec, kv_spec, win_spec, win_spec],
+        out_specs=pl.BlockSpec((1, 1, W, Dh),
+                               lambda b, h, i, lens, tbl, *_s: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((W, Dh), jnp.float32),
+            pltpu.VMEM((W, 1), jnp.float32),
+            pltpu.VMEM((W, 1), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _verify_kernel, sm_scale=scale, page_size=page_size,
+        num_pages=pages_per_seq, window=W, quantized=quantized,
+        packed=packed)
+    operands = ((lens, tables, k_scales.astype(jnp.float32),
+                 v_scales.astype(jnp.float32), qh, k_pages, v_pages, wkh, wvh)
+                if quantized else (lens, tables, qh, k_pages, v_pages,
+                                   wkh, wvh))
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, W, Dh), q.dtype),
+        interpret=_interpret(),
+    )(*operands)
+    return out.transpose(0, 2, 1, 3)    # back to [B, W, H, Dh]
+
+
+def _verify_kernel(len_ref, tbl_ref, *refs, sm_scale: float, page_size: int,
+                   num_pages: int, window: int, quantized: bool,
+                   packed: bool):
+    """Online softmax over (pool pages ++ the causal window tile), with a
+    [W, ·] state row per window position. Pool tiles mask at the POOL length
+    (every window query sees the whole history); the final grid step scores
+    the window against itself with the in-window causal mask and
+    finalizes."""
+    if quantized:
+        (ks_ref, vs_ref, q_ref, k_ref, v_ref, wk_ref, wv_ref,
+         o_ref, acc_ref, m_ref, l_ref) = refs
+    else:
+        ks_ref = vs_ref = None
+        (q_ref, k_ref, v_ref, wk_ref, wv_ref,
+         o_ref, acc_ref, m_ref, l_ref) = refs
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    ki = pl.program_id(2)
+    cur = len_ref[b]
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    def _online_update(s, v):
+        """s: [W, bk] masked scores; v: [bk, Dh] values."""
+        m_prev = m_ref[...]
+        l_prev = l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot(p, v)
+
+    @pl.when(jnp.logical_and(ki < num_pages, ki * page_size < cur))
+    def _pool_tile():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale   # [W, Dh]
+        kq = k_ref[0, 0]
+        vq = v_ref[0, 0]
+        if quantized:
+            if packed:
+                k = unpack_kv_int4(kq)
+                v = unpack_kv_int4(vq)
+            else:
+                k = kq.astype(jnp.float32)
+                v = vq.astype(jnp.float32)
+            page = tbl_ref[b, ki]
+            k = k * ks_ref[h, page]
+            v = v * vs_ref[h, page]
+        else:
+            k = kq.astype(jnp.float32)
+            v = vq.astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # [W, ps]
+        s_pos = (ki * page_size
+                 + jax.lax.broadcasted_iota(jnp.int32, (window, page_size), 1))
+        # pool history is valid for EVERY window query: the window itself
+        # never lives in the pool during verification
+        s = jnp.where(s_pos < cur, s, NEG_INF)
+        _online_update(s, v)
+
+    @pl.when(ki == num_pages)
+    def _window_tile():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale   # [W, Dh]
+        wk = wk_ref[0, 0].astype(jnp.float32)            # [W, Dh]
+        wv = wv_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, wk, (((1,), (1,)), ((), ())))  # [W, W]
+        row = jax.lax.broadcasted_iota(jnp.int32, (window, window), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (window, window), 1)
+        s = jnp.where(col <= row, s, NEG_INF)  # causal within the window
+        _online_update(s, wv)
+        l_safe = jnp.where(l_ref[...] == 0.0, 1.0, l_ref[...])
+        o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+def _paged_verify_gather(q, k_pages, v_pages, lens, tables, win_k, win_v,
+                         scale, k_scales=None, v_scales=None):
+    """XLA fallback for :func:`paged_verify_attention`: gather the pool like
+    the single-token fallback, scatter the dense window K/V at their true
+    absolute positions (``lengths[b] + i`` maps to gathered index
+    ``lengths[b] + i`` because gathered order IS table order), then run the
+    identical masked softmax once per window position via one einsum. For a
+    dense pool the per-position arithmetic is bit-identical to ``W``
+    sequential single-token fallback calls over a pool holding the same
+    committed tokens."""
+    B, W, H, Dh = q.shape
+
+    def gather(pages, scales):
+        g = pages[:, tables]          # [H, B, n, ps, Dp]
+        if scales is not None:
+            g = (unpack_kv_int4(g) if g.shape[-1] * 2 == Dh
+                 else g.astype(jnp.float32))
+            g = g * scales[:, tables][..., None, None]
+        g = g.transpose(1, 0, 2, 3, 4)
+        return g.reshape(B, g.shape[1], -1, g.shape[-1])  # [B, H, S, Dh]
+
+    k = gather(k_pages, k_scales)
+    v = gather(v_pages, v_scales)
+    S = k.shape[2]
+    # window position i lives at absolute (= gathered) position lens + i;
+    # positions past the table capacity DROP (never clip: clipping would
+    # overwrite an earlier window token's K/V at S-1 for a request whose
+    # final window touches the capacity edge — a committable query would
+    # then attend a rejected draft's K/V at its own position). Dropped
+    # positions can never be committed: budget caps n at max_new, and
+    # admission bounds prompt+max_new to the table.
+    pos = lens[:, None] + jnp.arange(W)[None, :]              # [B, W]
+    bidx = jnp.broadcast_to(jnp.arange(B)[:, None], (B, W))
+    k = k.at[bidx, :, pos, :].set(win_k.astype(k.dtype), mode="drop")
+    v = v.at[bidx, :, pos, :].set(win_v.astype(v.dtype), mode="drop")
+    s = jnp.einsum("bwhd,bhsd->bhws", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    # query i sees positions < lens + i + 1 (history + window prefix + self)
+    limit = lens[:, None] + jnp.arange(1, W + 1)[None, :]      # [B, W]
+    mask = jnp.arange(S)[None, None, :] < limit[:, :, None]    # [B, W, S]
+    s = jnp.where(mask[:, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhws,bhsd->bwhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
 def _paged_gather_attention(q, k_pages, v_pages, lens, tables, scale,
                             k_scales=None, v_scales=None):
     """XLA fallback: materialize each request's pages contiguously (one

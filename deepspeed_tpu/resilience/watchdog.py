@@ -57,11 +57,12 @@ PHASES = ("compile", "step", "collective", "checkpoint")
 #: Serving phases (docs/SERVING.md "Overload & failure"): the
 #: continuous-batching scheduler brackets every executor dispatch with one
 #: of these, each with its own deadline (prefill is a multi-chunk forward,
-#: decode a fixed-slot step/block — very different time scales). A stalled
-#: dispatch gets the same treatment a stalled training collective does:
-#: stack dump, wire-ledger log, ``watchdog_stall`` recovery event,
-#: escalation callback.
-SERVING_PHASES = ("serving_prefill", "serving_decode")
+#: decode a fixed-slot step/block — very different time scales; verify is
+#: the speculative k+1-token analog of a decode step and shares its
+#: deadline). A stalled dispatch gets the same treatment a stalled
+#: training collective does: stack dump, wire-ledger log,
+#: ``watchdog_stall`` recovery event, escalation callback.
+SERVING_PHASES = ("serving_prefill", "serving_decode", "serving_verify")
 
 
 class HealthWatchdog:

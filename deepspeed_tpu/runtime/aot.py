@@ -743,12 +743,69 @@ def find_max_decode_batch(
             "report": best}
 
 
+def speculation_hbm_bytes(
+    model: str,
+    *,
+    draft_model: Optional[Any] = None,  # PRESETS name or GPTConfig
+    num_slots: int = 1,
+    max_model_len: int = 1024,
+    spec_k: int = 4,
+    dtype: str = "bfloat16",
+) -> Dict[str, Any]:
+    """The EXTRA resident HBM speculative decoding arms on top of a serving
+    engine (docs/SERVING.md "Speculative decoding"), itemized so
+    ``num_slots="auto"`` can charge it against the fit budget:
+
+    - ``draft_params`` — the draft model's weights (resident for the whole
+      serving lifetime);
+    - ``draft_cache`` — its per-slot dense KV cache
+      ([L_d, slots, H_d, max_model_len, Dh_d] x K and V);
+    - ``verify_window`` — the target's per-layer dense window K/V stacks
+      ([L, slots, k+1, H, Dh] x 2, the commit scatter's input) plus the
+      [slots, k+1, V] verify logits — the activation footprint that scales
+      with ``spec_k``.
+
+    n-gram self-drafting (``draft_model=None``) pays only ``verify_window``
+    — that is its whole pitch. Estimates are compile-free and deliberately
+    additive-conservative: the AOT probe's own peak already covers the
+    single-token decode activations, so only speculation's NEW buffers are
+    charged. ``draft_model`` is a PRESETS name or a ``GPTConfig`` (the
+    serving engine passes the config of an explicitly supplied
+    ``draft=(cfg, params)`` pair, so "auto" prices the draft model that
+    will ACTUALLY be resident, not just a preset name)."""
+    from ..models import gpt as gpt_mod
+
+    item = 2 if dtype == "bfloat16" else 4
+    W = int(spec_k) + 1
+    parts: Dict[str, int] = {}
+    if draft_model is not None:
+        dcfg = (gpt_mod.PRESETS[draft_model]
+                if isinstance(draft_model, str) else draft_model)
+        parts["draft_params"] = int(dcfg.num_params()) * item
+        parts["draft_cache"] = (2 * dcfg.n_layer * int(num_slots)
+                                * dcfg.n_head * int(max_model_len)
+                                * dcfg.head_dim * item)
+    tcfg = gpt_mod.PRESETS[model]
+    win_kv = 2 * tcfg.n_layer * int(num_slots) * W * tcfg.d_model * item
+    logits = int(num_slots) * W * tcfg.vocab_size * item
+    parts["verify_window"] = win_kv + logits
+    return {"model": model,
+            "draft_model": (draft_model if isinstance(draft_model, str)
+                            or draft_model is None else "<config>"),
+            "num_slots": int(num_slots), "spec_k": int(spec_k),
+            "max_model_len": int(max_model_len),
+            "parts": parts, "total": int(sum(parts.values()))}
+
+
 def serving_admission_limit(
     model: str,
     *,
     lo: int = 1,
     hi: int = 64,
     safety_margin: float = 1.0,
+    draft_model: Optional[Any] = None,  # PRESETS name or GPTConfig
+    spec_k: int = 0,
+    spec_max_len: Optional[int] = None,
     **report_kwargs: Any,
 ) -> Dict[str, Any]:
     """The continuous-batching admission limit, from the AOT fit ladder.
@@ -766,14 +823,47 @@ def serving_admission_limit(
     slots from QUANTIZED pools — ``ServingConfig(num_slots="auto",
     kv_bits=8)`` resolves here, so the admission limit prices the KV bytes
     the pool actually holds instead of dense pages (which under-admits ~2x
-    at int8)."""
-    r = find_max_decode_batch(model, lo=lo, hi=hi, **report_kwargs)
+    at int8).
+
+    ``draft_model``/``spec_k`` (speculation armed): each probe's compiled
+    peak is topped up with :func:`speculation_hbm_bytes` at THAT batch's
+    slot count before the fit verdict — "auto" with a drafter configured
+    admits only what still fits with the draft params, the per-slot draft
+    cache, and the k-token verify activations resident."""
+    spec_armed = draft_model is not None or int(spec_k) > 0
+    if not spec_armed:
+        r = find_max_decode_batch(model, lo=lo, hi=hi, **report_kwargs)
+    else:
+        max_len = int(spec_max_len
+                      if spec_max_len is not None
+                      else (report_kwargs.get("prompt", 128)
+                            + report_kwargs.get("gen", 64) + 8))
+
+        def probe(b: int) -> Dict[str, Any]:
+            rep = decode_program_report(model, batch=b, **report_kwargs)
+            if not rep.get("fits_v5e_hbm"):
+                return rep
+            spec = speculation_hbm_bytes(
+                model, draft_model=draft_model, num_slots=b,
+                max_model_len=max_len, spec_k=max(int(spec_k), 1),
+                dtype=rep.get("cache_dtype", "bfloat16"))
+            peak = rep["per_device_bytes"]["peak"] + spec["total"]
+            rep["speculation"] = spec
+            rep["fit"] = fit_verdict(peak)
+            rep["fits_v5e_hbm"] = rep["fit"]["confidence"] != "oom"
+            return rep
+
+        best_v, best, trace = _find_max(probe, "batch", lo, hi)
+        r = {"max_batch": best_v, "report": best, "trace": trace}
     slots = int(r["max_batch"] * safety_margin)
     fit = (r.get("report") or {}).get("fit")
-    return {"model": model, "max_slots": slots,
-            "max_decode_batch": r["max_batch"], "fit": fit,
-            "kv_bits": int(report_kwargs.get("kv_bits", 0) or 0),
-            "trace": r["trace"]}
+    out = {"model": model, "max_slots": slots,
+           "max_decode_batch": r["max_batch"], "fit": fit,
+           "kv_bits": int(report_kwargs.get("kv_bits", 0) or 0),
+           "trace": r["trace"]}
+    if spec_armed:
+        out["speculation"] = (r.get("report") or {}).get("speculation")
+    return out
 
 
 def fleet_replica_plan(

@@ -31,6 +31,14 @@ prompt through a copy-on-write prefix-cache engine: physical pages
 allocated must undercut the sum of logical pages, greedy outputs must stay
 generate-identical, and the refcount audit must be clean after the drain.
 
+``--spec`` (docs/SERVING.md "Speculative decoding") drives the REAL engine
+with both drafters: an n-gram self-drafting run (whose early random
+histories force >= 1 full-reject window) and a draft-model run with the
+draft == the target (forcing >= 1 full-accept window in fewer dispatches),
+asserting greedy outputs stay IDENTICAL to ``InferenceEngine.generate``
+under both, the page audit is clean, and the adaptive-k/accept-rate ledger
+flowed.
+
 ``--fleet`` (docs/SERVING.md "Fleet") runs TWO real-engine replicas as
 separate worker PROCESSES behind the fleet router and SIGKILLs one of them
 mid-stream: the router must detect the death (pipe EOF), re-route the dead
@@ -313,6 +321,68 @@ def prefix_main() -> int:
     return 0
 
 
+def spec_main() -> int:
+    """Speculative decoding end to end on the real engine (docs/SERVING.md
+    "Speculative decoding"): both drafters, >= 1 full-reject and >= 1
+    full-accept window, generate-identical outputs, clean page audit."""
+    cfg = G.GPTConfig(vocab_size=64, d_model=32, n_layer=2, n_head=4,
+                      max_seq_len=128)
+    params = G.init_params(cfg, jax.random.PRNGKey(0))
+    ie = InferenceEngine(for_gpt(cfg, params), DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=64))
+
+    def run(drafter, draft=None):
+        eng = ServingEngine(cfg, params, ServingConfig(
+            num_slots=3, page_size=8, max_model_len=64, prefill_chunk=16,
+            dtype="float32", decode_block=4, max_queue=32,
+            spec_drafter=drafter, spec_k=4), draft=draft)
+        eng.warmup()
+        wl = make_open_loop_workload(8, rate_rps=500.0, prompt_len=(3, 30),
+                                     max_new=(4, 16), vocab_size=64, seed=7)
+        rep = run_continuous(eng, wl)
+        assert rep["finished"] == len(wl), rep
+        sched = eng.last_scheduler
+        audit = sched.audit()
+        assert audit["ok"] and sched.allocator.allocated_pages == 0, audit
+        for r in wl:
+            ref = np.asarray(ie.generate(
+                np.asarray(r.prompt)[None],
+                max_new_tokens=r.max_new_tokens))[0, len(r.prompt):]
+            got = np.asarray(r.tokens[:r.max_new_tokens])
+            assert np.array_equal(ref, got), (r.rid, ref, got)
+        return rep["spec"], rep
+
+    # n-gram self-drafting: random prompts give degenerate early matches,
+    # so full-reject windows MUST occur; greedy loops then lock in accepts
+    ngram, rep_n = run("ngram")
+    assert ngram["windows"] > 0 and ngram["drafted"] > 0, ngram
+    assert ngram["full_reject_windows"] >= 1, ngram
+    assert 0.0 <= ngram["accept_rate"] <= 1.0, ngram
+    print(f"[spec] ngram: {ngram['windows']} windows, accept_rate "
+          f"{ngram['accept_rate']}, tokens/dispatch "
+          f"{ngram['tokens_per_dispatch']}, "
+          f"{ngram['full_reject_windows']} full-reject window(s), "
+          f"outputs identical to generate, audit clean")
+
+    # draft model == target: proposals are the target's own greedy
+    # continuations, so full-accept windows MUST occur and the stream
+    # finishes in fewer dispatches than one-token-per-step would need
+    dm, rep_d = run("draft_model", draft=(cfg, params))
+    assert dm["full_accept_windows"] >= 1, dm
+    assert dm["accept_rate"] > 0.5, dm
+    assert (rep_d["decode_steps"] < rep_n["decode_steps"]
+            or dm["tokens_per_dispatch"] > ngram["tokens_per_dispatch"]), \
+        (dm, ngram)
+    print(f"[spec] draft_model: {dm['windows']} windows, accept_rate "
+          f"{dm['accept_rate']}, tokens/dispatch "
+          f"{dm['tokens_per_dispatch']}, "
+          f"{dm['full_accept_windows']} full-accept window(s), "
+          f"outputs identical to generate, audit clean")
+
+    print("serving_smoke[spec]: PASS")
+    return 0
+
+
 def fleet_main() -> int:
     """Fleet failover end to end (docs/SERVING.md "Fleet"): two real-engine
     replica processes, one SIGKILL'd mid-stream. The router re-routes the
@@ -405,6 +475,8 @@ if __name__ == "__main__":
         sys.exit(chaos_main())
     if "--prefix" in sys.argv[1:]:
         sys.exit(prefix_main())
+    if "--spec" in sys.argv[1:]:
+        sys.exit(spec_main())
     if "--fleet" in sys.argv[1:]:
         sys.exit(fleet_main())
     sys.exit(main())

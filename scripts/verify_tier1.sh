@@ -68,7 +68,7 @@ grep -aE '^[0-9]+ passed' /tmp/_t1_overlap.log || true
 # serving dslint rule.
 if ! timeout -k 10 420 env JAX_PLATFORMS=cpu \
         python -m pytest tests/test_serving.py tests/test_serving_chaos.py \
-        tests/test_paged_kv.py tests/test_fleet.py \
+        tests/test_paged_kv.py tests/test_fleet.py tests/test_speculation.py \
         tests/test_decode_attention.py -q -m 'not slow' \
         -p no:cacheprovider -p no:randomly > /tmp/_t1_serving.log 2>&1; then
     echo "verify_tier1: FAIL — serving/paged-KV tests:" >&2
@@ -101,6 +101,20 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 grep -a "serving_smoke\[prefix\]: PASS" /tmp/_t1_serving_prefix.log || true
+
+# the speculative-decoding smoke (docs/SERVING.md "Speculative decoding"):
+# both drafters against the real engine — >= 1 full-reject window (n-gram
+# on random history) and >= 1 full-accept window (draft == target), greedy
+# outputs generate-IDENTICAL under both, page audit clean.
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        python scripts/serving_smoke.py --spec \
+        > /tmp/_t1_serving_spec.log 2>&1; then
+    echo "verify_tier1: FAIL — speculative-decoding smoke" \
+         "(scripts/serving_smoke.py --spec):" >&2
+    tail -30 /tmp/_t1_serving_spec.log >&2
+    exit 1
+fi
+grep -a "serving_smoke\[spec\]: PASS" /tmp/_t1_serving_spec.log || true
 
 # the serving chaos smoke (docs/SERVING.md "Overload & failure"): one
 # injected dispatch-failure episode (preempt-and-requeue heal) and one
