@@ -36,15 +36,17 @@ from .ir import ProgramIR, capture
 from .rules_collectives import collective_rules
 from .rules_config import config_rules
 from .rules_hostsync import hostsync_rules
+from .rules_offload import offload_rules
 from .rules_precision import precision_rules
 from .rules_serving import serving_rules
 from .rules_sharding import sharding_rules
 
 
 def default_rules() -> List[Rule]:
-    """The shipped rule set, all six families."""
+    """The shipped rule set, all seven families."""
     return (sharding_rules() + precision_rules() + hostsync_rules()
-            + collective_rules() + config_rules() + serving_rules())
+            + collective_rules() + config_rules() + serving_rules()
+            + offload_rules())
 
 
 def options_from_config(block) -> AnalysisOptions:
@@ -192,5 +194,5 @@ __all__ = [
     "Severity", "Finding", "Rule", "Report", "Analyzer", "AnalysisContext",
     "AnalysisOptions", "AnalysisError", "ProgramIR", "capture",
     "default_rules", "options_from_config", "analyze_engine", "analyze_fn",
-    "analyze_compile_log", "synthesize_batch",
+    "analyze_compile_log", "synthesize_batch", "offload_rules",
 ]

@@ -145,16 +145,26 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                             os.path.join(ckpt_dir, "zero_to_fp32.py"))
         except Exception as e:  # never fail a save over the convenience copy
             log_dist(f"zero_to_fp32.py copy skipped: {e}")
-    # ZeRO-Offload: the fp32 master + moments live in host RAM/SSD on the runner.
-    # Written BEFORE the 'latest' pointer so a crash in between can never leave a
-    # resolvable tag with missing optimizer state.
+    # ZeRO-Offload/Infinity: the fp32 master + moments live in host RAM/SSD on
+    # the runner. Written BEFORE the 'latest' pointer so a crash in between can
+    # never leave a resolvable tag with missing optimizer state. RAM-mode
+    # runners flush per-unit/per-group SHARDS (docs/OFFLOAD.md): each shard is
+    # atomic, a fault_point("host-shard", k) fires between them, and the
+    # manifest/COMMIT below covers them — a SIGKILL mid-flush leaves this tag
+    # uncommitted and the previous committed one loadable. NVMe-store runners
+    # keep the consolidated npz format.
     offload = (getattr(engine, "_offload", None)
                or getattr(engine, "_param_stream", None))
     if offload is not None and is_writer:
         if offload.master is None:  # checkpoint before the first step
             offload.init_host_state()
-        ckpt_engine.save(offload.host_state_dict(),
-                         os.path.join(ckpt_dir, "host_optimizer.npz"))
+        flush = getattr(offload, "flush_host_shards", None)
+        from ..runtime.zero.stream import HOST_STATE_DIRNAME
+
+        if flush is None or not flush(
+                os.path.join(ckpt_dir, HOST_STATE_DIRNAME)):
+            ckpt_engine.save(offload.host_state_dict(),
+                             os.path.join(ckpt_dir, "host_optimizer.npz"))
     # durability point 1: async engines flush all queued writes here (raising
     # on any background failure), BEFORE the manifest hashes what's on disk
     ckpt_engine.commit(tag)
@@ -254,18 +264,25 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     offload = (getattr(engine, "_offload", None)
                or getattr(engine, "_param_stream", None))
     if offload is not None and load_optimizer_states:
+        from ..runtime.zero.stream import HOST_STATE_DIRNAME
+
+        host_dir = os.path.join(ckpt_dir, HOST_STATE_DIRNAME)
         host_path = os.path.join(ckpt_dir, "host_optimizer.npz")
-        if not os.path.exists(host_path):
+        if not os.path.isdir(host_dir) and not os.path.exists(host_path):
             raise FileNotFoundError(
-                f"checkpoint {ckpt_dir} has no host_optimizer.npz but the engine "
-                "runs ZeRO-Offload; pass load_optimizer_states=False to restart "
-                "the optimizer deliberately")
+                f"checkpoint {ckpt_dir} has no host_state/ shards or "
+                "host_optimizer.npz but the engine runs ZeRO-Offload; pass "
+                "load_optimizer_states=False to restart the optimizer "
+                "deliberately")
         import numpy as np
 
         if offload.master is None:
             offload.init_host_state(for_load=True)
-        with np.load(host_path) as d:
-            offload.load_host_state_dict(dict(d))
+        if os.path.isdir(host_dir):
+            offload.load_host_shards_dir(host_dir)
+        else:  # legacy consolidated format + the NVMe-store path
+            with np.load(host_path) as d:
+                offload.load_host_state_dict(dict(d))
     log_dist(f"loaded checkpoint {ckpt_dir}")
     return ckpt_dir, meta.get("client_state", {})
 

@@ -220,6 +220,66 @@ def dequantize_blockwise(
     return x
 
 
+# numpy mirrors of the blockwise pair — the HOST side of the quantized
+# host<->HBM DMA path (runtime/zero/stream.py pushes int8 payloads instead of
+# bf16/fp32, GatheredParameters(quantized=True) dequantizes fetched payloads).
+# Same effective-block / edge-pad / round-half-even semantics as the jnp pair,
+# so a host-quantized push dequantized on device round-trips identically.
+def np_quantize_blockwise(
+    x: np.ndarray,
+    bits: int = 8,
+    block_size: int = DEFAULT_BLOCK,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host (numpy) :func:`quantize_blockwise`: returns ``(q, scale, zp)``
+    with the same shapes/dtypes the jnp quantizer produces (deterministic
+    rounding only — stochastic rounding is a device-side concern)."""
+    if bits not in SUPPORTED_BITS:
+        raise ValueError(f"bits must be one of {SUPPORTED_BITS}, got {bits}")
+    levels = (1 << bits) - 1
+    block_size = effective_block(x.shape[-1], block_size)
+    x32 = np.asarray(x, np.float32)
+    pad = (-x32.shape[-1]) % block_size
+    if pad:
+        x32 = np.pad(x32, [(0, 0)] * (x32.ndim - 1) + [(0, pad)], mode="edge")
+    lead = x32.shape[:-1]
+    nb = x32.shape[-1] // block_size
+    xb = x32.reshape(lead + (nb, block_size))
+    mn = np.min(xb, axis=-1).astype(np.float32)
+    mx = np.max(xb, axis=-1).astype(np.float32)
+    scale = np.maximum((mx - mn) / levels, np.float32(1e-12))
+    v = (xb - mn[..., None]) / scale[..., None]
+    q = np.clip(np.round(v), 0, levels).astype(np.uint8).reshape(
+        lead + (nb * block_size,))
+    if bits == 4:
+        q = (q[..., 0::2] | (q[..., 1::2] << 4)).astype(np.uint8)
+    return q, scale, mn
+
+
+def np_dequantize_blockwise(
+    q: np.ndarray,
+    scale: np.ndarray,
+    zero_point: np.ndarray,
+    bits: int = 8,
+    orig_size: Optional[int] = None,
+) -> np.ndarray:
+    """Host (numpy) :func:`dequantize_blockwise` (fp32 output, trailing
+    padding trimmed to ``orig_size``). The block extent is derived from the
+    payload/scale shapes, exactly like the jnp dequantizer."""
+    lead = q.shape[:-1]
+    if bits == 4:
+        q = np.stack([q & 0xF, q >> 4], axis=-1).reshape(
+            lead + (q.shape[-1] * 2,))
+    nb = scale.shape[-1]
+    block = q.shape[-1] // nb
+    xb = q.reshape(lead + (nb, block)).astype(np.float32)
+    x = (xb * np.asarray(scale, np.float32)[..., None]
+         + np.asarray(zero_point, np.float32)[..., None]).reshape(
+        lead + (nb * block,))
+    if orig_size is not None and orig_size != x.shape[-1]:
+        x = x[..., :orig_size]
+    return np.ascontiguousarray(x)
+
+
 # 1-bit (sign) quantizer — the wire format of the compressed allreduce; lives
 # here so the error-feedback machinery is shared with the int collectives.
 def pack_signs(x: jnp.ndarray) -> jnp.ndarray:
@@ -598,6 +658,8 @@ __all__ = [
     "active_quantization",
     "quantize_blockwise",
     "dequantize_blockwise",
+    "np_quantize_blockwise",
+    "np_dequantize_blockwise",
     "pack_signs",
     "unpack_signs",
     "quantize_1bit",

@@ -70,6 +70,10 @@ class WireLedger:
 
     records: Dict[str, WireRecord] = field(default_factory=dict)
     overlap: Optional[Dict[str, float]] = None
+    # host<->HBM DMA column (:class:`HostDmaStats`.to_dict): attached by the
+    # streaming offload engine after each step so comms_summary() renders
+    # the host wire next to the collective wire
+    host_dma: Optional[Dict[str, float]] = None
     # graceful-degradation history: ops demoted off the quantized wire by the
     # health subsystem (resilience/rollback.py WireDemotionController) — kept
     # in the ledger so comms_summary() shows the wire's true state, not just
@@ -124,6 +128,10 @@ class WireLedger:
         """Attach a measured overlap column (:meth:`OverlapStats.to_dict`)."""
         self.overlap = dict(overlap) if overlap else None
 
+    def set_host_dma(self, dma: Optional[Dict[str, float]]) -> None:
+        """Attach a host-DMA column (:meth:`HostDmaStats.to_dict`)."""
+        self.host_dma = dict(dma) if dma else None
+
     def summary(self) -> str:
         lines = ["quantized wire accounting (trace-time):"]
         for name, row in self.summary_dict().items():
@@ -140,6 +148,16 @@ class WireLedger:
                 f"exposed={o.get('exposed_us', 0):.0f}us "
                 f"overlapped={o.get('overlapped_us', 0):.0f}us "
                 f"({o.get('hidden_frac', 0.0):.0%} hidden)")
+        if self.host_dma:
+            h = self.host_dma
+            lines.append(
+                f"  host DMA (offload stream, last step): "
+                f"pushed={h.get('push_bytes', 0)}B "
+                f"wire={h.get('wire_bytes', 0)}B "
+                f"grads={h.get('grad_bytes', 0)}B "
+                f"depth={h.get('prefetch_depth', 0)} "
+                f"exposed_wait={h.get('exposed_wait_s', 0.0):.3f}s "
+                f"({h.get('overlapped_frac', 0.0):.0%} of waits overlapped)")
         for d in self.demotions:
             end = (f"re-promoted at step {d['repromoted_step']}"
                    if d["repromoted_step"] is not None else "STILL DEMOTED")
@@ -165,9 +183,84 @@ class WireLedger:
     def reset(self) -> None:
         self.records.clear()
         self.demotions.clear()
+        self.host_dma = None
 
 
 wire_ledger = WireLedger()
+
+
+@dataclass
+class HostDmaStats:
+    """Per-step host<->HBM DMA accounting for the streaming offload engine
+    (``runtime/zero/stream.py``).
+
+    ``push_bytes`` is the logical (compute-dtype) volume pushed host->HBM;
+    ``wire_bytes`` what actually moved (smaller under quantized fetch);
+    ``grad_bytes`` the device->host gradient fetch volume.
+    ``exposed_wait_s`` is the time the host spent BLOCKED on an in-flight
+    transfer at a consume point — the step-time cost of the DMA the prefetch
+    schedule failed to hide. A push whose wait was under ``READY_EPS_S``
+    counts as *overlapped* (the transfer landed entirely under compute);
+    ``overlapped_frac`` is the fraction of waits that did — the bench A/B
+    observable for streamed vs fetch-on-demand schedules."""
+
+    READY_EPS_S = 1e-3
+
+    pushes: int = 0
+    push_bytes: int = 0
+    wire_bytes: int = 0
+    grad_fetches: int = 0
+    grad_bytes: int = 0
+    waits: int = 0
+    overlapped_waits: int = 0
+    exposed_wait_s: float = 0.0
+    issue_s: float = 0.0
+    step_s: float = 0.0
+    prefetch_depth: int = 0
+    quantized: bool = False
+
+    def record_push(self, logical_bytes: int, wire_bytes: int) -> None:
+        self.pushes += 1
+        self.push_bytes += int(logical_bytes)
+        self.wire_bytes += int(wire_bytes)
+
+    def record_wait(self, seconds: float) -> None:
+        self.waits += 1
+        if seconds < self.READY_EPS_S:
+            self.overlapped_waits += 1
+        self.exposed_wait_s += float(seconds)
+
+    def record_grad_fetch(self, nbytes: int, seconds: float) -> None:
+        self.grad_fetches += 1
+        self.grad_bytes += int(nbytes)
+        self.record_wait(seconds)
+
+    @property
+    def overlapped_frac(self) -> float:
+        return self.overlapped_waits / self.waits if self.waits else 0.0
+
+    @property
+    def ratio(self) -> float:
+        """Logical/wire compression of the host->HBM push path."""
+        return self.push_bytes / self.wire_bytes if self.wire_bytes else 1.0
+
+    def to_dict(self) -> Dict[str, float]:
+        return {
+            "pushes": self.pushes,
+            "push_bytes": self.push_bytes,
+            "wire_bytes": self.wire_bytes,
+            "grad_fetches": self.grad_fetches,
+            "grad_bytes": self.grad_bytes,
+            "waits": self.waits,
+            "overlapped_waits": self.overlapped_waits,
+            "overlapped_frac": round(self.overlapped_frac, 4),
+            "exposed_wait_s": round(self.exposed_wait_s, 4),
+            "issue_s": round(self.issue_s, 4),
+            "step_s": round(self.step_s, 4),
+            "prefetch_depth": self.prefetch_depth,
+            "quantized": self.quantized,
+            "ratio": round(self.ratio, 3),
+        }
 
 
 @dataclass

@@ -95,6 +95,20 @@ class FaultPlan:
       ``PageAllocator.alloc`` call reports pool exhaustion (returns None) —
       admission must queue (head-of-line) and growth must preempt, exactly
       as under real pool pressure.
+
+    Offload-path injectors (docs/OFFLOAD.md; consumed by the streaming
+    offload engine via :func:`offload_fetch_fault` at every blocking
+    host<->HBM wait, inside the ``offload_fetch`` watchdog phase):
+
+    - ``stall_offload_at`` + ``stall_offload_seconds``: the Nth (0-based,
+      process-wide) offload fetch wait sleeps host-side before blocking on
+      the transfer — a hung host<->HBM DMA the ``offload_fetch`` watchdog
+      deadline must flag. One-shot. The streamed-vs-inline numerics are
+      untouched: the stall delays the wait, never the values.
+    - the ``host-shard`` save phase (``kill_at_phase: "host-shard:N"``):
+      SIGKILL right after host-optimizer shard ``N`` hits the checkpoint
+      directory — a preemption mid-flush. The tag has no COMMIT marker, so
+      resume must fall back to the newest committed one, step-exact.
     """
 
     kill_at_phase: Optional[str] = None
@@ -116,6 +130,9 @@ class FaultPlan:
     dispatch_stall_seconds: float = 0.0
     alloc_fail_at: Optional[int] = None
     alloc_fail_times: int = 1
+    # offload-path injectors
+    stall_offload_at: Optional[int] = None
+    stall_offload_seconds: float = 0.0
 
     # runtime counters (not part of the plan spec)
     _save_index: int = dataclasses.field(default=-1, repr=False)
@@ -124,6 +141,7 @@ class FaultPlan:
     _stalls_left: int = dataclasses.field(default=0, repr=False)
     _collective_stall_fired: bool = dataclasses.field(default=False, repr=False)
     _ef_overflows_left: int = dataclasses.field(default=0, repr=False)
+    _offload_stall_fired: bool = dataclasses.field(default=False, repr=False)
 
     def __post_init__(self) -> None:
         self._io_failures_left = int(self.fail_io_times)
@@ -231,6 +249,17 @@ class FaultPlan:
                 and self.dispatch_stall_seconds > 0):
             stall = float(self.dispatch_stall_seconds)
         return ServingFault(raise_error=raise_error, stall_s=stall)
+
+    def offload_fetch(self, index: int) -> float:
+        """Seconds to stall offload fetch wait ``index`` (0-based, counted
+        process-wide across forward pushes and gradient fetches); 0 when
+        disarmed. One-shot: a retried/looping fetch never re-fires it."""
+        if (self.stall_offload_at is None or self._offload_stall_fired
+                or index < int(self.stall_offload_at)
+                or self.stall_offload_seconds <= 0):
+            return 0.0
+        self._offload_stall_fired = True
+        return float(self.stall_offload_seconds)
 
     def serving_alloc(self, index: int) -> bool:
         """Whether ``PageAllocator.alloc`` call ``index`` should report pool
@@ -345,6 +374,22 @@ def serving_dispatch_fault(kind: str, index: int) -> None:
             f"chaos: injected failure on serving {kind} dispatch #{index}")
 
 
+def offload_fetch_fault(index: int) -> None:
+    """Fire the offload-DMA stall armed for blocking fetch wait ``index``.
+    Called by the streaming offload engine INSIDE the ``offload_fetch``
+    watchdog phase, so the injected hang is observed by the same deadline
+    machinery a genuinely wedged host<->HBM transfer would trip."""
+    plan = get_fault_plan()
+    if plan is None:
+        return
+    stall = plan.offload_fetch(index)
+    if stall > 0:
+        logger.warning(
+            f"chaos: stalling offload fetch #{index} for {stall}s "
+            f"(injected host<->HBM DMA hang)")
+        time.sleep(stall)
+
+
 def serving_alloc_fault(index: int) -> bool:
     """Whether the armed plan wants ``PageAllocator.alloc`` call ``index``
     to report exhaustion (False when no plan is installed)."""
@@ -361,4 +406,5 @@ def serving_alloc_fault(index: int) -> bool:
 __all__ = ["FaultPlan", "TrainingFaults", "ServingFault",
            "InjectedDispatchError", "FAULT_PLAN_ENV", "install_plan",
            "get_fault_plan", "fault_point", "training_faults",
-           "serving_dispatch_fault", "serving_alloc_fault"]
+           "serving_dispatch_fault", "serving_alloc_fault",
+           "offload_fetch_fault"]

@@ -64,6 +64,16 @@ PHASES = ("compile", "step", "collective", "checkpoint")
 #: ``watchdog_stall`` recovery event, escalation callback.
 SERVING_PHASES = ("serving_prefill", "serving_decode", "serving_verify")
 
+#: Host-offload DMA phases (docs/OFFLOAD.md): the ZeRO-Offload/Infinity
+#: runners bracket every host<->HBM blocking point with one of these —
+#: ``offload_fetch`` around a wait on an in-flight unit/gradient transfer,
+#: ``offload_flush`` around the host optimizer pass and the per-unit
+#: host-shard checkpoint flush. They NEST inside the engine's ``step`` /
+#: ``checkpoint`` phases (the watchdog tracks a phase stack, checking every
+#: open deadline), so a wedged DMA is named as ``offload_fetch`` instead of
+#: surfacing as a generic slow step.
+OFFLOAD_PHASES = ("offload_fetch", "offload_flush")
+
 
 class HealthWatchdog:
     """Deadline monitor over the engine's step-loop phases.
@@ -88,10 +98,13 @@ class HealthWatchdog:
         self.recovery_log = recovery_log
         self.stacks_dir = stacks_dir
         self._lock = threading.Lock()
-        self._phase: Optional[str] = None
-        self._phase_start: float = 0.0
-        self._phase_seq = 0          # increments on every enter/exit
-        self._stalled_seq: Optional[int] = None  # seq a stall fired for
+        # open phases, outermost first: [name, start_monotonic, seq]. A stack
+        # (not a single slot) because the offload runners bracket host-DMA
+        # waits INSIDE the engine's step/checkpoint phases — every open
+        # phase's deadline is checked independently.
+        self._stack: List[list] = []
+        self._seq = 0                 # increments on every enter
+        self._stalled: set = set()    # seqs a stall already fired for
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.stall_count = 0
@@ -100,7 +113,9 @@ class HealthWatchdog:
     # ------------------------------------------------------------- phase API
     @contextmanager
     def phase(self, name: str):
-        """Bracket one deadline-checked phase (the engine's step loop)."""
+        """Bracket one deadline-checked phase (the engine's step loop).
+        Nestable: an inner phase (e.g. ``offload_fetch`` inside ``step``)
+        does not suspend the outer one's deadline."""
         seq = self._enter(name)
         try:
             yield self
@@ -109,19 +124,21 @@ class HealthWatchdog:
 
     def _enter(self, name: str) -> int:
         with self._lock:
-            self._phase = name
-            self._phase_start = time.monotonic()
-            self._phase_seq += 1
-            return self._phase_seq
+            self._seq += 1
+            self._stack.append([name, time.monotonic(), self._seq])
+            return self._seq
 
     def _exit(self, seq: int) -> None:
         with self._lock:
-            elapsed = time.monotonic() - self._phase_start
-            phase = self._phase
-            recovered = self._stalled_seq == seq
-            self._phase = None
-            self._phase_seq += 1
-            self._stalled_seq = None
+            phase, elapsed = None, 0.0
+            for i in range(len(self._stack) - 1, -1, -1):
+                if self._stack[i][2] == seq:
+                    name, start, _ = self._stack.pop(i)
+                    phase = name
+                    elapsed = time.monotonic() - start
+                    break
+            recovered = seq in self._stalled
+            self._stalled.discard(seq)
         if recovered and phase is not None:
             # the stall cleared: a straggler, not a deadlock — record it so
             # the run record distinguishes "slow" from "dead"
@@ -151,23 +168,22 @@ class HealthWatchdog:
             self._check()
 
     def _check(self) -> None:
+        now = time.monotonic()
         with self._lock:
-            phase = self._phase
-            seq = self._phase_seq
-            elapsed = time.monotonic() - self._phase_start
-            already = self._stalled_seq == seq
-        if phase is None or already:
-            return
-        deadline = self.deadlines.get(phase, 0.0)
-        if deadline <= 0 or elapsed <= deadline:
-            return
-        with self._lock:
-            if self._phase_seq != seq:  # phase ended while we decided
-                return
-            self._stalled_seq = seq
-        self.stall_count += 1
-        self.last_stall = (phase, elapsed)
-        self._on_stall_detected(phase, elapsed)
+            snapshot = [(name, now - start, seq)
+                        for name, start, seq in self._stack
+                        if seq not in self._stalled]
+        for phase, elapsed, seq in snapshot:
+            deadline = self.deadlines.get(phase, 0.0)
+            if deadline <= 0 or elapsed <= deadline:
+                continue
+            with self._lock:
+                if not any(e[2] == seq for e in self._stack):
+                    continue  # phase ended while we decided
+                self._stalled.add(seq)
+            self.stall_count += 1
+            self.last_stall = (phase, elapsed)
+            self._on_stall_detected(phase, elapsed)
 
     def _on_stall_detected(self, phase: str, elapsed: float) -> None:
         logger.error(
@@ -272,4 +288,4 @@ def allgather_host_stats(duration_s: float) -> Optional[List[dict]]:
 
 
 __all__ = ["HealthWatchdog", "identify_stragglers", "allgather_host_stats",
-           "PHASES", "SERVING_PHASES", "STACKS_FILENAME"]
+           "PHASES", "SERVING_PHASES", "OFFLOAD_PHASES", "STACKS_FILENAME"]

@@ -241,6 +241,12 @@ class WatchdogConfig(DeepSpeedConfigModel):
     step_deadline_s: float = 300.0
     collective_deadline_s: float = 120.0
     checkpoint_deadline_s: float = 600.0
+    # host<->HBM DMA phases (docs/OFFLOAD.md): the ZeRO-Offload/Infinity
+    # runners bracket blocking transfer waits (offload_fetch) and the host
+    # optimizer pass / host-shard checkpoint flush (offload_flush); these
+    # nest inside step/checkpoint, so a wedged DMA is named precisely
+    offload_fetch_deadline_s: float = 120.0
+    offload_flush_deadline_s: float = 600.0
     escalate: bool = True
     straggler_check_every: int = Field(0, ge=0)
     straggler_factor: float = Field(2.0, gt=1)

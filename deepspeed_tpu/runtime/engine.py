@@ -536,6 +536,10 @@ class DeepSpeedEngine:
                         "step": wd.step_deadline_s,
                         "collective": wd.collective_deadline_s,
                         "checkpoint": wd.checkpoint_deadline_s,
+                        # host<->HBM DMA phases (ZeRO-Offload/Infinity
+                        # runners; docs/OFFLOAD.md) — nested inside step
+                        "offload_fetch": wd.offload_fetch_deadline_s,
+                        "offload_flush": wd.offload_flush_deadline_s,
                     },
                     poll_interval=wd.poll_interval_s,
                     on_stall=(self._watchdog_escalate if wd.escalate
@@ -1508,7 +1512,9 @@ class DeepSpeedEngine:
         out = comm.comms_logger.log_summary(scale=max(1, self.global_steps))
         from ..comm.runtime_accounting import wire_ledger
 
-        if wire_ledger.records:
+        if wire_ledger.records or wire_ledger.host_dma:
+            # host_dma: the offload stream's host<->HBM column renders even
+            # when no quantized collective traced (unquantized streaming)
             out += "\n" + wire_ledger.summary()
         return out
 

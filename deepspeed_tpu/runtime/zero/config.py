@@ -43,7 +43,24 @@ class OffloadDeviceEnum(str, Enum):
 
 
 class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
-    """Parity: ``runtime/zero/offload_config.py`` (param offload)."""
+    """Parity: ``runtime/zero/offload_config.py`` (param offload), plus the
+    TPU-native streaming knobs (``docs/OFFLOAD.md``):
+
+    - ``stream``: software-pipelined host->HBM unit prefetch (unit ``i``'s
+      compute overlaps unit ``i+d``'s async DMA). Unset means ON — latency
+      hiding is the default; ``stream: false`` restores fetch-on-demand
+      (issue-and-wait per unit). The streamed schedule consumes the same
+      values in the same order, so it is bitwise-identical to inline.
+    - ``prefetch_depth``: how many unit fetches are in flight ahead of the
+      consuming layer (``d``; 1 = classic double buffer, 2 = the default
+      triple buffer). 0 also means fetch-on-demand.
+    - ``quantized_fetch``: push layer units over the block-int8/int4 host
+      wire (``comm/quantized.py`` — quantize on host, DMA the int payload +
+      per-block scales, dequantize on device). ~4x less host->HBM traffic
+      at up to half a quantization step of weight perturbation per block;
+      bits/block ride the ``zero_quantize_bits``/``zero_quantize_block_size``
+      knobs. Recorded in the wire ledger as ``qpush[host-dma]``.
+    """
 
     device: OffloadDeviceEnum = OffloadDeviceEnum.none
     nvme_path: Optional[str] = None
@@ -51,6 +68,20 @@ class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
     buffer_size: int = Field(int(1e8), ge=0)
     max_in_cpu: int = Field(int(1e9), ge=0)
     pin_memory: bool = False
+    # ---- streaming engine knobs (runtime/zero/stream.py) ----
+    stream: Optional[bool] = None
+    prefetch_depth: int = Field(2, ge=0, le=8)
+    quantized_fetch: bool = False
+
+    @property
+    def stream_effective(self) -> bool:
+        """``stream`` with the unset default resolved to ON (and a zero
+        prefetch depth resolving to fetch-on-demand)."""
+        return self.stream is not False and self.prefetch_depth >= 1
+
+    @property
+    def effective_prefetch_depth(self) -> int:
+        return self.prefetch_depth if self.stream_effective else 0
 
 
 class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):

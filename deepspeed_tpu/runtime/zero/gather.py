@@ -127,6 +127,31 @@ def window_size(blocks, L: int) -> int:
     return k
 
 
+def prefetch_schedule(n: int, depth: int):
+    """The software-pipelined issue/consume order, as a host-side index
+    stream: yields ``("issue", i)`` / ``("consume", i)`` events.
+
+    This is the same prologue/steady-state/epilogue skeleton
+    :func:`zero3_layer_scan` traces into its scan carry (iteration ``i``
+    issues window ``i+d`` and consumes window ``i``), factored out so the
+    HOST-driven streaming offload engine (``runtime/zero/stream.py`` — where
+    the hidden latency is a host<->HBM DMA instead of a ``qall_gather``) runs
+    the identical schedule. ``depth == 0`` degenerates to fetch-on-demand
+    (issue-and-consume per step). Consume order is always ``0..n-1``, so a
+    pipelined consumer is value-identical to an inline one.
+    """
+    n = int(n)
+    d = max(0, min(int(depth), n))
+    for i in range(d):            # prologue: d fetches in flight up front
+        yield ("issue", i)
+    # steady state: issue i+d, consume i; the epilogue is implicit — the last
+    # d consumes drain fetches issued in earlier iterations
+    for i in range(n):
+        if i + d < n:
+            yield ("issue", i + d)
+        yield ("consume", i)
+
+
 def _quantization():
     """The active quantized-weights config for ZeRO-3 gathers, or None."""
     cfg = _active_cfg()

@@ -21,9 +21,15 @@ TPU-native structure (vs the reference's per-tensor hook machinery):
   embed fwd, layer fwd, layer bwd (recompute-in-bwd, i.e. full remat by
   construction), head loss+bwd — and reused for every layer; the layer index
   rides in as a traced scalar.
-- Transfers overlap compute through JAX async dispatch: the next unit's
-  ``device_put`` and the previous unit's gradient ``device_get`` are issued
-  while the current unit's program runs.
+- Transfers overlap compute through the STREAMED schedule
+  (``runtime/zero/stream.py``, ``docs/OFFLOAD.md``): unit ``i``'s compute
+  overlaps unit ``i+d``'s async host->HBM fetch (``offload_param.
+  prefetch_depth``, default 2; ``stream: false`` restores fetch-on-demand),
+  pushes optionally ride the block-int8 host wire
+  (``offload_param.quantized_fetch`` — ledger op ``qpush[host-dma]``), and
+  gradients stream back device->host through a depth-matched fetch queue.
+  Every blocking wait is watchdog-bracketed as ``offload_fetch``; the host
+  optimizer pass as ``offload_flush``.
 - Gradients cross the wire in the compute dtype (bf16 — parity with the
   reference's fp16 grad transfer) and per-unit squared norms are computed
   ON DEVICE, so the host never makes an extra fp32 pass just for the global
@@ -42,6 +48,7 @@ from __future__ import annotations
 import math
 import os
 import tempfile
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -53,6 +60,14 @@ import ml_dtypes
 from ...ops.adam import DeepSpeedCPUAdam, DeepSpeedCPUAdagrad
 from ...utils.logging import log_dist
 from ..topology import mesh_context
+from .stream import (
+    HostDmaStats,
+    PinnedHostStage,
+    UnitFetchStream,
+    flush_host_shards,
+    load_host_shards,
+    quantized_push,
+)
 
 
 class ParamStreamRunner:
@@ -102,6 +117,16 @@ class ParamStreamRunner:
         # (which consumes them FIRST) skips their re-push (the reference's
         # prefetch buffers, offload_param.buffer_count)
         self.keep_layers = max(0, int(op.buffer_count)) if op else 2
+        # streaming schedule knobs (docs/OFFLOAD.md): depth-d prefetch of
+        # layer units against the layer scan; 0 = fetch-on-demand
+        self.prefetch_depth = (int(op.effective_prefetch_depth)
+                               if op is not None else 2)
+        self.quantized_fetch = bool(op.quantized_fetch) if op else False
+        self.qbits = int(getattr(cfg.zero_optimization,
+                                 "zero_quantize_bits", 8))
+        self.qblock = int(getattr(cfg.zero_optimization,
+                                  "zero_quantize_block_size", 256))
+        self._stage = PinnedHostStage(engine.mesh)
         self.count = 0
         self.seed = int(cfg.seed)
         # host state: leaf index -> (master, m, v) fp32 (RAM mode) or NVMe store
@@ -127,7 +152,11 @@ class ParamStreamRunner:
             f"host {opt_type} "
             f"({'native SIMD' if self.cpu_opt.is_native else 'numpy fallback'}"
             f"{', NVMe masters' if self.store is not None else ''}), "
-            f"keep_layers={self.keep_layers}")
+            f"keep_layers={self.keep_layers}, "
+            f"prefetch_depth={self.prefetch_depth}"
+            f"{' (fetch-on-demand)' if self.prefetch_depth == 0 else ''}"
+            f"{', quantized fetch' if self.quantized_fetch else ''}"
+            f"{', pinned staging' if self._stage.pinned else ''}")
 
     # ------------------------------------------------------------------ host state
     def init_host_state(self, for_load: bool = False) -> None:
@@ -184,16 +213,33 @@ class ParamStreamRunner:
             # a previous step's transfer could still be in flight
             self._push_bufs[i] = np.array(master, np.float32, copy=True)
 
-    def _push_unit(self, unit: str) -> Dict[str, jax.Array]:
+    def _push_value(self, i: int) -> np.ndarray:
+        """Host view of leaf ``i``'s push buffer in the compute dtype."""
+        _, _, shape = self._leaves[i]
+        buf = self._push_bufs[i]
+        if self.cdtype == jnp.bfloat16:
+            return buf.view(ml_dtypes.bfloat16).reshape(shape)
+        return buf.reshape(shape)
+
+    def _push_unit(self, unit: str,
+                   stats: Optional[HostDmaStats] = None
+                   ) -> Dict[str, jax.Array]:
+        """Issue the async host->HBM transfer for one unit's leaves — over
+        the quantized wire when ``quantized_fetch`` is set, else the
+        compute-dtype staging buffers through the (pinned when available)
+        host stage."""
         out = {}
         for i in self._unit_leaf_ids[unit]:
-            _, name, shape = self._leaves[i]
-            buf = self._push_bufs[i]
-            if self.cdtype == jnp.bfloat16:
-                arr = buf.view(ml_dtypes.bfloat16).reshape(shape)
+            _, name, _ = self._leaves[i]
+            arr = self._push_value(i)
+            if self.quantized_fetch:
+                out[name] = quantized_push(
+                    arr, self._stage, self._rep_sharding, self.qbits,
+                    self.qblock, self.cdtype, stats=stats)
             else:
-                arr = buf.reshape(shape)
-            out[name] = jax.device_put(arr, self._rep_sharding)
+                if stats is not None:
+                    stats.record_push(arr.nbytes, arr.nbytes)
+                out[name] = self._stage.put(arr, self._rep_sharding)
         return out
 
     # ------------------------------------------------------------------ programs
@@ -258,55 +304,82 @@ class ParamStreamRunner:
         loss_mask = batch.get("loss_mask")
         L = self.stream.n_layer
         keep = min(self.keep_layers, L)
+        d = self.prefetch_depth
         rngs = jax.random.split(rng, L)
+        stats = HostDmaStats(prefetch_depth=d, quantized=self.quantized_fetch)
+        watch = engine._watch_phase
+        t_step = time.perf_counter()
+
+        def fetch(unit):
+            return self._push_unit(unit, stats=stats)
+
+        def drain_grad(pend, into, unit):
+            """Blocking device->host gradient fetch (timed, phase-bracketed,
+            chaos-injectable like every other DMA wait)."""
+            from .stream import fetch_fault_point
+
+            with watch("offload_fetch"):
+                fetch_fault_point()
+                t0 = time.perf_counter()
+                host = jax.device_get(pend)
+                wait = time.perf_counter() - t0
+            nbytes = sum(np.asarray(g).nbytes
+                         for g in jax.tree_util.tree_leaves(host))
+            stats.record_grad_fetch(nbytes, wait)
+            into[unit] = host
 
         with mesh_context(engine.mesh):
-            # ---------------- forward: stream layer units through HBM
-            emb_dev = self._push_unit("embed")
-            final_dev = self._push_unit("final")
+            # ---------------- forward: stream layer units through HBM.
+            # Unit i's compute overlaps unit i+d's async fetch — the
+            # zero3_layer_scan carry skeleton with host DMA as the hidden
+            # latency (stream.UnitFetchStream; d=0 is fetch-on-demand).
+            emb_dev = fetch("embed")
+            final_dev = fetch("final")
             x = P["embed_fwd"](emb_dev, ids)
             acts: List[Any] = [x]
             cache: Dict[int, Any] = {}
-            w = self._push_unit("layer_0") if L else None
+            fwd = UnitFetchStream(
+                fetch, [f"layer_{i}" for i in range(L)], depth=d,
+                stats=stats, watch=watch)
             for i in range(L):
-                w_next = (self._push_unit(f"layer_{i + 1}")
-                          if i + 1 < L else None)  # prefetch during compute
+                w = fwd.take(f"layer_{i}")
                 x = P["layer_fwd"](w, x, jnp.int32(i), rngs[i])
                 acts.append(x)
                 if i >= L - keep:
                     cache[i] = w
-                w = w_next
 
             # ---------------- head: loss + grads wrt (final, wte, x)
             loss, df, dwte_head, dx, gn2_head = P["head_bwd"](
                 final_dev, emb_dev["wte"], acts[L], ids, labels, loss_mask)
 
-            # ---------------- backward: stream units in reverse, fetch grads
+            # ---------------- backward: stream the non-cached units in
+            # reverse through the same pipelined schedule, and stream grads
+            # back device->host through a depth-matched fetch queue
+            bwd = UnitFetchStream(
+                fetch, [f"layer_{i}" for i in reversed(range(L - keep))],
+                depth=d, stats=stats, watch=watch)
+            # prime: the first d re-pushes stream in under the cached
+            # layers' backward compute
+            bwd.prime()
             grads: Dict[str, Any] = {"final": df}
             gn2_dev = [gn2_head]
             fetch_q: List[Tuple[str, Any]] = []
-            prefetched: Dict[int, Any] = {}
             for i in reversed(range(L)):
                 w = cache.pop(i, None)
                 if w is None:
-                    w = prefetched.pop(i, None)
-                if w is None:
-                    w = self._push_unit(f"layer_{i}")
+                    w = bwd.take(f"layer_{i}")
                 dx, dw, g2 = P["layer_bwd"](
                     w, acts[i], dx, jnp.int32(i), rngs[i])
                 acts[i + 1] = None  # free the consumed activation
-                j = i - 1
-                if j >= 0 and j not in cache:
-                    prefetched[j] = self._push_unit(f"layer_{j}")
                 gn2_dev.append(g2)
                 fetch_q.append((f"layer_{i}", dw))
-                if len(fetch_q) > 1:  # one-deep pipeline: fetch while computing
+                if len(fetch_q) > max(1, d):  # pipelined device->host drain
                     unit, pend = fetch_q.pop(0)
-                    grads[unit] = jax.device_get(pend)
+                    drain_grad(pend, grads, unit)
             demb = P["embed_bwd"](emb_dev, ids, dx)
             for unit, pend in fetch_q:
-                grads[unit] = jax.device_get(pend)
-            grads["embed"] = jax.device_get(demb)
+                drain_grad(pend, grads, unit)
+            drain_grad(demb, grads, "embed")
             dwte_head_h = np.asarray(jax.device_get(dwte_head), np.float32)
             gn2_host = float(jax.device_get(sum(gn2_dev)))
             loss = jax.device_get(loss)
@@ -327,9 +400,15 @@ class ParamStreamRunner:
         lr = float(engine.lr_fn(engine.state["step"]))
         if finite:
             self.count += 1
-            self._apply_host_optimizer(grads, scale, lr)
+            with engine._watch_phase("offload_flush"):
+                self._apply_host_optimizer(grads, scale, lr)
         engine.state["step"] = engine.state["step"] + 1
+        stats.step_s = time.perf_counter() - t_step
         self.last_stats = self._memory_stats()
+        self.last_stats["host_dma"] = stats.to_dict()
+        from ...comm.runtime_accounting import wire_ledger
+
+        wire_ledger.set_host_dma(self.last_stats["host_dma"])
         metrics = {
             "loss": jnp.asarray(loss),
             "grad_norm": jnp.float32(gnorm),
@@ -403,9 +482,58 @@ class ParamStreamRunner:
         # and every unit's grads come back once — all in the compute dtype
         out["wire_bytes_per_step"] = (
             (2 * n_params + repushed) * self.cdtype.itemsize)
+        # streamed HBM cost beyond the live window: d in-flight unit buffers
+        # (the double/triple buffer; docs/OFFLOAD.md). Fetches dequantize at
+        # issue time, so each in-flight unit is COMPUTE-DTYPE resident; a
+        # quantized fetch transiently co-resides its payload + scales on top
+        # (quantization saves DMA traffic, not residency)
+        per_elem = float(self.cdtype.itemsize)
+        if self.quantized_fetch:
+            from ...comm.quantized import wire_bytes_per_element
+
+            per_elem += wire_bytes_per_element(self.qbits, self.qblock)
+        out["prefetch_depth"] = self.prefetch_depth
+        out["stream_buffer_bytes"] = int(
+            self.prefetch_depth * unit_size("layer_0") * per_elem)
         return out
 
     # ------------------------------------------------------------------ checkpoint
+    def flush_host_shards(self, dir_path: str, writer=None) -> bool:
+        """Crash-consistent per-UNIT host-state flush (docs/OFFLOAD.md): one
+        atomic ``shard_<k>.npz`` per layer unit under the tag directory, a
+        ``fault_point("host-shard", k)`` between shards, the PR 3 manifest/
+        COMMIT covering all of them. Returns False in NVMe-master mode (the
+        store's consolidated ``read_all`` path stays the format there)."""
+        if self.store is not None:
+            return False
+
+        def shards():
+            for unit in self.stream.unit_names():
+                arrays: Dict[str, np.ndarray] = {}
+                for i in self._unit_leaf_ids[unit]:
+                    mst, m, v = self._state[i]
+                    arrays[f"master_{i}"] = mst
+                    arrays[f"m_{i}"] = m
+                    arrays[f"v_{i}"] = v
+                yield unit, arrays
+
+        with self.engine._watch_phase("offload_flush"):
+            flush_host_shards(
+                dir_path, shards(),
+                meta={"count": int(self.count), "runner": "param_stream",
+                      # leaf naming for standalone recovery: zero_to_fp32.py
+                      # keys the exported masters `unit/name` from this
+                      # (param-stream checkpoints have NO device param tree)
+                      "leaves": [{"i": i, "unit": u, "name": n}
+                                 for i, (u, n, _) in enumerate(self._leaves)]},
+                writer=writer)
+        return True
+
+    def load_host_shards_dir(self, dir_path: str) -> None:
+        d, meta = load_host_shards(dir_path)
+        d["count"] = np.int64(meta.get("count", 0))
+        self.load_host_state_dict(d)
+
     def host_state_dict(self) -> Dict[str, Any]:
         out = {"count": np.int64(self.count)}
         if self.store is not None:

@@ -204,9 +204,15 @@ class HostOffloadRunner:
             loss, grads = self._grads_jit(engine.state["params"], batch, rng)
         flat_g, _ = _leaves(grads)
         # copy=True: device_get can hand back read-only views (axon backend) and
-        # both the clip and the in-place C++ step need writable memory
-        g_np = [np.array(jax.device_get(g), np.float32, copy=True)
-                for g in flat_g]
+        # both the clip and the in-place C++ step need writable memory. The
+        # blocking device->host fetch is a host<->HBM DMA wait — bracketed
+        # under the offload_fetch watchdog deadline like the param stream's
+        with engine._watch_phase("offload_fetch"):
+            from .stream import fetch_fault_point
+
+            fetch_fault_point()
+            g_np = [np.array(jax.device_get(g), np.float32, copy=True)
+                    for g in flat_g]
 
         # global grad norm + clip (parity: stage_1_and_2.py unscale_and_clip)
         gnorm = float(np.sqrt(sum(float((g ** 2).sum()) for g in g_np)))
@@ -218,6 +224,22 @@ class HostOffloadRunner:
 
         self.count += 1
         lr = float(engine.lr_fn(engine.state["step"]))
+        with engine._watch_phase("offload_flush"):
+            self._host_step(engine, g_np, lr)
+        engine.state["step"] = engine.state["step"] + 1
+
+        metrics = {
+            "loss": loss,
+            "grad_norm": jnp.float32(gnorm),
+            "lr": jnp.float32(lr),
+            "loss_scale": jnp.float32(1.0),
+            "overflow": jnp.bool_(False),
+        }
+        return engine.state, metrics
+
+    def _host_step(self, engine, g_np, lr: float) -> None:
+        """The host optimizer pass + compute-dtype copy-back (the
+        ``offload_flush`` watchdog phase)."""
         if self.store is not None:
             # ZeRO-Infinity pipelined loop: while stepping leaf i, leaf i+1 is
             # being read and leaf i-1 written back, all on the AIO pool (parity:
@@ -249,13 +271,41 @@ class HostOffloadRunner:
                 else:
                     self.cpu_opt.step(mst, self.v[i].ravel(), g.ravel(), lr=lr)
             self._push_params()
-        engine.state["step"] = engine.state["step"] + 1
 
-        metrics = {
-            "loss": loss,
-            "grad_norm": jnp.float32(gnorm),
-            "lr": jnp.float32(lr),
-            "loss_scale": jnp.float32(1.0),
-            "overflow": jnp.bool_(False),
-        }
-        return engine.state, metrics
+    # ------------------------------------------------------------------ shards
+    #: leaves per host shard file: small models stay one file, billion-scale
+    #: masters flush in bounded atomic chunks a mid-flush kill cannot tear
+    SHARD_LEAVES = 32
+
+    def flush_host_shards(self, dir_path: str, writer=None) -> bool:
+        """Crash-consistent host-state flush (docs/OFFLOAD.md): bounded
+        groups of fp32 master/moment leaves per atomic ``shard_<k>.npz``,
+        ``fault_point("host-shard", k)`` between shards, the PR 3 manifest/
+        COMMIT covering all of them. Returns False in NVMe-swap mode."""
+        from .stream import flush_host_shards as _flush
+
+        if self.store is not None:
+            return False
+
+        def shards():
+            n = len(self.master)
+            for k0 in range(0, n, self.SHARD_LEAVES):
+                arrays: Dict[str, Any] = {}
+                for i in range(k0, min(n, k0 + self.SHARD_LEAVES)):
+                    arrays[f"master_{i}"] = self.master[i]
+                    arrays[f"m_{i}"] = self.m[i]
+                    arrays[f"v_{i}"] = self.v[i]
+                yield f"leaves_{k0}", arrays
+
+        with self.engine._watch_phase("offload_flush"):
+            _flush(dir_path, shards(),
+                   meta={"count": int(self.count), "runner": "offload"},
+                   writer=writer)
+        return True
+
+    def load_host_shards_dir(self, dir_path: str) -> None:
+        from .stream import load_host_shards as _load
+
+        d, meta = _load(dir_path)
+        d["count"] = np.int64(meta.get("count", 0))
+        self.load_host_state_dict(d)

@@ -106,18 +106,17 @@ class GatheredParameters:
             # short trailing rows (scalars, (N, 2)-shaped leaves, narrow bf16):
             # per-block scale/zero-point overhead would INFLATE the transfer
             return np.array(jax.device_get(leaf))
+        from ...comm.quantized import np_dequantize_blockwise
+
         q, s, z = _quantize_jit(bits, block)(leaf)
         wire_ledger.record("qgather[host]", int(leaf.nbytes),
                            int(q.nbytes + s.nbytes + z.nbytes))
         qh, sh, zh = (np.asarray(a) for a in jax.device_get((q, s, z)))
-        lead = qh.shape[:-1]
-        if bits == 4:
-            qh = np.stack([qh & 0xF, qh >> 4], axis=-1).reshape(lead + (-1,))
-        nb = sh.shape[-1]
-        eff = qh.shape[-1] // nb  # the quantizer's effective block, from shapes
-        xb = qh.reshape(lead + (nb, eff)).astype(np.float32)
-        x = (xb * sh[..., None] + zh[..., None]).reshape(lead + (nb * eff,))
-        return np.ascontiguousarray(x[..., :leaf.shape[-1]])
+        # the shared host dequantizer derives the effective block from the
+        # payload/scale shapes, so it stays consistent with whatever block
+        # the device quantizer picked
+        return np_dequantize_blockwise(qh, sh, zh, bits=bits,
+                                       orig_size=leaf.shape[-1])
 
     def _leaf(self, tree, dotted: str):
         node = tree

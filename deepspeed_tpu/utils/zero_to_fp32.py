@@ -48,6 +48,29 @@ def _resolve_tag_dir(path: str) -> str:
     raise FileNotFoundError(f"{path} is neither a tag dir nor has a 'latest' file")
 
 
+def _load_host_masters(ckpt: str):
+    """Flat ``master_{i}`` dict + shard meta from the host-offload state:
+    the sharded ``host_state/`` layout (docs/OFFLOAD.md — per-unit atomic
+    ``shard_<k>.npz`` + ``host_meta.json``) or the legacy/NVMe consolidated
+    ``host_optimizer.npz``. Standalone: numpy + json only."""
+    host_dir = os.path.join(ckpt, "host_state")
+    meta_path = os.path.join(host_dir, "host_meta.json")
+    if os.path.isdir(host_dir) and os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        out: Dict[str, np.ndarray] = {}
+        for shard in meta.get("shards", []):
+            with np.load(os.path.join(host_dir, shard["file"])) as d:
+                for key in d.files:
+                    out[key] = d[key]
+        return out, meta
+    host_path = os.path.join(ckpt, "host_optimizer.npz")
+    if os.path.exists(host_path):
+        with np.load(host_path) as d:
+            return {k: d[k] for k in d.files}, {}
+    return {}, {}
+
+
 def get_fp32_state_dict_from_zero_checkpoint(
         checkpoint_dir: str, tag: Optional[str] = None) -> Dict[str, np.ndarray]:
     """Parity: the reference function of the same name (``zero_to_fp32.py``)."""
@@ -60,15 +83,22 @@ def get_fp32_state_dict_from_zero_checkpoint(
                if k.startswith("master/")}
     params = {k[len("params/"):]: v for k, v in leaves.items()
               if k.startswith("params/")}
-    # ZeRO-Offload: fp32 masters live in host_optimizer.npz, positionally keyed
-    # master_{i} in the params tree's flatten order (_load_leaves preserves it)
-    host_path = os.path.join(ckpt, "host_optimizer.npz")
-    if not masters and os.path.exists(host_path):
-        with np.load(host_path) as d:
-            for i, key in enumerate(params):
-                mkey = f"master_{i}"
-                if mkey in d:
-                    masters[key] = d[mkey].reshape(params[key].shape)
+    # ZeRO-Offload/Infinity: fp32 masters live in the host state (host_state/
+    # shards or legacy host_optimizer.npz), positionally keyed master_{i} in
+    # the params tree's flatten order (_load_leaves preserves it)
+    host, host_meta = ({}, {}) if masters else _load_host_masters(ckpt)
+    if not masters and host:
+        if not params and host_meta.get("leaves"):
+            # ZeRO-Infinity param stream: the device tree is EMPTY — the
+            # weights exist ONLY as host masters. The shard meta names every
+            # leaf (unit, name), so recovery keys them `unit/name`.
+            return {f"{lf['unit']}/{lf['name']}":
+                    np.asarray(host[f"master_{lf['i']}"], np.float32)
+                    for lf in host_meta["leaves"]}
+        for i, key in enumerate(params):
+            mkey = f"master_{i}"
+            if mkey in host:
+                masters[key] = host[mkey].reshape(params[key].shape)
     out = {}
     for key, arr in params.items():
         src = masters.get(key, arr)

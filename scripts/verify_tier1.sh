@@ -144,6 +144,31 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
 fi
 grep -a "serving_smoke\[fleet\]: PASS" /tmp/_t1_serving_fleet.log || true
 
+# --- offload gate (docs/OFFLOAD.md) ---------------------------------------
+# the streamed host<->HBM DMA pipeline: streamed-vs-inline bitwise
+# equivalence (depths 1/2), quantized-fetch ledger ratio, the
+# offload/unstreamed-host-fetch rule, and the nested watchdog phase stack.
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_infinity_stream.py -q -m 'not slow' \
+        -p no:cacheprovider -p no:randomly > /tmp/_t1_offload.log 2>&1; then
+    echo "verify_tier1: FAIL — offload stream tests" \
+         "(tests/test_infinity_stream.py):" >&2
+    tail -30 /tmp/_t1_offload.log >&2
+    exit 1
+fi
+grep -aE '^[0-9]+ passed' /tmp/_t1_offload.log || true
+
+# the offload smoke: streamed step == inline step bitwise, quantized-fetch
+# ledger ratio, an injected DMA hang flagged as an offload_fetch stall, and
+# SIGKILL mid host-shard flush -> committed-tag resume, bitwise step-exact.
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        python scripts/offload_smoke.py > /tmp/_t1_offload_smoke.log 2>&1; then
+    echo "verify_tier1: FAIL — offload smoke (scripts/offload_smoke.py):" >&2
+    tail -30 /tmp/_t1_offload_smoke.log >&2
+    exit 1
+fi
+grep -a "offload_smoke: PASS" /tmp/_t1_offload_smoke.log || true
+
 # --- fault-injection smoke (docs/RESILIENCE.md) ---------------------------
 # two heal cycles on the CPU mesh: SIGKILL mid-checkpoint + auto-resume
 # (crash consistency), and injected NaN -> divergence rollback -> poisoned

@@ -1,0 +1,76 @@
+"""Offload-stream worker fixture: tiny GPT trained through the ZeRO-Infinity
+param stream (host masters, streamed units) on one forced-CPU device, with
+the ``resilience`` block enabled (auto-resume). Checkpoints after every
+step. Faults are injected via ``DS_FAULT_PLAN`` set by the driver
+(test_infinity_stream.py, scripts/offload_smoke.py) — the worker has no
+fault-specific code: a ``kill_at_phase: "host-shard:N"`` plan SIGKILLs the
+process inside the REAL per-unit host-state flush.
+
+Exit codes: 0 = reached --steps; -9 / 137 = the fault plan's SIGKILL fired.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--steps", type=int, required=True)
+    p.add_argument("--log", default=None, help="jsonl per-step log")
+    p.add_argument("--save-every", type=int, default=1)
+    p.add_argument("--prefetch-depth", type=int, default=2)
+    args = p.parse_args()
+
+    # single forced-CPU device, independent of the inherited test env
+    flags = " ".join(
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count"))
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=1"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["DS_TPU_ACCELERATOR"] = "cpu"
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_gpt, gpt
+
+    model, _ = build_gpt(gpt.GPTConfig(
+        vocab_size=64, n_layer=3, n_head=2, d_model=32, max_seq_len=32))
+    engine, _, _, _ = ds.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "bf16": {"enabled": False},
+        "steps_per_print": 0,
+        "zero_optimization": {"offload_param": {
+            "device": "cpu", "buffer_count": 1,
+            "prefetch_depth": args.prefetch_depth}},
+        # auto-resume from the newest committed tag
+        "resilience": {"enabled": True, "save_dir": args.ckpt_dir},
+    })
+
+    def batch_for(step: int):
+        r = np.random.default_rng(1000 + step)
+        return {"input_ids": r.integers(0, 64, size=(2, 16), dtype=np.int32)}
+
+    while engine.global_steps < args.steps:
+        m = engine.train_batch(batch_for(engine.global_steps))
+        if args.log:
+            with open(args.log, "a") as f:
+                f.write(json.dumps({"step": engine.global_steps,
+                                    "loss": float(m["loss"]),
+                                    "grad_norm": float(m["grad_norm"])})
+                        + "\n")
+        if engine.global_steps % max(1, args.save_every) == 0:
+            engine.save_checkpoint(args.ckpt_dir)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

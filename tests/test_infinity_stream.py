@@ -1,0 +1,418 @@
+"""Streamed ZeRO-Infinity host offload (docs/OFFLOAD.md): the double-buffered
+host<->HBM DMA pipeline against the layer scan.
+
+Contracts under test:
+- the pipelined schedule (``prefetch_schedule`` / ``UnitFetchStream``) issues
+  ahead and consumes in order — streamed training is BITWISE identical to
+  fetch-on-demand at depths 1 and 2;
+- quantized host fetches are tolerance-gated and ledger-recorded (the
+  ``qpush[host-dma]`` ratio);
+- an injected DMA hang (``FaultPlan.stall_offload_at``) trips the
+  ``offload_fetch`` watchdog deadline;
+- a SIGKILL mid host-shard flush leaves the previous committed tag loadable
+  and resume from it is step-exact;
+- the ``offload/unstreamed-host-fetch`` dslint rule fires/stays silent.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.zero.gather import prefetch_schedule
+from deepspeed_tpu.runtime.zero.stream import UnitFetchStream
+
+WORKER = os.path.join(os.path.dirname(__file__), "offload_worker.py")
+
+
+def _engine(config_extra=None, vocab=64, n_layer=4):
+    from deepspeed_tpu.models import build_gpt
+    from deepspeed_tpu.models.gpt import GPTConfig
+
+    model, cfg = build_gpt(GPTConfig(
+        vocab_size=vocab, d_model=32, n_layer=n_layer, n_head=2,
+        max_seq_len=32))
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "steps_per_print": 0,
+    }
+    config.update(config_extra or {})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    return engine, cfg
+
+
+def _batch(cfg, seed=0, bs=16, seq=16):
+    r = np.random.default_rng(seed)
+    return {"input_ids": r.integers(0, cfg.vocab_size, size=(bs, seq),
+                                    dtype=np.int32)}
+
+
+def _stream_cfg(**op):
+    # buffer_count=1: only one layer cached for backward, so the backward
+    # pass genuinely streams (the default 5 would cache every test layer)
+    return {"zero_optimization": {"offload_param": {
+        "device": "cpu", "buffer_count": 1, **op}}}
+
+
+# ------------------------------------------------------------------ schedule
+def test_prefetch_schedule_orders():
+    for n, d in [(5, 0), (5, 1), (5, 2), (5, 4), (3, 8), (0, 2), (1, 0)]:
+        events = list(prefetch_schedule(n, d))
+        issues = [i for k, i in events if k == "issue"]
+        consumes = [i for k, i in events if k == "consume"]
+        assert issues == list(range(n)), (n, d)
+        assert consumes == list(range(n)), (n, d)
+        # every unit's issue precedes its consume, by exactly min(d, ...) slots
+        for i in range(n):
+            assert events.index(("issue", i)) < events.index(("consume", i))
+        # at consume i, units 0..min(i+d, n-1) have been issued (the carry
+        # holds d windows in flight — zero3_layer_scan's pbody, on the host)
+        for i in range(n):
+            pos = events.index(("consume", i))
+            issued = {j for k, j in events[:pos] if k == "issue"}
+            assert issued == set(range(min(i + max(d, 0) + 1, n))), (n, d, i)
+
+
+def test_unit_fetch_stream_mechanics():
+    issued = []
+
+    def fetch(name):
+        issued.append(name)
+        return np.zeros(2)
+
+    s = UnitFetchStream(fetch, ["a", "b", "c", "d"], depth=2)
+    out = s.take("a")
+    assert isinstance(out, np.ndarray)
+    # depth 2: consuming "a" means a, b AND c's fetches are out already
+    assert issued == ["a", "b", "c"]
+    s.take("b")
+    assert issued == ["a", "b", "c", "d"]
+    with pytest.raises(ValueError, match="out-of-order"):
+        s.take("b")
+    s.take("c")
+    s.take("d")
+
+    # depth 0 = fetch-on-demand: nothing issued before the consume point
+    issued.clear()
+    s0 = UnitFetchStream(fetch, ["a", "b"], depth=0)
+    assert issued == []
+    s0.take("a")
+    assert issued == ["a"]
+
+    # prime() pushes the prologue out before the first take
+    issued.clear()
+    sp = UnitFetchStream(fetch, ["a", "b", "c"], depth=2)
+    sp.prime()
+    assert issued == ["a", "b"]
+    sp.take("a")
+    assert issued == ["a", "b", "c"]
+
+
+# ------------------------------------------------------------------ numerics
+@pytest.mark.parametrize("depth", [1, 2])
+def test_streamed_bitwise_matches_fetch_on_demand(depth):
+    """Same seed -> identical host masters; the streamed schedule must then
+    reproduce the inline trajectory BITWISE (same units, same order — only
+    the DMA issue points move)."""
+    e_str, cfg = _engine(_stream_cfg(prefetch_depth=depth))
+    e_inl, _ = _engine(_stream_cfg(stream=False))
+    assert e_str._param_stream.prefetch_depth == depth
+    assert e_inl._param_stream.prefetch_depth == 0
+    for i in range(3):
+        b = _batch(cfg, seed=i)
+        m1 = e_str.train_batch(b)
+        m2 = e_inl.train_batch(b)
+        assert float(m1["loss"]) == float(m2["loss"])
+        assert float(m1["grad_norm"]) == float(m2["grad_norm"])
+    # updated host masters agree bitwise too
+    s1, s2 = e_str._param_stream, e_inl._param_stream
+    for i in range(len(s1._leaves)):
+        np.testing.assert_array_equal(s1._state[i][0], s2._state[i][0])
+    dma = s1.last_stats["host_dma"]
+    assert dma["prefetch_depth"] == depth
+    assert dma["pushes"] > 0 and dma["waits"] > 0
+
+
+def test_np_quantize_matches_jnp():
+    from deepspeed_tpu.comm.quantized import (
+        dequantize_blockwise,
+        np_dequantize_blockwise,
+        np_quantize_blockwise,
+        quantize_blockwise,
+    )
+
+    r = np.random.default_rng(0)
+    for shape, bits in [((4, 300), 8), ((4, 300), 4), ((7,), 8),
+                        ((2, 32), 8)]:
+        x = r.normal(size=shape).astype(np.float32)
+        qn, sn, zn = np_quantize_blockwise(x, bits=bits, block_size=64)
+        qj, sj, zj = quantize_blockwise(x, bits=bits, block_size=64)
+        np.testing.assert_array_equal(qn, np.asarray(qj))
+        np.testing.assert_array_equal(sn, np.asarray(sj))
+        np.testing.assert_array_equal(zn, np.asarray(zj))
+        # host and device dequantizers reconstruct identically
+        back_n = np_dequantize_blockwise(qn, sn, zn, bits=bits,
+                                         orig_size=shape[-1])
+        back_j = np.asarray(dequantize_blockwise(qj, sj, zj, bits=bits,
+                                                 orig_size=shape[-1]))
+        np.testing.assert_array_equal(back_n, back_j)
+        assert np.max(np.abs(back_n - x)) <= np.max(sn) * 0.5 + 1e-6
+
+
+def test_quantized_fetch_tolerance_and_ledger():
+    from deepspeed_tpu.comm.runtime_accounting import wire_ledger
+
+    wire_ledger.reset()
+    e_q, cfg = _engine(_stream_cfg(quantized_fetch=True))
+    e_x, _ = _engine(_stream_cfg())
+    for i in range(2):
+        b = _batch(cfg, seed=i)
+        mq = e_q.train_batch(b)
+        mx = e_x.train_batch(b)
+        # int8 blocks perturb weights by <= scale/2 — tolerance-gated, never
+        # bitwise (that is the exact path's bar)
+        assert float(mq["loss"]) == pytest.approx(float(mx["loss"]), rel=0.05)
+    assert "qpush[host-dma]" in wire_ledger.records
+    # fp32 logical vs int8+scales wire: > 3x even at these short rows
+    assert wire_ledger.ratio("qpush") > 3.0
+    dma = e_q._param_stream.last_stats["host_dma"]
+    assert dma["quantized"] and dma["ratio"] > 3.0
+    wire_ledger.reset()
+
+
+# ------------------------------------------------------------------ watchdog
+def test_watchdog_flags_injected_dma_hang(tmp_path):
+    from deepspeed_tpu.resilience.chaos import FaultPlan, install_plan
+    from deepspeed_tpu.resilience.events import read_events
+
+    e, cfg = _engine({
+        **_stream_cfg(prefetch_depth=1),
+        "resilience": {"enabled": True, "save_dir": str(tmp_path),
+                       "watchdog": {"enabled": True,
+                                    "poll_interval_s": 0.05,
+                                    "offload_fetch_deadline_s": 0.3,
+                                    "escalate": False}}})
+    try:
+        install_plan(FaultPlan(stall_offload_at=0,
+                               stall_offload_seconds=1.2))
+        e.train_batch(_batch(cfg))
+        deadline = time.monotonic() + 3.0
+        while e._watchdog.stall_count == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert e._watchdog.stall_count >= 1
+        assert e._watchdog.last_stall[0] == "offload_fetch"
+        events = [ev for ev in read_events(
+            os.path.join(str(tmp_path), "recovery_events.jsonl"))
+            if ev.get("event") == "watchdog_stall"]
+        assert events and events[-1]["phase"] == "offload_fetch"
+    finally:
+        install_plan(None)
+        if e._watchdog is not None:
+            e._watchdog.stop()
+
+
+def test_nested_phase_stack_keeps_outer_deadline():
+    """offload_fetch nests inside step: the outer phase's deadline must stay
+    armed while (and after) the inner one runs."""
+    from deepspeed_tpu.resilience.watchdog import HealthWatchdog
+
+    wd = HealthWatchdog({"step": 0.2, "offload_fetch": 10.0},
+                        poll_interval=0.03)
+    wd.start()
+    try:
+        with wd.phase("step"):
+            with wd.phase("offload_fetch"):
+                time.sleep(0.05)
+            time.sleep(0.4)  # outer overruns AFTER the inner exited
+        deadline = time.monotonic() + 2.0
+        while wd.stall_count == 0 and time.monotonic() < deadline:
+            time.sleep(0.03)
+        assert wd.stall_count >= 1
+        assert wd.last_stall[0] == "step"
+    finally:
+        wd.stop()
+
+
+# ----------------------------------------------------------------- shards
+def test_host_shards_committed_under_manifest(tmp_path):
+    e, cfg = _engine(_stream_cfg())
+    e.train_batch(_batch(cfg))
+    ckpt = e.save_checkpoint(str(tmp_path))
+    host_dir = os.path.join(ckpt, "host_state")
+    shards = sorted(f for f in os.listdir(host_dir) if f.endswith(".npz"))
+    # one shard per unit: embed + L layers + final
+    assert len(shards) == e._param_stream.stream.n_layer + 2
+    with open(os.path.join(ckpt, "MANIFEST.json")) as f:
+        manifest = json.load(f)
+    for s in shards:
+        assert f"host_state/{s}" in manifest["files"]
+    assert os.path.exists(os.path.join(ckpt, "COMMIT"))
+    # roundtrip through the sharded format is exact
+    e2, _ = _engine(_stream_cfg())
+    e2.load_checkpoint(str(tmp_path))
+    ref = float(e.train_batch(_batch(cfg, seed=7))["loss"])
+    got = float(e2.train_batch(_batch(cfg, seed=7))["loss"])
+    assert ref == got
+
+
+def test_zero_to_fp32_recovers_sharded_host_state(tmp_path):
+    """The standalone recovery script (auto-copied into every tag) must read
+    the sharded host_state/ format: param-stream checkpoints export their
+    host masters keyed `unit/name` (the weights exist NOWHERE else), and
+    optimizer-offload checkpoints keep the positional master mapping."""
+    from deepspeed_tpu.utils.zero_to_fp32 import (
+        get_fp32_state_dict_from_zero_checkpoint,
+    )
+
+    e, cfg = _engine(_stream_cfg())
+    e.train_batch(_batch(cfg))
+    e.save_checkpoint(str(tmp_path / "stream"))
+    sd = get_fp32_state_dict_from_zero_checkpoint(str(tmp_path / "stream"))
+    runner = e._param_stream
+    leaf_by = {(u, n): i for i, (u, n, _) in enumerate(runner._leaves)}
+    assert "layer_1/qkv_w" in sd and "embed/wte" in sd
+    np.testing.assert_array_equal(
+        sd["layer_1/qkv_w"], runner._state[leaf_by[("layer_1", "qkv_w")]][0])
+
+    # optimizer offload (RAM mode -> host_state shards): positional mapping
+    e2, cfg2 = _engine({"zero_optimization": {
+        "stage": 1, "offload_optimizer": {"device": "cpu"}}})
+    e2.train_batch(_batch(cfg2))
+    e2.save_checkpoint(str(tmp_path / "opt"))
+    assert os.path.isdir(tmp_path / "opt" / "global_step1" / "host_state")
+    sd2 = get_fp32_state_dict_from_zero_checkpoint(str(tmp_path / "opt"))
+    for i, key in enumerate(sd2):  # insertion order == leaves order
+        np.testing.assert_array_equal(sd2[key].ravel(),
+                                      np.asarray(e2._offload.master[i]).ravel())
+
+
+def _run_worker(ckpt_dir, steps, log, env_extra=None, timeout=240):
+    env = {**os.environ, **(env_extra or {})}
+    return subprocess.run(
+        [sys.executable, WORKER, "--ckpt-dir", str(ckpt_dir),
+         "--steps", str(steps), "--log", str(log)],
+        env=env, capture_output=True, text=True, timeout=timeout)
+
+
+def _read_log(path):
+    with open(path) as f:
+        return {row["step"]: row for row in map(json.loads, f)}
+
+
+def test_sigkill_mid_flush_resumes_step_exact(tmp_path):
+    """A SIGKILL inside the per-unit host-shard flush (save #2, after shard 1
+    of the step-3 tag) must leave the step-2 tag the newest COMMITTED one;
+    auto-resume from it reproduces the uninterrupted run bitwise."""
+    plan = json.dumps({"kill_at_phase": "host-shard:1", "kill_at_save": 2})
+    r = _run_worker(tmp_path / "ckpt", 4, tmp_path / "killed.jsonl",
+                    env_extra={"DS_FAULT_PLAN": plan})
+    assert r.returncode in (-signal.SIGKILL, 128 + signal.SIGKILL), r.stderr
+    # the torn tag has no COMMIT; the step-2 tag stays committed
+    tags = sorted(os.listdir(tmp_path / "ckpt"))
+    assert "global_step2" in tags
+    assert os.path.exists(tmp_path / "ckpt" / "global_step2" / "COMMIT")
+    assert not os.path.exists(tmp_path / "ckpt" / "global_step3" / "COMMIT")
+    # resume (no plan): runs steps 3..4 from the committed step-2 state
+    r2 = _run_worker(tmp_path / "ckpt", 4, tmp_path / "resumed.jsonl",
+                     env_extra={"DS_FAULT_PLAN": ""})
+    assert r2.returncode == 0, r2.stderr + r2.stdout
+    # uninterrupted reference
+    r3 = _run_worker(tmp_path / "clean", 4, tmp_path / "clean.jsonl",
+                     env_extra={"DS_FAULT_PLAN": ""})
+    assert r3.returncode == 0, r3.stderr
+    resumed, clean = _read_log(tmp_path / "resumed.jsonl"), _read_log(
+        tmp_path / "clean.jsonl")
+    for step in (3, 4):
+        assert resumed[step]["loss"] == clean[step]["loss"], step
+        assert resumed[step]["grad_norm"] == clean[step]["grad_norm"], step
+
+
+# ------------------------------------------------------------------ dslint
+def _rule_ctx(n_params=2_000_000_000, engine_present=True, **op):
+    from deepspeed_tpu.analysis import AnalysisContext
+    from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+
+    zero = DeepSpeedZeroConfig(
+        stage=0, offload_param={"device": "cpu", **op})
+    cfg = SimpleNamespace(zero_optimization=zero)
+    eng = None
+    if engine_present:
+        model_cfg = SimpleNamespace(num_params=lambda: n_params)
+        eng = SimpleNamespace(
+            _param_stream=SimpleNamespace(
+                stream=SimpleNamespace(cfg=model_cfg)),
+            state={"params": {}})
+    return AnalysisContext(engine=eng, config=cfg)
+
+
+def test_unstreamed_host_fetch_rule_fires():
+    from deepspeed_tpu.analysis.rules_offload import UnstreamedHostFetchRule
+
+    rule = UnstreamedHostFetchRule()
+    found = list(rule.check_context(_rule_ctx(stream=False)))
+    assert len(found) == 1
+    assert found[0].rule_id == "offload/unstreamed-host-fetch"
+    assert "stream=false" in found[0].message
+    found = list(rule.check_context(_rule_ctx(prefetch_depth=0)))
+    assert len(found) == 1 and "prefetch_depth=0" in found[0].message
+
+
+def test_unstreamed_host_fetch_rule_silent():
+    from deepspeed_tpu.analysis.rules_offload import UnstreamedHostFetchRule
+
+    rule = UnstreamedHostFetchRule()
+    # streaming on (the default): silent regardless of size
+    assert not list(rule.check_context(_rule_ctx()))
+    # small model: exposed DMA is cheap — silent
+    assert not list(rule.check_context(
+        _rule_ctx(n_params=125_000_000, stream=False)))
+    # unknown model size (no engine): a size-gated rule must not guess
+    assert not list(rule.check_context(
+        _rule_ctx(engine_present=False, stream=False)))
+
+
+def test_rule_registered_in_default_set():
+    from deepspeed_tpu.analysis import default_rules
+
+    assert any(r.rule_id == "offload/unstreamed-host-fetch"
+               for r in default_rules())
+
+
+# ------------------------------------------------------------------ aot
+@pytest.mark.slow
+def test_infinity_report_streamed_peak():
+    """The fit verdict includes the d in-flight prefetch buffers, itemized
+    (streamed peak = compiled moment peak + d * unit buffer bytes). One
+    compiled report (the TPU-topology compiles are multi-minute); the
+    depth-0 and quantized variants differ only in the itemized arithmetic,
+    asserted against the report's own fields."""
+    from deepspeed_tpu.comm.quantized import wire_bytes_per_element
+    from deepspeed_tpu.runtime.aot import fit_verdict, infinity_program_report
+
+    r2 = infinity_program_report("gpt2-125m", micro_bs=1, seq=128,
+                                 keep_layers=1, prefetch_depth=2)
+    assert r2["peak_source"] == "compiled_moments+stream_buffers"
+    assert r2["stream"]["prefetch_depth"] == 2
+    assert not r2["stream"]["quantized_fetch"]
+    # in-flight units are COMPUTE-DTYPE resident (dequantized at issue time)
+    assert r2["stream"]["unit_buffer_bytes"] == r2["layer_unit_bytes"]
+    assert r2["stream"]["unit_wire_bytes"] == r2["layer_unit_bytes"]
+    assert r2["stream"]["buffer_bytes"] == 2 * r2["stream"]["unit_buffer_bytes"]
+    assert (r2["whole_run_peak_bytes"]
+            == r2["moment_peak_bytes"] + r2["stream"]["buffer_bytes"])
+    assert r2["fit"] == fit_verdict(r2["whole_run_peak_bytes"])
+    # a quantized fetch shrinks the WIRE (DMA traffic), and ADDS its payload
+    # transiently to residency — it never shrinks the in-flight buffer
+    elems = r2["layer_unit_bytes"] // 2
+    wire = int(elems * wire_bytes_per_element(8, 256))
+    assert wire < r2["layer_unit_bytes"]  # the DMA saving
+    # residency formula mirrored from infinity_program_report:
+    # quantized unit_buffer = compute bytes + wire bytes > compute bytes
