@@ -216,12 +216,67 @@ class RollbackWithoutDataCursorRule(Rule):
         )
 
 
+class ElasticWithoutReshardAnchorRule(Rule):
+    """The ``elasticity`` block is armed, but nothing guarantees a committed
+    reshard anchor: a membership change relaunches the job at a new world
+    size by resuming the newest committed checkpoint — with no sentinel
+    ``checkpoint_interval`` auto-anchors the newest committed tag can be
+    arbitrarily old (or absent: the whole run lost), and without a
+    checkpointable data cursor the resized run cannot rejoin the data stream
+    sample-exactly (batches get dropped or replayed across the resize)."""
+
+    rule_id = "config/elastic-without-reshard-anchor"
+    default_severity = Severity.WARNING
+    description = "elasticity armed without committed reshard anchors"
+
+    def check_context(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        e = getattr(ctx.config, "elasticity", None)
+        if not isinstance(e, dict) or not e.get("enabled", False):
+            return
+        res = getattr(ctx.config, "resilience", None)
+        sen = getattr(res, "sentinel", None)
+        anchored = bool(
+            res is not None and getattr(res, "enabled", False)
+            and sen is not None and getattr(sen, "enabled", False)
+            and int(getattr(sen, "checkpoint_interval", 0)) > 0)
+        cursor_ok = bool(
+            sen is not None and getattr(sen, "cursor_checkpointable", False))
+        if not cursor_ok and ctx.engine is not None and getattr(
+                ctx.engine, "resume_state_provider", None) is not None:
+            cursor_ok = True
+        missing = []
+        if not anchored:
+            missing.append(
+                "committed anchors (resilience.sentinel.checkpoint_interval "
+                "> 0 auto-saves the rollback/reshard anchor)")
+        if not cursor_ok:
+            missing.append(
+                "a checkpointable data cursor "
+                "(sentinel.cursor_checkpointable or "
+                "engine.resume_state_provider)")
+        if not missing:
+            return
+        yield self.finding(
+            "elasticity.enabled arms resize-and-resume, but the elastic "
+            "resume has no guaranteed landing point: missing "
+            + " and ".join(missing)
+            + " — a membership change would resume an arbitrarily stale tag "
+              "(or none) and re-feed the data stream inexactly",
+            location="config.elasticity",
+            suggestion="enable resilience.sentinel with checkpoint_interval "
+                       "> 0 and drive batches from engine.data_cursor with "
+                       "sentinel.cursor_checkpointable=true (or register "
+                       "engine.resume_state_provider)",
+        )
+
+
 def config_rules() -> List[Rule]:
     return [QuantizedWireMissingRule(), QuantizedWeightsBelowStage3Rule(),
             LossScaleDtypeRule(), CheckpointUncommittedLoadRule(),
-            RollbackWithoutDataCursorRule()]
+            RollbackWithoutDataCursorRule(), ElasticWithoutReshardAnchorRule()]
 
 
 __all__ = ["QuantizedWireMissingRule", "QuantizedWeightsBelowStage3Rule",
            "LossScaleDtypeRule", "CheckpointUncommittedLoadRule",
-           "RollbackWithoutDataCursorRule", "config_rules"]
+           "RollbackWithoutDataCursorRule", "ElasticWithoutReshardAnchorRule",
+           "config_rules"]

@@ -111,9 +111,17 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                 resume_state = provider()
             except Exception as e:  # dataloader hook must not kill a drain save
                 logger.warning(f"resume_state_provider failed: {e}")
+        from ..runtime.zero.reshard import partition_record
+
+        part = partition_record(engine)
         meta = {
             "tag": tag,
             "has_grad_acc": mid_accum,
+            # elastic reshard-on-load (docs/RESILIENCE.md "Elastic
+            # membership"): the dp world size + partition spec that wrote
+            # this tag; a load at a different world size reshards against it
+            "world_size": (part["dp"] if part else None),
+            "partition": part,
             "global_steps": engine.global_steps,
             "micro_steps": engine.micro_steps,
             "skipped_steps": engine.skipped_steps,
@@ -219,18 +227,41 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                            tag=bad_tag, reason=reason)
     tag = resolved
     ckpt_dir = os.path.join(load_dir, tag)
-    state = load_pytree(engine.state, os.path.join(ckpt_dir, "state"))
+    # meta first: the reshard decision (world size written vs world size
+    # loading) gates HOW the state is loaded
+    with open(os.path.join(ckpt_dir, "meta.json")) as f:
+        meta = json.load(f)
+    rec = getattr(engine, "_recovery_log", None)
+    old_world = meta.get("world_size")
+    topo = getattr(engine, "topo", None)
+    new_world = int(topo.data_parallel_size) if topo is not None else None
+    resharding = (old_world is not None and new_world is not None
+                  and int(old_world) != new_world)
+    resolver = None
+    if resharding:
+        # elastic reshard-on-load (docs/RESILIENCE.md "Elastic membership"):
+        # logical leaves reshard via device_put against the new mesh; the
+        # world-coupled EF residuals reset by policy (demotion-reset
+        # semantics) through the shape-mismatch resolver
+        from ..runtime.zero.reshard import apply_cursor_reshard, load_resolver
+
+        resolver = load_resolver(int(old_world), new_world,
+                                 recovery_log=rec,
+                                 step=int(meta.get("global_steps", 0)))
+    state = load_pytree(engine.state, os.path.join(ckpt_dir, "state"),
+                        on_shape_mismatch=resolver)
     if not load_optimizer_states:
         state = {**state, "opt": engine.state["opt"], "master": engine.state["master"]}
     engine.state = state
-    with open(os.path.join(ckpt_dir, "meta.json")) as f:
-        meta = json.load(f)
-    if meta.get("has_grad_acc"):
+    if meta.get("has_grad_acc") and not resharding:
         engine._grad_acc = load_pytree(
             engine._fresh_grad_acc(), os.path.join(ckpt_dir, "grad_acc"))
     else:
-        # boundary checkpoint: drop any pre-load accumulation so the next
-        # window starts from zeros (forward() lazily rebuilds the buffer)
+        # boundary checkpoint (or a mid-accumulation save being resharded —
+        # an N-way partial gradient window cannot be continued M-way, so the
+        # window rewinds to its start and re-consumes in full): drop any
+        # pre-load accumulation so the next window starts from zeros
+        # (forward() lazily rebuilds the buffer)
         engine._grad_acc = None
     engine.global_steps = int(meta.get("global_steps", 0))
     engine.micro_steps = int(meta.get("micro_steps", 0))
@@ -239,6 +270,29 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     # batch count a skip-free run would have consumed
     engine.data_cursor = int(meta.get(
         "data_cursor", engine.global_steps + engine.skipped_steps))
+    if resharding:
+        plan = apply_cursor_reshard(engine, meta, int(old_world))
+        if meta.get("has_grad_acc"):
+            # rewound window: the in-program micro counter must restart the
+            # accumulation window from zero alongside the dropped buffer
+            import jax.numpy as jnp
+
+            micro0 = jnp.zeros((), jnp.int32)
+            old_micro = engine.state.get("micro")
+            sharding = getattr(old_micro, "sharding", None)
+            engine.state["micro"] = (jax.device_put(micro0, sharding)
+                                     if sharding is not None else micro0)
+        logger.warning(
+            f"load_checkpoint: resharded tag {tag!r} from world="
+            f"{int(old_world)} to world={plan.new_world} (cursor "
+            f"{plan.old_cursor} -> {plan.new_cursor}"
+            + (", mid-accumulation window rewound" if plan.window_rewound
+               else "") + ")")
+        if rec is not None:
+            rec.record("reshard_applied", step=engine.global_steps, tag=tag,
+                       old_world=int(old_world), new_world=plan.new_world,
+                       old_cursor=plan.old_cursor, new_cursor=plan.new_cursor,
+                       window_rewound=plan.window_rewound)
     if meta.get("rng_key") is not None:
         # step-exact resume: restore the host PRNG chain, so the resumed
         # run's _next_rng splits reproduce the uninterrupted run bitwise

@@ -94,8 +94,15 @@ def save_pytree(tree, directory: str, write: bool = True,
             msgpack.packb({"leaves": meta, "format_version": 1}), fsync=False)
 
 
-def load_pytree(template, directory: str):
-    """Load into the structure (and shardings) of ``template``."""
+def load_pytree(template, directory: str, on_shape_mismatch=None):
+    """Load into the structure (and shardings) of ``template``.
+
+    ``on_shape_mismatch(key, arr, template_leaf)``: optional resolver for
+    leaves whose stored shape disagrees with the template — the elastic
+    reshard-on-load path (``runtime/zero/reshard.py``) uses it to remap or
+    reset world-size-coupled leaves instead of rejecting the checkpoint. It
+    must return a host array of the template leaf's shape (or raise).
+    Without a resolver a shape mismatch raises, as before."""
     with open(os.path.join(directory, "state.msgpack"), "rb") as f:
         meta = msgpack.unpackb(f.read())
     version = meta.get("format_version") if isinstance(meta, dict) else None
@@ -121,8 +128,14 @@ def load_pytree(template, directory: str):
         if str(arr.dtype) != str(target_dtype):
             arr = arr.astype(target_dtype)
         if tuple(arr.shape) != tuple(leaf.shape):
-            raise ValueError(
-                f"shape mismatch for {key!r}: checkpoint {arr.shape} vs model {leaf.shape}")
+            if on_shape_mismatch is None:
+                raise ValueError(
+                    f"shape mismatch for {key!r}: checkpoint {arr.shape} vs model {leaf.shape}")
+            arr = np.asarray(on_shape_mismatch(key, arr, leaf))
+            if tuple(arr.shape) != tuple(leaf.shape):
+                raise ValueError(
+                    f"shape-mismatch resolver for {key!r} returned shape "
+                    f"{arr.shape}, expected {tuple(leaf.shape)}")
         sharding = getattr(leaf, "sharding", None)
         leaves.append(jax.device_put(arr, sharding) if sharding is not None else jnp.asarray(arr))
     return jax.tree_util.tree_unflatten(treedef, leaves)

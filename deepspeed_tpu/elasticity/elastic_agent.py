@@ -30,14 +30,14 @@ import os
 import subprocess
 import sys
 import time
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, List, Optional, Sequence, Tuple
 
 from ..resilience import RecoveryLog, quarantine_tag, read_latest
 from ..resilience.preemption import PREEMPTED_EXIT_CODE
 from ..resilience.retry import backoff_delay
 from ..utils.logging import logger
 from .elasticity import (ELASTICITY_CONFIG_ENV, ElasticityError,
-                         compute_elastic_config)
+                         compute_elastic_config, validate_elasticity_block)
 
 
 def probe_device_count(timeout: float = 120.0) -> int:
@@ -73,6 +73,7 @@ class AgentResult:
     history: List[WorkerSpec]
     preemptions: int = 0            # graceful drain exits survived
     quarantined: List[str] = dataclasses.field(default_factory=list)
+    membership_changes: int = 0     # budget-free resize relaunches
 
 
 class DSElasticAgent:
@@ -92,7 +93,12 @@ class DSElasticAgent:
         ``max_restarts``). Graceful preemption exits
         (:data:`~deepspeed_tpu.resilience.preemption.PREEMPTED_EXIT_CODE`)
         do NOT consume restart budget — the worker checkpointed and left on
-        purpose; it is relaunched immediately without backoff.
+        purpose; it is relaunched immediately without backoff. Membership
+        changes are equally budget-free (docs/RESILIENCE.md "Elastic
+        membership"): a worker dying together with a device-count change (a
+        lost host kills its worker) relaunches at the re-resolved world size
+        with no restart counted and a ``membership_change`` recovery event;
+        only same-world crashes spend budget and back off.
       poll_interval: seconds between membership checks while the worker runs.
       checkpoint_dir: the worker's checkpoint directory. When set, the agent
         (a) applies exponential restart backoff, (b) detects crash loops —
@@ -124,6 +130,11 @@ class DSElasticAgent:
         self._elastic_block = dict(
             ds_config.get("elasticity", {}) if isinstance(ds_config, dict)
             else getattr(ds_config, "elasticity", None) or {})
+        if self._elastic_block.get("enabled"):
+            # fail at construction, not at the first resize: this is the same
+            # validation runtime/config.py applies to the worker's copy
+            self._elastic_block = validate_elasticity_block(
+                self._elastic_block, warn=logger.warning)
         self.device_count_fn = device_count_fn or probe_device_count
         self.max_restarts = int(max_restarts)
         self.poll_interval = float(poll_interval)
@@ -172,20 +183,54 @@ class DSElasticAgent:
     def run(self) -> AgentResult:
         restarts = 0
         preemptions = 0
+        membership_changes = 0
         quarantined: List[str] = []
         history: List[WorkerSpec] = []
         consecutive_failures = 0    # resets on preemption/membership change
         same_tag_failures = 0
         last_failed_tag: Optional[str] = None
+        prev_spec: Optional[WorkerSpec] = None
+        # the (world, spec) a post-death probe already resolved: carried into
+        # the next launch so ONE probe drives both the budget decision and
+        # the membership event/relaunch — two independent probes around an
+        # unstable dying runtime could classify the death one way and
+        # relaunch another
+        pending: Optional[Tuple[int, WorkerSpec]] = None
         while True:
-            world = self.device_count_fn()
-            spec = self.resolve(world)
+            # re-probe device count before EVERY launch: the world this
+            # worker group is resolved for is the world that exists NOW, not
+            # the one the agent started with
+            if pending is not None:
+                world, spec = pending
+                pending = None
+            else:
+                world = self.device_count_fn()
+                spec = self.resolve(world)
+            if prev_spec is not None and spec.world_size != prev_spec.world_size:
+                # membership change: budget-free like a drained preemption —
+                # losing a device is the cluster's fault, not the worker's
+                membership_changes += 1
+                consecutive_failures = 0
+                same_tag_failures = 0
+                last_failed_tag = None
+                self._events.record(
+                    "membership_change", value=membership_changes,
+                    old_world=prev_spec.world_size,
+                    new_world=spec.world_size,
+                    tag=self._latest_tag() or "")
+                logger.warning(
+                    f"elastic agent: membership change "
+                    f"{prev_spec.world_size} -> {spec.world_size}; "
+                    f"relaunching at the new decomposition (budget-free, "
+                    f"{membership_changes} change(s) absorbed)")
+            prev_spec = spec
             history.append(spec)
             resume_tag = self._latest_tag()
             argv = list(self.make_cmd(spec))
             logger.info(
                 f"elastic agent: launching worker (attempt "
-                f"{restarts + preemptions + 1}): world={spec.world_size} "
+                f"{restarts + preemptions + membership_changes + 1}): "
+                f"world={spec.world_size} "
                 f"micro={spec.micro_batch} gas={spec.gas} "
                 f"global_batch={spec.global_batch}"
                 + (f" resume_tag={resume_tag}" if resume_tag else ""))
@@ -201,7 +246,8 @@ class DSElasticAgent:
                 logger.info("elastic agent: worker SUCCEEDED")
                 return AgentResult("SUCCEEDED", restarts, history,
                                    preemptions=preemptions,
-                                   quarantined=quarantined)
+                                   quarantined=quarantined,
+                                   membership_changes=membership_changes)
             if rc == self.preempted_exit_code:
                 # graceful drain: the worker committed an emergency checkpoint
                 # and left — relaunch immediately, spend no restart budget
@@ -214,27 +260,36 @@ class DSElasticAgent:
                     f"cleanly); relaunching from its emergency checkpoint "
                     f"({preemptions} preemption(s) survived)")
                 continue
+            post = self._probe_after_death()
+            if rc is None or (post is not None
+                              and post[1].world_size != spec.world_size):
+                # the worker died WITH a membership change (a lost device
+                # kills its worker): budget-free — the SAME probe that made
+                # this call is carried to the loop top, which records the
+                # membership_change event and launches at its decomposition
+                pending = post
+                logger.warning(
+                    f"elastic agent: worker exited rc={rc} with a membership "
+                    "change pending; re-resolving the world size "
+                    "(budget-free restart)")
+                continue
             restarts += 1
-            if rc is None:
-                # membership change, not a crash: re-resolve at once
-                consecutive_failures = 0
-            else:
-                consecutive_failures += 1
+            consecutive_failures += 1
             if restarts > self.max_restarts:
                 logger.error(
                     f"elastic agent: giving up after {restarts - 1} restarts")
                 return AgentResult("FAILED", restarts - 1, history,
                                    preemptions=preemptions,
-                                   quarantined=quarantined)
+                                   quarantined=quarantined,
+                                   membership_changes=membership_changes)
             self._events.record("worker_restart", value=restarts,
-                                rc="membership-change" if rc is None else rc,
-                                tag=resume_tag or "")
+                                rc=rc, tag=resume_tag or "")
             # crash-loop detection: K consecutive crashes while 'latest'
             # still points at the same tag → the tag is poisoned (loads but
             # kills the worker); quarantine it so the next resume falls back
             # to the previous committed tag
             failed_tag = self._latest_tag()
-            if rc is not None and failed_tag is not None:
+            if failed_tag is not None:
                 if failed_tag == last_failed_tag:
                     same_tag_failures += 1
                 else:
@@ -253,17 +308,28 @@ class DSElasticAgent:
                                         new_latest=new_latest or "")
                     same_tag_failures = 0
                     last_failed_tag = None
-            if consecutive_failures > 0:
-                delay = self._backoff(consecutive_failures)
-                logger.warning(
-                    f"elastic agent: worker exited rc={rc}; restarting in "
-                    f"{delay:.1f}s ({restarts}/{self.max_restarts}) from the "
-                    f"latest committed checkpoint")
-                time.sleep(delay)
-            else:
-                logger.warning(
-                    f"elastic agent: restarting ({restarts}/"
-                    f"{self.max_restarts}) after membership change")
+            delay = self._backoff(consecutive_failures)
+            logger.warning(
+                f"elastic agent: worker exited rc={rc}; restarting in "
+                f"{delay:.1f}s ({restarts}/{self.max_restarts}) from the "
+                f"latest committed checkpoint")
+            time.sleep(delay)
+
+    def _probe_after_death(self) -> Optional[Tuple[int, WorkerSpec]]:
+        """ONE device probe after a worker death, resolved against the
+        ladder. Its spec decides whether the death was a membership change
+        (budget-free) AND — carried to the loop top — what to launch next,
+        so a probe flapping between the two decisions cannot classify the
+        death one way and relaunch another. ``None`` when the probe or
+        resolution fails: not a membership change the agent can act on, so
+        the exit counts as a plain crash (backoff + budget)."""
+        try:
+            world = self.device_count_fn()
+            return world, self.resolve(world)
+        except (ElasticityError, RuntimeError, OSError) as e:
+            logger.warning(f"elastic agent: post-crash device probe failed "
+                           f"({e}); counting the exit as a crash")
+            return None
 
     def _watch(self, proc: subprocess.Popen,
                launched_world: int) -> Optional[int]:

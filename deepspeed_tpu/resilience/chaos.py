@@ -76,6 +76,14 @@ class FaultPlan:
       (repeated overflow -> fp32 wire) without having to construct a real
       error-feedback blow-up. The in-program overflow handling itself
       (skip + EF residual reset) is exercised by the real overflow tests.
+    - ``lose_worker_at_step`` (the device-loss injector,
+      ``docs/RESILIENCE.md`` "Elastic membership"): SIGKILL our own pid when
+      the batch at data cursor ``N`` is about to execute — a dp worker dying
+      with its lost device, mid-run, with whatever accumulation window was
+      open simply gone. The elastic agent must observe the death, re-probe
+      the (now smaller) device count, and relaunch at the new world size
+      from the newest committed tag — the reshard-on-load path. Like
+      ``kill_at_phase`` this is a real SIGKILL: no handler runs.
 
     Serving-path injectors (docs/SERVING.md "Overload & failure"; consumed
     by the continuous-batching scheduler at the 2.5-method executor protocol
@@ -123,6 +131,7 @@ class FaultPlan:
     stall_collective: float = 0.0
     stall_collective_at_step: int = 1
     ef_overflow_steps: int = 0
+    lose_worker_at_step: Optional[int] = None
     # serving-path injectors
     dispatch_raise_at: Optional[int] = None
     dispatch_raise_times: int = 1
@@ -214,6 +223,12 @@ class FaultPlan:
     def training_faults(self, cursor: int) -> "TrainingFaults":
         """Resolve the training-path injections armed for the batch at data
         cursor ``cursor`` (called by the engine once per executed batch)."""
+        if (self.lose_worker_at_step is not None
+                and cursor == int(self.lose_worker_at_step)):
+            logger.warning(
+                f"chaos: SIGKILL at data cursor {cursor} (lost dp worker — "
+                "elastic device-loss injection)")
+            os.kill(os.getpid(), signal.SIGKILL)
         nan = self.nan_at_step is not None and cursor == int(self.nan_at_step)
         if nan:
             logger.warning(f"chaos: poisoning batch at data cursor {cursor} "

@@ -459,9 +459,86 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
             if key not in known:
                 logger.warning(f"DeepSpeedConfig: ignoring unrecognized key {key!r}")
         self = cls(**config)
+        self._adopt_elastic_batch(world_size)
         self._resolve_batch(world_size)
         self._validate(world_size)
         return self
+
+    def _elastic_world(self, world_size: int) -> int:
+        """The dp replica count the elasticity ladder is judged at: an
+        explicit ``mesh.dp`` wins over the probed device count (device-subset
+        meshes in tests, or an agent-pinned decomposition)."""
+        return self.mesh.dp if self.mesh.dp and self.mesh.dp > 0 else world_size
+
+    def _adopt_elastic_batch(self, world_size: int) -> None:
+        """Elasticity dictates the batch triangle (parity: the reference
+        refuses batch knobs next to an elasticity block): when the block is
+        enabled and NO batch knob is given, adopt the ladder's decomposition
+        for the current world size — the one validated source the agent and
+        the engine both consume."""
+        e = self.elasticity
+        if not (e and e.get("enabled")):
+            return
+        if e.get("ignore_non_elastic_batch_info", False):
+            return
+        if (self.train_batch_size is not None
+                or self.train_micro_batch_size_per_gpu is not None
+                or self.gradient_accumulation_steps is not None):
+            return  # explicit knobs: checked for ladder consistency in _validate
+        from ..elasticity import ElasticityError, compute_elastic_config
+
+        world = self._elastic_world(world_size)
+        try:
+            final_bs, _, micro = compute_elastic_config(
+                {"elasticity": dict(e)}, world)
+        except ElasticityError as err:
+            raise ValueError(f"invalid elasticity block: {err}") from err
+        self.train_batch_size = final_bs
+        self.train_micro_batch_size_per_gpu = micro
+        self.gradient_accumulation_steps = final_bs // (micro * world)
+        logger.info(
+            f"elasticity: adopted batch plan for world={world}: "
+            f"global={final_bs} micro={micro} "
+            f"gas={self.gradient_accumulation_steps}")
+
+    def _validate_elasticity(self, world_size: int) -> None:
+        """The ``elasticity`` block is validated HERE, not silently carried:
+        a malformed block (or a batch triangle off the elastic ladder) dies
+        at config load instead of at the first resize (docs/RESILIENCE.md
+        "Elastic membership")."""
+        e = self.elasticity
+        if not e:
+            return
+        from ..elasticity import (ElasticityError, compute_elastic_config,
+                                  validate_elasticity_block)
+
+        try:
+            block = validate_elasticity_block(dict(e), warn=logger.warning)
+        except ElasticityError as err:
+            raise ValueError(f"invalid elasticity block: {err}") from err
+        if not block.get("enabled"):
+            return
+        final_bs, valid, _ = compute_elastic_config({"elasticity": block}, 0)
+        if block.get("ignore_non_elastic_batch_info", False):
+            logger.warning(
+                "elasticity.ignore_non_elastic_batch_info: the batch "
+                "triangle is NOT checked against the elastic ladder — "
+                "resizes may change the effective batch")
+            return
+        world = self._elastic_world(world_size)
+        if world not in valid:
+            raise ValueError(
+                f"elasticity: world size {world} is not among the valid "
+                f"elastic sizes {valid} for batch {final_bs} — the resize "
+                f"plan could never have launched this decomposition (set "
+                f"ignore_non_elastic_batch_info to override)")
+        if self.train_batch_size != final_bs:
+            raise ValueError(
+                f"elasticity: train_batch_size={self.train_batch_size} is "
+                f"off the elastic ladder (the block resolves to "
+                f"{final_bs}) — a resize would change the effective batch; "
+                f"drop the batch knobs to adopt the ladder, or set "
+                f"ignore_non_elastic_batch_info to override")
 
     # The reference's batch triangle (train = micro * gas * dp_world) — fill any one
     # missing vertex, default gas=1.
@@ -491,6 +568,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
         self.gradient_accumulation_steps = gas
 
     def _validate(self, world_size: int) -> None:
+        self._validate_elasticity(world_size)
         train = self.train_batch_size
         micro = self.train_micro_batch_size_per_gpu
         gas = self.gradient_accumulation_steps

@@ -1,0 +1,304 @@
+"""Deterministic ZeRO reshard: flat-shard repartitioning on world-size change.
+
+Elastic training (``docs/RESILIENCE.md`` "Elastic membership") resizes a job
+when cluster membership changes — a preempted host shrinks dp from N to M, a
+returned one grows it back. The partitioned pieces of ZeRO state (fp32
+masters, optimizer m/v moments, host-offload unit shards) must then be
+remapped from N-way to M-way partitions *deterministically*: the resharded
+run's state must be bitwise what a fresh M-way partitioning of the merged
+logical state would produce, or the resized run silently trains on different
+numbers than the one that died.
+
+This module is that math, pure and property-testable:
+
+- :func:`partition_flat` / :func:`merge_flat` / :func:`repartition_flat` —
+  the canonical flat-padded layout (rank ``i`` owns the contiguous slice
+  ``[i*s, (i+1)*s)`` of the logical vector padded with zeros to ``W*s``,
+  ``s = ceil(n/W)``). ``repartition_flat`` is pure memory movement — no
+  float op ever runs — so ``repartition(partition(x, N), M) ==
+  partition(x, M)`` bitwise and an N→M→N round-trip is the identity, for
+  any dtype (including raw-view bf16) and any uneven/non-divisible sizes.
+- :func:`partition_host_state` / :func:`repartition_host_state` — the same
+  mapping over a dict of host-offload leaves (the PR 11 ``host_state``
+  unit-shard format: each fp32 master/m/v leaf raveled and partitioned).
+- :func:`rescale_cursor` — the data-cursor remap. The cursor counts consumed
+  *global batches*; elastic resizes keep the effective batch constant, so
+  the cursor is world-invariant whenever ``old_global == new_global`` and is
+  otherwise rescaled exactly in sample units — refusing (loudly) any remap
+  that would split a global batch, i.e. drop or replay samples.
+
+World-size-coupled *residue* is handled by policy, not arithmetic: the
+quantized-gradient error-feedback residuals (``state["qgrad_residual"]``,
+``state["qgrad_bucket_residual"]``) accumulate per-rank quantization error
+against the OLD decomposition's block boundaries and chunk ownership — after
+a reshard they are meaningless, so they are reset to zeros exactly like the
+PR 5 wire-demotion re-promotion path resets them
+(:class:`~deepspeed_tpu.resilience.rollback.WireDemotionController`). The
+reset is recorded as a ``reshard_residual_reset`` recovery event.
+
+``load_checkpoint`` applies all of this on load (``reshard-on-load``): the
+checkpoint meta records ``world_size`` + a partition spec at save time, and
+loading at a different world size reshards instead of rejecting — emitting a
+``reshard_applied`` recovery event. Mid-accumulation saves rewind to the
+window start (the partial gradient window of an N-way decomposition cannot
+be continued by an M-way one; its contribution is discarded WITH the cursor
+rewind, so re-consuming the window is exact — no sample is dropped or
+replayed across a global-batch boundary).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+#: state keys whose reshard policy is RESET (accumulated quantization error
+#: tied to the old decomposition), mirroring the demotion-reset path
+RESIDUAL_RESET_KEYS = ("qgrad_residual", "qgrad_bucket_residual")
+
+#: partition layout identifier recorded in checkpoint meta
+PARTITION_FORMAT = "flat-padded-v1"
+
+
+class ReshardError(ValueError):
+    """A world-size remap that cannot be performed exactly."""
+
+
+# ------------------------------------------------------------------ flat math
+def shard_len(logical_size: int, world: int) -> int:
+    """Per-rank shard length: ``ceil(logical/world)`` (the padded layout)."""
+    if world < 1:
+        raise ReshardError(f"world size must be >= 1, got {world}")
+    if logical_size < 0:
+        raise ReshardError(f"logical size must be >= 0, got {logical_size}")
+    return -(-logical_size // world) if logical_size else 0
+
+
+def partition_flat(flat: np.ndarray, world: int) -> np.ndarray:
+    """Partition a 1-D logical vector into ``[world, shard_len]`` contiguous
+    shards, zero-padding the tail rank. Pure reshape/pad: bitwise."""
+    flat = np.ascontiguousarray(flat)
+    if flat.ndim != 1:
+        raise ReshardError(f"partition_flat takes a 1-D vector, got shape "
+                           f"{flat.shape} (ravel the leaf first)")
+    s = shard_len(flat.size, world)
+    padded = np.zeros(world * s, dtype=flat.dtype)
+    padded[:flat.size] = flat
+    return padded.reshape(world, s)
+
+
+def merge_flat(shards: np.ndarray, logical_size: int) -> np.ndarray:
+    """Merge ``[world, shard_len]`` shards back into the logical vector,
+    dropping the tail padding."""
+    shards = np.asarray(shards)
+    if shards.ndim != 2:
+        raise ReshardError(
+            f"merge_flat takes [world, shard] stacks, got shape {shards.shape}")
+    if shards.size < logical_size:
+        raise ReshardError(
+            f"shards hold {shards.size} elements < logical size {logical_size}")
+    return np.ascontiguousarray(shards.reshape(-1)[:logical_size])
+
+
+def repartition_flat(shards: np.ndarray, new_world: int,
+                     logical_size: int) -> np.ndarray:
+    """Remap ``[old_world, s_old]`` shards to ``[new_world, s_new]``.
+
+    Provably equal (bitwise) to freshly partitioning the merged logical
+    vector ``new_world`` ways — the N→M→N round-trip is the identity for
+    canonical (zero-padded) shards. No float operation runs."""
+    return partition_flat(merge_flat(shards, logical_size), new_world)
+
+
+# ------------------------------------------------------- host-offload shards
+def partition_host_state(host_state: Dict[str, np.ndarray], world: int
+                         ) -> Tuple[Dict[str, np.ndarray], Dict[str, int]]:
+    """Partition every leaf of a PR 11 host-state dict (``master_i``/``m_i``/
+    ``v_i`` fp32 arrays) into ``[world, shard]`` stacks. Returns the shard
+    dict plus the logical sizes needed to merge back."""
+    shards: Dict[str, np.ndarray] = {}
+    sizes: Dict[str, int] = {}
+    for key, arr in host_state.items():
+        arr = np.asarray(arr)
+        if arr.ndim == 0:  # counters (e.g. "count") are world-invariant
+            shards[key] = arr
+            sizes[key] = 0
+            continue
+        shards[key] = partition_flat(arr.reshape(-1), world)
+        sizes[key] = int(arr.size)
+    return shards, sizes
+
+
+def repartition_host_state(shards: Dict[str, np.ndarray],
+                           sizes: Dict[str, int],
+                           new_world: int) -> Dict[str, np.ndarray]:
+    """Remap every partitioned host-state leaf to ``new_world`` shards —
+    per-leaf :func:`repartition_flat`, scalars passed through."""
+    out: Dict[str, np.ndarray] = {}
+    for key, stack in shards.items():
+        arr = np.asarray(stack)
+        out[key] = (arr if arr.ndim == 0
+                    else repartition_flat(arr, new_world, sizes[key]))
+    return out
+
+
+# ------------------------------------------------------------------- cursor
+def rescale_cursor(cursor: int, old_global_batch: int,
+                   new_global_batch: int) -> int:
+    """Remap a data cursor (consumed *global batches*) across a global-batch
+    change, exactly in sample units.
+
+    Elastic resizes keep the effective batch constant
+    (``compute_elastic_config``), so the common case is the identity. A
+    genuine global-batch change is only representable when the consumed
+    sample count lands on a new-global-batch boundary; anything else would
+    drop or replay samples and raises instead."""
+    cursor = int(cursor)
+    old_global_batch = int(old_global_batch)
+    new_global_batch = int(new_global_batch)
+    if old_global_batch <= 0 or new_global_batch <= 0:
+        raise ReshardError(
+            f"global batch sizes must be positive "
+            f"(old={old_global_batch}, new={new_global_batch})")
+    if old_global_batch == new_global_batch:
+        return cursor
+    samples = cursor * old_global_batch
+    if samples % new_global_batch:
+        raise ReshardError(
+            f"cursor {cursor} x old global batch {old_global_batch} = "
+            f"{samples} consumed samples does not land on a new global-batch "
+            f"boundary ({new_global_batch}); resuming here would drop or "
+            f"replay samples — keep the effective batch constant across "
+            f"resizes (the elasticity ladder guarantees this)")
+    return samples // new_global_batch
+
+
+# -------------------------------------------------------------- save-side meta
+def partition_record(engine) -> Optional[Dict[str, Any]]:
+    """The partition spec recorded into checkpoint ``meta.json``: enough for
+    a later load at any world size to reshard deterministically (and for a
+    human to see what decomposition wrote the tag)."""
+    topo = getattr(engine, "topo", None)
+    if topo is None:
+        return None
+    dp = int(topo.data_parallel_size)
+    micro = int(getattr(engine, "micro_batch_size", 1) or 1)
+    gas = int(getattr(engine, "gas", 1) or 1)
+    rec: Dict[str, Any] = {
+        "format": PARTITION_FORMAT,
+        "dp": dp,
+        "micro_batch": micro,
+        "gas": gas,
+        # the REAL samples-per-cursor-tick (micro x gas x dp), not the config
+        # triangle's train_batch_size (which can legally disagree in
+        # device-subset test meshes)
+        "global_batch": micro * gas * dp,
+    }
+    if getattr(engine, "_qgrad_npad", None):
+        rec["qgrad"] = {"n": int(engine._qgrad_n),
+                        "npad": int(engine._qgrad_npad)}
+    if getattr(engine, "_qgrad_bucket_key", None):
+        rec["qgrad_bucket"] = {"L": int(engine._qgrad_bucket_L),
+                               "npad": int(engine._qgrad_bucket_npad)}
+    return rec
+
+
+def engine_global_batch(engine) -> int:
+    """Samples one data-cursor tick consumes on this engine."""
+    topo = getattr(engine, "topo", None)
+    dp = int(topo.data_parallel_size) if topo is not None else 1
+    return (int(getattr(engine, "micro_batch_size", 1) or 1)
+            * int(getattr(engine, "gas", 1) or 1) * dp)
+
+
+# --------------------------------------------------------------- load-side
+def load_resolver(old_world: int, new_world: int,
+                  recovery_log: Any = None,
+                  step: int = 0) -> Callable[[str, np.ndarray, Any], np.ndarray]:
+    """The ``on_shape_mismatch`` hook ``load_pytree`` calls when a checkpoint
+    leaf's shape disagrees with the engine template during a reshard-on-load.
+
+    Policy per key:
+
+    - error-feedback residuals (:data:`RESIDUAL_RESET_KEYS`): RESET to zeros
+      at the new decomposition's shape — the demotion-reset semantics
+      (accumulated per-rank quantization error is only meaningful against
+      the block boundaries and chunk ownership of the world size that wrote
+      it). Recorded as a ``reshard_residual_reset`` event.
+    - anything else: raise :class:`ReshardError` naming the leaf and both
+      worlds — an unknown world-coupled leaf must fail loudly, never load
+      approximately.
+    """
+
+    def resolve(key: str, arr: np.ndarray, leaf: Any) -> np.ndarray:
+        name = key.rsplit("/", 1)[-1]
+        if name in RESIDUAL_RESET_KEYS:
+            if recovery_log is not None:
+                recovery_log.record("reshard_residual_reset", step=step,
+                                    key=key, old_world=old_world,
+                                    new_world=new_world)
+            try:
+                return np.zeros(tuple(leaf.shape), dtype=leaf.dtype)
+            except TypeError:  # ml_dtypes leaf: match via a same-size view
+                return np.zeros(tuple(leaf.shape), dtype=np.float32)
+        raise ReshardError(
+            f"checkpoint leaf {key!r} has shape {tuple(arr.shape)} (written "
+            f"at world={old_world}) but the engine at world={new_world} "
+            f"expects {tuple(leaf.shape)} — no reshard policy covers this "
+            f"leaf; it is world-coupled state this build does not know how "
+            f"to remap")
+
+    return resolve
+
+
+# ------------------------------------------------------------- engine wiring
+@dataclasses.dataclass(frozen=True)
+class ReshardPlan:
+    """What a reshard-on-load decided (returned for events/logging)."""
+
+    old_world: int
+    new_world: int
+    old_cursor: int
+    new_cursor: int
+    window_rewound: bool
+
+
+def apply_cursor_reshard(engine, meta: Dict[str, Any],
+                         old_world: int) -> ReshardPlan:
+    """Remap ``engine.data_cursor`` after a reshard-on-load.
+
+    Called by ``load_checkpoint`` AFTER the engine counters were restored
+    from ``meta``. The cursor counts global batches and only advances at
+    window boundaries; with the effective batch held constant (the elastic
+    contract) it passes through unchanged, and a genuine global-batch change
+    is rescaled sample-exactly (or refused). A mid-accumulation save
+    (``has_grad_acc``) recorded a cursor still pointing AT the in-progress
+    window; the caller drops the old decomposition's partial gradient
+    buffer, so re-consuming that window from its start at the new
+    decomposition is exact — the discarded partial contribution is the only
+    thing replayed, and nothing across a global-batch boundary is dropped
+    or replayed."""
+    new_world = int(getattr(engine, "topo").data_parallel_size)
+    part = meta.get("partition") or {}
+    old_global = int(part.get("global_batch") or 0)
+    if old_global <= 0:
+        # pre-partition-spec checkpoints: best effort from the saved config
+        ds_cfg = meta.get("ds_config") or {}
+        old_global = int(ds_cfg.get("train_batch_size") or 0)
+    old_cursor = int(engine.data_cursor)
+    new_cursor = old_cursor
+    if old_global > 0:
+        new_cursor = rescale_cursor(old_cursor, old_global,
+                                    engine_global_batch(engine))
+    engine.data_cursor = new_cursor
+    return ReshardPlan(old_world=old_world, new_world=new_world,
+                       old_cursor=old_cursor, new_cursor=new_cursor,
+                       window_rewound=bool(meta.get("has_grad_acc")))
+
+
+__all__ = ["ReshardError", "ReshardPlan", "RESIDUAL_RESET_KEYS",
+           "PARTITION_FORMAT", "shard_len", "partition_flat", "merge_flat",
+           "repartition_flat", "partition_host_state",
+           "repartition_host_state", "rescale_cursor", "partition_record",
+           "engine_global_batch", "load_resolver", "apply_cursor_reshard"]

@@ -1,0 +1,256 @@
+#!/usr/bin/env python
+"""Elastic device-loss smoke for CI (scripts/verify_tier1.sh; docs/RESILIENCE.md
+"Elastic membership").
+
+One full resize-and-resume cycle against the real training worker:
+
+1. The elastic agent launches a dp=4 worker (quantized-gradient error
+   feedback armed, so the run carries world-size-coupled ``qgrad_residual``
+   state). A ``lose_worker_at_step`` fault plan SIGKILLs the worker mid-run
+   at data cursor 3 — a dp worker dying with its lost device. The device
+   probe sees 3 devices from then on.
+2. The agent must absorb the death budget-free (``membership_change``, not a
+   counted restart), re-resolve the elastic ladder at world=3 (same
+   effective batch 12), and relaunch. The worker auto-resumes from the
+   newest committed tag, resharding on load (``reshard_applied`` +
+   ``reshard_residual_reset`` events).
+3. The resharded run must be *exactly* the run a fresh dp=3 worker resumed
+   from the same anchor produces: per-step losses identical, final engine
+   state bitwise identical, and the consumed data-cursor sequence
+   contiguous across the resize (no sample dropped or replayed).
+4. Library check on the real anchor: for every master/optimizer leaf,
+   repartitioning its 4-way flat shards to 3-way equals freshly
+   partitioning the merged leaf 3 ways, bitwise
+   (``runtime/zero/reshard.py``).
+
+The full property matrix lives in ``tests/test_reshard.py``; this is the
+end-to-end contract in one script.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+TOTAL_STEPS = 6
+LOSE_AT = 3  # data cursor of the injected device loss (steps 1..3 committed)
+
+ELASTIC = {
+    "enabled": True,
+    "max_train_batch_size": 12,
+    "micro_batch_sizes": [1, 2, 3, 4],
+    "min_world_size": 1,
+    "max_world_size": 6,
+    "prefer_larger_batch": True,
+    "version": 0.2,
+}
+
+
+def fail(msg: str) -> int:
+    print(f"elastic_smoke: FAIL — {msg}")
+    return 1
+
+
+def read_log(path):
+    with open(path) as f:
+        return [json.loads(ln) for ln in f]
+
+
+def pid_alive(pid_file: str) -> bool:
+    try:
+        with open(pid_file) as f:
+            pid = int(f.read().strip())
+    except (OSError, ValueError):
+        return True  # not written yet: the worker is starting up
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+def anchor_partition_check(tag_dir: str) -> str:
+    """Repartitioning the anchor's 4-way flat shards to 3-way must equal
+    freshly partitioning the merged state 3 ways — bitwise, on the REAL
+    committed anchor's master/optimizer leaves."""
+    import msgpack
+    import numpy as np
+
+    from deepspeed_tpu.runtime.zero.reshard import (
+        partition_flat,
+        repartition_flat,
+    )
+
+    state_dir = os.path.join(tag_dir, "state")
+    with open(os.path.join(state_dir, "state.msgpack"), "rb") as f:
+        meta = msgpack.unpackb(f.read())
+    checked = 0
+    for leaf in meta["leaves"]:
+        key = leaf["key"]
+        if not (key.startswith("master/") or key.startswith("opt/")
+                or key.startswith("params/")):
+            continue
+        arr = np.load(os.path.join(state_dir, "arrays",
+                                   f"{leaf['index']}.npy")).reshape(-1)
+        if arr.size < 2:
+            continue
+        four = partition_flat(arr, 4)
+        via_reshard = repartition_flat(four, 3, arr.size)
+        fresh = partition_flat(arr, 3)
+        if via_reshard.tobytes() != fresh.tobytes():
+            return f"leaf {key!r}: 4->3 reshard != fresh 3-way partition"
+        back = repartition_flat(via_reshard, 4, arr.size)
+        if back.tobytes() != four.tobytes():
+            return f"leaf {key!r}: 4->3->4 round-trip not bitwise"
+        checked += 1
+    if checked < 3:
+        return f"anchor partition check covered only {checked} leaves"
+    print(f"elastic_smoke: anchor partition property held on {checked} "
+          f"master/opt/param leaves (4->3 bitwise == fresh 3-way)")
+    return ""
+
+
+def main() -> int:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    worker = os.path.join(root, "tests", "elastic_worker.py")
+
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+    from deepspeed_tpu.resilience import is_committed, read_events
+
+    with tempfile.TemporaryDirectory() as td:
+        ckpt = os.path.join(td, "ckpt")
+        log = os.path.join(td, "log.jsonl")
+        pid_file = os.path.join(td, "worker1.pid")
+        out_state = os.path.join(td, "resharded_state.npz")
+        os.makedirs(ckpt, exist_ok=True)
+        launches = []
+
+        def device_count():
+            # 4 devices while the first worker lives; the SIGKILL takes one
+            # with it (a lost host kills its worker), so every later probe
+            # reports 3
+            if len(launches) >= 2 or (launches and not pid_alive(pid_file)):
+                return 3
+            return 4
+
+        def make_cmd(spec):
+            launches.append(spec)
+            cmd = [sys.executable, worker, "--ckpt-dir", ckpt, "--log", log,
+                   "--steps", str(TOTAL_STEPS),
+                   "--elastic-world", str(spec.world_size),
+                   "--elastic-micro", str(spec.micro_batch),
+                   "--elastic-gas", str(spec.gas),
+                   "--resilience", "--cursor-data", "--qgrad",
+                   "--elastic-config", json.dumps(ELASTIC)]
+            if len(launches) == 1:
+                cmd += ["--lose-at", str(LOSE_AT), "--pid-file", pid_file]
+            else:
+                cmd += ["--out-state", out_state]
+            return cmd
+
+        agent = DSElasticAgent(
+            make_cmd, {"elasticity": ELASTIC}, device_count_fn=device_count,
+            max_restarts=2, poll_interval=0.3, checkpoint_dir=ckpt,
+            backoff_base=0.05, backoff_max=0.2)
+        result = agent.run()
+
+        if result.state != "SUCCEEDED":
+            return fail(f"agent did not succeed: {result}")
+        if [s.world_size for s in launches] != [4, 3]:
+            return fail(f"expected launches at dp4 then dp3, got "
+                        f"{[s.world_size for s in launches]}")
+        if result.membership_changes != 1:
+            return fail(f"expected 1 membership change, got "
+                        f"{result.membership_changes}")
+        if result.restarts != 0:
+            return fail(f"device loss spent restart budget: "
+                        f"{result.restarts} restarts counted")
+        anchor = os.path.join(ckpt, f"global_step{LOSE_AT}")
+        if not is_committed(anchor):
+            return fail(f"anchor tag global_step{LOSE_AT} not committed")
+
+        events = {e["event"] for e in read_events(ckpt)}
+        for needed in ("membership_change", "reshard_applied",
+                       "reshard_residual_reset"):
+            if needed not in events:
+                return fail(f"recovery event {needed!r} missing ({sorted(events)})")
+
+        rows = read_log(log)
+        run1 = [r for r in rows if r["world"] == 4]
+        run2 = [r for r in rows if r["world"] == 3]
+        if [r["step"] for r in run1] != list(range(1, LOSE_AT + 1)):
+            return fail(f"dp4 run steps wrong: {[r['step'] for r in run1]}")
+        if [r["step"] for r in run2] != list(range(LOSE_AT + 1, TOTAL_STEPS + 1)):
+            return fail(f"dp3 run steps wrong: {[r['step'] for r in run2]}")
+        # cursor exactness: the consumed data indexes must be one contiguous
+        # range across the resize — nothing dropped, nothing replayed
+        consumed = [r["index"] for r in run1] + [r["index"] for r in run2]
+        if consumed != list(range(TOTAL_STEPS)):
+            return fail(f"data indexes not contiguous across the resize: "
+                        f"{consumed}")
+        if {r["effective"] for r in rows} != {12}:
+            return fail(f"effective batch changed across the resize: "
+                        f"{sorted({r['effective'] for r in rows})}")
+        if not all(r["loss"] == r["loss"] for r in rows):
+            return fail("non-finite loss in the healed run")
+
+        # library property on the real anchor bytes
+        err = anchor_partition_check(anchor)
+        if err:
+            return fail(err)
+
+        # control: a fresh dp3 worker resumed from the SAME anchor must
+        # produce the identical trajectory and final state
+        control = os.path.join(td, "control")
+        control_log = os.path.join(td, "control_log.jsonl")
+        control_state = os.path.join(td, "control_state.npz")
+        os.makedirs(control, exist_ok=True)
+        shutil.copytree(anchor, os.path.join(control,
+                                             f"global_step{LOSE_AT}"))
+        with open(os.path.join(control, "latest"), "w") as f:
+            f.write(f"global_step{LOSE_AT}")
+        spec3 = launches[1]
+        p = subprocess.run(
+            [sys.executable, worker, "--ckpt-dir", control,
+             "--log", control_log, "--steps", str(TOTAL_STEPS),
+             "--elastic-world", str(spec3.world_size),
+             "--elastic-micro", str(spec3.micro_batch),
+             "--elastic-gas", str(spec3.gas),
+             "--resilience", "--cursor-data", "--qgrad",
+             "--elastic-config", json.dumps(ELASTIC),
+             "--out-state", control_state],
+            timeout=300)
+        if p.returncode != 0:
+            return fail(f"control dp3 run exited rc={p.returncode}")
+        control_rows = read_log(control_log)
+        got = [(r["step"], r["loss"]) for r in run2]
+        want = [(r["step"], r["loss"]) for r in control_rows]
+        if got != want:
+            return fail(f"resharded trajectory diverged from the dp3-from-"
+                        f"anchor control: {got} vs {want}")
+
+        import numpy as np
+
+        with np.load(out_state) as a, np.load(control_state) as b:
+            if sorted(a.files) != sorted(b.files):
+                return fail(f"state key sets differ: {sorted(a.files)} vs "
+                            f"{sorted(b.files)}")
+            for k in a.files:
+                if a[k].tobytes() != b[k].tobytes():
+                    return fail(f"final state leaf {k!r} not bitwise equal "
+                                f"to the dp3-from-anchor control")
+
+    print(f"elastic_smoke: PASS — SIGKILL one of 4 dp workers at cursor "
+          f"{LOSE_AT} -> budget-free relaunch at dp3 from global_step"
+          f"{LOSE_AT}, resharded run bitwise-identical to the dp3-from-"
+          f"anchor control, cursors contiguous {consumed}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -169,6 +169,32 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
 fi
 grep -a "offload_smoke: PASS" /tmp/_t1_offload_smoke.log || true
 
+# --- elastic gate (docs/RESILIENCE.md "Elastic membership") ---------------
+# the deterministic ZeRO reshard: flat-shard repartition properties, cursor
+# remap exactness, reshard-on-load through the real engine, the validated
+# elasticity block, budget-free membership restarts, and the
+# config/elastic-without-reshard-anchor rule.
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_reshard.py -q -m 'not slow' \
+        -p no:cacheprovider -p no:randomly > /tmp/_t1_reshard.log 2>&1; then
+    echo "verify_tier1: FAIL — reshard tests (tests/test_reshard.py):" >&2
+    tail -30 /tmp/_t1_reshard.log >&2
+    exit 1
+fi
+grep -aE '^[0-9]+ passed' /tmp/_t1_reshard.log || true
+
+# the elastic device-loss smoke: SIGKILL one of four dp workers mid-run ->
+# the agent relaunches at dp3 from the newest committed tag (budget-free
+# membership change), the resharded run is bitwise-identical to a dp3 run
+# resumed from the same anchor, and no data sample is dropped or replayed.
+if ! timeout -k 10 420 env JAX_PLATFORMS=cpu \
+        python scripts/elastic_smoke.py > /tmp/_t1_elastic.log 2>&1; then
+    echo "verify_tier1: FAIL — elastic smoke (scripts/elastic_smoke.py):" >&2
+    tail -40 /tmp/_t1_elastic.log >&2
+    exit 1
+fi
+grep -a "elastic_smoke: PASS" /tmp/_t1_elastic.log || true
+
 # --- fault-injection smoke (docs/RESILIENCE.md) ---------------------------
 # two heal cycles on the CPU mesh: SIGKILL mid-checkpoint + auto-resume
 # (crash consistency), and injected NaN -> divergence rollback -> poisoned

@@ -1,6 +1,29 @@
 """Elastic-agent worker fixture: trains a tiny GPT on a forced-CPU mesh of
 ``--elastic-world`` devices, checkpointing every step, resuming from the latest
-checkpoint on start. Used by test_elastic_agent.py (kill-and-resume)."""
+checkpoint on start. Used by test_elastic_agent.py (kill-and-resume),
+test_reshard.py and scripts/elastic_smoke.py (chaos-tested device-loss
+recovery, docs/RESILIENCE.md "Elastic membership").
+
+Elastic-resume extensions (all optional; defaults keep the original
+behavior):
+
+- ``--resilience``: arm the ``resilience`` block (commit-protocol saves,
+  auto-resume from the newest committed tag, recovery-event log — the
+  ``reshard_applied`` event lands in ``<ckpt>/recovery_events.jsonl``).
+- ``--cursor-data``: drive batches from ``engine.data_cursor`` (the
+  checkpointable-cursor contract the reshard path keeps sample-exact).
+- ``--qgrad``: arm the quantized gradient exchange with error feedback —
+  the run carries the world-size-coupled ``qgrad_residual`` state the
+  reshard-on-load path must reset by policy.
+- ``--lose-at N``: install a ``lose_worker_at_step`` fault plan (SIGKILL at
+  data cursor N — a dp worker dying with its lost device).
+- ``--pid-file``: write our pid at start (the smoke's device probe treats
+  this process's existence as one device's health).
+- ``--out-state``: npz dump of the final engine state for bitwise compares.
+- ``--elastic-config JSON``: include this ``elasticity`` block in the ds
+  config — exercises the runtime-side validation + the scheduler
+  fingerprint check against ``DS_TPU_ELASTICITY_CONFIG``.
+"""
 
 import argparse
 import json
@@ -20,7 +43,18 @@ def main() -> int:
     p.add_argument("--elastic-world", type=int, required=True)
     p.add_argument("--elastic-micro", type=int, required=True)
     p.add_argument("--elastic-gas", type=int, required=True)
+    p.add_argument("--resilience", action="store_true")
+    p.add_argument("--cursor-data", action="store_true")
+    p.add_argument("--qgrad", action="store_true")
+    p.add_argument("--lose-at", type=int, default=-1)
+    p.add_argument("--pid-file", default=None)
+    p.add_argument("--out-state", default=None)
+    p.add_argument("--elastic-config", default=None)
     args = p.parse_args()
+
+    if args.pid_file:
+        with open(args.pid_file, "w") as f:
+            f.write(str(os.getpid()))
 
     # strip any inherited device-count flag so ours wins (XLA_FLAGS is read at
     # backend init, which has not happened yet even though sitecustomize
@@ -47,7 +81,7 @@ def main() -> int:
     model, cfg = build_gpt(gpt.GPTConfig(
         vocab_size=64, n_layer=2, n_head=2, d_model=32, max_seq_len=32))
     topo = MeshTopology.create(dp=world, devices=jax.devices()[:world])
-    engine, _, _, _ = ds.initialize(model=model, topology=topo, config={
+    config = {
         "train_micro_batch_size_per_gpu": micro,
         "gradient_accumulation_steps": gas,
         "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
@@ -55,8 +89,23 @@ def main() -> int:
         "mesh": {"dp": world},
         "bf16": {"enabled": False},
         "steps_per_print": 0,
-    })
-    engine.load_checkpoint(args.ckpt_dir)  # no-op on the first launch
+    }
+    if args.qgrad:
+        config["zero_optimization"].update({
+            "zero_quantized_gradients": True,
+            "zero_quantize_error_feedback": True,
+        })
+    if args.elastic_config:
+        config["elasticity"] = json.loads(args.elastic_config)
+    if args.resilience:
+        res = {"enabled": True, "save_dir": args.ckpt_dir}
+        if args.lose_at >= 0:
+            res["chaos"] = {"lose_worker_at_step": args.lose_at}
+        config["resilience"] = res
+    engine, _, _, _ = ds.initialize(model=model, topology=topo, config=config)
+    if not args.resilience:
+        engine.load_checkpoint(args.ckpt_dir)  # no-op on the first launch
+    # resilience mode auto-resumed from the newest COMMITTED tag at init
 
     effective = micro * gas * world
 
@@ -72,11 +121,12 @@ def main() -> int:
         return {"input_ids": ids}
 
     while engine.global_steps < args.steps:
-        step = engine.global_steps
-        m = engine.train_batch(batch_for(step))
+        index = engine.data_cursor if args.cursor_data else engine.global_steps
+        m = engine.train_batch(batch_for(index))
         with open(args.log, "a") as f:
             f.write(json.dumps({
                 "step": engine.global_steps, "loss": float(m["loss"]),
+                "cursor": engine.data_cursor, "index": index,
                 "world": world, "micro": micro, "gas": gas,
                 "effective": effective}) + "\n")
         engine.save_checkpoint(args.ckpt_dir)
@@ -86,6 +136,21 @@ def main() -> int:
                 with open(path, "w") as f:
                     f.write(text)
             os._exit(17)  # simulated worker failure
+
+    if args.out_state:
+        from deepspeed_tpu.checkpoint.serialization import (
+            _UINT_FOR_SIZE,
+            _fetch_full,
+            _flatten_with_paths,
+        )
+
+        out = {}
+        for key, leaf in _flatten_with_paths(engine.state)[0]:
+            arr = _fetch_full(leaf)
+            if arr.dtype.kind not in "biufc":
+                arr = arr.view(_UINT_FOR_SIZE[arr.dtype.itemsize])
+            out[key] = arr
+        np.savez(args.out_state, **out)
     return 0
 
 

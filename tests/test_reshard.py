@@ -1,0 +1,499 @@
+"""Elastic world-size reshard (ISSUE 15, docs/RESILIENCE.md "Elastic
+membership"): the pure flat-shard repartition properties, the cursor remap,
+reshard-on-load through the real engine/checkpoint path, the validated
+elasticity config block, the budget-free membership-change agent semantics,
+and the ``config/elastic-without-reshard-anchor`` dslint rule.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint.serialization import _fetch_full, _flatten_with_paths
+from deepspeed_tpu.models import GPTConfig, build_gpt
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.topology import MeshTopology
+from deepspeed_tpu.runtime.zero.reshard import (
+    ReshardError,
+    merge_flat,
+    partition_flat,
+    partition_host_state,
+    repartition_flat,
+    repartition_host_state,
+    rescale_cursor,
+    shard_len,
+)
+
+TINY = GPTConfig(vocab_size=64, n_layer=2, n_head=2, d_model=32,
+                 max_seq_len=32)
+
+
+# ------------------------------------------------------------- pure properties
+@pytest.mark.parametrize("n,old,new", [
+    (17, 4, 3),     # both worlds uneven, non-divisible either way
+    (16, 4, 2),     # both divide
+    (16, 4, 3),     # old divides, new pads
+    (15, 3, 5),     # new divides, old pads
+    (1, 4, 2),      # fewer elements than ranks (empty tail shards)
+    (7, 1, 6),      # from a single rank
+    (7, 6, 1),      # to a single rank
+    (1024, 8, 5),
+])
+def test_repartition_equals_fresh_partition_bitwise(n, old, new):
+    rng = np.random.default_rng(n * 31 + old * 7 + new)
+    flat = rng.standard_normal(n).astype(np.float32)
+    shards = partition_flat(flat, old)
+    assert shards.shape == (old, shard_len(n, old))
+    # repartition == freshly partitioning the merged logical state, bitwise
+    got = repartition_flat(shards, new, n)
+    want = partition_flat(flat, new)
+    assert got.tobytes() == want.tobytes()
+    # N -> M -> N round-trip is the identity, bitwise
+    back = repartition_flat(got, old, n)
+    assert back.tobytes() == shards.tobytes()
+    # merge drops exactly the tail padding
+    assert merge_flat(got, n).tobytes() == flat.tobytes()
+
+
+def test_padded_tail_is_zeros_and_layout_contiguous():
+    flat = np.arange(10, dtype=np.int64)
+    shards = partition_flat(flat, 4)  # shard_len 3, 2 pad elements
+    assert shards.shape == (4, 3)
+    assert shards[3, 1] == 0 and shards[3, 2] == 0
+    # rank i owns the contiguous slice [i*s, (i+1)*s)
+    assert shards[1].tolist() == [3, 4, 5]
+
+
+def test_repartition_preserves_raw_dtypes():
+    # bf16 leaves travel as raw uint16 views in checkpoints; int8 covers the
+    # quantized payload case — pure memory movement must never touch bits
+    for dtype in (np.uint16, np.int8, np.float64):
+        flat = np.frombuffer(np.random.default_rng(3).bytes(
+            26 * np.dtype(dtype).itemsize), dtype=dtype).copy()
+        got = repartition_flat(partition_flat(flat, 5), 3, flat.size)
+        assert got.dtype == dtype
+        assert merge_flat(got, flat.size).tobytes() == flat.tobytes()
+
+
+def test_partition_rejects_bad_shapes():
+    with pytest.raises(ReshardError):
+        partition_flat(np.zeros((2, 3), np.float32), 2)
+    with pytest.raises(ReshardError):
+        merge_flat(np.zeros((6,), np.float32), 6)
+    with pytest.raises(ReshardError):
+        partition_flat(np.zeros((4,), np.float32), 0)
+    with pytest.raises(ReshardError):
+        merge_flat(np.zeros((2, 2), np.float32), 5)  # fewer elements than logical
+
+
+def test_host_offload_unit_shards_roundtrip():
+    # the PR 11 host_state format: fp32 master/m/v leaves + a scalar counter
+    rng = np.random.default_rng(0)
+    host = {"count": np.int64(7)}
+    for i, shape in enumerate([(33,), (8, 9), (5,), (2, 3, 4)]):
+        host[f"master_{i}"] = rng.standard_normal(shape).astype(np.float32)
+        host[f"m_{i}"] = rng.standard_normal(shape).astype(np.float32)
+        host[f"v_{i}"] = rng.standard_normal(shape).astype(np.float32)
+    shards4, sizes = partition_host_state(host, 4)
+    shards3 = repartition_host_state(shards4, sizes, 3)
+    for key, arr in host.items():
+        arr = np.asarray(arr)
+        if arr.ndim == 0:
+            assert shards3[key] == arr
+            continue
+        fresh = partition_flat(arr.reshape(-1), 3)
+        assert shards3[key].tobytes() == fresh.tobytes()
+        assert merge_flat(shards3[key], arr.size).tobytes() == \
+            arr.reshape(-1).tobytes()
+
+
+# ------------------------------------------------------------------ cursor
+def test_rescale_cursor_identity_and_exact():
+    # the elastic contract: effective batch constant -> cursor is invariant
+    assert rescale_cursor(17, 12, 12) == 17
+    # exact sample-unit remap across a genuine global-batch change
+    assert rescale_cursor(6, 8, 16) == 3
+    assert rescale_cursor(3, 16, 8) == 6
+    assert rescale_cursor(0, 8, 16) == 0
+
+
+def test_rescale_cursor_gas_boundary_decompositions():
+    # all (micro, gas, world) decompositions of one effective batch consume
+    # identical sample counts per cursor tick — the cursor crosses any gas
+    # boundary unchanged
+    for micro, gas, world in [(1, 3, 4), (3, 1, 4), (4, 1, 3), (2, 2, 3),
+                              (2, 3, 2), (12, 1, 1)]:
+        assert micro * gas * world == 12
+        assert rescale_cursor(5, 12, micro * gas * world) == 5
+
+
+def test_rescale_cursor_refuses_sample_splits():
+    # 5 batches of 12 = 60 samples: not a whole number of 16-sample batches
+    with pytest.raises(ReshardError):
+        rescale_cursor(5, 12, 16)
+    with pytest.raises(ReshardError):
+        rescale_cursor(1, 8, 0)
+
+
+# --------------------------------------------------------------- engine level
+def _make_engine(dp: int, micro: int, save_dir: str, qgrad: bool = True,
+                 gas: int = 1):
+    import jax
+
+    model, _ = build_gpt(TINY)
+    topo = MeshTopology.create(dp=dp, devices=jax.devices()[:dp])
+    zero = {"stage": 1}
+    if qgrad:
+        zero.update({"zero_quantized_gradients": True,
+                     "zero_quantize_error_feedback": True})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, topology=topo, config={
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": zero,
+        "mesh": {"dp": dp},
+        "bf16": {"enabled": False},
+        "steps_per_print": 0,
+        "resilience": {"enabled": True, "save_dir": save_dir},
+    })
+    return engine
+
+
+def _batch(effective: int, cursor: int, gas: int = 1):
+    r = np.random.default_rng(1000 + cursor)
+    ids = r.integers(0, 64, size=(effective, 16), dtype=np.int32)
+    if gas > 1:
+        ids = ids.reshape(gas, effective // gas, 16)
+    return {"input_ids": ids}
+
+
+def _state_arrays(engine):
+    return {key: np.asarray(_fetch_full(leaf))
+            for key, leaf in _flatten_with_paths(engine.state)[0]}
+
+
+def test_reshard_on_load_world_change(tmp_path):
+    """dp4 run with quantized-gradient EF -> checkpoint -> dp2 engine loads:
+    logical leaves bitwise, EF residual reset to the new decomposition's
+    zeros, cursor preserved, ``reshard_applied`` recorded, run continues."""
+    save = str(tmp_path / "ckpt")
+    a = _make_engine(4, 2, save)
+    for _ in range(2):
+        a.train_batch(_batch(8, a.data_cursor))
+    a.save_checkpoint(save)
+    before = _state_arrays(a)
+    assert before["qgrad_residual"].shape[0] == 4
+    meta = json.load(open(os.path.join(save, "global_step2", "meta.json")))
+    assert meta["world_size"] == 4
+    assert meta["partition"]["global_batch"] == 8
+    assert meta["partition"]["qgrad"]["npad"] >= meta["partition"]["qgrad"]["n"]
+
+    # dp2 engine, same effective batch: auto-resume reshards at init
+    b = _make_engine(2, 4, save)
+    assert b.global_steps == 2
+    assert b.data_cursor == 2
+    after = _state_arrays(b)
+    for key, arr in after.items():
+        if key.startswith("qgrad"):
+            # world-coupled EF residue: reset by policy (demotion-reset
+            # semantics), never loaded across decompositions
+            assert arr.shape[0] == 2
+            assert not arr.any()
+        else:
+            assert arr.tobytes() == before[key].tobytes(), key
+    events = [json.loads(ln)
+              for ln in open(os.path.join(save, "recovery_events.jsonl"))]
+    names = [e["event"] for e in events]
+    assert "reshard_applied" in names
+    assert "reshard_residual_reset" in names
+    applied = next(e for e in events if e["event"] == "reshard_applied")
+    assert applied["old_world"] == 4 and applied["new_world"] == 2
+    # the resharded engine trains on
+    m = b.train_batch(_batch(8, b.data_cursor))
+    assert np.isfinite(float(m["loss"]))
+    assert b.data_cursor == 3
+
+
+def test_same_world_load_does_not_reshard(tmp_path):
+    save = str(tmp_path / "ckpt")
+    a = _make_engine(2, 4, save)
+    a.train_batch(_batch(8, 0))
+    a.save_checkpoint(save)
+    resid = _state_arrays(a)["qgrad_residual"]
+    b = _make_engine(2, 4, save)
+    # same world: the (generally nonzero) EF residual loads verbatim
+    assert _state_arrays(b)["qgrad_residual"].tobytes() == resid.tobytes()
+    from deepspeed_tpu.resilience import read_events
+
+    assert not any(e["event"] == "reshard_applied"
+                   for e in read_events(save))
+
+
+def test_mid_accum_reshard_drops_window_and_keeps_cursor(tmp_path):
+    """A mid-accumulation (imperative) save resharded to a new world drops
+    the partial gradient window and keeps the cursor AT that window, so the
+    resumed run re-consumes it from the start — sample-exact."""
+    save = str(tmp_path / "ckpt")
+    a = _make_engine(4, 1, save, qgrad=False, gas=2)
+    # one full step, then half a window
+    a.train_batch(_batch(8, 0, gas=2))
+    assert a.data_cursor == 1
+    a.forward({"input_ids": _batch(8, 1)["input_ids"][:4]})
+    a.backward()
+    assert int(a.state["micro"]) == 1
+    a.save_checkpoint(save, tag="mid")
+    meta = json.load(open(os.path.join(save, "mid", "meta.json")))
+    assert meta["has_grad_acc"] and meta["data_cursor"] == 1
+
+    b = _make_engine(2, 2, save, qgrad=False, gas=2)
+    b.load_checkpoint(save, tag="mid")
+    assert b._grad_acc is None          # partial window dropped
+    assert int(b.state["micro"]) == 0   # window restarts from zero
+    assert b.data_cursor == 1           # still pointing AT the window
+    # the same-world load keeps the window instead
+    c = _make_engine(4, 1, save, qgrad=False, gas=2)
+    c.load_checkpoint(save, tag="mid")
+    assert c._grad_acc is not None
+    assert int(c.state["micro"]) == 1
+
+
+def test_unknown_world_coupled_leaf_still_raises(tmp_path):
+    # only policy-covered keys reshard; any other shape mismatch must fail
+    # loudly even mid-reshard
+    from deepspeed_tpu.runtime.zero.reshard import load_resolver
+
+    resolve = load_resolver(4, 2)
+    with pytest.raises(ReshardError, match="mystery"):
+        resolve("opt/mystery", np.zeros((4, 3), np.float32),
+                np.zeros((2, 6), np.float32))
+    out = resolve("qgrad_residual", np.zeros((4, 8), np.float32),
+                  np.zeros((2, 16), np.float32))
+    assert out.shape == (2, 16) and not out.any()
+
+
+# ------------------------------------------------------------- config block
+ELASTIC_OK = {
+    "enabled": True,
+    "max_train_batch_size": 12,
+    "micro_batch_sizes": [1, 2, 3, 4],
+    "min_world_size": 1,
+    "max_world_size": 6,
+}
+
+
+def test_elasticity_block_validated_in_config(monkeypatch):
+    monkeypatch.delenv("DS_TPU_ELASTICITY_CONFIG", raising=False)
+    monkeypatch.delenv("DEEPSPEED_ELASTICITY_CONFIG", raising=False)
+    # a typo'd key no longer rides silently
+    with pytest.raises(ValueError, match="max_train_batchsize"):
+        DeepSpeedConfig.load({"elasticity": {"enabled": True,
+                                             "max_train_batchsize": 16}},
+                             world_size=4)
+    with pytest.raises(ValueError, match="micro_batch_sizes"):
+        DeepSpeedConfig.load(
+            {"elasticity": {"enabled": True, "micro_batch_sizes": []}},
+            world_size=4)
+    with pytest.raises(ValueError, match="world-size range"):
+        DeepSpeedConfig.load(
+            {"elasticity": {"enabled": True, "min_world_size": 5,
+                            "max_world_size": 2}}, world_size=4)
+    # disabled blocks are still shape-checked but impose nothing
+    cfg = DeepSpeedConfig.load(
+        {"elasticity": {"enabled": False},
+         "train_micro_batch_size_per_gpu": 2}, world_size=4)
+    assert cfg.train_batch_size == 8
+
+
+def test_elasticity_adopts_ladder_batch(monkeypatch):
+    monkeypatch.delenv("DS_TPU_ELASTICITY_CONFIG", raising=False)
+    cfg = DeepSpeedConfig.load({"elasticity": dict(ELASTIC_OK)}, world_size=4)
+    # world 4 on the 12-batch ladder: micro 3 (largest dividing), gas 1
+    assert cfg.train_batch_size == 12
+    assert cfg.train_micro_batch_size_per_gpu == 3
+    assert cfg.gradient_accumulation_steps == 1
+    # explicit knobs consistent with the ladder pass
+    cfg = DeepSpeedConfig.load(
+        {"elasticity": dict(ELASTIC_OK),
+         "train_micro_batch_size_per_gpu": 1,
+         "gradient_accumulation_steps": 3}, world_size=4)
+    assert cfg.train_batch_size == 12
+
+
+def test_elasticity_rejects_off_ladder_batch(monkeypatch):
+    monkeypatch.delenv("DS_TPU_ELASTICITY_CONFIG", raising=False)
+    with pytest.raises(ValueError, match="off the elastic ladder"):
+        DeepSpeedConfig.load(
+            {"elasticity": dict(ELASTIC_OK), "train_batch_size": 16,
+             "train_micro_batch_size_per_gpu": 4}, world_size=4)
+    with pytest.raises(ValueError, match="not among the valid"):
+        DeepSpeedConfig.load({"elasticity": dict(ELASTIC_OK)}, world_size=5)
+    # the explicit escape hatch keeps off-ladder configs loadable
+    cfg = DeepSpeedConfig.load(
+        {"elasticity": {**ELASTIC_OK, "ignore_non_elastic_batch_info": True},
+         "train_batch_size": 16, "train_micro_batch_size_per_gpu": 4},
+        world_size=4)
+    assert cfg.train_batch_size == 16
+
+
+def test_elastic_ladder_one_source():
+    from deepspeed_tpu.elasticity import elastic_ladder
+
+    ladder = elastic_ladder({"elasticity": dict(ELASTIC_OK)})
+    assert (4, 3, 1) in ladder and (3, 4, 1) in ladder
+    for world, micro, gas in ladder:
+        assert micro * gas * world == 12
+
+
+def test_validate_block_accepts_reference_aliases():
+    from deepspeed_tpu.elasticity import validate_elasticity_block
+
+    block = validate_elasticity_block(
+        {"enabled": True, "max_train_batch_size": 8,
+         "micro_batch_sizes": [2], "min_gpus": 2, "max_gpus": 4})
+    assert block["min_world_size"] == 2 and block["max_world_size"] == 4
+
+
+# ------------------------------------------------------------------- agent
+def test_membership_change_is_budget_free(tmp_path):
+    """A worker dying together with a membership change spends NO restart
+    budget (max_restarts=0 still succeeds) and records membership_change."""
+    import sys
+
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+    from deepspeed_tpu.resilience import read_events
+
+    ckpt = tmp_path / "ckpt"
+    ckpt.mkdir()
+    marker = tmp_path / "first_done"
+    launches = []
+
+    def device_count():
+        # the first worker's crash IS the lost device: 4 -> 2 at its death
+        return 2 if marker.exists() else 4
+
+    def make_cmd(spec):
+        launches.append(spec)
+        if len(launches) == 1:
+            script = f"open({str(marker)!r}, 'w').write('x'); raise SystemExit(9)"
+        else:
+            script = "raise SystemExit(0)"
+        return [sys.executable, "-c", script]
+
+    agent = DSElasticAgent(
+        make_cmd, {"elasticity": {"enabled": True, "max_train_batch_size": 16,
+                                  "micro_batch_sizes": [2, 4],
+                                  "min_world_size": 1, "max_world_size": 8}},
+        device_count_fn=device_count, max_restarts=0, poll_interval=0.05,
+        checkpoint_dir=str(ckpt))
+    result = agent.run()
+    assert result.state == "SUCCEEDED"
+    assert result.restarts == 0
+    assert result.membership_changes == 1
+    assert [s.world_size for s in launches] == [4, 2]
+    events = [e for e in read_events(str(ckpt))
+              if e["event"] == "membership_change"]
+    assert len(events) == 1
+    assert events[0]["old_world"] == 4 and events[0]["new_world"] == 2
+
+
+def test_same_world_crash_still_spends_budget(tmp_path):
+    import sys
+
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+
+    agent = DSElasticAgent(
+        lambda s: [sys.executable, "-c", "raise SystemExit(9)"],
+        {"elasticity": {"enabled": True, "max_train_batch_size": 16,
+                        "micro_batch_sizes": [2, 4]}},
+        device_count_fn=lambda: 4, max_restarts=1, poll_interval=0.05,
+        checkpoint_dir=str(tmp_path), backoff_base=0.01, backoff_max=0.02)
+    result = agent.run()
+    assert result.state == "FAILED"
+    assert result.membership_changes == 0
+    assert result.restarts == 1
+
+
+def test_agent_rejects_malformed_block():
+    from deepspeed_tpu.elasticity import ElasticityError
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+
+    with pytest.raises(ElasticityError, match="unknown elasticity keys"):
+        DSElasticAgent(lambda s: ["true"],
+                       {"elasticity": {"enabled": True, "maxbatch": 16}})
+
+
+def test_fault_plan_accepts_lose_worker_key():
+    from deepspeed_tpu.resilience import FaultPlan
+
+    plan = FaultPlan.from_dict({"lose_worker_at_step": 3})
+    assert plan.lose_worker_at_step == 3
+    # disarmed cursors resolve to no-fault without killing anything
+    f = plan.training_faults(2)
+    assert not f.nan_loss and not f.ef_overflow and f.stall_s == 0.0
+
+
+# ------------------------------------------------------------------ dslint
+def _ctx(config):
+    from deepspeed_tpu.analysis.core import AnalysisContext
+
+    return AnalysisContext(config=config)
+
+
+def test_elastic_anchor_rule_fires_without_anchors(tmp_path, monkeypatch):
+    monkeypatch.delenv("DS_TPU_ELASTICITY_CONFIG", raising=False)
+    from deepspeed_tpu.analysis.rules_config import (
+        ElasticWithoutReshardAnchorRule)
+
+    cfg = DeepSpeedConfig.load({"elasticity": dict(ELASTIC_OK)}, world_size=4)
+    findings = list(ElasticWithoutReshardAnchorRule().check_context(_ctx(cfg)))
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule_id == "config/elastic-without-reshard-anchor"
+    assert "committed anchors" in f.message
+    assert "data cursor" in f.message
+
+
+def test_elastic_anchor_rule_fires_on_missing_cursor_only(tmp_path,
+                                                          monkeypatch):
+    monkeypatch.delenv("DS_TPU_ELASTICITY_CONFIG", raising=False)
+    from deepspeed_tpu.analysis.rules_config import (
+        ElasticWithoutReshardAnchorRule)
+
+    cfg = DeepSpeedConfig.load({
+        "elasticity": dict(ELASTIC_OK),
+        "resilience": {"enabled": True, "save_dir": str(tmp_path),
+                       "sentinel": {"enabled": True,
+                                    "checkpoint_interval": 5}},
+    }, world_size=4)
+    findings = list(ElasticWithoutReshardAnchorRule().check_context(_ctx(cfg)))
+    assert len(findings) == 1
+    assert "data cursor" in findings[0].message
+    assert "committed anchors" not in findings[0].message
+
+
+def test_elastic_anchor_rule_silent_when_anchored(tmp_path, monkeypatch):
+    monkeypatch.delenv("DS_TPU_ELASTICITY_CONFIG", raising=False)
+    from deepspeed_tpu.analysis.rules_config import (
+        ElasticWithoutReshardAnchorRule)
+
+    cfg = DeepSpeedConfig.load({
+        "elasticity": dict(ELASTIC_OK),
+        "resilience": {"enabled": True, "save_dir": str(tmp_path),
+                       "sentinel": {"enabled": True, "checkpoint_interval": 5,
+                                    "cursor_checkpointable": True}},
+    }, world_size=4)
+    assert not list(ElasticWithoutReshardAnchorRule().check_context(_ctx(cfg)))
+    # and entirely silent without an elasticity block
+    cfg = DeepSpeedConfig.load({"train_micro_batch_size_per_gpu": 2},
+                               world_size=4)
+    assert not list(ElasticWithoutReshardAnchorRule().check_context(_ctx(cfg)))
+
+
+def test_elastic_anchor_rule_registered():
+    from deepspeed_tpu.analysis import default_rules
+
+    assert any(r.rule_id == "config/elastic-without-reshard-anchor"
+               for r in default_rules())
