@@ -211,6 +211,99 @@ class UnoverlappedQuantizedCollectiveRule(Rule):
                     )
 
 
+class TpCollectiveOrderRule(Rule):
+    """Collectives inside scheduling-dependent control flow of a
+    tensor-parallel SERVING program.
+
+    Stricter than :class:`DivergentBranchCollectivesRule`: inside a tp
+    replica's shard_map (``inference/serving/tp.py``) every traced branch
+    predicate is derived from scheduler state — slot lengths, page tables,
+    quantized-page growth — which is uniform across the replica's tp ranks
+    at runtime but NOT provably uniform in the jaxpr. A collective under
+    such a ``cond`` (even when both branches issue the *same* sequence) or
+    in a ``while`` predicate couples the cross-chip exchange schedule to
+    per-step scheduling data: XLA manual mode either refuses to partition
+    it or the ranks hang the moment the proof assumption breaks. The safe
+    shape — and what the shipped tp decode/verify programs do — is one
+    unconditional psum per block, with any data-dependent work (e.g. the
+    quantized-page ``grew`` requantize cond in ``_append_kv_token``) kept
+    collective-free inside the branch.
+
+    Runs two ways: over captured :class:`~.ir.ProgramIR` programs
+    (``check_program``), and over a live serving engine's
+    ``engine.tp_context.captured`` jaxprs (``check_context``) — the tp
+    decode/verify programs the engine traces at warmup exactly so this
+    audit has something to read without re-tracing."""
+
+    rule_id = "serving/tp-collective-order"
+    default_severity = Severity.ERROR
+    description = ("collective under scheduling-dependent control flow in a "
+                   "tp serving program")
+
+    def _scan(self, jaxpr, where: str) -> Iterable[Finding]:
+        for eqn, path in iter_eqns(jaxpr):
+            if eqn.primitive.name != "shard_map":
+                continue
+            for tag, body in sub_jaxprs(eqn):
+                yield from self._scan_body(body, f"{where}:{path}.{tag}")
+
+    def _scan_body(self, body, where: str) -> Iterable[Finding]:
+        for eqn, path in iter_eqns(body):
+            if eqn.primitive.name == "cond":
+                sigs = [collective_signature(b.jaxpr)
+                        for b in eqn.params.get("branches", ())]
+                if not any(sigs):
+                    continue  # collective-free branches are fine
+                src = source_line(eqn)
+                detail = "; ".join(f"branch {i}: {_fmt(s)}"
+                                   for i, s in enumerate(sigs))
+                yield self.finding(
+                    f"tp serving shard_map body issues collectives under a "
+                    f"cond ({detail}) — the predicate is traced scheduler "
+                    f"state, so the cross-chip exchange order depends on "
+                    f"per-step scheduling data; hoist the collective out of "
+                    f"the branch",
+                    location=f"{where}{path}" + (f" ({src})" if src else ""),
+                    suggestion="issue the collective unconditionally outside "
+                               "the cond and keep branch bodies "
+                               "collective-free (the quantized-page requant "
+                               "cond in models/gpt.py is the reference "
+                               "pattern)",
+                )
+            elif eqn.primitive.name == "while":
+                cond_jaxpr = eqn.params.get("cond_jaxpr")
+                if cond_jaxpr is None:
+                    continue
+                sig = collective_signature(cond_jaxpr.jaxpr)
+                if not sig:
+                    continue
+                src = source_line(eqn)
+                yield self.finding(
+                    f"tp serving shard_map body evaluates collectives in a "
+                    f"while predicate ({_fmt(sig)}) — the trip count then "
+                    f"depends on a cross-chip exchange driven by scheduler "
+                    f"state",
+                    location=f"{where}{path}" + (f" ({src})" if src else ""),
+                    suggestion="reduce the exit quantity once per iteration "
+                               "in the body and branch on the replicated "
+                               "scalar",
+                )
+
+    def check_program(self, prog: ProgramIR,
+                      ctx: AnalysisContext) -> Iterable[Finding]:
+        yield from self._scan(prog.jaxpr, prog.name)
+
+    def check_context(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        tp = getattr(ctx.engine, "tp_context", None) \
+            if ctx.engine is not None else None
+        captured = getattr(tp, "captured", None)
+        if not captured:
+            return
+        for name, closed in captured.items():
+            jaxpr = getattr(closed, "jaxpr", closed)
+            yield from self._scan(jaxpr, f"tp_context[{name}]")
+
+
 def collective_rules() -> List[Rule]:
     return [DivergentBranchCollectivesRule(), CollectiveInWhilePredicateRule(),
             ShardMapBranchlessGuardRule(),
@@ -218,5 +311,5 @@ def collective_rules() -> List[Rule]:
 
 
 __all__ = ["DivergentBranchCollectivesRule", "CollectiveInWhilePredicateRule",
-           "ShardMapBranchlessGuardRule",
+           "ShardMapBranchlessGuardRule", "TpCollectiveOrderRule",
            "UnoverlappedQuantizedCollectiveRule", "collective_rules"]

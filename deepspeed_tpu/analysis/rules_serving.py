@@ -309,6 +309,12 @@ class SpeculationWithoutGreedyGateRule(Rule):
 
 
 def serving_rules() -> List[Rule]:
+    # TpCollectiveOrderRule lives with the collective-order family but is
+    # registered HERE (once): serving_rules() feeds both default_rules()
+    # and the analyze_compile_log audit, so the tp serving check runs in
+    # both without double-registering in the default set.
+    from .rules_collectives import TpCollectiveOrderRule
+
     return [UnbucketedDecodeShapeRule(), UnboundedAdmissionRule(),
             DenseKVAtCapacityRule(), FleetWithoutFailoverRule(),
-            SpeculationWithoutGreedyGateRule()]
+            SpeculationWithoutGreedyGateRule(), TpCollectiveOrderRule()]

@@ -43,8 +43,9 @@ which is the whole re-route correctness story.
 
 from __future__ import annotations
 
+import base64
 import time
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -56,9 +57,14 @@ class ReplicaDeadError(RuntimeError):
     process stopped answering) — callers must re-route its work."""
 
 
-def request_spec(req: Request, age_s: float = 0.0) -> Dict[str, Any]:
-    """The JSON-safe wire form of one request, kept tokens included."""
-    return {
+def request_spec(req: Request, age_s: float = 0.0,
+                 kv_payload: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
+    """The JSON-safe wire form of one request, kept tokens included.
+    ``kv_payload`` (an :func:`encode_kv_payload` product) rides along for
+    disaggregated prefill->decode forwarding — the receiving replica admits
+    by importing the pages instead of prefilling."""
+    spec = {
         "rid": int(req.rid),
         "prompt": [int(t) for t in np.asarray(req.prompt).tolist()],
         "max_new_tokens": int(req.max_new_tokens),
@@ -70,6 +76,37 @@ def request_spec(req: Request, age_s: float = 0.0) -> Dict[str, Any]:
         "session_id": req.session_id,
         "age_s": float(max(age_s, 0.0)),
     }
+    if kv_payload is not None:
+        spec["kv_payload"] = kv_payload
+    return spec
+
+
+def encode_kv_payload(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """JSON-safe form of an ``export_pages`` payload: raw buffers become
+    base64 text. Quantized pools keep their wire advantage — the int8/int4
+    payload plus fp32 per-page scales is what gets encoded, 2-4x smaller
+    than fp32 pages before base64's constant 4/3."""
+    return {
+        "page_ids": [int(p) for p in payload["page_ids"]],
+        "tensors": {
+            k: {"dtype": str(t["dtype"]),
+                "shape": [int(x) for x in t["shape"]],
+                "data": base64.b64encode(t["data"]).decode("ascii")}
+            for k, t in payload["tensors"].items()},
+    }
+
+
+def decode_kv_payload(wire: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse of :func:`encode_kv_payload` (idempotent on raw bytes)."""
+    tensors = {}
+    for k, t in wire["tensors"].items():
+        data = t["data"]
+        if isinstance(data, str):
+            data = base64.b64decode(data)
+        tensors[k] = {"dtype": t["dtype"],
+                      "shape": [int(x) for x in t["shape"]], "data": data}
+    return {"page_ids": [int(p) for p in wire["page_ids"]],
+            "tensors": tensors}
 
 
 def _verdict_dict(v) -> Dict[str, Any]:
@@ -106,6 +143,10 @@ class LocalReplica:
         elif scheduler.recovery_log is None:
             scheduler.recovery_log = recovery_log
         self.sched = scheduler
+        # disaggregated role (docs/SERVING.md): the router's role-aware
+        # placement reads this — "prefill" replicas take fresh admissions
+        # and stage handoffs, "decode" replicas take handoff arrivals
+        self.role = getattr(scheduler, "role", "both") or "both"
         self._alive = True
         self._reqs: Dict[int, Request] = {}
         self._reported_len: Dict[int, int] = {}
@@ -139,6 +180,10 @@ class LocalReplica:
             rid=int(spec["rid"]),
         )
         req.tokens = [int(t) for t in spec.get("tokens", ())]
+        if spec.get("kv_payload") is not None:
+            # disaggregated arrival: the scheduler admits this by importing
+            # the exported pages instead of prefilling
+            req.kv_payload = decode_kv_payload(spec["kv_payload"])
         # deadline clocks measure the request's LIFETIME: a re-routed
         # request arrives pre-aged, not freshly submitted
         req.t_submit = self.clock() - float(spec.get("age_s", 0.0))
@@ -169,6 +214,7 @@ class LocalReplica:
         finished: List[int] = []
         expired: List[int] = []
         shed: List[int] = []
+        pending_handoffs = self.sched.pending_handoff_rids
         for rid, req in list(self._reqs.items()):
             if len(req.tokens) > self._reported_len.get(rid, 0):
                 tokens[rid] = [int(t) for t in req.tokens]
@@ -181,9 +227,30 @@ class LocalReplica:
                 # post-admission policy shed (reject_largest victim, or a
                 # drain rejecting re-queued work) — the router may re-place
                 shed.append(rid)
+            elif (req.state is RequestState.HANDOFF
+                  and rid not in pending_handoffs):
+                # handoff completed (or aborted) in an earlier cycle: the
+                # request's lifecycle now belongs to the decode side
+                self._reqs.pop(rid, None)
+                self._reported_len.pop(rid, None)
         for rid in finished + expired + shed:
             self._reqs.pop(rid, None)
             self._reported_len.pop(rid, None)
+        # stage the wire form of every newly staged handoff: pages exported
+        # THROUGH the executor (quantized pools ship int8 + scales), pages
+        # still owned here until the router acks via handoff_complete
+        handoffs: List[Dict[str, Any]] = []
+        now = self.clock()
+        for e in self.sched.pop_handoffs():
+            req = e["request"]
+            age = 0.0 if req.t_submit is None else now - req.t_submit
+            payload = self.sched.executor.export_pages(e["page_ids"])
+            handoffs.append({
+                "rid": int(e["rid"]),
+                "context_len": int(e["context_len"]),
+                "spec": request_spec(req, age_s=age),
+                "payload": encode_kv_payload(payload),
+            })
         self._last_beat = self.clock()
         return {
             "replica_id": self.replica_id,
@@ -192,11 +259,19 @@ class LocalReplica:
             "finished": finished,
             "expired": expired,
             "shed": shed,
+            "handoffs": handoffs,
             "counters": dict(self.sched.counters),
             "load": self.load(),
             "draining": self.sched.draining,
             "drained": self.sched.drained,
         }
+
+    def handoff_complete(self, rid: int, success: bool = True) -> bool:
+        """Ownership-transfer ack from the router: the decode side admitted
+        (``success``) or the handoff was abandoned — free the staged pages
+        either way (idempotent on unknown rids)."""
+        self._check_alive()
+        return self.sched.complete_handoff(int(rid), ok=bool(success))
 
     def load(self) -> Dict[str, Any]:
         self._check_alive()
@@ -248,4 +323,5 @@ class LocalReplica:
         self.close()
 
 
-__all__ = ["LocalReplica", "ReplicaDeadError", "request_spec"]
+__all__ = ["LocalReplica", "ReplicaDeadError", "request_spec",
+           "encode_kv_payload", "decode_kv_payload"]

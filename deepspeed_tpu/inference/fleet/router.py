@@ -81,6 +81,15 @@ class FleetConfig:
     pump_steps: int = 1
     #: in-memory fleet event window (entries, for autoscale trends)
     event_window: int = 4096
+    #: disaggregated prefill/decode (docs/SERVING.md "Tensor parallel &
+    #: disaggregation"): the role assumed for replica handles that don't
+    #: declare one. Handles built from a role-configured ServingConfig
+    #: carry their own ``role`` attribute; placement is role-aware —
+    #: fresh requests go to prefill-capable replicas ("prefill"/"both"),
+    #: handoff forwards to decode-capable ones ("decode"/"both"), with
+    #: fall-back to ANY live replica when a role pool is empty (failover:
+    #: every program family stays lazily compilable on every replica)
+    role: str = "both"
 
     @property
     def failover_armed(self) -> bool:
@@ -161,10 +170,23 @@ class ReplicaRouter:
             self._last_load[rep.replica_id] = load
         return int(load.get("work_tokens", 0))
 
-    def _placement_order(self, req: Request) -> List[Any]:
+    def _replica_role(self, rep) -> str:
+        return getattr(rep, "role", None) or self.config.role
+
+    def _placement_order(self, req: Request,
+                         need: str = "prefill") -> List[Any]:
+        """Live replicas in least-loaded order, filtered by role capability:
+        ``need="prefill"`` wants a replica that runs prefill programs
+        ("prefill"/"both"), ``need="decode"`` one that accepts handoff
+        imports and decodes ("decode"/"both"). An empty capability pool
+        falls back to EVERY live replica — a decode specialist re-prefills
+        an orphaned request rather than the fleet dropping it (it just pays
+        a lazy compile)."""
         live = self.live_replicas
-        order = sorted(live, key=lambda r: (self._load_score(r),
-                                            r.replica_id))
+        capable = [r for r in live
+                   if self._replica_role(r) in (need, "both")]
+        order = sorted(capable or live,
+                       key=lambda r: (self._load_score(r), r.replica_id))
         if self.config.session_affinity and req.session_id is not None:
             sticky = self._affinity.get(req.session_id)
             for i, r in enumerate(order):
@@ -433,8 +455,73 @@ class ReplicaRouter:
             if req is not None:
                 req.state = RequestState.QUEUED
                 reroute.append(req)
+        for h in out.get("handoffs") or ():
+            # disaggregated prefill→decode: the prefill replica finished
+            # the prompt and exported the filled KV pages; forward them to
+            # a decode-capable sibling. The source OWNS the pages until we
+            # answer handoff_complete — success frees them, failure frees
+            # them too and the request falls back to kept-token re-prefill.
+            rid = int(h["rid"])
+            req = self._requests.get(rid)
+            if req is None or self._assignment.get(rid) != rep.replica_id:
+                # stale stream from before a re-route: the fleet already
+                # re-placed this request elsewhere; just release the pages
+                try:
+                    rep.handoff_complete(rid, False)
+                except ReplicaDeadError as e:
+                    reroute.extend(self._fail_replica(rep, e))
+                continue
+            self._place_handoff(req, h, rep, reroute)
         self._drain_pending(reroute)
         return int(out.get("produced", 0))
+
+    def _place_handoff(self, req: Request, h: Dict[str, Any], src,
+                       pending: List[Request]) -> None:
+        """Forward one staged handoff to a decode-capable replica (wire
+        payload rides the normal ``submit`` spec as ``kv_payload``). Any
+        refusal or death along the way degrades to the proven recovery
+        contract: tell the source to free the staged pages and re-place
+        the request with its kept tokens (greedy re-prefill reproduces the
+        exact continuation)."""
+        spec = dict(h["spec"])
+        spec["kv_payload"] = h["payload"]
+        for dest in self._placement_order(req, need="decode"):
+            if dest.replica_id == src.replica_id:
+                continue  # a handoff back to its own exporter is a no-op
+            try:
+                verdict = dest.submit(spec)
+            except ReplicaDeadError as e:
+                pending.extend(self._fail_replica(dest, e))
+                continue
+            if verdict["admitted"]:
+                self._assignment[req.rid] = dest.replica_id
+                load = self._last_load.get(dest.replica_id)
+                if load is not None:
+                    load["work_tokens"] = (load.get("work_tokens", 0)
+                                           + req.work_tokens)
+                if req.session_id is not None and self.config.session_affinity:
+                    self._affinity[req.session_id] = dest.replica_id
+                self._record("handoff_forwarded", persist=False,
+                             rid=req.rid, from_replica=src.replica_id,
+                             replica_id=dest.replica_id,
+                             context_len=int(h.get("context_len", 0)))
+                try:
+                    src.handoff_complete(req.rid, True)
+                except ReplicaDeadError as e:
+                    pending.extend(self._fail_replica(src, e))
+                return
+        # every decode-capable sibling refused (or none exists): free the
+        # staged pages and fall back to normal placement with kept tokens
+        self._record("handoff_fallback", rid=req.rid,
+                     from_replica=src.replica_id)
+        try:
+            src.handoff_complete(req.rid, False)
+        except ReplicaDeadError as e:
+            pending.extend(self._fail_replica(src, e))
+        if self._assignment.get(req.rid) == src.replica_id:
+            del self._assignment[req.rid]
+        req.state = RequestState.QUEUED
+        self._place(req, pending)
 
     def _finalize(self, rid: int, replica_id: str) -> Optional[Request]:
         if self._assignment.get(rid) != replica_id:

@@ -9,6 +9,7 @@ as one JSON object per line:
      "serving": {...ServingConfig kwargs...}, "seed": 0}
     {"op": "submit", "spec": {...}} | {"op": "pump", "steps": K}
     {"op": "load"} | {"op": "drain"} | {"op": "audit"} | {"op": "close"}
+    {"op": "handoff_complete", "rid": N, "success": true}
 
 :class:`SubprocessReplica` is the parent-side handle: it spawns the
 worker, speaks the same dicts :class:`~.replica.LocalReplica` speaks
@@ -65,6 +66,9 @@ class SubprocessReplica:
             env=penv, cwd=os.path.dirname(os.path.dirname(os.path.dirname(
                 os.path.dirname(os.path.abspath(__file__))))))
         self.call_timeout_s = float(call_timeout_s)
+        # disaggregated role, read by the router's role-aware placement
+        # (the worker's scheduler enforces the same role internally)
+        self.role = str(serving.get("role", "both") or "both")
         self._alive = True
         self._buf = b""
         self._last_beat = time.monotonic()
@@ -202,6 +206,11 @@ class SubprocessReplica:
     def load(self) -> Dict[str, Any]:
         return self._call({"op": "load"})
 
+    def handoff_complete(self, rid: int, success: bool = True) -> bool:
+        out = self._call({"op": "handoff_complete", "rid": int(rid),
+                          "success": bool(success)})
+        return bool(out.get("ok"))
+
     def drain(self) -> None:
         out = self._call({"op": "drain"})
         self._draining = True
@@ -282,6 +291,9 @@ def main() -> int:
                 resp = replica.pump(int(msg.get("steps", 1)))
             elif op == "load":
                 resp = replica.load()
+            elif op == "handoff_complete":
+                resp = {"ok": replica.handoff_complete(
+                    int(msg["rid"]), bool(msg.get("success", True)))}
             elif op == "drain":
                 replica.drain()
                 resp = {"ok": True, "drained": replica.drained}

@@ -39,6 +39,17 @@ from .paging import pages_for
 from .scheduler import ContinuousBatchingScheduler
 
 
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a dtype name from a handoff payload — including the ml_dtypes
+    extension types (bfloat16) plain numpy can't look up by string."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 @dataclasses.dataclass
 class ServingConfig:
     """Knobs for the serving path. ``num_slots`` is the admission limit —
@@ -89,6 +100,21 @@ class ServingConfig:
     sampling_temperature: float = 0.0
     dtype: str = "bfloat16"
     kernel_impl: Optional[str] = None   # None=auto | "kernel" | "gather"
+    # ---- tensor-parallel replica (docs/SERVING.md "Tensor parallel &
+    # disaggregation"): tp > 1 shards the weight stacks, paged pools and
+    # every serving program across the first `tp` devices of a dedicated
+    # ("tp",) mesh (inference/serving/tp.py). The scheduler, page
+    # allocator, speculation and chaos machinery are mesh-oblivious; tp2
+    # output is greedy-identical to tp1.
+    tp: int = 1
+    # ---- disaggregated prefill/decode role. "both" (default) = the fused
+    # single-replica engine; "prefill" = fill pages + first token, then
+    # hand the request off (scheduler HANDOFF state -> fleet forwarding);
+    # "decode" = accept page-handoff admissions. Roles gate which program
+    # families warm up eagerly — the rest stay lazily compilable so
+    # failover (a decode replica re-prefilling an orphaned request) still
+    # works, it just pays a mid-traffic compile.
+    role: str = "both"
     eos_token_id: Optional[int] = None
     model_name: Optional[str] = None    # for num_slots="auto"
     # ---- overload control + deadlines (docs/SERVING.md "Overload &
@@ -161,6 +187,9 @@ class ServingEngine:
                 "implemented")
         if s.spec_drafter and not (1 <= s.spec_k <= 16):
             raise ValueError(f"spec_k {s.spec_k} outside [1, 16]")
+        if s.role not in ("both", "prefill", "decode"):
+            raise ValueError(f"role must be both|prefill|decode, got "
+                             f"{s.role!r}")
         self.num_slots = self._resolve_slots()
         self.num_pages = (s.num_pages if s.num_pages is not None
                           else self.num_slots * s.pages_per_seq + 1)
@@ -178,6 +207,16 @@ class ServingEngine:
         self.paged_cache = gpt_mod.init_paged_cache(
             cfg, self.num_pages, s.page_size, self.dtype,
             kv_bits=s.kv_bits)
+        # tensor-parallel replica: relayout + shard the weight tree and the
+        # paged pools over a dedicated ("tp",) mesh; every program getter
+        # below dispatches to the shard_map builders in tp.py
+        self.tp_context = None
+        if int(s.tp or 1) > 1:
+            from .tp import TPContext
+
+            self.tp_context = TPContext(cfg, int(s.tp))
+            self.params = self.tp_context.shard_params(self.params)
+            self.paged_cache = self.tp_context.shard_cache(self.paged_cache)
         self.last_scheduler = None  # most recent make_scheduler product —
         # the capacity-pressure evidence dslint's dense-kv-at-capacity reads
         # prefill's contiguous scratch cache: chunks append at chunk-aligned
@@ -217,12 +256,16 @@ class ServingEngine:
             # explicit draft=(cfg, params) pair wins over the preset name
             draft_model = (self.draft[0] if self.draft is not None
                            else s.spec_draft_model)
+        # tp + role reach the ladder too: a tp replica's per-chip HBM holds
+        # 1/tp of the weights and pools, and a prefill-only replica never
+        # pays the drafter/verify residency (aot prices per-role program
+        # sets since PR 16)
         limit = serving_admission_limit(
             s.model_name, prompt=min(128, s.max_model_len),
             gen=min(128, s.max_model_len), kv_bits=s.kv_bits or 0,
             page_size=s.page_size, draft_model=draft_model,
             spec_k=(s.spec_k if s.spec_drafter else 0),
-            spec_max_len=s.max_model_len)
+            spec_max_len=s.max_model_len, tp=int(s.tp or 1), role=s.role)
         if limit["max_slots"] < 1:
             raise ValueError(
                 f"AOT fit ladder found no decode batch that fits for "
@@ -236,12 +279,71 @@ class ServingEngine:
         record_compile(self.compile_log, self.monitor,
                        "Serving/compile_events", kind, shape)
 
+    # ---- tp dispatch: each model program either calls the gpt.py
+    # single-device function or its shard_map twin (tp.py) over the replica
+    # mesh. Same signatures/semantics, so the jitted wrappers below stay
+    # tp-oblivious.
+    def _forward_with_cache(self, params, ids, cache):
+        if self.tp_context is not None:
+            from .tp import tp_forward_with_cache
+
+            return tp_forward_with_cache(self.cfg, params, ids, cache,
+                                         self.tp_context.mesh)
+        return gpt_mod.forward_with_cache(self.cfg, params, ids, cache)
+
+    def _write_prompt(self, paged, dense, table, length, start):
+        if self.tp_context is not None:
+            from .tp import tp_write_prompt_kv
+
+            return tp_write_prompt_kv(paged, dense, table, length, start,
+                                      self.tp_context.mesh)
+        return gpt_mod.write_prompt_kv(paged, dense, table, length,
+                                       start=start)
+
+    def _write_prompt_batch(self, paged, dense, tables, lengths, starts):
+        if self.tp_context is not None:
+            from .tp import tp_write_prompt_kv_batch
+
+            return tp_write_prompt_kv_batch(paged, dense, tables, lengths,
+                                            starts, self.tp_context.mesh)
+        return gpt_mod.write_prompt_kv_batch(paged, dense, tables, lengths,
+                                             starts=starts)
+
+    def _decode_step(self, params, toks, cache, tables, lengths, impl):
+        if self.tp_context is not None:
+            from .tp import tp_paged_decode_step
+
+            return tp_paged_decode_step(self.cfg, params, toks, cache,
+                                        tables, lengths,
+                                        self.tp_context.mesh, impl=impl)
+        return gpt_mod.paged_decode_step(self.cfg, params, toks, cache,
+                                         tables, lengths, impl=impl)
+
+    def _verify_step(self, params, toks, cache, tables, lengths, impl):
+        if self.tp_context is not None:
+            from .tp import tp_paged_verify_step
+
+            return tp_paged_verify_step(self.cfg, params, toks, cache,
+                                        tables, lengths,
+                                        self.tp_context.mesh, impl=impl)
+        return gpt_mod.paged_verify_step(self.cfg, params, toks, cache,
+                                         tables, lengths, impl=impl)
+
+    def _commit_window(self, cache, win_k, win_v, tables, lengths, n):
+        if self.tp_context is not None:
+            from .tp import tp_commit_window_kv
+
+            return tp_commit_window_kv(cache, win_k, win_v, tables, lengths,
+                                       n, self.tp_context.mesh)
+        return gpt_mod.commit_window_kv(cache, win_k, win_v, tables,
+                                        lengths, n)
+
     def _get_prefill(self, chunk: int):
         if chunk not in self._prefill_fns:
             self._log_compile("serving_prefill", (1, chunk))
 
             def fn(params, ids, cache):
-                return gpt_mod.forward_with_cache(self.cfg, params, ids, cache)
+                return self._forward_with_cache(params, ids, cache)
 
             self._prefill_fns[chunk] = jax.jit(fn, donate_argnums=(2,))
         return self._prefill_fns[chunk]
@@ -256,13 +358,11 @@ class ServingEngine:
 
             def fn(params, ids, paged, table, length, start):
                 cache = gpt_mod.init_cache(self.cfg, 1, chunk, self.dtype)
-                logits, cache = gpt_mod.forward_with_cache(
-                    self.cfg, params, ids, cache)
+                logits, cache = self._forward_with_cache(params, ids, cache)
                 # start > 0: shared prefix pages already hold [0, start) —
                 # never write a borrowed page (start is traced, so shared
                 # and unshared admissions hit the same compiled program)
-                paged = gpt_mod.write_prompt_kv(paged, cache, table, length,
-                                                start=start)
+                paged = self._write_prompt(paged, cache, table, length, start)
                 last = jax.lax.dynamic_index_in_dim(logits[0], length - 1, 0,
                                                     keepdims=False)
                 return jnp.argmax(last).astype(jnp.int32), paged
@@ -282,10 +382,9 @@ class ServingEngine:
             def fn(params, ids, paged, tables, lengths, starts):
                 cache = gpt_mod.init_cache(self.cfg, self.num_slots, chunk,
                                            self.dtype)
-                logits, cache = gpt_mod.forward_with_cache(
-                    self.cfg, params, ids, cache)
-                paged = gpt_mod.write_prompt_kv_batch(paged, cache, tables,
-                                                      lengths, starts=starts)
+                logits, cache = self._forward_with_cache(params, ids, cache)
+                paged = self._write_prompt_batch(paged, cache, tables,
+                                                 lengths, starts)
                 idx = jnp.maximum(lengths - 1, 0)[:, None, None]
                 last = jnp.take_along_axis(logits, idx, axis=1)[:, 0]
                 return jnp.argmax(last, axis=-1).astype(jnp.int32), paged
@@ -301,8 +400,8 @@ class ServingEngine:
             impl = self.serving.kernel_impl
 
             def one(cache, toks, tables, lengths, params):
-                logits, cache = gpt_mod.paged_decode_step(
-                    self.cfg, params, toks, cache, tables, lengths, impl=impl)
+                logits, cache = self._decode_step(params, toks, cache,
+                                                  tables, lengths, impl)
                 return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
             if steps == 1:
@@ -340,9 +439,8 @@ class ServingEngine:
             impl = self.serving.kernel_impl
 
             def fn(params, cache, toks, tables, lengths, eos, budget):
-                logits, win_k, win_v = gpt_mod.paged_verify_step(
-                    self.cfg, params, toks, cache, tables, lengths,
-                    impl=impl)
+                logits, win_k, win_v = self._verify_step(
+                    params, toks, cache, tables, lengths, impl)
                 outs = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 # longest-prefix greedy acceptance: draft i (toks[:, i+1])
                 # survives iff it equals the target's output at position i
@@ -357,8 +455,8 @@ class ServingEngine:
                 # never accept past max_new (budget 0 = inactive slot:
                 # nothing commits, nothing is written anywhere)
                 n = jnp.clip(n, 0, jnp.maximum(budget, 0))
-                cache = gpt_mod.commit_window_kv(cache, win_k, win_v,
-                                                 tables, lengths, n)
+                cache = self._commit_window(cache, win_k, win_v, tables,
+                                            lengths, n)
                 return outs, n, cache
 
             self._verify_fns[W] = jax.jit(fn, donate_argnums=(1,))
@@ -369,8 +467,7 @@ class ServingEngine:
             self._log_compile("serving_scatter", (self._dense_S,))
 
             def fn(paged, dense, table, length, start):
-                return gpt_mod.write_prompt_kv(paged, dense, table, length,
-                                               start=start)
+                return self._write_prompt(paged, dense, table, length, start)
 
             self._scatter_fn = jax.jit(fn, donate_argnums=(0,))
         return self._scatter_fn
@@ -400,6 +497,10 @@ class ServingEngine:
                 jnp.int32(start))
             return int(tok)
         cache = gpt_mod.init_cache(self.cfg, 1, self._dense_S, self.dtype)
+        if self.tp_context is not None:
+            # carried between chunked-prefill dispatches: keep the dense
+            # scratch on the head-sharded layout the tp programs expect
+            cache = self.tp_context.shard_dense_cache(cache)
         pos = 0
         logits = None
         while pos < T:
@@ -483,6 +584,56 @@ class ServingEngine:
             jnp.asarray(eos, jnp.int32), jnp.asarray(budget, jnp.int32))
         return np.asarray(outs), np.asarray(n)
 
+    # ----------------------------------------------- disaggregated handoff
+    def export_pages(self, page_ids) -> dict:
+        """Serialize the KV held in ``page_ids`` (a request's block-table
+        prefix, in table order) for a prefill->decode handoff. Returns a
+        payload of raw little-endian buffers per pool tensor — quantized
+        pools ship their int8/int4-packed payload plus fp32 per-page scales,
+        so an int8 pool serializes ~4x cheaper than fp32 (the EQuARX-style
+        cheap wire the disaggregation design rides). The pages themselves
+        are NOT freed here: the scheduler keeps ownership until the decode
+        side acknowledges (export-before-free)."""
+        ids = jnp.asarray(np.asarray(page_ids, np.int32))
+        tensors = {}
+        for key, arr in self.paged_cache.items():
+            # every pool tensor indexes pages on axis 2:
+            # pages [L, H, P, ps, Dq], scales [L, H, P]
+            sel = np.asarray(arr[:, :, ids])
+            tensors[key] = {"dtype": sel.dtype.name,
+                            "shape": list(sel.shape),
+                            "data": sel.tobytes()}
+        return {"page_ids": [int(p) for p in np.asarray(page_ids)],
+                "tensors": tensors}
+
+    def import_pages(self, page_ids, payload: dict) -> None:
+        """Install a handoff payload (``export_pages`` on the prefill side)
+        into locally-owned pages. ``page_ids`` are THIS engine's freshly
+        claimed pages, in the same table order the exporter used — the page
+        numbers themselves need not match across replicas, only the order."""
+        src = payload["tensors"]
+        if set(src) != set(self.paged_cache):
+            raise ValueError(
+                f"handoff pool mismatch: payload has {sorted(src)}, engine "
+                f"pools are {sorted(self.paged_cache)} (kv_bits must match "
+                f"across prefill and decode replicas)")
+        ids = jnp.asarray(np.asarray(page_ids, np.int32))
+        cache = dict(self.paged_cache)
+        for key, rec in src.items():
+            dt = _np_dtype(rec["dtype"])
+            vals = np.frombuffer(rec["data"], dtype=dt).reshape(rec["shape"])
+            if list(vals.shape[2:3]) != [len(np.asarray(page_ids))]:
+                raise ValueError(
+                    f"handoff {key}: payload carries {vals.shape[2]} pages, "
+                    f"importer claimed {len(np.asarray(page_ids))}")
+            cache[key] = cache[key].at[:, :, ids].set(
+                jnp.asarray(vals, cache[key].dtype))
+        if self.tp_context is not None:
+            # the functional .at[].set above may drop the NamedSharding —
+            # pin the pools back onto the tp mesh before the next dispatch
+            cache = self.tp_context.shard_cache(cache)
+        self.paged_cache = cache
+
     def warmup(self) -> int:
         """Compile every serving program shape before traffic arrives:
         fused prefill per chunk bucket, the chunked long-prompt path (+
@@ -492,45 +643,57 @@ class ServingEngine:
         number of compiled programs."""
         s = self.serving
         sink_row = np.zeros(s.pages_per_seq, np.int32)
-        for chunk in self._chunk_buckets:
-            # cap at prefill_chunk: the top bucket can exceed it (non-pow2
-            # prefill_chunk) and a longer probe would take the chunked path,
-            # leaving the fused/batch programs for this bucket uncompiled
-            t = np.zeros(min(chunk, s.prefill_chunk, s.max_model_len),
-                         np.int32)
-            self.prefill(0, t, sink_row)
-            if self.num_slots >= 2:  # the admission-batch program
-                self.prefill_many([(0, t, sink_row), (1, t, sink_row)])
-        if s.max_model_len > s.prefill_chunk:
-            # the chunked long-prompt path: full chunks compile ONE program,
-            # but the final partial chunk lands on any REACHABLE bucket —
-            # compile each (a long prompt's remainder must not pay a
-            # mid-traffic compile). Bucket b is reachable when some legal
-            # remainder maps to it, even if prefill_chunk + b itself
-            # overshoots max_model_len.
-            max_rem = s.max_model_len - s.prefill_chunk
-            prev = 0
-            for b in self._chunk_buckets:
-                if max_rem > prev:
-                    n = s.prefill_chunk + min(b, max_rem)
-                    self.prefill(0, np.zeros(n, np.int32), sink_row)
-                prev = b
+        # per-role program sets: a decode-specialist replica admits page
+        # handoffs (import, no prefill programs); a prefill specialist never
+        # decodes past the first token. The skipped families stay lazily
+        # compilable for failover — they just aren't paid for up front
+        # (aot.serving_admission_limit prices the same split).
+        if s.role != "decode":
+            for chunk in self._chunk_buckets:
+                # cap at prefill_chunk: the top bucket can exceed it
+                # (non-pow2 prefill_chunk) and a longer probe would take the
+                # chunked path, leaving the fused/batch programs for this
+                # bucket uncompiled
+                t = np.zeros(min(chunk, s.prefill_chunk, s.max_model_len),
+                             np.int32)
+                self.prefill(0, t, sink_row)
+                if self.num_slots >= 2:  # the admission-batch program
+                    self.prefill_many([(0, t, sink_row), (1, t, sink_row)])
+            if s.max_model_len > s.prefill_chunk:
+                # the chunked long-prompt path: full chunks compile ONE
+                # program, but the final partial chunk lands on any
+                # REACHABLE bucket — compile each (a long prompt's remainder
+                # must not pay a mid-traffic compile). Bucket b is reachable
+                # when some legal remainder maps to it, even if
+                # prefill_chunk + b itself overshoots max_model_len.
+                max_rem = s.max_model_len - s.prefill_chunk
+                prev = 0
+                for b in self._chunk_buckets:
+                    if max_rem > prev:
+                        n = s.prefill_chunk + min(b, max_rem)
+                        self.prefill(0, np.zeros(n, np.int32), sink_row)
+                    prev = b
         zeros = np.zeros(self.num_slots, np.int32)
         tables = np.zeros((self.num_slots, s.pages_per_seq), np.int32)
         mask = np.zeros(self.num_slots, bool)
-        steps_set = {1}
-        k = 1
-        while k * 2 <= s.decode_block:  # the scheduler's power-of-two blocks
-            k *= 2
-            steps_set.add(k)
-        for steps in sorted(steps_set):
-            self.decode(zeros, tables, zeros, mask, steps=steps)
-        # every verify window shape in the spec ladder (budget all-zero:
-        # nothing commits, every write is masked to nowhere)
-        for k in s.spec_k_set:
-            self.verify(np.zeros((self.num_slots, k + 1), np.int32), tables,
-                        zeros, mask, np.full(self.num_slots, -1, np.int32),
-                        zeros)
+        if s.role != "prefill":
+            steps_set = {1}
+            k = 1
+            while k * 2 <= s.decode_block:  # scheduler's power-of-two blocks
+                k *= 2
+                steps_set.add(k)
+            for steps in sorted(steps_set):
+                self.decode(zeros, tables, zeros, mask, steps=steps)
+            # every verify window shape in the spec ladder (budget all-zero:
+            # nothing commits, every write is masked to nowhere)
+            for k in s.spec_k_set:
+                self.verify(np.zeros((self.num_slots, k + 1), np.int32),
+                            tables, zeros, mask,
+                            np.full(self.num_slots, -1, np.int32), zeros)
+        if self.tp_context is not None:
+            # trace (not execute) the tp decode/verify programs to jaxprs
+            # for the serving/tp-collective-order dslint audit
+            self.tp_context.capture_programs(self)
         return len(self.compile_log)
 
     # -------------------------------------------------------------- assembly
@@ -593,7 +756,7 @@ class ServingEngine:
             dispatch_failure_budget=s.dispatch_failure_budget,
             recovery_log=recovery_log, watchdog=watchdog,
             prefix_cache=prefix_cache, drafter=drafter, spec_k=s.spec_k,
-            spec_adaptive=s.spec_adaptive)
+            spec_adaptive=s.spec_adaptive, role=s.role)
         sched._owns_watchdog = owns
         self.last_scheduler = sched
         return sched

@@ -85,6 +85,8 @@ class RequestState(enum.Enum):
     FINISHED = "finished"
     REJECTED = "rejected"   # shed at/after submit (overload or unservable)
     EXPIRED = "expired"     # missed its deadline; evicted, pages freed
+    HANDOFF = "handoff"     # prefilled on a prefill-role scheduler; pages
+    #                         staged for export to a decode-role replica
 
 
 class ServingFaultError(RuntimeError):
@@ -145,6 +147,11 @@ class Request:
     # session_id are routed to the same replica so its prefix-cache pages
     # stay hot; a lone scheduler ignores it
     session_id: Optional[str] = None
+    # disaggregated prefill/decode: a request arriving WITH a KV payload
+    # (an ``export_pages`` product from a prefill-role scheduler) admits by
+    # IMPORTING the pages instead of prefilling — cleared after the import,
+    # so a later preemption falls back to the normal kept-token re-prefill
+    kv_payload: Optional[dict] = None
     rid: int = dataclasses.field(default_factory=lambda: next(_rid))
 
     # lifecycle (filled by the scheduler)
@@ -199,12 +206,15 @@ class ContinuousBatchingScheduler:
                  recovery_log: Any = None, watchdog: Any = None,
                  prefix_cache: Optional[PrefixIndex] = None,
                  drafter: Any = None, spec_k: int = 4,
-                 spec_adaptive: bool = True):
+                 spec_adaptive: bool = True, role: str = "both"):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if shed_policy not in SHED_POLICIES:
             raise ValueError(f"shed_policy {shed_policy!r} not in "
                              f"{SHED_POLICIES}")
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(f"role must be both|prefill|decode, got "
+                             f"{role!r}")
         self.executor = executor
         self.num_slots = int(num_slots)
         self.page_size = int(page_size)
@@ -263,6 +273,16 @@ class ContinuousBatchingScheduler:
         self.steps = 0
         self._draining = False
         self._dispatch_count = 0           # chaos injection index
+        # disaggregated prefill/decode (docs/SERVING.md "Tensor parallel &
+        # disaggregation"): a "prefill" scheduler stops each request after
+        # its first token and STAGES the slot for handoff — the pages stay
+        # owned (export-before-free) until the decode side acknowledges via
+        # complete_handoff(). A "decode" scheduler admits kv_payload
+        # requests by importing pages instead of prefilling.
+        self.role = role
+        self._handoffs: Dict[int, dict] = {}      # rid -> staged entry
+        self._handoff_slots: Set[int] = set()
+        self.handed_off: List[Request] = []       # completed exports
         # failed dispatch EPISODES in a row, per kind: a healthy prefill
         # path must not mask a dead decode path (or vice versa) — the
         # admit/fail/requeue cycle would spin forever against a shared
@@ -292,11 +312,16 @@ class ContinuousBatchingScheduler:
     # ------------------------------------------------------------ bookkeeping
     @property
     def active_slots(self) -> List[int]:
-        return [i for i, r in enumerate(self.slots) if r is not None]
+        """Slots actively DECODING — a staged handoff still occupies its
+        slot (pages owned until the decode side acks) but never decodes,
+        never expires as "running", and is never a preemption victim."""
+        return [i for i, r in enumerate(self.slots)
+                if r is not None and r.state is RequestState.RUNNING]
 
     @property
     def idle(self) -> bool:
-        return not self.queue and not self.active_slots
+        return (not self.queue and not self.active_slots
+                and not self._handoffs)
 
     @property
     def draining(self) -> bool:
@@ -608,6 +633,12 @@ class ContinuousBatchingScheduler:
                 errors.append(f"page {p}: {n} slot reference(s) vs "
                               f"allocator refcount {have} (leaked refcount)")
         for s_idx, pages in enumerate(self._slot_pages):
+            if s_idx in self._handoff_slots:
+                # a staged handoff is read-only by construction: its table
+                # row is parked on the sink page (lengths 0), so the
+                # frontier invariants below do not apply — conservation and
+                # refcount checks above still do
+                continue
             frontier = int(self.lengths[s_idx])
             # the borrowed-prefix bookkeeping must agree with reality: the
             # slot borrowed its first _slot_shared pages, so the write
@@ -691,6 +722,12 @@ class ContinuousBatchingScheduler:
             if self.slots[slot] is not None or not self.queue:
                 continue
             req = self.queue[0]
+            if req.kv_payload is not None:
+                # disaggregated handoff arrival: admit by IMPORTING the
+                # prefill replica's exported pages — no prefill dispatch
+                if not self._admit_import(slot, req):
+                    break  # pool-blocked (FIFO) or the import failed
+                continue
             ctx = req.context_len
             # +1: the first decode step appends its token's KV at position
             # ctx, which may open a fresh page
@@ -764,7 +801,124 @@ class ContinuousBatchingScheduler:
                                            self._slot_pages[slot])
             if req.done:
                 self._finish(slot)
+            elif self.role == "prefill":
+                self._stage_handoff(slot)
         return len(batch)
+
+    # --------------------------------------------- disaggregated handoff
+    def _stage_handoff(self, slot: int) -> None:
+        """A prefill-role scheduler just delivered a request's first token:
+        stage its pages for export instead of decoding. The slot's table
+        row is parked on the sink page so a concurrent decode dispatch for
+        OTHER slots can never write into the staged pages (a stray append
+        would dirty a quantized page's scale before export); the page order
+        is snapshotted in the entry."""
+        req = self.slots[slot]
+        req.state = RequestState.HANDOFF
+        # KV live on this replica: everything prefilled — the freshly
+        # sampled first token's KV is NOT written yet (the decode side
+        # writes it at its own first decode step)
+        live = req.context_len - 1
+        n_pages = pages_for(live, self.page_size) if live else 0
+        self._handoffs[req.rid] = {
+            "rid": req.rid, "slot": slot, "request": req,
+            "page_ids": list(self._slot_pages[slot][:n_pages]),
+            "context_len": live, "popped": False}
+        self._handoff_slots.add(slot)
+        self.tables[slot] = 0
+        self.lengths[slot] = 0
+        self.next_input[slot] = 0
+        self._record("handoff_staged", rid=req.rid, pages=n_pages,
+                     context_len=live)
+
+    @property
+    def pending_handoff_rids(self) -> Set[int]:
+        """Rids staged (popped or not) whose pages this replica still owns."""
+        return set(self._handoffs)
+
+    def pop_handoffs(self) -> List[dict]:
+        """Staged handoff entries not yet handed to the transport, WITHOUT
+        freeing anything (export-before-free: the pages stay owned and
+        refcounted until :meth:`complete_handoff`). Each entry carries the
+        request, its page ids in table order, and the live context length;
+        the caller serializes the pages (``ServingEngine.export_pages``)
+        and ships them to a decode-role replica."""
+        out = []
+        for e in self._handoffs.values():
+            if not e["popped"]:
+                e["popped"] = True
+                out.append(e)
+        return out
+
+    def complete_handoff(self, rid: int, ok: bool = True) -> bool:
+        """The decode side acknowledged (``ok=True``) — or the handoff was
+        orphaned and the router re-routed the request (``ok=False``) —
+        either way THIS replica's ownership ends: free the staged pages,
+        recycle the slot, audit. Returns False for an unknown rid (already
+        completed; idempotent)."""
+        e = self._handoffs.pop(rid, None)
+        if e is None:
+            return False
+        slot = e["slot"]
+        req = self.slots[slot]
+        self._handoff_slots.discard(slot)
+        if req is not None:
+            if ok:
+                self.handed_off.append(req)
+            self._release(slot)
+        self._record("handoff_complete" if ok else "handoff_aborted",
+                     rid=rid)
+        self._audit_after_recovery(
+            f"handoff_{'complete' if ok else 'abort'}")
+        return True
+
+    def _admit_import(self, slot: int, req: Request) -> bool:
+        """Admission of a handoff arrival: claim this replica's own pages,
+        install the exported KV into them (``executor.import_pages``), and
+        seed the slot mid-stream — lengths at the live context, next input
+        the already-delivered first token. Page ids need not match across
+        replicas; only the table ORDER is the contract."""
+        ctx = req.context_len
+        # first decode write lands at position ctx-1 (the handed-off
+        # token's KV) — pages must cover it
+        need = pages_for(ctx, self.page_size)
+        pages = (self.allocator.alloc(need)
+                 if self.allocator.can_alloc(need) else None)
+        if pages is None:
+            return False
+        self.queue.popleft()
+        live = ctx - 1
+        n_kv = pages_for(live, self.page_size) if live else 0
+        try:
+            self._dispatch("import_kv", self.executor.import_pages,
+                           pages[:n_kv], req.kv_payload)
+        except _DispatchFailure as fail:
+            # nothing installed durably matters — the claim unwinds whole
+            # and the request requeues intact for another import attempt
+            self.allocator.free(pages)
+            self.queue.appendleft(req)
+            self._on_dispatch_episode_failed(fail, [])
+            return False
+        self.page_stats["logical"] += need
+        self.page_stats["physical"] += need
+        self._slot_pages[slot] = pages
+        self._slot_shared[slot] = 0
+        self.tables[slot] = 0
+        self.tables[slot, :len(pages)] = pages
+        self.lengths[slot] = live
+        self.next_input[slot] = int(req.tokens[-1])
+        self.slots[slot] = req
+        self._admissions += 1
+        self._admit_seq[slot] = self._admissions
+        req.state = RequestState.RUNNING
+        # consumed: a later preemption re-prefills prompt+kept tokens — the
+        # payload's KV no longer covers the grown context
+        req.kv_payload = None
+        if req.t_first_token is None:
+            req.t_first_token = self.clock()
+        self._record("handoff_import", rid=req.rid, pages=n_kv,
+                     context_len=live)
+        return True
 
     def _ensure_page(self, slot: int, horizon: int = 1) -> bool:
         """Make sure pages exist for write positions ``lengths[slot]`` up to

@@ -857,6 +857,7 @@ def serving_admission_limit(
     draft_model: Optional[Any] = None,  # PRESETS name or GPTConfig
     spec_k: int = 0,
     spec_max_len: Optional[int] = None,
+    role: str = "both",
     **report_kwargs: Any,
 ) -> Dict[str, Any]:
     """The continuous-batching admission limit, from the AOT fit ladder.
@@ -880,7 +881,25 @@ def serving_admission_limit(
     peak is topped up with :func:`speculation_hbm_bytes` at THAT batch's
     slot count before the fit verdict — "auto" with a drafter configured
     admits only what still fits with the draft params, the per-slot draft
-    cache, and the k-token verify activations resident."""
+    cache, and the k-token verify activations resident.
+
+    ``tp`` (in ``report_kwargs``, forwarded to the probe) prices the
+    PER-CHIP footprint of a tensor-parallel replica — the compiled probe
+    shards weights over the tp mesh, so a tp replica's verdict reflects
+    1/tp of the weight bytes per chip. ``role`` picks the program set the
+    verdict prices instead of always charging the fused single-replica
+    family: a ``"prefill"`` replica holds prompt pages + one handoff token
+    per slot and never runs the drafter/verify family (speculation top-up
+    dropped, pool sized at gen=1); ``"decode"`` and ``"both"`` price the
+    full decode/verify residency as before."""
+    if role not in ("both", "prefill", "decode"):
+        raise ValueError(f"role must be both|prefill|decode, got {role!r}")
+    if role == "prefill":
+        # prefill specialists fill pages and emit ONE token before handing
+        # off — a decode-length pool + speculation top-up would under-admit
+        # the cheap role
+        report_kwargs = dict(report_kwargs, gen=1)
+        draft_model, spec_k = None, 0
     spec_armed = draft_model is not None or int(spec_k) > 0
     if not spec_armed:
         r = find_max_decode_batch(model, lo=lo, hi=hi, **report_kwargs)
@@ -911,6 +930,7 @@ def serving_admission_limit(
     out = {"model": model, "max_slots": slots,
            "max_decode_batch": r["max_batch"], "fit": fit,
            "kv_bits": int(report_kwargs.get("kv_bits", 0) or 0),
+           "tp": int(report_kwargs.get("tp", 1) or 1), "role": role,
            "trace": r["trace"]}
     if spec_armed:
         out["speculation"] = (r.get("report") or {}).get("speculation")
@@ -925,24 +945,37 @@ def fleet_replica_plan(
     safety_margin: float = 1.0,
     lo: int = 1,
     hi: int = 64,
+    role: str = "both",
     **report_kwargs: Any,
 ) -> Dict[str, Any]:
     """Size a serving fleet from the AOT fit ladder: per-replica slots are
     one :func:`serving_admission_limit` verdict (one replica = one chip
-    allocation = one compiled decode program), and the replica count is
-    what covers ``target_total_slots`` of aggregate admission capacity.
-    The ``inference/fleet`` router and autoscaler consume this plan —
-    the policy decides HOW MANY replicas run, never how big one is
-    (that is a compile-time fact, not a load signal)."""
+    allocation — ``tp`` chips on a tensor-parallel mesh — = one compiled
+    decode program), and the replica count is what covers
+    ``target_total_slots`` of aggregate admission capacity. The
+    ``inference/fleet`` router and autoscaler consume this plan — the
+    policy decides HOW MANY replicas run, never how big one is (that is a
+    compile-time fact, not a load signal).
+
+    ``tp`` (in ``report_kwargs``) and ``role`` forward to the admission
+    ladder, so a disaggregated fleet sizes its prefill-specialist and
+    decode-specialist pools with SEPARATE calls (per-role program sets,
+    per-chip tp footprint) instead of pricing every replica as the fused
+    single-chip family; the plan reports the chip bill (``replicas * tp``)
+    the autoscaler actually spends."""
     limit = serving_admission_limit(model, safety_margin=safety_margin,
-                                    lo=lo, hi=hi, **report_kwargs)
+                                    lo=lo, hi=hi, role=role,
+                                    **report_kwargs)
     per = int(limit["max_slots"])
+    tp = int(report_kwargs.get("tp", 1) or 1)
     if per < 1:
         return {"model": model, "slots_per_replica": 0, "replicas": 0,
-                "total_slots": 0, "admission": limit}
+                "total_slots": 0, "tp": tp, "chips": 0, "role": role,
+                "admission": limit}
     n = min(int(max_replicas), -(-int(target_total_slots) // per))
     return {"model": model, "slots_per_replica": per, "replicas": n,
-            "total_slots": n * per, "admission": limit}
+            "total_slots": n * per, "tp": tp, "chips": n * tp,
+            "role": role, "admission": limit}
 
 
 def sd_program_report(

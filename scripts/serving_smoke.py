@@ -45,6 +45,14 @@ mid-stream: the router must detect the death (pipe EOF), re-route the dead
 replica's in-flight requests to the survivor with their streamed tokens
 kept, finish every request generate-identical, and leave the survivor's
 page-conservation audit clean.
+
+``--disagg`` (docs/SERVING.md "Tensor parallel & disaggregation") runs a
+prefill-specialist and a decode-specialist worker PROCESS behind the
+role-aware router: every request must prefill on one replica, hand its
+quantized KV pages off over the wire (ownership transfer — the prefill
+side frees only after the decode side imports), and finish decoding on
+the other, generate-identical, with BOTH replicas' page audits clean
+after the drain.
 """
 
 import os
@@ -470,6 +478,81 @@ def fleet_main() -> int:
     return 0
 
 
+def disagg_main() -> int:
+    """Disaggregated prefill/decode end to end (docs/SERVING.md "Tensor
+    parallel & disaggregation"): a prefill-specialist and a decode-specialist
+    worker process behind the role-aware router. Every request prefills on
+    one replica, hands its int8 KV pages off over the subprocess wire, and
+    decodes on the other — generate-identical, both pools drained."""
+    from deepspeed_tpu.inference.fleet import (FleetConfig, ReplicaRouter,
+                                               SubprocessReplica)
+    from deepspeed_tpu.inference.serving import RequestState
+
+    model = dict(vocab_size=64, d_model=32, n_layer=2, n_head=4,
+                 max_seq_len=128)
+    # int8 KV pages: the handoff wire payload is the quantized pool slices
+    # + per-page scales — the cheap-serialization path the design leans on
+    serving = dict(page_size=8, max_model_len=64, prefill_chunk=16,
+                   dtype="float32", decode_block=4, max_queue=32, kv_bits=8)
+    pre = SubprocessReplica("pre", model, dict(serving, num_slots=2,
+                                               role="prefill"), seed=0)
+    dec = SubprocessReplica("dec", model, dict(serving, num_slots=2,
+                                               role="decode"), seed=0)
+    router = ReplicaRouter([pre, dec], FleetConfig(reroute_budget=2,
+                                                   heartbeat_deadline_s=60.0))
+    print(f"[disagg] prefill + decode specialists up "
+          f"(pids {pre.pid}, {dec.pid})")
+
+    rng = np.random.default_rng(29)
+    wl = [Request(prompt=rng.integers(0, 64, (int(rng.integers(4, 24)),))
+                  .astype(np.int32),
+                  max_new_tokens=int(rng.integers(6, 14)))
+          for _ in range(5)]
+    # one prompt spanning several pages: the handoff must transfer a
+    # multi-page KV prefix, not just a single page
+    wl.append(Request(prompt=(np.arange(30, dtype=np.int32) * 5 + 1) % 64,
+                      max_new_tokens=8))
+    for r in wl:
+        assert router.submit(r), r.rid
+    assert all(router._assignment[r.rid] == "pre" for r in wl), \
+        "role-aware placement must send fresh requests to the prefill " \
+        "specialist"
+    router.run_to_completion()
+
+    assert router.counters.get("handoff_forwarded", 0) == len(wl), \
+        router.counters
+    assert not router.counters.get("handoff_fallback"), router.counters
+    assert all(r.state is RequestState.FINISHED for r in wl), \
+        [r.state for r in wl]
+    print(f"[disagg] {len(wl)} requests prefilled on 'pre', pages handed "
+          f"off, decoded on 'dec' "
+          f"({router.counters['handoff_forwarded']} handoffs forwarded)")
+
+    audit = router.audit_survivors()
+    assert audit["ok"], audit
+    assert audit["replicas"]["pre"]["allocated"] == 0, audit
+    assert audit["replicas"]["dec"]["allocated"] == 0, audit
+    print("[disagg] ownership transfer clean: both pools drained to zero")
+
+    # greedy equivalence: the prefill->wire->decode split must be invisible
+    cfg = G.GPTConfig(**model)
+    params = G.init_params(cfg, jax.random.PRNGKey(0))
+    ie = InferenceEngine(for_gpt(cfg, params), DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=64))
+    for r in wl:
+        ref = np.asarray(ie.generate(
+            np.asarray(r.prompt)[None],
+            max_new_tokens=r.max_new_tokens))[0, len(r.prompt):]
+        got = np.asarray(r.tokens[:r.max_new_tokens])
+        assert np.array_equal(ref, got), (r.rid, ref, got)
+    print("[disagg] greedy outputs identical to InferenceEngine.generate "
+          "across the handoff")
+
+    router.close()
+    print("serving_smoke[disagg]: PASS")
+    return 0
+
+
 if __name__ == "__main__":
     if "--chaos" in sys.argv[1:]:
         sys.exit(chaos_main())
@@ -479,4 +562,6 @@ if __name__ == "__main__":
         sys.exit(spec_main())
     if "--fleet" in sys.argv[1:]:
         sys.exit(fleet_main())
+    if "--disagg" in sys.argv[1:]:
+        sys.exit(disagg_main())
     sys.exit(main())

@@ -66,10 +66,11 @@ grep -aE '^[0-9]+ passed' /tmp/_t1_overlap.log || true
 # hits its budget mid-run: decode-kernel batch regression (the b16 BlockSpec
 # crash class), paged allocator/equivalence, scheduler mechanics, and the
 # serving dslint rule.
-if ! timeout -k 10 420 env JAX_PLATFORMS=cpu \
+if ! timeout -k 10 480 env JAX_PLATFORMS=cpu \
         python -m pytest tests/test_serving.py tests/test_serving_chaos.py \
         tests/test_paged_kv.py tests/test_fleet.py tests/test_speculation.py \
-        tests/test_decode_attention.py -q -m 'not slow' \
+        tests/test_decode_attention.py tests/test_tp_serving.py \
+        -q -m 'not slow' \
         -p no:cacheprovider -p no:randomly > /tmp/_t1_serving.log 2>&1; then
     echo "verify_tier1: FAIL — serving/paged-KV tests:" >&2
     tail -30 /tmp/_t1_serving.log >&2
@@ -143,6 +144,21 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 grep -a "serving_smoke\[fleet\]: PASS" /tmp/_t1_serving_fleet.log || true
+
+# the disaggregated prefill/decode smoke (docs/SERVING.md "Tensor parallel
+# & disaggregation"): a prefill-specialist and a decode-specialist worker
+# process behind the role-aware router — every request prefills on one,
+# hands its int8 KV pages off over the wire (ownership transfer), decodes
+# on the other, generate-identical, with BOTH pools drained to zero.
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        python scripts/serving_smoke.py --disagg \
+        > /tmp/_t1_serving_disagg.log 2>&1; then
+    echo "verify_tier1: FAIL — serving disagg smoke" \
+         "(scripts/serving_smoke.py --disagg):" >&2
+    tail -30 /tmp/_t1_serving_disagg.log >&2
+    exit 1
+fi
+grep -a "serving_smoke\[disagg\]: PASS" /tmp/_t1_serving_disagg.log || true
 
 # --- offload gate (docs/OFFLOAD.md) ---------------------------------------
 # the streamed host<->HBM DMA pipeline: streamed-vs-inline bitwise

@@ -1,0 +1,475 @@
+"""Tensor-parallel serving replicas + disaggregated prefill/decode.
+
+Two layers of coverage (docs/SERVING.md "Tensor parallel & disaggregation"):
+
+- **Real engines on the simulated 8-device CPU mesh** — a tp=2 replica must
+  be *invisible* in the outputs: greedy token streams identical to tp=1
+  for dense pools AND for the quantized+speculative stack, with the
+  sharded-pool audit clean even when pool pressure drives the recompute
+  preemption path. Disaggregated serving (one prefill-role + one
+  decode-role replica behind the router) must generate exactly what a
+  colocated replica generates, including after the prefill replica is
+  killed mid-handoff.
+- **Device-free scheduler/router tests over the arithmetic fake executor**
+  (test_fleet.py idiom) — the handoff ownership-transfer protocol itself:
+  staging after the first token, export-before-free, abort/idempotency,
+  import-side admission, role-aware placement, and kill-mid-handoff
+  failover with zero page leaks on survivors.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.fleet import (FleetConfig, LocalReplica,
+                                           ReplicaDeadError, ReplicaRouter)
+from deepspeed_tpu.inference.serving import (ContinuousBatchingScheduler,
+                                             Request, RequestState,
+                                             ServingConfig, ServingEngine,
+                                             make_open_loop_workload,
+                                             run_continuous)
+from deepspeed_tpu.models import gpt as G
+
+CFG = G.GPTConfig(vocab_size=64, d_model=32, n_layer=2, n_head=4,
+                  max_seq_len=128)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return G.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _engine(params, tp=None, role="both", **kw):
+    kw.setdefault("num_slots", 3)
+    kw.setdefault("num_pages", 48)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_model_len", 64)
+    kw.setdefault("prefill_chunk", 16)
+    kw.setdefault("dtype", "float32")
+    kw.setdefault("max_queue", 64)
+    eng = ServingEngine(CFG, params, ServingConfig(tp=tp, role=role, **kw))
+    eng.warmup()
+    return eng
+
+
+def _workload(seed=3, n=6):
+    wl = make_open_loop_workload(n, rate_rps=1e4, prompt_len=(3, 30),
+                                 max_new=(2, 8), vocab_size=64, seed=seed)
+    # one multi-chunk prompt for the serial chunked-prefill path
+    wl.append(Request(prompt=np.arange(20, dtype=np.int32) + 1,
+                      max_new_tokens=4))
+    return wl
+
+
+# --------------------------------------------------- tp2 == tp1 (real mesh)
+@pytest.fixture(scope="module")
+def tp_pair_dense(params):
+    """tp1/tp2 engines with a PAGE-TIGHT pool, so the run also exercises
+    the recompute-preemption recovery path under sharding."""
+    kw = dict(num_pages=12)
+    return _engine(params, **kw), _engine(params, tp=2, **kw)
+
+
+@pytest.fixture(scope="module")
+def tp_pair_kv8_spec(params):
+    kw = dict(kv_bits=8, spec_drafter="ngram", spec_k=4)
+    return _engine(params, **kw), _engine(params, tp=2, **kw)
+
+
+def _run_pair(e1, e2, wl_fn):
+    wl1, wl2 = wl_fn(), wl_fn()
+    r1, r2 = run_continuous(e1, wl1), run_continuous(e2, wl2)
+    assert r1["finished"] == len(wl1) and r2["finished"] == len(wl2)
+    for a, b in zip(wl1, wl2):
+        assert list(a.tokens) == list(b.tokens), (a.rid, a.tokens, b.tokens)
+    return r1, r2
+
+
+def test_tp2_greedy_identical_dense_with_preemption(tp_pair_dense):
+    """Head-sharded attention + row/col-split MLP over the 2-chip mesh must
+    not change a single greedy token — including through recompute
+    preemptions (the page-tight pool forces them identically on both sides,
+    since the scheduler is host-pure), and the sharded pool must pass the
+    page audit afterwards."""
+    e1, e2 = tp_pair_dense
+
+    def wl():
+        w = _workload(3)
+        # growers: 1 page at admission, 4 pages at completion — three of
+        # them outgrow the 11-page pool together, forcing recompute
+        # preemption identically on both sides
+        for i in range(3):
+            w.append(Request(
+                prompt=(np.arange(6, dtype=np.int32) + 1 + 5 * i) % 63 + 1,
+                max_new_tokens=26))
+        return w
+
+    r1, r2 = _run_pair(e1, e2, wl)
+    assert r1["recovery_counters"].get("preemption", 0) >= 1
+    assert r1["recovery_counters"] == r2["recovery_counters"]
+    assert r1["pool_audit_ok"] and r2["pool_audit_ok"]
+
+
+def test_tp2_greedy_identical_quantized_speculative(tp_pair_kv8_spec):
+    """The full serving stack — int8 KV pages + n-gram speculation with
+    paged multi-token verify — stays greedy-identical under tp=2."""
+    e1, e2 = tp_pair_kv8_spec
+    r1, r2 = _run_pair(e1, e2, lambda: _workload(5))
+    assert r1["pool_audit_ok"] and r2["pool_audit_ok"]
+
+
+def test_tp_sharded_page_export_import_roundtrip(tp_pair_kv8_spec):
+    """Pages exported from a SHARDED quantized pool survive the wire
+    round-trip (int8 payload + fp32 per-page scales through the base64
+    transport form) bit-exactly across a tp2 -> tp1 transfer, and import
+    re-pins the tp sharding on the receiving pool."""
+    from deepspeed_tpu.inference.fleet.replica import (decode_kv_payload,
+                                                       encode_kv_payload)
+
+    e1, e2 = tp_pair_kv8_spec
+    p2 = e2.export_pages([1, 2])
+    wire = decode_kv_payload(encode_kv_payload(p2))
+    e1.import_pages([3, 4], wire)
+    back = e1.export_pages([3, 4])
+    assert set(back["tensors"]) == set(p2["tensors"])
+    for key in p2["tensors"]:
+        assert back["tensors"][key]["data"] == p2["tensors"][key]["data"], key
+    e2.import_pages([3, 4], wire)
+    specs = e2.tp_context.cache_specs(e2.paged_cache)
+    for k, arr in e2.paged_cache.items():
+        assert arr.sharding.spec == specs[k], k
+
+
+# ----------------------------------------- disaggregation with real engines
+@pytest.fixture(scope="module")
+def disagg_engines(params):
+    """colocated-reference / prefill-specialist / decode-specialist, all
+    over int8 KV pages (the payload wire the handoff quantizes)."""
+    kw = dict(kv_bits=8)
+    return (_engine(params, role="both", **kw),
+            _engine(params, role="prefill", **kw),
+            _engine(params, role="decode", **kw))
+
+
+def _route(replicas, wl):
+    router = ReplicaRouter(replicas, FleetConfig(reroute_budget=2))
+    reqs = []
+    for r in wl:
+        assert router.submit(r).admitted
+        reqs.append(r)
+    router.run_to_completion(max_steps=10_000)
+    return router, [list(r.tokens) for r in reqs]
+
+
+def test_disagg_generate_identical_to_colocated(disagg_engines):
+    """Prefill-specialist fills the pages, hands them off over the wire
+    protocol, decode-specialist continues — outputs identical to one
+    colocated replica, quantized payloads and all."""
+    colo_eng, pre_eng, dec_eng = disagg_engines
+    _, ref = _route([LocalReplica("colo", engine=colo_eng)], _workload(7))
+    router, got = _route([LocalReplica("pre", engine=pre_eng),
+                          LocalReplica("dec", engine=dec_eng)], _workload(7))
+    assert got == ref
+    assert router.counters.get("handoff_forwarded", 0) == len(ref)
+    audit = router.audit_survivors()
+    assert audit["ok"], audit
+
+
+def test_disagg_prefill_killed_mid_handoff_heals(disagg_engines):
+    """The prefill replica dies with handoffs staged but never delivered
+    (the SIGKILL-mid-handoff model: pages exported, ack never arrives, the
+    pool dies with the process). Victims re-route with kept tokens; the
+    decode specialist re-prefills them (role fallback) and the outputs
+    still match the colocated reference; the survivor audits clean."""
+    colo_eng, pre_eng, dec_eng = disagg_engines
+    _, ref = _route([LocalReplica("colo", engine=colo_eng)], _workload(9))
+
+    class DiesMidHandoff(LocalReplica):
+        def pump(self, max_steps=1):
+            super().pump(max_steps)  # stages + pops handoffs internally
+            self._alive = False      # ... but the report never lands
+            raise ReplicaDeadError("SIGKILL mid-handoff")
+
+    router, got = _route([DiesMidHandoff("pre", engine=pre_eng),
+                          LocalReplica("dec", engine=dec_eng)], _workload(9))
+    assert got == ref
+    assert router.counters.get("replica_dead", 0) == 1
+    assert router.counters.get("request_rerouted", 0) >= 1
+    audit = router.audit_survivors()
+    assert audit["ok"], audit
+
+
+# ------------------------------------- scheduler-level handoff (device-free)
+class FakeExecutor:
+    """test_fleet.py's arithmetic executor + the disaggregation protocol:
+    export/import move a deterministic per-page byte payload so the test
+    can assert the transport carried exactly the staged pages."""
+
+    def __init__(self):
+        self.exported = []
+        self.imported = []
+
+    def prefill(self, slot, tokens, table_row):
+        return (int(tokens[-1]) + 1) % 97
+
+    def decode(self, tokens, tables, lengths, active, steps=1):
+        return np.stack([(tokens + k + 1) % 97 for k in range(steps)])
+
+    def export_pages(self, page_ids):
+        ids = [int(p) for p in page_ids]
+        self.exported.append(ids)
+        return {"page_ids": ids,
+                "tensors": {"k_pages": {
+                    "dtype": "int32", "shape": [1, 1, len(ids)],
+                    "data": np.asarray(ids, np.int32).tobytes()}}}
+
+    def import_pages(self, page_ids, payload):
+        self.imported.append(([int(p) for p in page_ids], payload))
+
+
+def mk_sched(num_slots=2, num_pages=32, page_size=4, pages_per_seq=8, **kw):
+    return ContinuousBatchingScheduler(
+        FakeExecutor(), num_slots=num_slots, num_pages=num_pages,
+        page_size=page_size, pages_per_seq=pages_per_seq, **kw)
+
+
+def test_prefill_role_stages_handoff_after_first_token():
+    sched = mk_sched(role="prefill")
+    req = Request(prompt=np.arange(1, 6, dtype=np.int32), max_new_tokens=8)
+    assert sched.submit(req).admitted
+    sched.step()
+    assert req.state is RequestState.HANDOFF
+    assert req.tokens == [6]                    # last+1, exactly one token
+    assert sched.pending_handoff_rids == {req.rid}
+    assert not sched.idle                       # staged pages still owned
+    (entry,) = sched.pop_handoffs()
+    # live KV = context_len - 1: the first token's KV is unwritten (the
+    # decode side writes it at its first decode step)
+    assert entry["context_len"] == len(req.prompt)
+    assert len(entry["page_ids"]) == 2          # ceil(5/4) pages
+    assert sched.pop_handoffs() == []           # popped entries not re-sent
+    assert sched.audit()["ok"]
+    free_before = sched.allocator.free_pages
+    assert sched.complete_handoff(req.rid, ok=True)
+    assert sched.allocator.free_pages == free_before + 2
+    assert sched.idle and sched.audit()["ok"]
+    assert not sched.complete_handoff(req.rid)  # idempotent
+
+
+def test_handoff_abort_frees_pages():
+    sched = mk_sched(role="prefill")
+    req = Request(prompt=np.arange(1, 4, dtype=np.int32), max_new_tokens=4)
+    sched.submit(req)
+    sched.step()
+    assert sched.complete_handoff(req.rid, ok=False)
+    assert sched.counters.get("handoff_aborted", 0) == 1
+    assert sched.allocator.allocated_pages == 0
+    assert sched.idle and sched.audit()["ok"]
+
+
+def test_import_admission_continues_identically():
+    """A decode-side scheduler admitting via kv_payload must produce the
+    same continuation a colocated run produces, without ever prefilling."""
+    prompt = np.arange(1, 6, dtype=np.int32)
+    ref = Request(prompt=prompt.copy(), max_new_tokens=6)
+    colo = mk_sched()
+    colo.submit(ref)
+    colo.run_to_completion(max_steps=100)
+
+    pre = mk_sched(role="prefill")
+    req = Request(prompt=prompt.copy(), max_new_tokens=6)
+    pre.submit(req)
+    pre.step()
+    (entry,) = pre.pop_handoffs()
+    payload = pre.executor.export_pages(entry["page_ids"])
+    pre.complete_handoff(req.rid, ok=True)
+
+    dec = mk_sched(role="decode")
+    cont = Request(prompt=prompt.copy(), max_new_tokens=6, rid=req.rid)
+    cont.tokens = list(req.tokens)
+    cont.kv_payload = payload
+    assert dec.submit(cont).admitted
+    dec.run_to_completion(max_steps=100)
+    assert cont.tokens == ref.tokens
+    # the import claimed pages and fed the transport the staged payload
+    (ids, got) = dec.executor.imported[0]
+    assert got is payload and len(ids) == len(entry["page_ids"])
+    assert cont.kv_payload is None   # consumed: preemption re-prefills
+    assert dec.audit()["ok"] and pre.audit()["ok"]
+
+
+def test_router_role_aware_placement_and_forwarding():
+    """Fresh requests land only on prefill-capable replicas; handoffs are
+    forwarded only to decode-capable ones; every stream matches the
+    single-scheduler reference."""
+    spec = ((3, 6), (5, 4), (2, 8), (4, 3))
+
+    def workload():
+        return [Request(prompt=np.arange(1, n + 1, dtype=np.int32),
+                        max_new_tokens=m) for n, m in spec]
+
+    ref_sched = mk_sched(num_slots=4)
+    refs = workload()
+    for r in refs:
+        ref_sched.submit(r)
+    ref_sched.run_to_completion(max_steps=500)
+
+    pre = LocalReplica("pre", scheduler=mk_sched(num_slots=4,
+                                                 role="prefill"))
+    dec = LocalReplica("dec", scheduler=mk_sched(num_slots=4, role="decode"))
+    router = ReplicaRouter([pre, dec])
+    reqs = workload()
+    for r in reqs:
+        assert router.submit(r).admitted
+        assert router._assignment[r.rid] == "pre"
+    router.run_to_completion()
+    assert [list(r.tokens) for r in reqs] == [list(r.tokens) for r in refs]
+    assert pre.sched.counters["handoff_staged"] == len(spec)
+    assert pre.sched.counters["handoff_complete"] == len(spec)
+    assert dec.sched.counters["handoff_import"] == len(spec)
+    assert router.counters["handoff_forwarded"] == len(spec)
+    assert router.audit_survivors()["ok"]
+
+
+def test_router_handoff_falls_back_to_reprefill_when_no_decode_capacity():
+    """Every decode-capable sibling refusing degrades to the kept-token
+    re-prefill contract: the source frees the staged pages and the request
+    re-places normally (here back onto the prefill-capable pool, which
+    re-prefills and re-stages until capacity frees up — with NO decode
+    replica at all, role fallback lets the prefill replica finish it)."""
+    pre = LocalReplica("pre", scheduler=mk_sched(num_slots=2,
+                                                 role="prefill"))
+    router = ReplicaRouter([pre])
+    req = Request(prompt=np.arange(1, 5, dtype=np.int32), max_new_tokens=4)
+    assert router.submit(req).admitted
+    router.run_to_completion()
+    # no decode-capable replica exists: the handoff aborts, the request
+    # re-routes to the only live replica, which (being prefill-role)
+    # stages again — the reroute budget caps the ping-pong and the fleet
+    # rejects rather than loops forever. Either terminal state is a
+    # CORRECT degraded outcome; what must hold is conservation:
+    assert req.state in (RequestState.FINISHED, RequestState.REJECTED)
+    assert pre.sched.counters.get("handoff_aborted", 0) >= 1
+    assert router.audit_survivors()["ok"]
+    assert pre.sched.idle
+
+
+# ------------------------------------------------------------------ dslint
+def test_tp_collective_order_rule_silent_on_shipped_programs(
+        tp_pair_kv8_spec):
+    from deepspeed_tpu.analysis import analyze_compile_log
+
+    _, e2 = tp_pair_kv8_spec
+    assert e2.tp_context is not None and e2.tp_context.captured
+    rep = analyze_compile_log(e2)
+    assert not [f for f in rep.findings
+                if f.rule_id == "serving/tp-collective-order"], rep.findings
+
+
+def test_tp_collective_order_rule_fires():
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.analysis import analyze_fn
+    from deepspeed_tpu.analysis.rules_collectives import TpCollectiveOrderRule
+    from deepspeed_tpu.utils.jax_compat import shard_map
+
+    mesh = jax.make_mesh((2,), ("tp",))
+
+    def guarded_psum(x, flag):
+        def body(x, flag):
+            return jax.lax.cond(flag > 0,
+                                lambda v: jax.lax.psum(v, "tp"),
+                                lambda v: v, x)
+        return shard_map(body, mesh=mesh, in_specs=(P("tp"), P()),
+                         out_specs=P("tp"), check_vma=False)(x, flag)
+
+    rep = analyze_fn(guarded_psum, jnp.zeros((8,)), jnp.int32(1),
+                     name="guarded", rules=[TpCollectiveOrderRule()])
+    assert [f for f in rep.findings
+            if f.rule_id == "serving/tp-collective-order"], rep.findings
+
+    def while_psum(x):
+        def body(x):
+            def cond(c):
+                return jax.lax.psum(c[1].sum(), "tp") > 0
+
+            def step(c):
+                return c[0] + 1, c[1] - 1.0
+
+            return jax.lax.while_loop(cond, step, (0, x))[1]
+        return shard_map(body, mesh=mesh, in_specs=(P("tp"),),
+                         out_specs=P("tp"), check_vma=False)(x)
+
+    rep = analyze_fn(while_psum, jnp.ones((8,)), name="while_pred",
+                     rules=[TpCollectiveOrderRule()])
+    assert [f for f in rep.findings
+            if f.rule_id == "serving/tp-collective-order"], rep.findings
+
+
+def test_tp_collective_order_rule_silent_on_collective_free_cond():
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.analysis import analyze_fn
+    from deepspeed_tpu.analysis.rules_collectives import TpCollectiveOrderRule
+    from deepspeed_tpu.utils.jax_compat import shard_map
+
+    mesh = jax.make_mesh((2,), ("tp",))
+
+    def hoisted(x, flag):
+        def body(x, flag):
+            y = jax.lax.cond(flag > 0, lambda v: v * 2, lambda v: v, x)
+            return jax.lax.psum(y, "tp")
+        return shard_map(body, mesh=mesh, in_specs=(P("tp"), P()),
+                         out_specs=P(), check_vma=False)(x, flag)
+
+    rep = analyze_fn(hoisted, jnp.zeros((8,)), jnp.int32(1), name="hoisted",
+                     rules=[TpCollectiveOrderRule()])
+    assert not [f for f in rep.findings
+                if f.rule_id == "serving/tp-collective-order"], rep.findings
+
+
+# --------------------------------------------------------------- aot sizing
+def test_fleet_replica_plan_roles_and_tp(monkeypatch):
+    from deepspeed_tpu.runtime import aot
+
+    seen = {}
+
+    def fake_limit(model, **kw):
+        seen.update(kw)
+        return {"model": model, "max_slots": 4, "max_decode_batch": 4,
+                "fit": "fits", "trace": [], "tp": int(kw.get("tp", 1) or 1),
+                "role": kw.get("role", "both")}
+
+    monkeypatch.setattr(aot, "serving_admission_limit", fake_limit)
+    plan = aot.fleet_replica_plan("gpt2-125m", target_total_slots=10,
+                                  tp=2, role="prefill")
+    assert seen["tp"] == 2 and seen["role"] == "prefill"
+    assert plan["tp"] == 2 and plan["role"] == "prefill"
+    assert plan["replicas"] == 3
+    assert plan["chips"] == plan["replicas"] * 2
+
+
+def test_serving_admission_limit_prefill_pricing(monkeypatch):
+    """A prefill-role replica is priced at gen=1 (it never decodes past the
+    first token) with speculation dropped — more slots per chip."""
+    from deepspeed_tpu.runtime import aot
+
+    calls = []
+
+    def fake_find(model, lo=1, hi=64, **kw):
+        calls.append(kw)
+        return {"model": model, "max_batch": 8, "trace": [],
+                "report": {"fit": {"confidence": "fits"}}}
+
+    monkeypatch.setattr(aot, "find_max_decode_batch", fake_find)
+    # the drafter is DROPPED for prefill replicas, so the verdict goes
+    # through the plain (non-speculative) ladder at gen=1
+    out = aot.serving_admission_limit("gpt2-125m", role="prefill",
+                                      draft_model="gpt2-125m", spec_k=4)
+    assert out["role"] == "prefill" and out["tp"] == 1
+    assert out["max_slots"] == 8 and "speculation" not in out
+    assert calls and all(kw.get("gen") == 1 for kw in calls)
+    with pytest.raises(ValueError, match="role"):
+        aot.serving_admission_limit("gpt2-125m", role="bogus")
