@@ -218,6 +218,12 @@ PIPELINE_CONFIGS = [
     {"kind": "pipeline_mpmd", "name": "mpmd-dispatch-overhead",
      "d_model": 1024, "n_blocks": 24, "stages": 2, "num_micro": 4,
      "micro_bs": 4, "seq": 1024, "steps": 5, "timeout": 1500},
+    # static schedule-prover comparison (ISSUE 18): 1F1B vs interleaved vs
+    # zero-bubble bubble % at equal microbatches on the 8-device mesh shape
+    # (MULTICHIP_r05.json dry-run world) — pure host math, proofs included
+    {"kind": "pipeline_schedule", "name": "schedule-bubble-pp8",
+     "stages": 8, "num_micro": 16, "vstages": 2, "micro_bs": 4, "seq": 1024,
+     "d_model": 1024, "force_cpu": True, "n_devices": 8, "timeout": 600},
 ]
 
 
@@ -295,8 +301,10 @@ def run_worker(cfg: dict, platform: str, retries: int = 1):
     """Run one benchmark config in a subprocess; returns parsed JSON or error dict."""
     if cfg.get("force_cpu"):
         # e.g. the AOT pipeline row: the XLA TPU compiler runs on the host —
-        # touching the axon backend would only add a hang risk
-        env = _cpu_env(os.environ)
+        # touching the axon backend would only add a hang risk. Rows that
+        # model a multi-chip world (the schedule-prover row's 8-stage mesh)
+        # set n_devices for a virtual CPU mesh of that size.
+        env = _cpu_env(os.environ, n_devices=int(cfg.get("n_devices", 1)))
     else:
         env = dict(os.environ) if platform == "tpu" else _cpu_env(os.environ)
     timeout = int(cfg.get("timeout", WORKER_TIMEOUT))
@@ -339,6 +347,7 @@ def _worker(cfg: dict) -> None:
           "kernels": _worker_kernels, "diffusion": _worker_diffusion,
           "pipeline_aot": _worker_pipeline_aot,
           "pipeline_mpmd": _worker_pipeline_mpmd,
+          "pipeline_schedule": _worker_pipeline_schedule,
           "train_aot": _worker_train_aot,
           "infer_aot": _worker_infer_aot,
           "sd_aot": _worker_sd_aot,
@@ -1936,6 +1945,63 @@ def _worker_moe_aot(cfg: dict) -> dict:
         compile_s = time.perf_counter() - t0
     out.update(_aot_report(compiled, compile_s))
     return out
+
+
+def _worker_pipeline_schedule(cfg: dict) -> dict:
+    """Static schedule comparison (ISSUE 18): generate 1F1B, interleaved,
+    and zero-bubble IRs at equal microbatches on the 8-device mesh shape,
+    prove each with the pipeline-schedule prover, and report the static
+    bubble %% + priced peak residency side by side. Pure host math — the
+    whole point is that this verdict is available before any compile or
+    dispatch."""
+    import jax
+
+    from deepspeed_tpu.analysis.schedule import prove_schedule
+    from deepspeed_tpu.runtime.aot import pipeline_schedule_report
+    from deepspeed_tpu.runtime.pipe.mpmd import (
+        generate_1f1b_ir, generate_interleaved_ir, generate_zero_bubble_ir)
+
+    platform = jax.devices()[0].platform
+    S = int(cfg.get("stages", 8))
+    M = int(cfg.get("num_micro", 16))
+    V = int(cfg.get("vstages", 2))
+    mb = int(cfg.get("micro_bs", 4))
+    seq = int(cfg.get("seq", 1024))
+    d_model = int(cfg.get("d_model", 1024))
+    act_bytes = mb * seq * d_model * 2  # one bf16 stage-input activation
+
+    rows = {}
+    for ir in (generate_1f1b_ir(M, S),
+               generate_interleaved_ir(M, S, num_vstages=V),
+               generate_zero_bubble_ir(M, S)):
+        rep = pipeline_schedule_report(ir, activation_bytes=act_bytes)
+        kind = ir.name.split("[")[0]
+        rows[kind] = {
+            "schedule": ir.name,
+            "proof_ok": rep["proof_ok"],
+            "n_findings": len(rep["findings"]),
+            "bubble_frac": rep["bubble_frac"],
+            "peak_activation_buffers": rep["peak_activation_buffers"],
+            "peak_schedule_bytes": rep["peak_schedule_bytes"],
+            "confidence": rep.get("confidence"),
+        }
+    zb, il, f1 = (rows["zero-bubble"]["bubble_frac"],
+                  rows["interleaved"]["bubble_frac"],
+                  rows["1f1b"]["bubble_frac"])
+    return {
+        "config": cfg["name"], "kind": "pipeline_schedule",
+        "platform": platform, "n_devices": len(jax.devices()),
+        "num_stages": S, "num_micro": M, "vstages": V,
+        "activation_bytes": act_bytes,
+        "schedules": rows,
+        "all_proven": all(r["proof_ok"] for r in rows.values()),
+        "zero_bubble_beats_1f1b": bool(zb < f1),
+        "interleaved_beats_1f1b": bool(il < f1),
+        "bubble_reduction_vs_1f1b": {
+            "interleaved": round(1.0 - il / f1, 4) if f1 else None,
+            "zero-bubble": round(1.0 - zb / f1, 4) if f1 else None,
+        },
+    }
 
 
 def _worker_pipeline_mpmd(cfg: dict) -> dict:

@@ -37,16 +37,18 @@ from .rules_collectives import collective_rules
 from .rules_config import config_rules
 from .rules_hostsync import hostsync_rules
 from .rules_offload import offload_rules
+from .rules_pipeline import pipeline_rules
 from .rules_precision import precision_rules
 from .rules_serving import serving_rules
 from .rules_sharding import sharding_rules
+from .schedule import ScheduleIR, prove_schedule, schedule_report
 
 
 def default_rules() -> List[Rule]:
-    """The shipped rule set, all seven families."""
+    """The shipped rule set, all eight families."""
     return (sharding_rules() + precision_rules() + hostsync_rules()
             + collective_rules() + config_rules() + serving_rules()
-            + offload_rules())
+            + offload_rules() + pipeline_rules())
 
 
 def options_from_config(block) -> AnalysisOptions:
@@ -168,6 +170,21 @@ def analyze_compile_log(engine_or_log,
                     options=ctx.options).run([], ctx)
 
 
+def analyze_schedule(schedules,
+                     rules: Optional[Sequence[Rule]] = None) -> Report:
+    """Prove pipeline-schedule IR(s) (:class:`~.schedule.ScheduleIR`, or a
+    list of them) through the analyzer: per-channel send/recv pairing,
+    deadlock-freedom, weight-version consistency (``pipe/*`` rules —
+    docs/STATIC_ANALYSIS.md "Pipeline schedules"). Pure host analysis: no
+    tracing, no device work."""
+    ctx = AnalysisContext(schedules=schedules)
+    report = Analyzer(rules=rules or pipeline_rules(),
+                      options=ctx.options).run([], ctx)
+    irs = schedules if isinstance(schedules, (list, tuple)) else [schedules]
+    report.programs = [ir.name for ir in irs]
+    return report
+
+
 def analyze_fn(fn: Callable, *args, name: str = "program",
                donate_argnums: Sequence[int] = (), compile: bool = False,
                config: Any = None, mesh: Any = None,
@@ -194,5 +211,7 @@ __all__ = [
     "Severity", "Finding", "Rule", "Report", "Analyzer", "AnalysisContext",
     "AnalysisOptions", "AnalysisError", "ProgramIR", "capture",
     "default_rules", "options_from_config", "analyze_engine", "analyze_fn",
-    "analyze_compile_log", "synthesize_batch", "offload_rules",
+    "analyze_compile_log", "analyze_schedule", "synthesize_batch",
+    "offload_rules", "pipeline_rules", "ScheduleIR", "prove_schedule",
+    "schedule_report",
 ]

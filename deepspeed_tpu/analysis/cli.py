@@ -18,6 +18,7 @@ import dataclasses
 import importlib.util
 import json
 import os
+import re
 import sys
 from typing import Any, Dict, List, Optional
 
@@ -50,6 +51,84 @@ def load_bench_rows() -> List[Dict[str, Any]]:
             if row.get("kind") == "train" and "model" in row:
                 rows.append(row)
     return rows
+
+
+def _doc_anchors() -> Dict[str, str]:
+    """rule_id -> GitHub-style anchor into docs/STATIC_ANALYSIS.md, parsed
+    from the actual headings so the links cannot drift from the doc."""
+    path = os.path.join(_repo_root(), "docs", "STATIC_ANALYSIS.md")
+    anchors: Dict[str, str] = {}
+    try:
+        lines = open(path, encoding="utf-8").read().splitlines()
+    except OSError:
+        return anchors
+    for ln in lines:
+        if not ln.startswith("#"):
+            continue
+        text = ln.lstrip("#").strip().replace("`", "")
+        slug = re.sub(r"[^\w\- ]", "", text.lower()).strip().replace(" ", "-")
+        for rid in re.findall(r"`([a-z0-9_\-]+/[a-z0-9_\-]+)`", ln):
+            anchors.setdefault(rid, f"docs/STATIC_ANALYSIS.md#{slug}")
+    return anchors
+
+
+def rule_registry() -> List[Dict[str, Any]]:
+    """Machine-readable registry of the shipped rule set: per-rule family,
+    severity, description, and doc anchor (``--list --json``)."""
+    from . import default_rules
+
+    anchors = _doc_anchors()
+    return [{
+        "rule_id": r.rule_id,
+        "family": r.rule_id.split("/", 1)[0],
+        "severity": r.default_severity.name,
+        "description": r.description,
+        "doc_anchor": anchors.get(r.rule_id),
+    } for r in default_rules()]
+
+
+#: the (micro, stages, vstages) matrix the --schedules gate proves — the
+#: 8-stage row is the MULTICHIP_r05.json mesh shape
+SCHEDULE_MATRIX = [(4, 2, 2), (8, 4, 2), (16, 8, 2)]
+
+
+def run_schedules(as_json: bool, fail_on: str) -> int:
+    """Prove the shipped schedule generators (1F1B / interleaved /
+    zero-bubble) over :data:`SCHEDULE_MATRIX` through the ``pipe/*`` rules
+    and report static bubble %% per schedule. Pure host math; the CI
+    pipeline gate runs this."""
+    from . import analyze_schedule
+    from .schedule import schedule_report
+    from ..runtime.pipe.mpmd import (generate_1f1b_ir,
+                                     generate_interleaved_ir,
+                                     generate_zero_bubble_ir)
+
+    had_error = False
+    out = []
+    for m, s, v in SCHEDULE_MATRIX:
+        irs = [generate_1f1b_ir(m, s), generate_interleaved_ir(m, s, v),
+               generate_zero_bubble_ir(m, s)]
+        report = analyze_schedule(irs)
+        had_error |= bool(report.errors())
+        entry = {"num_micro": m, "num_stages": s,
+                 "n_errors": len(report.errors()),
+                 "schedules": [schedule_report(ir) for ir in irs]}
+        out.append(entry)
+        if not as_json:
+            print(f"== m={m} s={s}: {len(report.errors())} error(s)")
+            for rep in entry["schedules"]:
+                bubble = rep["bubble"]
+                frac = (f"{bubble['bubble_frac']:.4f}"
+                        if bubble is not None else "n/a")
+                print(f"  {rep['schedule']:<28} proof="
+                      f"{'ok' if rep['ok'] else 'REJECTED'} "
+                      f"bubble={frac} "
+                      f"peak_buffers={rep['peak_activation_buffers']}")
+            for f in report.findings:
+                print(f.render())
+    if as_json:
+        print(json.dumps(out, indent=2))
+    return 2 if (had_error and fail_on == "error") else 0
 
 
 def _row_to_ds_config(row: Dict[str, Any]) -> Dict[str, Any]:
@@ -183,7 +262,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         "target", nargs="?", default=DEFAULT_BENCH,
         help=f"bench.py train-config name (default: {DEFAULT_BENCH})")
     parser.add_argument("--list", action="store_true",
-                        help="list analyzable bench configs and exit")
+                        help="list analyzable bench configs (and, with "
+                             "--json, the full rule registry) and exit")
+    parser.add_argument("--schedules", action="store_true",
+                        help="prove the shipped pipeline-schedule "
+                             "generators (1F1B/interleaved/zero-bubble) "
+                             "and report static bubble %% (pipe/* rules)")
     parser.add_argument("--all", action="store_true",
                         help="sweep every bench train config")
     parser.add_argument("--compile", action="store_true",
@@ -198,12 +282,27 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help="exit 2 on ERROR findings (default) or never")
     args = parser.parse_args(argv)
 
+    if args.schedules:
+        return run_schedules(args.as_json, args.fail_on)
+
     rows = load_bench_rows()
     by_name = {r["name"]: r for r in rows}
     if args.list:
+        if args.as_json:
+            print(json.dumps({
+                "rules": rule_registry(),
+                "configs": [{"name": r["name"], "model": r["model"],
+                             "stage": r.get("stage", 0),
+                             "micro_bs": r["micro_bs"]} for r in rows],
+            }, indent=2))
+            return 0
         for r in rows:
             print(f"{r['name']:<32} model={r['model']} "
                   f"stage={r.get('stage', 0)} micro_bs={r['micro_bs']}")
+        print()
+        for r in rule_registry():
+            print(f"{r['rule_id']:<36} [{r['severity']:<7}] "
+                  f"{r['description']}")
         return 0
 
     targets = rows if args.all else [by_name.get(args.target)]

@@ -142,6 +142,10 @@ class AnalysisContext:
     # ({"kind","shape","time"} dicts); rules_serving audits it. When None,
     # rules fall back to ctx.engine.compile_log if the engine exposes one.
     compile_log: Any = None
+    # pipeline-schedule IR(s) (analysis.schedule.ScheduleIR, or a list) for
+    # the pipe/* prover rules. When None, rules fall back to
+    # ctx.engine.schedule_ir if the engine exposes one.
+    schedules: Any = None
 
     @property
     def n_devices(self) -> int:

@@ -1,0 +1,108 @@
+"""Pipeline-schedule rules: the prover's proofs as dslint registrations.
+
+The proofs themselves live in :mod:`.schedule` (pairing, deadlock-freedom,
+weight-version consistency over the schedule IR); these rules bind them to
+the analyzer so a schedule travels through the same reporting/gating
+machinery as every other compile-only check — ``engine.analyze()`` on an
+MPMD engine proves the schedule it is about to run, and the CLI's
+``--schedules`` mode gates CI on the shipped generators
+(``docs/STATIC_ANALYSIS.md`` "Pipeline schedules").
+
+Rules read ``ctx.schedules`` (a :class:`~.schedule.ScheduleIR` or list of
+them) and fall back to ``ctx.engine.schedule_ir`` when analyzing a live
+pipeline engine.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from .core import AnalysisContext, Finding, Rule, Severity
+from .schedule import (
+    RULE_DEADLOCK,
+    RULE_PAIRING,
+    RULE_STALE_WEIGHT,
+    ScheduleIR,
+    check_channel_pairing,
+    check_deadlock_free,
+    check_weight_versions,
+)
+
+
+def _context_schedules(ctx: AnalysisContext) -> List[ScheduleIR]:
+    """Schedule IRs bound to this analysis: ``ctx.schedules`` first, else a
+    pipeline engine's own proof obligation."""
+    sched = getattr(ctx, "schedules", None)
+    if sched is None and ctx.engine is not None:
+        sched = getattr(ctx.engine, "schedule_ir", None)
+    if sched is None:
+        return []
+    if isinstance(sched, ScheduleIR):
+        return [sched]
+    return [s for s in sched if isinstance(s, ScheduleIR)]
+
+
+class _ScheduleRule(Rule):
+    """Shared plumbing: run one proof pass over every bound schedule."""
+
+    _pass = staticmethod(lambda ir: ())
+
+    def check_context(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        for ir in _context_schedules(ctx):
+            for f in type(self)._pass(ir):
+                yield f
+
+
+class UnpairedSendRecvRule(_ScheduleRule):
+    """A schedule's per-channel send/recv streams do not pair in matching
+    order: a recv with no send (the stage blocks forever — the multihost
+    deadlock class), a send never consumed (a leaked in-flight buffer), or
+    k-th recv expecting a different (micro, vstage) payload than the k-th
+    send carries (FIFO channels deliver in order, so every later transfer on
+    that channel is silently off by one — gradients applied to the wrong
+    micro-batch). Subsumes PR 2's 1F1B-only ``validate_schedule_pairing``."""
+
+    rule_id = RULE_PAIRING
+    default_severity = Severity.ERROR
+    description = "schedule send/recv streams unpaired or out of order per channel"
+    _pass = staticmethod(check_channel_pairing)
+
+
+class ScheduleDeadlockRule(_ScheduleRule):
+    """The schedule's happens-before graph (per-stage program order ∪
+    send→recv channel edges) has a cycle: with asynchronous FIFO channels
+    only recvs block, so a cycle means every stage on it waits in a recv
+    whose send sits behind another blocked recv — the run hangs with no
+    error, burning the reservation. Acyclicity is the exact static criterion
+    for deadlock-freedom of this execution model."""
+
+    rule_id = RULE_DEADLOCK
+    default_severity = Severity.ERROR
+    description = "cyclic happens-before graph: the schedule deadlocks"
+    _pass = staticmethod(check_deadlock_free)
+
+
+class StaleWeightApplicationRule(_ScheduleRule):
+    """A backward-split (zero-bubble) schedule mis-sequences its weight
+    half: a ``W`` before its own micro-batch's ``B`` (applies a gradient
+    that has not been computed), a ``B`` with no ``W`` (silently drops that
+    micro-batch's weight gradient from the step), a duplicate ``W``
+    (double-applies it), or — under declared in-place updates — a forward
+    reading a half-updated weight. All four corrupt training silently; the
+    loss curve, not an exception, is where they would first show."""
+
+    rule_id = RULE_STALE_WEIGHT
+    default_severity = Severity.ERROR
+    description = "backward-split W mis-sequenced against its B / the forwards"
+    _pass = staticmethod(check_weight_versions)
+
+
+def pipeline_rules() -> List[Rule]:
+    return [UnpairedSendRecvRule(), ScheduleDeadlockRule(),
+            StaleWeightApplicationRule()]
+
+
+__all__ = [
+    "UnpairedSendRecvRule", "ScheduleDeadlockRule",
+    "StaleWeightApplicationRule", "pipeline_rules",
+]

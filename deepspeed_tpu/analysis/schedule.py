@@ -1,0 +1,541 @@
+"""Static pipeline-schedule prover: the IR and the four proofs.
+
+The MPMD interpreter (``runtime/pipe/mpmd.py``) executes per-stage
+instruction streams; PR 2's ``validate_schedule_pairing`` proved exactly one
+property (send/recv pairing) of exactly one schedule family (1F1B). This
+module generalizes that one-off check into a small schedule IR plus static
+passes, so aggressive schedules — interleaved virtual stages, zero-bubble
+B/W splits — ship with the same compile-only discipline dslint applies to
+sharding and precision: *proven before a single dispatch*.
+
+IR grammar (per physical stage, program order)::
+
+    F(micro, vstage)              run the forward of a micro-batch chunk
+    B(micro, vstage)              input-gradient backward (releases the
+                                  stage-input activation buffer)
+    W(micro, vstage)              weight-gradient application for the SAME
+                                  micro-batch's B (backward-split schedules
+                                  only; absent = B computes both halves)
+    SEND(peer, channel, micro, vstage)
+    RECV(peer, channel, micro, vstage)
+
+Channels are FIFO and asynchronous: a ``SEND`` never blocks, a ``RECV``
+blocks until the matching send has executed. Channel identity is
+``(src_stage, dst_stage, name)`` — the k-th send on a channel pairs with the
+k-th recv, which is exactly how the interpreter's per-(stage, micro) dict
+channels and a multihost p2p stream both behave.
+
+The four proofs (each emits :class:`~.core.Finding` s naming the exact
+instruction index + stage):
+
+1. **pairing** (``pipe/unpaired-send-recv``): every recv has a matching
+   send on its channel, every send is consumed, and the k-th recv's
+   ``(micro, vstage)`` tag equals the k-th send's — in-order, per channel.
+2. **deadlock-freedom** (``pipe/schedule-deadlock``): the happens-before
+   graph (program order ∪ send→recv channel edges) is acyclic. A cycle is
+   the static rendering of "rank A blocks in a recv whose send is behind a
+   recv blocked on rank A".
+3. **weight-version consistency** (``pipe/stale-weight-application``):
+   in backward-split schedules every ``W`` follows its own micro-batch's
+   ``B``, each ``B`` has exactly one ``W`` (no dropped or duplicate
+   gradient application), and — for schedules that declare
+   ``w_applies_update`` — no forward reads a half-updated weight.
+4. **buffer liveness** (:func:`schedule_liveness`): the max in-flight
+   activation buffers per stage (recv/load → released at ``B``) and the
+   W-backlog (``B`` → released at ``W``), feeding ``peak_bytes``-style
+   accounting so ``runtime/aot.py`` can price a schedule before compiling
+   it (:func:`~deepspeed_tpu.runtime.aot.pipeline_schedule_report`).
+
+:func:`static_bubble` prices the schedule's idle fraction from the same IR
+(earliest-start simulation over the happens-before graph), so every emitted
+schedule carries its theoretical bubble %% next to its proof.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .core import Finding, Severity
+
+# canonical rule ids (the dslint registrations live in rules_pipeline.py)
+RULE_PAIRING = "pipe/unpaired-send-recv"
+RULE_DEADLOCK = "pipe/schedule-deadlock"
+RULE_STALE_WEIGHT = "pipe/stale-weight-application"
+
+_OPS = ("F", "B", "W", "SEND", "RECV")
+
+
+@dataclasses.dataclass(frozen=True)
+class Instr:
+    """One schedule instruction. ``peer``/``channel`` are SEND/RECV-only."""
+
+    op: str
+    micro: int = -1
+    vstage: int = 0
+    peer: int = -1
+    channel: str = ""
+
+    def __post_init__(self):
+        if self.op not in _OPS:
+            raise ValueError(f"unknown schedule op {self.op!r}")
+
+    def __repr__(self):
+        if self.op in ("SEND", "RECV"):
+            arrow = "->" if self.op == "SEND" else "<-"
+            return (f"{self.op}({self.channel}{arrow}{self.peer}, "
+                    f"m{self.micro}, v{self.vstage})")
+        return f"{self.op}(m{self.micro}, v{self.vstage})"
+
+
+def F(micro: int, vstage: int = 0) -> Instr:  # noqa: N802 — IR constructors
+    return Instr("F", micro=micro, vstage=vstage)
+
+
+def B(micro: int, vstage: int = 0) -> Instr:  # noqa: N802
+    return Instr("B", micro=micro, vstage=vstage)
+
+
+def W(micro: int, vstage: int = 0) -> Instr:  # noqa: N802
+    return Instr("W", micro=micro, vstage=vstage)
+
+
+def SEND(peer: int, channel: str, micro: int, vstage: int = 0) -> Instr:  # noqa: N802
+    return Instr("SEND", micro=micro, vstage=vstage, peer=peer, channel=channel)
+
+
+def RECV(peer: int, channel: str, micro: int, vstage: int = 0) -> Instr:  # noqa: N802
+    return Instr("RECV", micro=micro, vstage=vstage, peer=peer, channel=channel)
+
+
+@dataclasses.dataclass
+class ScheduleIR:
+    """Per-stage instruction streams plus the step's shape.
+
+    ``w_applies_update``: the schedule's ``W`` mutates the live weights (an
+    asynchronous-update pipeline) rather than accumulating into the step's
+    gradient (the shipped zero-bubble semantics, applied at the implicit
+    optimizer step after the last instruction).
+    """
+
+    name: str
+    num_stages: int
+    num_micro: int
+    stages: List[List[Instr]]
+    num_vstages: int = 1
+    w_applies_update: bool = False
+
+    def __post_init__(self):
+        if len(self.stages) != self.num_stages:
+            raise ValueError(
+                f"{self.name}: {len(self.stages)} streams for "
+                f"{self.num_stages} stages")
+
+    def loc(self, s: int, i: int) -> str:
+        """The canonical finding location: schedule, stage, instruction
+        index, and the instruction itself."""
+        return f"{self.name}: stage {s}, instr {i}: {self.stages[s][i]!r}"
+
+    def instructions(self):
+        for s, stream in enumerate(self.stages):
+            for i, instr in enumerate(stream):
+                yield s, i, instr
+
+    @property
+    def has_w(self) -> bool:
+        return any(ins.op == "W" for _, _, ins in self.instructions())
+
+
+def _finding(rule_id: str, message: str, location: str,
+             suggestion: str = "") -> Finding:
+    return Finding(rule_id=rule_id, severity=Severity.ERROR,
+                   location=location, message=message, suggestion=suggestion)
+
+
+# ------------------------------------------------------------------ pairing
+def _channels(ir: ScheduleIR) -> Dict[Tuple[int, int, str],
+                                      Tuple[List[Tuple[int, int]],
+                                            List[Tuple[int, int]]]]:
+    """channel key (src, dst, name) -> (sends, recvs) as (stage, idx) lists
+    in program order."""
+    chans: Dict[Tuple[int, int, str], Tuple[list, list]] = {}
+    for s, i, ins in ir.instructions():
+        if ins.op == "SEND":
+            key = (s, ins.peer, ins.channel)
+            chans.setdefault(key, ([], []))[0].append((s, i))
+        elif ins.op == "RECV":
+            key = (ins.peer, s, ins.channel)
+            chans.setdefault(key, ([], []))[1].append((s, i))
+    return chans
+
+
+def check_channel_pairing(ir: ScheduleIR) -> List[Finding]:
+    """Proof 1: per-channel FIFO send/recv pairing in matching order."""
+    findings: List[Finding] = []
+    for (src, dst, name), (sends, recvs) in sorted(_channels(ir).items()):
+        chan = f"channel {name}[{src}->{dst}]"
+        for k in range(min(len(sends), len(recvs))):
+            ss, si = sends[k]
+            rs, ri = recvs[k]
+            stag = ir.stages[ss][si]
+            rtag = ir.stages[rs][ri]
+            if (stag.micro, stag.vstage) != (rtag.micro, rtag.vstage):
+                findings.append(_finding(
+                    RULE_PAIRING,
+                    f"{chan}: recv #{k} expects (m{rtag.micro}, "
+                    f"v{rtag.vstage}) but the in-order send #{k} (stage "
+                    f"{ss}, instr {si}) carries (m{stag.micro}, "
+                    f"v{stag.vstage}) — the channel is FIFO, so every later "
+                    f"transfer on it is off by one payload",
+                    ir.loc(rs, ri),
+                    suggestion="reorder the sends (or recvs) so the k-th "
+                               "send's payload is the k-th recv's"))
+        for ss, si in sends[len(recvs):]:
+            findings.append(_finding(
+                RULE_PAIRING,
+                f"{chan}: send has no matching recv — the payload is "
+                f"orphaned in the channel (a real p2p stream leaks the "
+                f"buffer; a rendezvous send blocks forever)",
+                ir.loc(ss, si),
+                suggestion="add the consuming RECV on stage "
+                           f"{dst}, or drop the send"))
+        for rs, ri in recvs[len(sends):]:
+            findings.append(_finding(
+                RULE_PAIRING,
+                f"{chan}: recv has no matching send — the stage blocks "
+                f"forever on a transfer no stage ever issues (the multihost "
+                f"deadlock class)",
+                ir.loc(rs, ri),
+                suggestion=f"add the producing SEND on stage {src}, or drop "
+                           "the recv"))
+    return findings
+
+
+# ----------------------------------------------------------------- deadlock
+def _message_edges(ir: ScheduleIR) -> List[Tuple[Tuple[int, int],
+                                                 Tuple[int, int]]]:
+    """Matched send -> recv edges (FIFO pairing; unmatched tails ignored —
+    pairing reports those)."""
+    edges = []
+    for (_, _, _), (sends, recvs) in _channels(ir).items():
+        edges.extend(zip(sends, recvs))
+    return edges
+
+
+def check_deadlock_free(ir: ScheduleIR) -> List[Finding]:
+    """Proof 2: acyclicity of program order ∪ channel edges.
+
+    With asynchronous FIFO channels only recvs block, so the schedule is
+    deadlock-free iff the happens-before graph has no cycle. On a cycle,
+    every stage on it is blocked in a recv whose send sits (transitively)
+    behind another blocked recv.
+    """
+    n_per = [len(st) for st in ir.stages]
+    node = lambda s, i: (s, i)  # noqa: E731
+    succ: Dict[Tuple[int, int], List[Tuple[int, int]]] = {}
+    indeg: Dict[Tuple[int, int], int] = {
+        node(s, i): 0 for s in range(ir.num_stages) for i in range(n_per[s])}
+
+    def add_edge(a, b):
+        succ.setdefault(a, []).append(b)
+        indeg[b] += 1
+
+    for s in range(ir.num_stages):
+        for i in range(n_per[s] - 1):
+            add_edge(node(s, i), node(s, i + 1))
+    for a, b in _message_edges(ir):
+        add_edge(a, b)
+
+    # Kahn: what survives is the union of cycles (plus their downstream)
+    from collections import deque
+
+    q = deque(n for n, d in indeg.items() if d == 0)
+    seen = 0
+    deg = dict(indeg)
+    while q:
+        n = q.popleft()
+        seen += 1
+        for m in succ.get(n, ()):
+            deg[m] -= 1
+            if deg[m] == 0:
+                q.append(m)
+    if seen == len(indeg):
+        return []
+
+    # extract one concrete cycle to name in the finding
+    blocked = {n for n, d in deg.items() if d > 0}
+    start = min(blocked)
+    cycle = [start]
+    seen_at: Dict[Tuple[int, int], int] = {start: 0}
+    cur = start
+    while True:
+        nxt = None
+        # walk backwards along a blocking predecessor still in the cycle set
+        preds = [a for a in blocked
+                 if cur in succ.get(a, ())]
+        nxt = preds[0]
+        if nxt in seen_at:
+            cycle = cycle[seen_at[nxt]:]
+            break
+        seen_at[nxt] = len(cycle)
+        cycle.append(nxt)
+        cur = nxt
+    cycle = list(reversed(cycle))
+    first_recv = next(
+        ((s, i) for (s, i) in cycle if ir.stages[s][i].op == "RECV"),
+        cycle[0])
+    path = " -> ".join(f"stage {s}[{i}]:{ir.stages[s][i]!r}"
+                       for s, i in cycle)
+    return [_finding(
+        RULE_DEADLOCK,
+        f"happens-before cycle: {path} — every stage on the cycle blocks in "
+        f"a recv whose send can never execute",
+        ir.loc(*first_recv),
+        suggestion="break the cycle: move one of the cycle's sends ahead of "
+                   "the recv that precedes it in stage program order")]
+
+
+def _topo_order(ir: ScheduleIR) -> Optional[List[Tuple[int, int]]]:
+    """A topological linearization of the happens-before graph, or None when
+    cyclic (deadlock pass reports that)."""
+    from collections import deque
+
+    succ: Dict[Tuple[int, int], List[Tuple[int, int]]] = {}
+    indeg: Dict[Tuple[int, int], int] = {
+        (s, i): 0 for s in range(ir.num_stages)
+        for i in range(len(ir.stages[s]))}
+    for s in range(ir.num_stages):
+        for i in range(len(ir.stages[s]) - 1):
+            succ.setdefault((s, i), []).append((s, i + 1))
+            indeg[(s, i + 1)] += 1
+    for a, b in _message_edges(ir):
+        succ.setdefault(a, []).append(b)
+        indeg[b] += 1
+    q = deque(sorted(n for n, d in indeg.items() if d == 0))
+    order = []
+    while q:
+        n = q.popleft()
+        order.append(n)
+        for m in succ.get(n, ()):
+            indeg[m] -= 1
+            if indeg[m] == 0:
+                q.append(m)
+    return order if len(order) == len(indeg) else None
+
+
+# ----------------------------------------------------------- weight version
+def check_weight_versions(ir: ScheduleIR) -> List[Finding]:
+    """Proof 3: weight-version consistency for backward-split schedules."""
+    findings: List[Finding] = []
+    # (stage, vstage) -> micro -> program index of B / W / F
+    b_at: Dict[Tuple[int, int], Dict[int, int]] = {}
+    w_at: Dict[Tuple[int, int], Dict[int, List[int]]] = {}
+    f_at: Dict[Tuple[int, int], Dict[int, int]] = {}
+    for s, i, ins in ir.instructions():
+        key = (s, ins.vstage)
+        if ins.op == "B":
+            b_at.setdefault(key, {})[ins.micro] = i
+        elif ins.op == "W":
+            w_at.setdefault(key, {}).setdefault(ins.micro, []).append(i)
+        elif ins.op == "F":
+            f_at.setdefault(key, {})[ins.micro] = i
+
+    for (s, vs), micros in sorted(w_at.items()):
+        for m, idxs in sorted(micros.items()):
+            b_idx = b_at.get((s, vs), {}).get(m)
+            for i in idxs[1:]:
+                findings.append(_finding(
+                    RULE_STALE_WEIGHT,
+                    f"duplicate W for micro {m} (vstage {vs}) — its gradient "
+                    f"would be applied twice",
+                    ir.loc(s, i),
+                    suggestion="emit exactly one W per (micro, vstage)"))
+            i = idxs[0]
+            if b_idx is None:
+                findings.append(_finding(
+                    RULE_STALE_WEIGHT,
+                    f"W for micro {m} (vstage {vs}) has no B on this stage — "
+                    f"there is no gradient for it to apply",
+                    ir.loc(s, i),
+                    suggestion="schedule the matching B, or drop the W"))
+            elif i < b_idx:
+                findings.append(_finding(
+                    RULE_STALE_WEIGHT,
+                    f"W for micro {m} (vstage {vs}) at instr {i} precedes "
+                    f"its own B at instr {b_idx} — it would apply a gradient "
+                    f"that has not been computed (a stale or garbage weight "
+                    f"delta)",
+                    ir.loc(s, i),
+                    suggestion="move the W after its micro-batch's B"))
+    # every B in a split schedule must have its W (dropped application)
+    for (s, vs), micros in sorted(b_at.items()):
+        if (s, vs) not in w_at:
+            continue  # this (stage, vstage) never splits — combined B
+        for m, b_idx in sorted(micros.items()):
+            if m not in w_at[(s, vs)]:
+                findings.append(_finding(
+                    RULE_STALE_WEIGHT,
+                    f"B for micro {m} (vstage {vs}) has no matching W — its "
+                    f"weight gradient is silently dropped from the step",
+                    ir.loc(s, b_idx),
+                    suggestion="schedule the matching W before the optimizer "
+                               "step"))
+    if ir.w_applies_update and ir.has_w:
+        # forwards must all read version 0: no W may happen-before an F of
+        # the same (stage, vstage) — program order is the conservative check
+        for (s, vs), micros in sorted(f_at.items()):
+            w_idxs = [i for m, idxs in w_at.get((s, vs), {}).items()
+                      for i in idxs]
+            if not w_idxs:
+                continue
+            first_w = min(w_idxs)
+            for m, f_idx in sorted(micros.items()):
+                if f_idx > first_w:
+                    findings.append(_finding(
+                        RULE_STALE_WEIGHT,
+                        f"forward of micro {m} (vstage {vs}) at instr "
+                        f"{f_idx} runs after a weight update (W at instr "
+                        f"{first_w}) — micro-batches within the step read "
+                        f"different weight versions",
+                        ir.loc(s, f_idx),
+                        suggestion="accumulate W gradients and apply at the "
+                                   "step boundary (w_applies_update=False), "
+                                   "or schedule all forwards first"))
+    return findings
+
+
+# ------------------------------------------------------------------ liveness
+def schedule_liveness(ir: ScheduleIR) -> Optional[List[Dict[str, int]]]:
+    """Proof 4 (accounting): per-stage peak in-flight buffers.
+
+    An activation buffer is live from the ``F`` that saves its stage input
+    until the ``B`` that consumes it (the interpreter's recompute
+    discipline: a "buffer" is one stage-input activation, measured at
+    ``ForwardPass`` — :attr:`MPMDPipelineEngine.peak_live_buffers`; every
+    ``RECV`` in the shipped IRs immediately precedes its ``F``, so the
+    recv-to-forward window adds nothing). In split schedules ``B``
+    additionally stashes the weight-gradient context until its ``W`` runs
+    (the W backlog). Returns None when the schedule is cyclic (the deadlock
+    proof owns that failure).
+    """
+    order = _topo_order(ir)
+    if order is None:
+        return None
+    held: List[set] = [set() for _ in range(ir.num_stages)]
+    wback: List[int] = [0] * ir.num_stages
+    out = [{"peak_activations": 0, "peak_w_backlog": 0}
+           for _ in range(ir.num_stages)]
+    for s, i in order:
+        ins = ir.stages[s][i]
+        if ins.op == "F":
+            held[s].add((ins.micro, ins.vstage))
+        elif ins.op == "B":
+            held[s].discard((ins.micro, ins.vstage))
+            wback[s] += 1
+            out[s]["peak_w_backlog"] = max(out[s]["peak_w_backlog"], wback[s])
+        elif ins.op == "W":
+            wback[s] -= 1
+        out[s]["peak_activations"] = max(out[s]["peak_activations"],
+                                         len(held[s]))
+    for s in range(ir.num_stages):
+        if not any(ins.op == "W" for ins in ir.stages[s]):
+            out[s]["peak_w_backlog"] = 0
+    return out
+
+
+# -------------------------------------------------------------------- bubble
+def static_bubble(ir: ScheduleIR, t_f: float = 1.0,
+                  t_b: Optional[float] = None, t_w: Optional[float] = None,
+                  t_comm: float = 0.0) -> Optional[Dict[str, object]]:
+    """Theoretical bubble fraction from an earliest-start simulation.
+
+    Cost model: each ``F`` costs ``t_f``, ``B`` costs ``t_b`` (default
+    ``2*t_f`` for combined-backward schedules, ``t_f`` for split ones so
+    ``t_b + t_w == 2*t_f`` and totals stay comparable), ``W`` costs ``t_w``
+    (default ``t_f``); all scaled by ``1/num_vstages`` (a chunk is 1/V of
+    the stage's layers). SEND/RECV are free plus ``t_comm`` of channel
+    latency on the edge. Bubble = idle fraction of the makespan across
+    stages — the quantity the generators compete on. None when cyclic.
+    """
+    order = _topo_order(ir)
+    if order is None:
+        return None
+    scale = 1.0 / max(1, ir.num_vstages)
+    tb = (t_b if t_b is not None else (t_f if ir.has_w else 2.0 * t_f))
+    tw = t_w if t_w is not None else t_f
+    cost = {"F": t_f * scale, "B": tb * scale, "W": tw * scale,
+            "SEND": 0.0, "RECV": 0.0}
+    recv_ready: Dict[Tuple[int, int], float] = {}
+    end: Dict[Tuple[int, int], float] = {}
+    send_to_recv = dict(_message_edges(ir))
+    stage_clock = [0.0] * ir.num_stages
+    busy = [0.0] * ir.num_stages
+    for s, i in order:
+        ins = ir.stages[s][i]
+        start = stage_clock[s]
+        if ins.op == "RECV":
+            start = max(start, recv_ready.get((s, i), 0.0))
+        t_end = start + cost[ins.op]
+        busy[s] += cost[ins.op]
+        end[(s, i)] = t_end
+        stage_clock[s] = t_end
+        if ins.op == "SEND" and (s, i) in send_to_recv:
+            dst = send_to_recv[(s, i)]
+            recv_ready[dst] = t_end + t_comm
+    makespan = max(stage_clock) if any(stage_clock) else 0.0
+    if makespan <= 0:
+        return {"makespan": 0.0, "bubble_frac": 0.0, "per_stage_bubble": [],
+                "per_stage_busy": []}
+    per_stage = [1.0 - b / makespan for b in busy]
+    return {
+        "makespan": makespan,
+        "bubble_frac": 1.0 - sum(busy) / (ir.num_stages * makespan),
+        "per_stage_bubble": per_stage,
+        "per_stage_busy": busy,
+        "cost_model": {"t_f": t_f, "t_b": tb, "t_w": tw if ir.has_w else None,
+                       "t_comm": t_comm, "vstage_scale": scale},
+    }
+
+
+# -------------------------------------------------------------------- prover
+def prove_schedule(ir: ScheduleIR) -> List[Finding]:
+    """Run the three refusal proofs (pairing, deadlock, weight-version).
+
+    Returns the combined findings, empty = the schedule is safe to dispatch.
+    Liveness/bubble are accounting, not refusals — see
+    :func:`schedule_report`.
+    """
+    findings = check_channel_pairing(ir)
+    findings += check_deadlock_free(ir)
+    findings += check_weight_versions(ir)
+    return findings
+
+
+def schedule_report(ir: ScheduleIR, t_f: float = 1.0,
+                    t_b: Optional[float] = None, t_w: Optional[float] = None,
+                    t_comm: float = 0.0) -> Dict[str, object]:
+    """Proofs + accounting in one dict (the bench/CLI rendering)."""
+    findings = prove_schedule(ir)
+    live = schedule_liveness(ir)
+    bubble = static_bubble(ir, t_f=t_f, t_b=t_b, t_w=t_w, t_comm=t_comm)
+    return {
+        "schedule": ir.name,
+        "num_stages": ir.num_stages,
+        "num_micro": ir.num_micro,
+        "num_vstages": ir.num_vstages,
+        "split_backward": ir.has_w,
+        "ok": not findings,
+        "findings": [f.to_dict() for f in findings],
+        "liveness": live,
+        "peak_activation_buffers": (
+            [d["peak_activations"] for d in live] if live else None),
+        "bubble": bubble,
+    }
+
+
+__all__ = [
+    "Instr", "ScheduleIR", "F", "B", "W", "SEND", "RECV",
+    "check_channel_pairing", "check_deadlock_free", "check_weight_versions",
+    "schedule_liveness", "static_bubble", "prove_schedule", "schedule_report",
+    "RULE_PAIRING", "RULE_DEADLOCK", "RULE_STALE_WEIGHT",
+]

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 
 __all__ = ["fused_train_step", "report_from_compiled", "oom_row",
            "train_program_report", "peak_flops_per_chip", "fit_verdict",
-           "infinity_program_report"]
+           "infinity_program_report", "pipeline_schedule_report"]
 
 # usable HBM on the target chip (v5e: 16 GB - runtime reserved)
 HBM_BYTES = float(os.environ.get("DS_TPU_HBM_BYTES", 15.75e9))
@@ -1053,4 +1053,56 @@ def sd_program_report(
     if flops:
         rep_fields["flops_per_image"] = round(flops / max(batch, 1))
     out.update(rep_fields)
+    return out
+
+
+def pipeline_schedule_report(schedule_ir, activation_bytes: int,
+                             stage_param_bytes: int = 0,
+                             hbm_bytes: float = None,
+                             t_f: float = 1.0, t_b: float = None,
+                             t_w: float = None,
+                             t_comm: float = 0.0) -> Dict[str, Any]:
+    """Price a pipeline schedule before compiling it, let alone running it.
+
+    Joins the schedule prover's buffer-liveness bound
+    (:func:`deepspeed_tpu.analysis.schedule.schedule_liveness`) to the AOT
+    fit machinery: each stage's peak in-flight activation buffers ×
+    ``activation_bytes`` (one stage-input activation — the 1F1B recompute
+    discipline's unit of residency) + ``stage_param_bytes`` (params, grads,
+    optimizer state for the stage, if the caller wants them priced) gives
+    the schedule-dependent peak, classified by :func:`fit_verdict` exactly
+    like a compiled program's ``peak_bytes``. The proof result and the
+    static bubble fraction ride along, so a schedule sweep reads like a
+    bench table: proof, bubble %%, fit — all host-side, zero device time.
+    """
+    from ..analysis.schedule import (prove_schedule, schedule_liveness,
+                                     static_bubble)
+
+    findings = prove_schedule(schedule_ir)
+    live = schedule_liveness(schedule_ir)
+    bubble = static_bubble(schedule_ir, t_f=t_f, t_b=t_b, t_w=t_w,
+                           t_comm=t_comm)
+    out: Dict[str, Any] = {
+        "schedule": schedule_ir.name,
+        "num_stages": schedule_ir.num_stages,
+        "num_micro": schedule_ir.num_micro,
+        "num_vstages": schedule_ir.num_vstages,
+        "split_backward": schedule_ir.has_w,
+        "proof_ok": not findings,
+        "findings": [f.to_dict() for f in findings],
+        "activation_bytes": int(activation_bytes),
+        "bubble_frac": (round(bubble["bubble_frac"], 6)
+                        if bubble is not None else None),
+        "makespan": bubble["makespan"] if bubble is not None else None,
+    }
+    if live is None:  # cyclic: no valid execution to account
+        out["peak_schedule_bytes"] = None
+        return out
+    peaks = [d["peak_activations"] for d in live]
+    per_stage_bytes = [stage_param_bytes + p * int(activation_bytes)
+                       for p in peaks]
+    out["peak_activation_buffers"] = peaks
+    out["peak_w_backlog"] = [d["peak_w_backlog"] for d in live]
+    out["peak_schedule_bytes"] = max(per_stage_bytes)
+    out.update(fit_verdict(out["peak_schedule_bytes"], hbm_bytes=hbm_bytes))
     return out

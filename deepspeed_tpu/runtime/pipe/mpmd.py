@@ -58,47 +58,273 @@ from .schedule import (
 )
 
 
+def ir_from_train_schedule(num_micro: int, num_stages: int
+                           ) -> "ScheduleIR":
+    """Lower the executed 1F1B :class:`TrainSchedule` command streams to the
+    prover's IR (:mod:`deepspeed_tpu.analysis.schedule`).
+
+    This is the engine's proof obligation: what the prover blesses is a
+    faithful rendering of exactly the streams the interpreter runs. Within a
+    slot the interpreter executes every stage's sends (phase 1) before any
+    recv/compute (phase 2), so each slot flattens as sends, then recvs, then
+    compute; channels carry the interpreter's act/grad payloads tagged with
+    their micro-batch, which is what the FIFO pairing proof checks.
+    """
+    from ...analysis.schedule import RECV, SEND, B, F, Instr, ScheduleIR
+
+    stages: List[List[Instr]] = []
+    for s in range(num_stages):
+        prog: List[Instr] = []
+        for cmds in TrainSchedule(num_micro, num_stages, s).steps():
+            sends, recvs, compute = [], [], []
+            for cmd in cmds:
+                m = getattr(cmd, "micro_batch", -1)
+                if isinstance(cmd, SendActivation):
+                    sends.append(SEND(s + 1, "act", m))
+                elif isinstance(cmd, SendGrad):
+                    sends.append(SEND(s - 1, "grad", m))
+                elif isinstance(cmd, RecvActivation):
+                    recvs.append(RECV(s - 1, "act", m))
+                elif isinstance(cmd, RecvGrad):
+                    recvs.append(RECV(s + 1, "grad", m))
+                elif isinstance(cmd, ForwardPass):
+                    compute.append(F(m))
+                elif isinstance(cmd, BackwardPass):
+                    compute.append(B(m))
+                # LoadMicroBatch / ReduceGrads / ReduceTiedGrads /
+                # OptimizerStep are host-side step bookkeeping, not
+                # schedule-ordering instructions
+            prog.extend(sends + recvs + compute)
+        stages.append(prog)
+    return ScheduleIR(name=f"1f1b[m{num_micro},s{num_stages}]",
+                      num_stages=num_stages, num_micro=num_micro,
+                      stages=stages)
+
+
 def validate_schedule_pairing(num_micro: int, num_stages: int) -> List[str]:
-    """Statically prove the 1F1B command streams pair every recv with a send.
+    """Statically prove the 1F1B command streams are sound (PR 2 contract,
+    now a thin shim over the general schedule prover).
 
     The MPMD interpreter moves activations/grads through per-(stage, micro)
     channels; a schedule whose ``RecvActivation``/``RecvGrad`` fires before
     the matching ``Send`` has run is the single-process rendering of the
-    multihost deadlock class (rank A blocks in a recv no rank ever sends —
-    the same bug family ``deepspeed_tpu.analysis``'s collective-order rules
-    catch in shard_map bodies). Returns a list of violations (empty = sound);
-    the engine refuses to construct on a non-empty list rather than hanging
-    mid-batch.
+    multihost deadlock class. The prover additionally proves global
+    deadlock-freedom (acyclic happens-before graph) and weight-version
+    consistency. Returns a list of violations (empty = sound); the engine
+    refuses to construct on a non-empty list rather than hanging mid-batch.
     """
-    streams = [list(TrainSchedule(num_micro, num_stages, s).steps())
-               for s in range(num_stages)]
-    if len({len(st) for st in streams}) != 1:
-        return [f"stage streams disagree on slot count: "
-                f"{[len(st) for st in streams]}"]
-    problems: List[str] = []
-    acts, grads = set(), set()
-    for t in range(len(streams[0])):
-        # sends land first within a slot (the interpreter's phase 1)...
-        for s in range(num_stages):
-            for cmd in streams[s][t]:
-                if isinstance(cmd, SendActivation):
-                    acts.add((s + 1, cmd.micro_batch))
-                elif isinstance(cmd, SendGrad):
-                    grads.add((s - 1, cmd.micro_batch))
-        # ...then recvs/compute (phase 2) may consume them
-        for s in range(num_stages):
-            for cmd in streams[s][t]:
-                if isinstance(cmd, RecvActivation) and \
-                        (s, cmd.micro_batch) not in acts:
-                    problems.append(
-                        f"slot {t}: stage {s} receives activation for micro "
-                        f"{cmd.micro_batch} that no stage has sent")
-                elif isinstance(cmd, RecvGrad) and \
-                        (s, cmd.micro_batch) not in grads:
-                    problems.append(
-                        f"slot {t}: stage {s} receives grad for micro "
-                        f"{cmd.micro_batch} that no stage has sent")
-    return problems
+    from ...analysis.schedule import prove_schedule
+
+    return [f"{f.location}: {f.message}"
+            for f in prove_schedule(ir_from_train_schedule(num_micro,
+                                                           num_stages))]
+
+
+# --------------------------------------------------------------------------
+# schedule generators: the schedules the prover makes safe to ship
+# --------------------------------------------------------------------------
+def _list_schedule(num_micro: int, num_stages: int, num_vstages: int = 1,
+                   split_backward: bool = False, name: str = ""
+                   ) -> "ScheduleIR":
+    """Emit a schedule IR by greedy list-scheduling the micro-batch DAG.
+
+    Virtual stage ``v`` (0..V*S-1) lives on physical stage ``v % S``
+    (Megatron's interleaved layout). Dependencies: ``F(m, v)`` needs
+    ``F(m, v-1)``; ``B(m, v)`` needs ``F(m, v)`` and ``B(m, v+1)``;
+    ``W(m, v)`` needs ``B(m, v)``. Each stage runs one instruction at a
+    time, preferring B over F over W (B drains activation memory; W is the
+    zero-bubble filler that soaks up what would otherwise be idle slots).
+
+    F admission is capped *per virtual stage* at the 1F1B warmup depth
+    ``min(V*S - v, M)`` — for V=1 exactly the interpreter's
+    ``min(S - s, M)`` buffer bound, and per physical stage the caps sum to
+    Megatron's interleaved warmup depth. The cap must be per-chunk: a
+    per-physical-stage pool lets shallow-chunk forwards exhaust it and
+    starve the deepest chunk's F, which every backward transitively needs —
+    a scheduler-induced deadlock. Per-chunk, the last virtual stage's cap is
+    ``min(1, M)`` and its B (which B-priority runs next) releases it, so the
+    backward chain always originates. Correct by construction — only ready
+    work is scheduled — and independently re-proven by the caller.
+    """
+    import heapq
+
+    from ...analysis.schedule import RECV, SEND, Instr, ScheduleIR
+
+    M, S, V = num_micro, num_stages, num_vstages
+    VS = V * S
+    t_f = 1.0 / V
+    t_b = (1.0 if split_backward else 2.0) / V
+    t_w = 1.0 / V
+    dur = {"F": t_f, "B": t_b, "W": t_w}
+    pri = {"B": 0, "F": 1, "W": 2}
+    phys = lambda v: v % S  # noqa: E731
+
+    deps: Dict[Tuple[str, int, int], List[Tuple[str, int, int]]] = {}
+    for m in range(M):
+        for v in range(VS):
+            deps[("F", m, v)] = [("F", m, v - 1)] if v > 0 else []
+            deps[("B", m, v)] = [("F", m, v)] + (
+                [("B", m, v + 1)] if v < VS - 1 else [])
+            if split_backward:
+                deps[("W", m, v)] = [("B", m, v)]
+
+    capv = [min(VS - v, M) for v in range(VS)]
+    pending = set(deps)
+    completed: Dict[Tuple[str, int, int], float] = {}
+    prog: List[List[Instr]] = [[] for _ in range(S)]
+    stage_busy = [False] * S
+    inflight = [0] * VS
+    running: List[Tuple[float, int, int, Tuple[str, int, int]]] = []
+    seq = 0
+    t = 0.0
+
+    def emit_pre(s: int, kind: str, m: int, v: int) -> None:
+        if kind == "F" and v > 0 and phys(v - 1) != s:
+            prog[s].append(RECV(phys(v - 1), f"act.v{v - 1}", m,
+                                vstage=v - 1))
+        elif kind == "B" and v < VS - 1 and phys(v + 1) != s:
+            prog[s].append(RECV(phys(v + 1), f"grad.v{v + 1}", m,
+                                vstage=v + 1))
+
+    def emit_post(s: int, kind: str, m: int, v: int) -> None:
+        if kind == "F" and v < VS - 1 and phys(v + 1) != s:
+            prog[s].append(SEND(phys(v + 1), f"act.v{v}", m, vstage=v))
+        elif kind == "B" and v > 0 and phys(v - 1) != s:
+            prog[s].append(SEND(phys(v - 1), f"grad.v{v}", m, vstage=v))
+
+    while pending or running:
+        started = True
+        while started:
+            started = False
+            for s in range(S):
+                if stage_busy[s]:
+                    continue
+                ready = [
+                    it for it in pending
+                    if phys(it[2]) == s
+                    and all(d in completed for d in deps[it])
+                    and (it[0] != "F" or inflight[it[2]] < capv[it[2]])
+                ]
+                if not ready:
+                    continue
+                kind, m, v = min(ready,
+                                 key=lambda it: (pri[it[0]], it[1], it[2]))
+                pending.discard((kind, m, v))
+                emit_pre(s, kind, m, v)
+                prog[s].append(Instr(kind, micro=m, vstage=v))
+                if kind == "F":
+                    inflight[v] += 1
+                stage_busy[s] = True
+                seq += 1
+                heapq.heappush(running, (t + dur[kind], seq, s, (kind, m, v)))
+                started = True
+        if not running:
+            if pending:  # pragma: no cover — the DAG is always serviceable
+                raise RuntimeError(f"list scheduler stalled with "
+                                   f"{len(pending)} items pending")
+            break
+        t, _, s, item = heapq.heappop(running)
+        completed[item] = t
+        stage_busy[s] = False
+        kind, m, v = item
+        if kind == "B":
+            inflight[v] -= 1
+        emit_post(s, kind, m, v)
+
+    return ScheduleIR(name=name or f"list[m{M},s{S},v{V}]",
+                      num_stages=S, num_micro=M, stages=prog,
+                      num_vstages=V)
+
+
+def generate_1f1b_ir(num_micro: int, num_stages: int) -> "ScheduleIR":
+    """The executed 1F1B schedule, in prover IR (lowered from
+    :class:`TrainSchedule` — identical to what the interpreter runs)."""
+    return ir_from_train_schedule(num_micro, num_stages)
+
+
+def generate_interleaved_ir(num_micro: int, num_stages: int,
+                            num_vstages: int = 2) -> "ScheduleIR":
+    """Interleaved virtual stages (Megatron-style closed form): each
+    physical stage hosts ``num_vstages`` chunks (virtual stage ``v`` on
+    physical ``v % S``), shrinking the warmup/drain bubble to exactly
+    ``((S-1)/V) / (M + (S-1)/V)`` of the step — 1/V of 1F1B's — at the cost
+    of V× the p2p transfers and a deeper warmup residency. Proven, not yet
+    interpreted — the executable engine runs 1F1B; this IR prices and
+    proves the upgrade path.
+
+    Per-rank order is the canonical interleaved 1F1B: ``2*(S-s-1) +
+    (V-1)*S`` warmup chunk-forwards, then strict F/B alternation, with the
+    k-th virtual microbatch mapping to chunk ``(k %% (S*V)) // S`` (reversed
+    for backwards) and micro ``(k // (S*V))*S + k %% S`` — which is why
+    ``num_micro`` must divide evenly into groups of ``num_stages``.
+    """
+    M, S, V = num_micro, num_stages, num_vstages
+    if V < 2:
+        raise ValueError("interleaved schedule needs num_vstages >= 2")
+    if M % S != 0:
+        raise ValueError(
+            f"interleaved schedule needs num_micro ({M}) divisible by "
+            f"num_stages ({S}) — the chunk rotation covers micro-batches in "
+            f"groups of num_stages")
+    from ...analysis.schedule import RECV, SEND, Instr, ScheduleIR
+
+    VS = V * S
+    total = M * V
+    phys = lambda v: v % S  # noqa: E731
+
+    def f_item(k: int, s: int) -> Tuple[str, int, int]:
+        chunk = (k % (S * V)) // S
+        return ("F", (k // (S * V)) * S + (k % S), chunk * S + s)
+
+    def b_item(k: int, s: int) -> Tuple[str, int, int]:
+        chunk = V - 1 - ((k % (S * V)) // S)
+        return ("B", (k // (S * V)) * S + (k % S), chunk * S + s)
+
+    stages: List[List[Instr]] = []
+    for s in range(S):
+        warmup = min(2 * (S - s - 1) + (V - 1) * S, total)
+        order = [f_item(k, s) for k in range(warmup)]
+        fk, bk = warmup, 0
+        while fk < total:
+            order.append(f_item(fk, s))
+            fk += 1
+            order.append(b_item(bk, s))
+            bk += 1
+        while bk < total:
+            order.append(b_item(bk, s))
+            bk += 1
+        prog: List[Instr] = []
+        for kind, m, v in order:
+            if kind == "F" and v > 0 and phys(v - 1) != s:
+                prog.append(RECV(phys(v - 1), f"act.v{v - 1}", m,
+                                 vstage=v - 1))
+            elif kind == "B" and v < VS - 1 and phys(v + 1) != s:
+                prog.append(RECV(phys(v + 1), f"grad.v{v + 1}", m,
+                                 vstage=v + 1))
+            prog.append(Instr(kind, micro=m, vstage=v))
+            if kind == "F" and v < VS - 1 and phys(v + 1) != s:
+                prog.append(SEND(phys(v + 1), f"act.v{v}", m, vstage=v))
+            elif kind == "B" and v > 0 and phys(v - 1) != s:
+                prog.append(SEND(phys(v - 1), f"grad.v{v}", m, vstage=v))
+        stages.append(prog)
+    return ScheduleIR(name=f"interleaved[m{M},s{S},v{V}]",
+                      num_stages=S, num_micro=M, stages=stages,
+                      num_vstages=V)
+
+
+def generate_zero_bubble_ir(num_micro: int, num_stages: int
+                            ) -> "ScheduleIR":
+    """Zero-bubble (ZB-H1-style) schedule: backward split into ``B`` (input
+    gradient, on the critical path) and ``W`` (weight gradient, reorderable
+    filler). W's are deferred into what 1F1B leaves as drain bubbles, so the
+    pipeline's idle fraction drops at *equal* activation residency — the
+    scheduler caps in-flight forwards at the same 1F1B warmup depth. Every
+    W applies the gradient of its own micro-batch's B; the prover's
+    weight-version pass (``pipe/stale-weight-application``) holds the
+    generator to that."""
+    return _list_schedule(
+        num_micro, num_stages, split_backward=True,
+        name=f"zero-bubble[m{num_micro},s{num_stages}]")
 
 
 def _sgd(lr: float):
@@ -129,7 +355,8 @@ class MPMDPipelineEngine:
 
     def __init__(self, module: PipelineModule, num_micro: int,
                  devices: Optional[Sequence] = None, optimizer=None,
-                 loss_fn: Optional[Callable] = None, lr: float = 1e-3):
+                 loss_fn: Optional[Callable] = None, lr: float = 1e-3,
+                 schedule_ir=None):
         self.module = module
         self.S = module.num_stages
         self.M = int(num_micro)
@@ -145,11 +372,22 @@ class MPMDPipelineEngine:
         else:  # optax GradientTransformation
             self._opt_init, self._opt_update = optimizer.init, optimizer.update
 
-        problems = validate_schedule_pairing(self.M, self.S)
-        if problems:
+        # the proof obligation: the schedule the interpreter will run (or an
+        # explicit override under test/experiment), proven BEFORE any stage
+        # program is built or dispatched — the engine refuses a rejected
+        # schedule rather than hanging mid-batch
+        from ...analysis.schedule import prove_schedule
+
+        self.schedule_ir = (schedule_ir if schedule_ir is not None
+                            else ir_from_train_schedule(self.M, self.S))
+        findings = prove_schedule(self.schedule_ir)
+        if findings:
             raise ValueError(
-                "pipeline schedule fails send/recv pairing (would deadlock "
-                "a multi-process run):\n  " + "\n  ".join(problems))
+                f"pipeline schedule {self.schedule_ir.name!r} rejected by "
+                "the static prover (would deadlock or corrupt gradients in "
+                "a multi-process run):\n  "
+                + "\n  ".join(f"{f.rule_id}: {f.location}: {f.message}"
+                              for f in findings))
 
         self._stage_fns = [self._make_stage_fn(s) for s in range(self.S)]
         self._fwd_jit: List[Callable] = []

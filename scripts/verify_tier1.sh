@@ -46,6 +46,33 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 
+# --- pipeline-schedule gate (docs/STATIC_ANALYSIS.md "Pipeline schedules")
+# the schedule prover itself: pairing/deadlock/liveness/weight-version
+# proofs over the three generators, the four mutation counterexamples
+# (each rejected with the exact stage + instruction named), the engine's
+# refuse-before-build check, and the AOT pricing join.
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_schedule_prover.py -q -m 'not slow' \
+        -p no:cacheprovider -p no:randomly > /tmp/_t1_schedule.log 2>&1; then
+    echo "verify_tier1: FAIL — schedule prover tests" \
+         "(tests/test_schedule_prover.py):" >&2
+    tail -30 /tmp/_t1_schedule.log >&2
+    exit 1
+fi
+grep -aE '^[0-9]+ passed' /tmp/_t1_schedule.log || true
+
+# the dslint pipe/* gate: prove the shipped 1F1B/interleaved/zero-bubble
+# generators over the schedule matrix and report static bubble % — exits 2
+# if any generated schedule is rejected by its own prover.
+if ! timeout -k 10 120 env JAX_PLATFORMS=cpu \
+        python -m deepspeed_tpu.analysis --schedules \
+        > /tmp/_t1_schedules_cli.log 2>&1; then
+    echo "verify_tier1: FAIL — pipeline-schedule prover gate" \
+         "(python -m deepspeed_tpu.analysis --schedules):" >&2
+    tail -30 /tmp/_t1_schedules_cli.log >&2
+    exit 1
+fi
+
 # --- overlap gate (docs/COMM_COMPRESSION.md "Overlap & fusion") -----------
 # the pipelined quantized-gather scan, bucketed gradient exchange, overlap
 # ledger arithmetic, and the collective/unoverlapped-quantized-collective

@@ -405,3 +405,253 @@ def test_rollback_without_data_cursor_rule(tmp_path):
     # sentinel off -> nothing armed, nothing to flag
     off = cfg({"enabled": False})
     assert not list(rule.check_context(AnalysisContext(config=off)))
+
+
+# ----------------------------------------------- coverage gaps + meta-test
+def test_unaccounted_collective_fires_and_silent():
+    """Quantized collectives configured, yet the post-GSPMD HLO moves a
+    full-precision all-gather: fires with the op + bytes named. Silent when
+    the payload is int (that IS the quantized wire) and when no
+    quantization is configured."""
+    from deepspeed_tpu.analysis.core import AnalysisContext
+    from deepspeed_tpu.analysis.ir import ProgramIR
+    from deepspeed_tpu.analysis.rules_sharding import UnaccountedCollectiveRule
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    rule = UnaccountedCollectiveRule()
+    cjx = jax.make_jaxpr(lambda x: x)(1.0)
+
+    def prog(hlo):
+        return ProgramIR(name="p", closed_jaxpr=cjx, in_avals=[],
+                         out_avals=[], donated=[], hlo=hlo)
+
+    qcfg = DeepSpeedConfig.load({
+        "train_micro_batch_size_per_gpu": 1,
+        "zero_optimization": {"stage": 2, "zero_quantized_gradients": True}})
+    f32_ag = ("  %ag = f32[1048576]{0} all-gather(f32[131072]{0} %p0), "
+              "dimensions={0}\n")
+    hits = list(rule.check_program(prog(f32_ag),
+                                   AnalysisContext(config=qcfg)))
+    assert len(hits) == 1, hits
+    assert hits[0].rule_id == "sharding/unaccounted-collective"
+    assert "all-gather" in hits[0].message and "4.0 MB" in hits[0].message
+
+    # int payload: that IS the quantized wire -> silent
+    s8_ag = ("  %ag = s8[4194304]{0} all-gather(s8[524288]{0} %p0), "
+             "dimensions={0}\n")
+    assert not list(rule.check_program(prog(s8_ag),
+                                       AnalysisContext(config=qcfg)))
+    # no quantization configured -> nothing to cross-check -> silent
+    plain = DeepSpeedConfig.load({"train_micro_batch_size_per_gpu": 1})
+    assert not list(rule.check_program(prog(f32_ag),
+                                       AnalysisContext(config=plain)))
+
+
+def test_f64_present_fires_and_silent(devices):
+    def promoting(x):
+        return jnp.sum(x.astype(jnp.float64) * 2.0)
+
+    x = jax.ShapeDtypeStruct((8, 8), jnp.float32)
+    with jax.experimental.enable_x64():
+        report = analyze_fn(promoting, x, name="f64leak")
+    hits = report.by_rule("precision/f64-present")
+    assert len(hits) == 1, report.render()
+    assert hits[0].severity == Severity.ERROR
+
+    report = analyze_fn(lambda x: jnp.sum(x * 2.0), x, name="f32clean")
+    assert not report.by_rule("precision/f64-present"), report.render()
+
+
+def test_shard_map_signature_inventory_and_silent(devices):
+    from deepspeed_tpu.utils.jax_compat import shard_map
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+
+    def body(x):
+        return jax.lax.psum(x, "dp")
+
+    fn = shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P(),
+                   check_vma=False)
+    x = jax.ShapeDtypeStruct((8, 4), jnp.float32)
+    report = analyze_fn(fn, x, name="smap", mesh=mesh)
+    hits = report.by_rule("collective/shard-map-signature")
+    assert len(hits) == 1, report.render()
+    assert hits[0].severity == Severity.INFO
+    assert "psum" in hits[0].message
+
+    # no shard_map in the program -> no inventory line
+    report = analyze_fn(lambda x: jnp.sum(x), x, name="plain")
+    assert not report.by_rule("collective/shard-map-signature")
+
+
+def test_loss_scale_dtype_rule_fires_and_silent():
+    from types import SimpleNamespace
+
+    from deepspeed_tpu.analysis.core import AnalysisContext
+    from deepspeed_tpu.analysis.rules_config import LossScaleDtypeRule
+
+    rule = LossScaleDtypeRule()
+
+    def eng(dtype):
+        return SimpleNamespace(
+            pc=SimpleNamespace(loss_scaling=True),
+            state={"scaler": SimpleNamespace(
+                scale=jnp.asarray(1024.0, dtype))})
+
+    hits = list(rule.check_context(AnalysisContext(engine=eng(jnp.bfloat16))))
+    assert len(hits) == 1 and hits[0].rule_id == "config/loss-scale-dtype"
+    assert not list(rule.check_context(
+        AnalysisContext(engine=eng(jnp.float32))))
+
+
+def test_rules_silent_on_clean_programs(devices):
+    """The fire-only-tested rules, pinned silent by id on known-good inputs
+    (the other half of the fire/silent contract the meta-test enforces)."""
+    from deepspeed_tpu.analysis import analyze_compile_log
+
+    # clean fp32 reduction, no callbacks, no while predicates
+    x = jax.ShapeDtypeStruct((2048, 2048), jnp.float32)
+    report = analyze_fn(lambda x: jnp.sum(x ** 2), x, name="cleansum")
+    for rid in ("precision/low-precision-accumulation",
+                "host-sync/callback-in-step",
+                "collective/collective-in-while-predicate"):
+        assert not report.by_rule(rid), report.render()
+
+    # clean tiny engine: the quantized-collective gates have nothing to flag
+    report = analyze_engine(tiny_engine(stage=3))
+    for rid in ("collective/unoverlapped-quantized-collective",
+                "config/quantized-weights-below-stage3"):
+        assert not report.by_rule(rid), report.render()
+
+    # serving: bounded admission and an armed fleet stay out of the report
+    from types import SimpleNamespace
+
+    from deepspeed_tpu.inference.serving import ServingConfig
+
+    bounded = SimpleNamespace(serving=ServingConfig(max_queue=8),
+                              compile_log=[])
+    assert not analyze_compile_log(bounded).by_rule(
+        "serving/unbounded-admission")
+    fleet = SimpleNamespace(
+        replicas=[object(), object()],
+        config=SimpleNamespace(heartbeat_deadline_s=None, reroute_budget=2),
+        compile_log=[])
+    assert not analyze_compile_log(fleet).by_rule(
+        "serving/fleet-without-failover")
+    bucketed = [{"kind": "decode", "shape": (1, b)} for b in (8, 16, 32, 64)]
+    assert not analyze_compile_log(bucketed).by_rule(
+        "serving/unbucketed-decode-shape")
+
+
+def test_meta_every_rule_documented_and_tested():
+    """Every shipped rule id (default_rules — the compile-log serving set is
+    a subset) must have a docs/STATIC_ANALYSIS.md catalog heading and be
+    exercised from tests at least twice (the fire + silent convention),
+    referenced by rule id or by rule class name."""
+    import glob
+    import os
+
+    from deepspeed_tpu.analysis import default_rules
+
+    rules = default_rules()
+    ids = [r.rule_id for r in rules]
+    assert len(ids) == len(set(ids)), "duplicate rule ids"
+    # the pipeline-prover family is registered in the default set
+    for rid in ("pipe/unpaired-send-recv", "pipe/schedule-deadlock",
+                "pipe/stale-weight-application"):
+        assert rid in ids
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "docs", "STATIC_ANALYSIS.md")) as fh:
+        doc = fh.read()
+    sources = ""
+    for path in sorted(glob.glob(os.path.join(root, "tests", "*.py"))):
+        with open(path) as fh:
+            sources += fh.read()
+
+    missing_doc = [r.rule_id for r in rules
+                   if f"### `{r.rule_id}`" not in doc]
+    assert not missing_doc, (
+        f"rules without a docs/STATIC_ANALYSIS.md heading: {missing_doc}")
+    undocumented = [r.rule_id for r in rules if not r.description]
+    assert not undocumented, f"rules without a description: {undocumented}"
+    untested = [
+        r.rule_id for r in rules
+        if sources.count(r.rule_id) + sources.count(type(r).__name__) < 2]
+    assert not untested, (
+        f"rules without a fire + silent test reference: {untested}")
+
+
+def test_cli_list_json_emits_rule_registry():
+    """--list --json: machine-readable per-rule family/severity/doc-anchor,
+    with every anchor resolving to a real docs/STATIC_ANALYSIS.md heading."""
+    import io
+    import json
+    from contextlib import redirect_stdout
+
+    from deepspeed_tpu.analysis import cli, default_rules
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli.main(["--list", "--json"])
+    assert rc == 0
+    data = json.loads(buf.getvalue())
+    assert {r["rule_id"] for r in data["rules"]} == {
+        r.rule_id for r in default_rules()}
+    for r in data["rules"]:
+        assert r["family"] == r["rule_id"].split("/")[0]
+        assert r["severity"] in ("ERROR", "WARNING", "INFO")
+        assert r["description"]
+        assert r["doc_anchor"].startswith("docs/STATIC_ANALYSIS.md#"), r
+    assert data["configs"] and all("name" in c for c in data["configs"])
+
+
+def test_cli_json_mode_gates_on_error_findings(monkeypatch):
+    """The --json path must exit 2 on ERROR findings exactly like the text
+    path (CI parses the JSON *and* trusts the exit code)."""
+    import io
+    import json
+    from contextlib import redirect_stdout
+
+    from deepspeed_tpu.analysis import cli
+    from deepspeed_tpu.analysis.core import Finding, Report
+
+    bad = Report(findings=[Finding(
+        rule_id="pipe/schedule-deadlock", severity=Severity.ERROR,
+        location="x", message="injected")])
+    monkeypatch.setattr(cli, "analyze_row", lambda row, **kw: bad)
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli.main(["--json"])
+    assert rc == 2
+    out = json.loads(buf.getvalue())
+    assert out["findings"][0]["severity"] == "ERROR"
+
+    with redirect_stdout(io.StringIO()):
+        assert cli.main(["--json", "--fail-on", "never"]) == 0
+        assert cli.main([]) == 2  # text path gates identically
+
+
+def test_cli_schedules_gate_proves_and_prices():
+    """--schedules: every generated schedule in the matrix proves clean, and
+    both interleaved and zero-bubble beat 1F1B's static bubble at equal
+    microbatches (the PR's headline row, CI-gated)."""
+    import io
+    import json
+    from contextlib import redirect_stdout
+
+    from deepspeed_tpu.analysis import cli
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = cli.main(["--schedules", "--json"])
+    assert rc == 0
+    for entry in json.loads(buf.getvalue()):
+        assert entry["n_errors"] == 0
+        by_kind = {rep["schedule"].split("[")[0]: rep
+                   for rep in entry["schedules"]}
+        assert all(rep["ok"] for rep in by_kind.values())
+        b1 = by_kind["1f1b"]["bubble"]["bubble_frac"]
+        assert by_kind["interleaved"]["bubble"]["bubble_frac"] < b1
+        assert by_kind["zero-bubble"]["bubble"]["bubble_frac"] < b1

@@ -20,8 +20,8 @@ def _bench():
 def test_all_config_lists_have_registered_kinds_and_serialize():
     bench = _bench()
     kinds = {"train", "inference", "kernels", "diffusion", "pipeline_aot",
-             "pipeline_mpmd", "train_aot", "kernels_aot", "infinity_aot",
-             "moe_aot", "infer_aot", "sd_aot"}
+             "pipeline_mpmd", "pipeline_schedule", "train_aot", "kernels_aot",
+             "infinity_aot", "moe_aot", "infer_aot", "sd_aot"}
     for lst in (bench.INFINITY_CONFIGS, bench.PIPELINE_CONFIGS,
                 bench.AOT_TRAIN_CONFIGS, bench.QUANTIZED_ZERO_CONFIGS):
         assert lst, "config list emptied"

@@ -92,6 +92,7 @@ def test_bert_import_matches_hf(rng):
     np.testing.assert_allclose(ours, theirs, atol=2e-3, rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_classification_head_trains():
     from deepspeed_tpu.models.bert import (
         BertConfig, classification_logits, init_classifier, init_params)

@@ -64,6 +64,7 @@ def test_cursor_counts_consumed_batches_and_rides_meta(tmp_path):
     assert engine2.data_cursor == 3  # the exact next batch index
 
 
+@pytest.mark.slow
 def test_resume_lands_on_exact_next_batch_bitwise(tmp_path):
     """Continuous 5-step run vs 3 steps + save + fresh-engine resume + 2
     steps, both driven by batch_for(data_cursor): final state is BITWISE

@@ -263,6 +263,7 @@ def test_host_shards_committed_under_manifest(tmp_path):
     assert ref == got
 
 
+@pytest.mark.slow
 def test_zero_to_fp32_recovers_sharded_host_state(tmp_path):
     """The standalone recovery script (auto-copied into every tag) must read
     the sharded host_state/ format: param-stream checkpoints export their
@@ -307,6 +308,7 @@ def _read_log(path):
         return {row["step"]: row for row in map(json.loads, f)}
 
 
+@pytest.mark.slow
 def test_sigkill_mid_flush_resumes_step_exact(tmp_path):
     """A SIGKILL inside the per-unit host-shard flush (save #2, after shard 1
     of the step-3 tag) must leave the step-2 tag the newest COMMITTED one;

@@ -362,6 +362,7 @@ def test_gptneo_import_matches_hf(rng):
     assert cfg.local_attention_period == 2 and cfg.window_size == 8
 
 
+@pytest.mark.slow
 def test_gptneo_cached_decode_matches_full_forward(rng):
     """The cached (generate) path must honor the local-attention window too."""
     from deepspeed_tpu.models import gpt as G

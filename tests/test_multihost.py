@@ -62,6 +62,7 @@ def test_two_process_distributed_train_and_checkpoint(tmp_path):
         np.testing.assert_allclose(o["resumed"], o["ref"], rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_single_process_dp8_equivalent(tmp_path):
     """The worker's exact scenario — dp data-parallel ZeRO-2 train, save,
     fresh-engine reload, identical continuation — on the in-process 8-device

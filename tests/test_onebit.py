@@ -137,6 +137,7 @@ def test_onebit_trains_through_switch(opt_type):
     assert losses[-1] < losses[2], losses  # improving after the switch
 
 
+@pytest.mark.slow
 def test_onebit_matches_dense_during_warmup():
     engine_1b, cfg = _tiny_engine("OneBitAdam", {"lr": 1e-3, "freeze_step": 100})
     engine_d, _ = _tiny_engine("Adam", {"lr": 1e-3})

@@ -226,6 +226,7 @@ def test_engine_flops_profiler_config_hook(tmp_path):
     assert "Flops Profiler" in open(out).read()
 
 
+@pytest.mark.slow
 def test_flops_profiler_on_engine():
     from deepspeed_tpu.models import build_gpt
     from deepspeed_tpu.models.gpt import GPTConfig

@@ -142,6 +142,7 @@ def _losses(engine, steps=2):
     return out
 
 
+@pytest.mark.slow
 def test_pipelined_quantized_gathers_bitwise():
     """The acceptance bar: the pipelined quantized-gather FORWARD is bitwise
     identical to the inline schedule (same gathers, same quantize/dequantize,
@@ -166,6 +167,7 @@ def test_pipelined_quantized_gathers_bitwise():
             np.testing.assert_allclose(pg, ig, rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_pipelined_windowed_gathers_bitwise():
     """Same bar with k=2 layer windows (stage3_prefetch_bucket_size):
     pipelining composes with gather windowing."""
@@ -335,6 +337,7 @@ def test_quantized_matmul_reshard_values_and_straight_through():
         rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_quantized_head_engine():
     """zero_quantized_head: the LM-head gather goes through the dequant-fused
     matmul — ledger records the qmatmul op, loss stays in the quantized-weight

@@ -362,6 +362,7 @@ def _dequant_cache(paged, bits):
 
 @pytest.mark.parametrize("bits", [8, 4])
 @pytest.mark.parametrize("rotary", [False, True])
+@pytest.mark.slow
 def test_quantized_paged_decode_matches_dequant_dense(params, rng, bits,
                                                       rotary):
     """The quantized paged step == paged decode over DEQUANTIZED pools, to

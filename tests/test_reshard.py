@@ -175,6 +175,7 @@ def _state_arrays(engine):
             for key, leaf in _flatten_with_paths(engine.state)[0]}
 
 
+@pytest.mark.slow
 def test_reshard_on_load_world_change(tmp_path):
     """dp4 run with quantized-gradient EF -> checkpoint -> dp2 engine loads:
     logical leaves bitwise, EF residual reset to the new decomposition's
@@ -232,6 +233,7 @@ def test_same_world_load_does_not_reshard(tmp_path):
                    for e in read_events(save))
 
 
+@pytest.mark.slow
 def test_mid_accum_reshard_drops_window_and_keeps_cursor(tmp_path):
     """A mid-accumulation (imperative) save resharded to a new world drops
     the partial gradient window and keeps the cursor AT that window, so the

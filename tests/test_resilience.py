@@ -266,6 +266,7 @@ def test_engine_save_writes_commit_protocol(tmp_path, devices):
         e2.load_checkpoint(str(tmp_path))
 
 
+@pytest.mark.slow
 def test_engine_load_falls_back_to_previous_committed_tag(tmp_path, devices):
     e = make_engine()
     e.train_batch(batch(0))
@@ -304,6 +305,7 @@ def test_format_version_rejected_explicitly(tmp_path, devices):
 
 
 # ------------------------------------------------------------------ preemption
+@pytest.mark.slow
 def test_drain_emergency_save_and_auto_resume(tmp_path, devices):
     e = make_engine(save_dir=tmp_path)
     e.train_batch(batch(0))

@@ -63,6 +63,7 @@ def test_engine_comms_verify_reports_measured():
                                   "all-gather"))
 
 
+@pytest.mark.slow
 def test_ds_bench_verify_flag(capsys):
     from deepspeed_tpu.benchmarks.communication import main
 

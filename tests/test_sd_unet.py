@@ -127,6 +127,7 @@ def test_vae_import_ignores_encoder_keys():
                                       np.asarray(params[k]), err_msg=k)
 
 
+@pytest.mark.slow
 def test_import_rejects_mismatched_state():
     params = init_sd_unet(TINY_UNET, jax.random.PRNGKey(4))
     sd = _to_torch_layout(params)

@@ -323,6 +323,7 @@ def tiny_engine():
         dtype="float32", decode_block=4, max_queue=64)), params
 
 
+@pytest.mark.slow
 def test_serving_greedy_matches_generate(tiny_engine):
     """Continuous batching must be invisible in the outputs: every request's
     greedy tokens == InferenceEngine.generate on the same prompt (covers
@@ -350,6 +351,7 @@ def test_serving_greedy_matches_generate(tiny_engine):
                                       np.asarray(r.tokens[:r.max_new_tokens]))
 
 
+@pytest.mark.slow
 def test_warmup_covers_unaligned_final_chunk_buckets():
     """A bucket only reachable through a capped remainder (prefill_chunk + b
     > max_model_len) must still warm — a legal long prompt's final chunk
@@ -481,6 +483,7 @@ def test_dense_kv_at_capacity_rule_fires_and_stays_silent():
         [{"kind": "decode", "shape": (2, 4)}]).findings
 
 
+@pytest.mark.slow
 def test_serving_kv8_greedy_matches_generate():
     """int8 KV pages end-to-end through the serving stack: every request's
     greedy tokens == InferenceEngine.generate on DENSE caches (the

@@ -154,6 +154,7 @@ def test_unet_shapes_and_determinism(rng):
     assert np.abs(np.asarray(out) - np.asarray(out4)).max() > 0
 
 
+@pytest.mark.slow
 def test_stable_diffusion_pipeline_end_to_end(rng):
     from deepspeed_tpu.models.diffusion import (
         StableDiffusionPipeline,

@@ -298,6 +298,7 @@ def test_commit_partial_matches_sequential_prefix(rng):
 
 
 @pytest.mark.parametrize("kv_bits", [8, 4])
+@pytest.mark.slow
 def test_commit_quantized_matches_sequential_appends(rng, kv_bits):
     """Quantized pools: GIVEN the same window K/V values, the one-shot
     commit reproduces per-token sequential ``_append_kv_token`` calls
@@ -611,6 +612,7 @@ def test_engine_spec_greedy_equivalence(tiny_setup):
     assert rep["pool_audit_ok"]
 
 
+@pytest.mark.slow
 def test_engine_spec_kv8_greedy_equivalence(tiny_setup):
     """Quantized pools: spec-on vs spec-off at kv_bits=8 stay identical —
     the window's dense-context verification plus sequential-exact commit
@@ -624,6 +626,7 @@ def test_engine_spec_kv8_greedy_equivalence(tiny_setup):
     assert rep["spec"]["windows"] > 0
 
 
+@pytest.mark.slow
 def test_engine_draft_model_drafter(tiny_setup):
     """draft == target: near-total acceptance, strictly fewer dispatches
     than the n-gram run, identical outputs."""
@@ -637,6 +640,7 @@ def test_engine_draft_model_drafter(tiny_setup):
     assert rep["decode_steps"] < off_rep["decode_steps"]
 
 
+@pytest.mark.slow
 def test_engine_spec_under_chaos(tiny_setup):
     """End-to-end greedy equivalence holds across an injected verify
     dispatch failure (mid-window preemption on the real engine)."""

@@ -97,6 +97,7 @@ def test_load_mp_checkpoint_composed_order_and_downshard(tmp_path, devices):
 
 
 # -------------------------------------------------------- convergence
+@pytest.mark.slow
 def test_fixed_seed_convergence():
     """Small GPT memorizes a fixed batch: the loss curve must fall below
     bounds at fixed step marks (parity: tests/model convergence checks)."""

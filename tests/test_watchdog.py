@@ -115,6 +115,7 @@ def test_spike_detector_warmup_gates_spikes():
 
 
 # ------------------------------------------------- pillar 1: NaN -> rollback
+@pytest.mark.slow
 def test_nan_rollback_skips_poison_and_rejoins(tmp_path):
     """Acceptance: injected NaN at data cursor 4 -> auto-rollback + cursor
     skip; the healed trajectory rejoins the clean run's loss level."""
@@ -224,6 +225,7 @@ def test_identify_stragglers_pure():
 
 
 # --------------------------------------- pillar 3: overflow -> wire demotion
+@pytest.mark.slow
 def test_ef_overflow_demotes_then_repromotes(tmp_path):
     """Acceptance: repeated forced EF overflows demote the quantized
     gradient exchange to the fp32 wire (recorded in comms_summary); a clean
