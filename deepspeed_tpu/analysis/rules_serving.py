@@ -308,6 +308,54 @@ class SpeculationWithoutGreedyGateRule(Rule):
         )
 
 
+class UntieredMultiTenantRule(Rule):
+    """Multiple distinct ``tenant_id``s observed in the serving submit
+    evidence while no SLO-tier config is armed — the
+    ``serving/unbounded-admission`` pattern one level up: admission is
+    (maybe) bounded, but every tenant shares ONE class, so a single batch
+    tenant flooding ``submit()`` degrades every interactive user
+    identically. The scheduler records every tenant it has seen
+    (``tenants_seen``); ≥2 of them with ``ServingConfig.tiers`` unset means
+    the multi-tenant contract is running without its isolation machinery
+    (WFQ, per-tier partitions, the degradation ladder)."""
+
+    rule_id = "serving/untiered-multi-tenant"
+    default_severity = Severity.WARNING
+    description = "multiple tenants served with no SLO-tier config armed"
+
+    def check_context(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        eng = ctx.engine
+        cfg = getattr(eng, "serving", None) if eng is not None else None
+        sched = getattr(eng, "last_scheduler", None) if eng is not None \
+            else None
+        if sched is None:
+            return  # no serving run to audit (or a raw compile_log list)
+        seen = getattr(sched, "tenants_seen", None)
+        if seen is None or len(seen) < 2:
+            return  # pre-tenancy scheduler, or effectively single-tenant
+        armed = getattr(cfg, "tiers_armed", None) if cfg is not None else None
+        if armed is None:  # duck-typed config without the property
+            armed = bool(getattr(cfg, "tiers", None)) if cfg is not None \
+                else getattr(sched, "tiers", None) is not None
+        if armed:
+            return
+        names = sorted(str(t) for t in seen)
+        shown = ", ".join(names[:4]) + ("..." if len(names) > 4 else "")
+        yield self.finding(
+            f"{len(names)} distinct tenant_ids observed ({shown}) with no "
+            f"tier config armed — every tenant competes in one FIFO class, "
+            f"so one batch tenant flooding submit() inflates every other "
+            f"tenant's TTFT/deadline misses identically (no fair queueing, "
+            f"no per-tier shed partitions, no degradation ladder)",
+            location="ServingConfig.tiers",
+            suggestion="set ServingConfig(tiers=True) (the built-in "
+                       "interactive/standard/batch ladder) or a TierConfig "
+                       "mapping, and map tenants via ServingConfig("
+                       "tenants={...}) — see docs/SERVING.md "
+                       "'Multi-tenancy & SLO tiers'",
+        )
+
+
 def serving_rules() -> List[Rule]:
     # TpCollectiveOrderRule lives with the collective-order family but is
     # registered HERE (once): serving_rules() feeds both default_rules()
@@ -317,4 +365,5 @@ def serving_rules() -> List[Rule]:
 
     return [UnbucketedDecodeShapeRule(), UnboundedAdmissionRule(),
             DenseKVAtCapacityRule(), FleetWithoutFailoverRule(),
-            SpeculationWithoutGreedyGateRule(), TpCollectiveOrderRule()]
+            SpeculationWithoutGreedyGateRule(), UntieredMultiTenantRule(),
+            TpCollectiveOrderRule()]

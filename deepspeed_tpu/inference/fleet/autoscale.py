@@ -51,6 +51,23 @@ def summarize_events(events: Iterable[Dict[str, Any]], now: float,
     routed = rejected = misses_early = misses_late = reroutes = 0
     spec_windows = spec_drafted = spec_accepted = 0
     spec_tokens = 0.0
+    imiss_early = imiss_late = 0
+    by_tenant: Dict[str, Dict[str, float]] = {}
+    by_tier: Dict[str, Dict[str, float]] = {}
+
+    def _bump(e, key, amount=1.0):
+        # per-tenant/per-tier attribution: any event stamped with
+        # tenant_id/tier (scheduler ledger rows, fleet rejections) lands in
+        # a merged row — the billing/brownout signal, fleet-wide
+        for table, ident in ((by_tenant, e.get("tenant_id")),
+                             (by_tier, e.get("tier"))):
+            if ident is None:
+                continue
+            row = table.setdefault(str(ident), {
+                "finished": 0, "goodput_tokens": 0.0, "shed": 0,
+                "deadline_misses": 0, "preemptions": 0})
+            row[key] += amount
+
     for e in events:
         t = float(e.get("unix_time", 0.0))
         if t < lo or t > now:
@@ -60,7 +77,21 @@ def summarize_events(events: Iterable[Dict[str, Any]], now: float,
             routed += 1
         elif ev == "fleet_reject":
             rejected += 1
+            _bump(e, "shed")
+        elif ev == "request_shed":
+            _bump(e, "shed")
+        elif ev == "request_finished":
+            _bump(e, "finished")
+            _bump(e, "goodput_tokens", float(e.get("tokens", 0)))
+        elif ev == "preemption":
+            _bump(e, "preemptions")
         elif ev == "deadline_miss":
+            _bump(e, "deadline_misses")
+            if e.get("tier") == "interactive":
+                if t >= mid:
+                    imiss_late += 1
+                else:
+                    imiss_early += 1
             if t >= mid:
                 misses_late += 1
             else:
@@ -91,6 +122,13 @@ def summarize_events(events: Iterable[Dict[str, Any]], now: float,
         out["spec_windows"] = spec_windows
         out["spec_accept_rate"] = spec_accepted / max(spec_drafted, 1)
         out["spec_tokens_per_dispatch"] = spec_tokens / spec_windows
+    if by_tenant or by_tier:
+        # tiered keys appear only when tenant-stamped events exist — the
+        # untiered summary schema is unchanged
+        out["by_tenant"] = by_tenant
+        out["by_tier"] = by_tier
+        out["interactive_misses"] = imiss_early + imiss_late
+        out["interactive_miss_trend"] = imiss_late - imiss_early
     return out
 
 
@@ -120,7 +158,12 @@ class AutoscalePolicy:
         overloaded = (
             summary.get("shed_rate", 0.0) > self.shed_rate_up
             or (summary.get("deadline_misses", 0) >= self.miss_floor
-                and summary.get("miss_trend", 0) > 0))
+                and summary.get("miss_trend", 0) > 0)
+            # interactive-tier misses trending up demand capacity even when
+            # the fleet-wide trend is flat (batch absorbing the pain must
+            # not mask an interactive SLO breach)
+            or (summary.get("interactive_misses", 0) >= self.miss_floor
+                and summary.get("interactive_miss_trend", 0) > 0))
         if overloaded and num_replicas < self.max_replicas:
             return "scale_up"
         quiet = (summary.get("rejected", 0) == 0

@@ -75,6 +75,10 @@ def request_spec(req: Request, age_s: float = 0.0,
         "deadline_s": req.deadline_s,
         "session_id": req.session_id,
         "age_s": float(max(age_s, 0.0)),
+        # tier metadata rides the wire as plain fields — a re-routed or
+        # handed-off request keeps its SLO class on the receiving replica
+        "tenant_id": req.tenant_id,
+        "tier": req.tier,
     }
     if kv_payload is not None:
         spec["kv_payload"] = kv_payload
@@ -177,6 +181,8 @@ class LocalReplica:
             ttft_deadline_s=spec.get("ttft_deadline_s"),
             deadline_s=spec.get("deadline_s"),
             session_id=spec.get("session_id"),
+            tenant_id=spec.get("tenant_id"),
+            tier=spec.get("tier"),
             rid=int(spec["rid"]),
         )
         req.tokens = [int(t) for t in spec.get("tokens", ())]

@@ -49,6 +49,7 @@ from collections import deque
 from typing import Any, Deque, Dict, List, Optional
 
 from ..serving.scheduler import AdmissionVerdict, Request, RequestState
+from ..serving.tenancy import tier_rank
 from .replica import ReplicaDeadError, request_spec
 
 #: Replica-level events mirrored into the router's merged in-memory window
@@ -136,6 +137,17 @@ class ReplicaRouter:
                 self.recovery_log.record(event, **fields)
             except Exception:  # event export must never fail routing
                 pass
+
+    @staticmethod
+    def _tenant_fields(req: Request) -> Dict[str, Any]:
+        """Tenant/tier stamps for fleet events — {} for untenanted
+        requests, so the pre-tier event schema is unchanged."""
+        fields: Dict[str, Any] = {}
+        if getattr(req, "tenant_id", None) is not None:
+            fields["tenant_id"] = req.tenant_id
+        if getattr(req, "tier", None) is not None:
+            fields["tier"] = req.tier
+        return fields
 
     def _mirror_counters(self, replica_id: str,
                          counters: Dict[str, int]) -> None:
@@ -243,7 +255,8 @@ class ReplicaRouter:
                   f"{last['detail'] if last else 'no live replicas'}")
         req.state = RequestState.REJECTED
         req.reject_reason = reason
-        self._record("fleet_reject", rid=req.rid, reason=reason)
+        self._record("fleet_reject", rid=req.rid, reason=reason,
+                     **self._tenant_fields(req))
         self._forget(req.rid)
         return AdmissionVerdict(False, reason, detail)
 
@@ -306,7 +319,8 @@ class ReplicaRouter:
                 continue
             self._reroutes[req.rid] = n + 1
             self._record("request_rerouted", rid=req.rid,
-                         kept_tokens=len(req.tokens), attempt=n + 1)
+                         kept_tokens=len(req.tokens), attempt=n + 1,
+                         **self._tenant_fields(req))
             self._place(req, pending)
             audited = True
         if audited:
@@ -455,7 +469,15 @@ class ReplicaRouter:
             if req is not None:
                 req.state = RequestState.QUEUED
                 reroute.append(req)
-        for h in out.get("handoffs") or ():
+        handoffs = list(out.get("handoffs") or ())
+        if len(handoffs) > 1:
+            # tier-ordered forwarding: interactive handoffs reach decode
+            # specialists ahead of batch work staged in the same pump
+            # (stable sort — same-tier handoffs keep their staging order;
+            # untiered specs rank as "standard" so ordering is unchanged)
+            handoffs.sort(
+                key=lambda h: tier_rank((h.get("spec") or {}).get("tier")))
+        for h in handoffs:
             # disaggregated prefill→decode: the prefill replica finished
             # the prompt and exported the filled KV pages; forward them to
             # a decode-capable sibling. The source OWNS the pages until we

@@ -20,8 +20,14 @@ from .scheduler import (AdmissionVerdict, ContinuousBatchingScheduler,
                         ServingFaultError)
 from .speculate import (AdaptiveSpecK, DraftModelDrafter, NGramDrafter,
                         spec_k_ladder)
+from .tenancy import (BROWNOUT_STAGES, BrownoutConfig, BrownoutController,
+                      DEFAULT_TIER, StartTimeFairQueue, TIER_ORDER,
+                      TenantConfig, TierConfig, TokenBucket, default_tiers,
+                      resolve_tenants, resolve_tiers, sacrifice_key,
+                      tier_rank)
 from .bench import (estimate_saturation_rps, make_open_loop_workload,
-                    percentile, run_continuous, run_static_baseline)
+                    make_tiered_workload, percentile, run_continuous,
+                    run_static_baseline)
 
 __all__ = [
     "PageAllocator", "PrefixIndex", "RESERVED_PAGE", "pages_for",
@@ -31,6 +37,11 @@ __all__ = [
     "RequestState", "SHED_POLICIES", "ServingFaultError",
     "ServingConfig", "ServingEngine",
     "AdaptiveSpecK", "DraftModelDrafter", "NGramDrafter", "spec_k_ladder",
-    "estimate_saturation_rps", "make_open_loop_workload", "percentile",
+    "BROWNOUT_STAGES", "BrownoutConfig", "BrownoutController",
+    "DEFAULT_TIER", "StartTimeFairQueue", "TIER_ORDER", "TenantConfig",
+    "TierConfig", "TokenBucket", "default_tiers", "resolve_tenants",
+    "resolve_tiers", "sacrifice_key", "tier_rank",
+    "estimate_saturation_rps", "make_open_loop_workload",
+    "make_tiered_workload", "percentile",
     "run_continuous", "run_static_baseline",
 ]

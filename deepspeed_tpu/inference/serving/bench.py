@@ -60,6 +60,83 @@ def make_open_loop_workload(n_requests: int, rate_rps: float,
     return out
 
 
+def make_tiered_workload(n_per_tier: int, rate_rps: float,
+                         prompt_len: tuple, max_new: tuple,
+                         vocab_size: int, seed: int = 0,
+                         eos_token_id: Optional[int] = None,
+                         tiers: Sequence[str] = ("interactive", "standard",
+                                                 "batch"),
+                         shares: Optional[Dict[str, float]] = None
+                         ) -> List[Request]:
+    """Mixed-tier open-loop stream: one Poisson arrival process per tier,
+    one synthetic tenant per tier (``t-<tier>``), merged in arrival order.
+    ``shares`` splits ``rate_rps`` across tiers (normalized; default an
+    even split) — the noisy-neighbor shape is a LIGHT interactive share
+    against a batch-heavy overload, since a tenant whose own demand
+    saturates the box is not a neighbor problem. The tiered-overload A/B
+    drives the SAME list through a tiered and an untiered scheduler."""
+    weights = [float((shares or {}).get(t, 1.0)) for t in tiers]
+    total_w = sum(weights) or 1.0
+    out: List[Request] = []
+    for k, (tier, w) in enumerate(zip(tiers, weights)):
+        if w <= 0.0:
+            continue
+        for r in make_open_loop_workload(
+                n_per_tier, rate_rps * w / total_w, prompt_len,
+                max_new, vocab_size, seed=seed + 1000 * k,
+                eos_token_id=eos_token_id):
+            r.tenant_id = f"t-{tier}"
+            r.tier = tier
+            out.append(r)
+    return sorted(out, key=lambda r: r.arrival_time)
+
+
+def _group_row(reqs: Sequence[Request], t0: float, t_end: float,
+               slo_s: Optional[float]) -> Dict:
+    """Per-tenant/per-tier sub-report: REJECTED requests count against THIS
+    group's shed rate (not the fleet aggregate), and a group's misses stay
+    its own — a flood victim's misses no longer dilute the flooder's
+    stats."""
+    ttft: List[float] = []
+    goodput = 0
+    late = 0
+    shed = sum(1 for r in reqs if r.state is RequestState.REJECTED)
+    expired = sum(1 for r in reqs if r.state is RequestState.EXPIRED)
+    for r in reqs:
+        arrive = t0 + r.arrival_time
+        if r.t_first_token is not None:
+            ttft.append(r.t_first_token - arrive)
+        n = min(len(r.tokens), r.max_new_tokens)
+        if r.t_done is not None:
+            if slo_s is None or r.t_done - arrive <= slo_s:
+                goodput += n
+            else:
+                late += 1
+        elif (slo_s is not None
+              and r.state not in (RequestState.REJECTED,
+                                  RequestState.EXPIRED)
+              and t_end - arrive > slo_s):
+            late += 1
+
+    def ms(x, nd=2):
+        return None if x != x else round(x * 1e3, nd)
+
+    accepted = len(reqs) - shed
+    misses = expired + late
+    return {
+        "requests": len(reqs),
+        "finished": sum(r.t_done is not None for r in reqs),
+        "shed": shed,
+        "shed_rate": round(shed / max(len(reqs), 1), 4),
+        "deadline_misses": misses,
+        "deadline_miss_rate": round(misses / max(accepted, 1), 4),
+        "goodput_tokens": int(goodput),
+        "preemptions": sum(r.preemptions for r in reqs),
+        "ttft_p50_ms": ms(percentile(ttft, 50)),
+        "ttft_p99_ms": ms(percentile(ttft, 99)),
+    }
+
+
 def _report(requests: Sequence[Request], t0: float, t_end: float,
             mode: str, extra: Optional[Dict] = None,
             slo_s: Optional[float] = None) -> Dict:
@@ -130,6 +207,21 @@ def _report(requests: Sequence[Request], t0: float, t_end: float,
     }
     if slo_s is not None:
         row["slo_s"] = slo_s
+    tagged = [r for r in requests
+              if getattr(r, "tenant_id", None) is not None
+              or getattr(r, "tier", None) is not None]
+    if tagged:
+        by_tier: Dict[str, List[Request]] = {}
+        by_tenant: Dict[str, List[Request]] = {}
+        for r in tagged:
+            if r.tier is not None:
+                by_tier.setdefault(str(r.tier), []).append(r)
+            if r.tenant_id is not None:
+                by_tenant.setdefault(str(r.tenant_id), []).append(r)
+        row["by_tier"] = {k: _group_row(v, t0, t_end, slo_s)
+                          for k, v in sorted(by_tier.items())}
+        row["by_tenant"] = {k: _group_row(v, t0, t_end, slo_s)
+                            for k, v in sorted(by_tenant.items())}
     if extra:
         row.update(extra)
     return row

@@ -126,6 +126,22 @@ class ServingConfig:
     shed_policy: str = "reject_newest"       # or "reject_largest"
     ttft_deadline_s: Optional[float] = None  # default per-request deadlines
     request_deadline_s: Optional[float] = None
+    # ---- SLO tiers / multi-tenancy (docs/SERVING.md "Multi-tenancy & SLO
+    # tiers"). Default OFF: tiers=None keeps the scheduler's FIFO queue and
+    # seed-identical behavior. tiers=True (or "default") arms the built-in
+    # interactive/standard/batch ladder; a mapping of TierConfig/dict
+    # overrides merges over the defaults. tenants maps tenant_id to a
+    # TenantConfig / dict / bare tier name. Both are validated eagerly in
+    # ServingEngine.__init__ via tenancy.resolve_tiers / resolve_tenants.
+    tiers: Union[None, bool, str, dict] = None
+    tenants: Optional[dict] = None
+    # degradation-ladder (brownout) controller knobs — only read when tiers
+    # are armed; see tenancy.BrownoutConfig for semantics
+    brownout_window_s: float = 5.0
+    brownout_enter_shed_rate: float = 0.25
+    brownout_enter_misses: int = 2
+    brownout_exit_shed_rate: float = 0.05
+    brownout_min_dwell_s: float = 1.0
     # ---- dispatch fault recovery
     dispatch_retries: int = 2
     quarantine_after: int = 2                # failures before a decode block
@@ -160,6 +176,30 @@ class ServingConfig:
                 or self.ttft_deadline_s is not None
                 or self.request_deadline_s is not None)
 
+    @property
+    def tiers_armed(self) -> bool:
+        """Whether SLO-tier scheduling is configured — what the
+        ``serving/untiered-multi-tenant`` rule checks when it sees multiple
+        tenant_ids in the submit evidence."""
+        return bool(self.tiers)
+
+    def resolved_tiers(self):
+        """Validated (tiers, tenants, brownout) triple for the scheduler —
+        (None, {}, None) when tiers are unarmed."""
+        from .tenancy import (BrownoutConfig, resolve_tenants, resolve_tiers)
+
+        tiers = resolve_tiers(self.tiers)
+        tenants = resolve_tenants(self.tenants, tiers)
+        brownout = None
+        if tiers is not None:
+            brownout = BrownoutConfig(
+                window_s=float(self.brownout_window_s),
+                enter_shed_rate=float(self.brownout_enter_shed_rate),
+                enter_misses=int(self.brownout_enter_misses),
+                exit_shed_rate=float(self.brownout_exit_shed_rate),
+                min_dwell_s=float(self.brownout_min_dwell_s))
+        return tiers, tenants, brownout
+
 
 class ServingEngine:
     """Executor over a GPT config + params (see module docstring)."""
@@ -190,6 +230,9 @@ class ServingEngine:
         if s.role not in ("both", "prefill", "decode"):
             raise ValueError(f"role must be both|prefill|decode, got "
                              f"{s.role!r}")
+        # tier/tenant specs fail fast at engine construction, not first
+        # submit — resolved_tiers() raises on malformed configs
+        s.resolved_tiers()
         self.num_slots = self._resolve_slots()
         self.num_pages = (s.num_pages if s.num_pages is not None
                           else self.num_slots * s.pages_per_seq + 1)
@@ -742,6 +785,7 @@ class ServingEngine:
             from .speculate import make_drafter
 
             drafter = make_drafter(self, s)
+        tiers, tenants, brownout = s.resolved_tiers()
         sched = ContinuousBatchingScheduler(
             executor=self, num_slots=self.num_slots,
             num_pages=self.num_pages, page_size=s.page_size,
@@ -756,7 +800,8 @@ class ServingEngine:
             dispatch_failure_budget=s.dispatch_failure_budget,
             recovery_log=recovery_log, watchdog=watchdog,
             prefix_cache=prefix_cache, drafter=drafter, spec_k=s.spec_k,
-            spec_adaptive=s.spec_adaptive, role=s.role)
+            spec_adaptive=s.spec_adaptive, role=s.role,
+            tiers=tiers, tenants=tenants, brownout=brownout)
         sched._owns_watchdog = owns
         self.last_scheduler = sched
         return sched

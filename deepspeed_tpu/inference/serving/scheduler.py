@@ -68,15 +68,18 @@ import itertools
 import time
 from collections import deque
 from contextlib import nullcontext
-from typing import Any, Deque, Dict, List, Optional, Set
+from typing import Any, Deque, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-from ...resilience.chaos import serving_dispatch_fault
+from ...resilience.chaos import serving_dispatch_fault, serving_tenant_flood
 from ...resilience.retry import backoff_delay
 from .paging import (PageAllocator, PrefixIndex, pages_for,
                      prefix_chain_hashes)
 from .speculate import AdaptiveSpecK, spec_k_ladder
+from .tenancy import (BROWNOUT_STAGES, DEFAULT_TIER, BrownoutConfig,
+                      BrownoutController, StartTimeFairQueue, TenantConfig,
+                      TierConfig, TokenBucket, sacrifice_key, tier_rank)
 
 
 class RequestState(enum.Enum):
@@ -114,9 +117,12 @@ class AdmissionVerdict:
     the serving bound — a caller bug, not load) | ``queue_full`` |
     ``token_backlog`` (the admission queue's token-budget backpressure
     estimate is exhausted) | ``draining`` (the scheduler is in a graceful
-    drain — finishing accepted work, admitting nothing new). ``shed_rid``:
-    under the ``reject_largest`` policy, the rid of the queued request
-    evicted to make room."""
+    drain — finishing accepted work, admitting nothing new) |
+    ``rate_limited`` (the tenant's token bucket is empty — its contracted
+    rate, not system load) | ``brownout`` (the degradation ladder has
+    closed this tier's admission; docs/SERVING.md "Multi-tenancy & SLO
+    tiers"). ``shed_rid``: under the ``reject_largest`` policy, the rid of
+    the queued request evicted to make room."""
 
     admitted: bool
     reason: str = "admitted"
@@ -130,9 +136,14 @@ class AdmissionVerdict:
 _rid = itertools.count()
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(eq=False)
 class Request:
-    """One generation request plus its lifecycle bookkeeping."""
+    """One generation request plus its lifecycle bookkeeping.
+
+    ``eq=False``: requests compare by identity. Field equality was never
+    meaningful (the ndarray prompt makes generated ``__eq__`` raise on any
+    same-length comparison) and the queue's ``remove()`` must match THE
+    request object, not a lookalike."""
 
     prompt: np.ndarray                  # [T] int32
     max_new_tokens: int
@@ -152,6 +163,13 @@ class Request:
     # IMPORTING the pages instead of prefilling — cleared after the import,
     # so a later preemption falls back to the normal kept-token re-prefill
     kv_payload: Optional[dict] = None
+    # multi-tenancy (docs/SERVING.md "Multi-tenancy & SLO tiers"): plain
+    # fields so they ride request_spec / the subprocess protocol verbatim.
+    # tier is resolved at submit (request override > tenant config >
+    # DEFAULT_TIER) and stamped back here so every downstream event,
+    # handoff, and ledger row carries it
+    tenant_id: Optional[str] = None
+    tier: Optional[str] = None
     rid: int = dataclasses.field(default_factory=lambda: next(_rid))
 
     # lifecycle (filled by the scheduler)
@@ -206,7 +224,11 @@ class ContinuousBatchingScheduler:
                  recovery_log: Any = None, watchdog: Any = None,
                  prefix_cache: Optional[PrefixIndex] = None,
                  drafter: Any = None, spec_k: int = 4,
-                 spec_adaptive: bool = True, role: str = "both"):
+                 spec_adaptive: bool = True, role: str = "both",
+                 tiers: Optional[Dict[str, TierConfig]] = None,
+                 tenants: Optional[Dict[str, TenantConfig]] = None,
+                 brownout: Optional[BrownoutConfig] = None,
+                 latency_preempt_budget: int = 2):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if shed_policy not in SHED_POLICIES:
@@ -255,6 +277,35 @@ class ContinuousBatchingScheduler:
         # page-reuse ratio
         self.page_stats: Dict[str, int] = {
             "logical": 0, "physical": 0, "shared": 0}
+        # multi-tenancy (docs/SERVING.md "Multi-tenancy & SLO tiers"):
+        # tiers=None keeps the scheduler byte-for-byte FIFO; with a tier
+        # table armed the queue is ordered by start-time-fair-queueing
+        # virtual time (per-tenant flows weighted by tier), admission
+        # partitions are per tier, and the brownout ladder degrades batch
+        # before standard before interactive under sustained pressure
+        self.tiers = dict(tiers) if tiers else None
+        self.tenants: Dict[str, TenantConfig] = dict(tenants) if tenants \
+            else {}
+        self._wfq = StartTimeFairQueue() if self.tiers else None
+        self._buckets: Dict[str, TokenBucket] = {}
+        self.brownout = (BrownoutController(brownout or BrownoutConfig())
+                         if self.tiers else None)
+        self.brownout_stage = 0
+        # how many times one batch request may be displaced by a queued
+        # interactive request before it becomes preemption-immune (0
+        # disables latency preemption; pool-pressure preemption is never
+        # budgeted — it is a capacity fact, not a policy choice)
+        self.latency_preempt_budget = int(latency_preempt_budget)
+        if self.tiers is not None:
+            total_reserved = sum(t.reserved_slots for t in
+                                 self.tiers.values())
+            if total_reserved >= self.num_slots:
+                raise ValueError(
+                    f"tier slot reservations ({total_reserved}) must leave "
+                    f"at least one unreserved slot of {self.num_slots}")
+        # distinct tenant ids observed at submit (tiered or not) — the
+        # evidence the serving/untiered-multi-tenant dslint rule reads
+        self.tenants_seen: Set[str] = set()
         self.queue: Deque[Request] = deque()
         self.slots: List[Optional[Request]] = [None] * self.num_slots
         self._slot_pages: List[List[int]] = [[] for _ in range(self.num_slots)]
@@ -362,18 +413,86 @@ class ContinuousBatchingScheduler:
             except Exception:  # event export must never fail serving
                 pass
 
+    # ------------------------------------------------------------- tenancy
+    def _tenant_fields(self, req: Request) -> Dict[str, Any]:
+        """Per-tenant attribution stamped onto recovery events: absent for
+        untenanted traffic, so the pre-tier event schema is unchanged."""
+        f: Dict[str, Any] = {}
+        if req.tenant_id is not None:
+            f["tenant_id"] = req.tenant_id
+        if req.tier is not None:
+            f["tier"] = req.tier
+        return f
+
+    def _resolve_tier(self, req: Request) -> Optional[str]:
+        """Effective tier of a submission (request override > tenant config
+        > DEFAULT_TIER), stamped back onto the request. None when untiered
+        (the request's tier field is left as-is for the ledger)."""
+        if req.tenant_id:
+            self.tenants_seen.add(req.tenant_id)
+        if self.tiers is None:
+            return None
+        tier = req.tier
+        if tier is None and req.tenant_id in self.tenants:
+            tier = self.tenants[req.tenant_id].tier
+        if tier not in self.tiers:
+            tier = DEFAULT_TIER if DEFAULT_TIER in self.tiers \
+                else min(self.tiers, key=tier_rank)
+        req.tier = tier
+        return tier
+
+    def _rate_limit_ok(self, req: Request, tcfg: TierConfig) -> bool:
+        """Per-tenant token bucket (work tokens/s): tenant override first,
+        tier default second, unlimited when neither sets a rate."""
+        if req.tenant_id is None:
+            return True
+        ten = self.tenants.get(req.tenant_id)
+        rate = (ten.rate_tokens_per_s if ten is not None
+                and ten.rate_tokens_per_s is not None
+                else tcfg.rate_tokens_per_s)
+        if rate is None:
+            return True
+        bucket = self._buckets.get(req.tenant_id)
+        if bucket is None:
+            burst = (ten.rate_burst_tokens if ten is not None
+                     and ten.rate_burst_tokens is not None
+                     else tcfg.rate_burst_tokens)
+            bucket = TokenBucket(rate, burst)
+            self._buckets[req.tenant_id] = bucket
+        return bucket.try_take(req.work_tokens, self.clock())
+
+    def _victim_key(self, slot: int) -> tuple:
+        """Preemption-victim ordering (``max()`` wins): untiered, pure
+        newest-first; tiered, batch slots die before interactive ones,
+        newest-first within a tier — the growing-slot rule is preserved
+        because the grower itself can still win."""
+        if self.tiers is None:
+            return (0, self._admit_seq[slot])
+        return sacrifice_key(self.slots[slot].tier, self._admit_seq[slot])
+
     def _mark_shed(self, req: Request, reason: str, detail: str = "") -> None:
         req.state = RequestState.REJECTED
         req.reject_reason = reason
         self.shed.append(req)
+        if (self.brownout is not None
+                and reason in ("queue_full", "token_backlog",
+                               "shed_for_smaller")):
+            # only ORGANIC pressure feeds the ladder: counting its own
+            # brownout sheds (or rate-limit/drain rejections) as pressure
+            # would latch the ladder at its deepest stage forever
+            self.brownout.observe("shed", self.clock())
         self._record("request_shed", rid=req.rid, reason=reason,
-                     work_tokens=req.work_tokens, detail=detail[:200])
+                     work_tokens=req.work_tokens, detail=detail[:200],
+                     **self._tenant_fields(req))
 
     def submit(self, req: Request) -> AdmissionVerdict:
         """Admission control. Returns a typed verdict — the caller sees WHY
         a request was turned away (unservable vs overload) instead of a
         silently growing queue. A rejected request is marked
         ``RequestState.REJECTED`` and never enters the queue."""
+        tier = self._resolve_tier(req)
+        if self.brownout is not None:
+            self.brownout.observe("submit", self.clock())
         if self._draining:
             detail = (f"request {req.rid} rejected: scheduler is draining "
                       f"({len(self.queue)} queued + "
@@ -397,30 +516,78 @@ class ContinuousBatchingScheduler:
                 f"front door, not mid-decode")
             self._mark_shed(req, "unservable", detail)
             return AdmissionVerdict(False, "unservable", detail)
+        tcfg = self.tiers[tier] if tier is not None else None
+        if tcfg is not None:
+            # degradation ladder: from shed_batch onward, new batch-tier
+            # work is turned away at the front door (reversible — the
+            # ladder steps back down when pressure clears)
+            if self.brownout_stage >= 1 and tier == "batch":
+                detail = (f"request {req.rid} rejected: brownout stage "
+                          f"{BROWNOUT_STAGES[self.brownout_stage]!r} sheds "
+                          f"batch-tier admissions")
+                self._mark_shed(req, "brownout", detail)
+                return AdmissionVerdict(False, "brownout", detail)
+            if not self._rate_limit_ok(req, tcfg):
+                detail = (f"request {req.rid} rejected: tenant "
+                          f"{req.tenant_id!r} token bucket empty "
+                          f"({req.work_tokens} work tokens requested)")
+                self._mark_shed(req, "rate_limited", detail)
+                return AdmissionVerdict(False, "rate_limited", detail)
         # overload control: queue-depth cap, then the token-budget estimate
+        # (per-tier partitions when a tier table is armed)
         verdict = self._admission_control(req)
         if not verdict.admitted:
             return verdict
         if req.ttft_deadline_s is None:
-            req.ttft_deadline_s = self.ttft_deadline_s
+            req.ttft_deadline_s = (tcfg.ttft_deadline_s
+                                   if tcfg is not None
+                                   and tcfg.ttft_deadline_s is not None
+                                   else self.ttft_deadline_s)
         if req.deadline_s is None:
-            req.deadline_s = self.deadline_s
+            req.deadline_s = (tcfg.deadline_s
+                              if tcfg is not None
+                              and tcfg.deadline_s is not None
+                              else self.deadline_s)
         req.state = RequestState.QUEUED
         if req.t_submit is None:
             req.t_submit = self.clock()
+        if self._wfq is not None:
+            # SFQ virtual-time tags: per-tenant flows, tier-weighted —
+            # a tenant's backlog chains behind itself, never behind
+            # another tenant's
+            req._wfq_start, req._wfq_finish = self._wfq.stamp(
+                req.tenant_id or "_anon", tcfg.weight, req.work_tokens)
         self.queue.append(req)
         return verdict
 
     def _admission_control(self, req: Request) -> AdmissionVerdict:
+        # untiered: one global partition (the whole queue, the global
+        # knobs). Tiered: the request competes only against its OWN tier's
+        # queued work, bounded by the tier's knobs (global fallback) — a
+        # batch flood exhausts the batch partition and draws token_backlog
+        # verdicts while interactive admission stays open.
+        tcfg = (self.tiers.get(req.tier)
+                if self.tiers is not None and req.tier is not None else None)
+        if tcfg is None:
+            pool = list(self.queue)
+            max_q, max_t = self.max_queue, self.max_queued_tokens
+        else:
+            pool = [r for r in self.queue
+                    if (r.tier or DEFAULT_TIER) == req.tier]
+            max_q = (tcfg.max_queue if tcfg.max_queue is not None
+                     else self.max_queue)
+            max_t = (tcfg.max_queued_tokens
+                     if tcfg.max_queued_tokens is not None
+                     else self.max_queued_tokens)
+
         def over(queued: List[Request]) -> bool:
-            depth = (self.max_queue is not None
-                     and len(queued) >= self.max_queue)
-            tokens = (self.max_queued_tokens is not None
+            depth = max_q is not None and len(queued) >= max_q
+            tokens = (max_t is not None
                       and sum(r.work_tokens for r in queued)
-                      + req.work_tokens > self.max_queued_tokens)
+                      + req.work_tokens > max_t)
             return depth or tokens
 
-        if not over(list(self.queue)):
+        if not over(pool):
             return AdmissionVerdict(True)
         if self.shed_policy == "reject_largest":
             # plan the shed set FIRST: the largest queued request(s) — the
@@ -428,7 +595,8 @@ class ContinuousBatchingScheduler:
             # larger than the incoming one (shedding down trades goodput
             # away). Victims are only actually sacrificed if the incoming
             # request then fits; otherwise nobody dies for a rejection.
-            sim = list(self.queue)
+            # Tiered, victims come from the request's own partition only.
+            sim = list(pool)
             victims: List[Request] = []
             while sim and over(sim):
                 v = max(sim, key=lambda r: r.work_tokens)
@@ -443,16 +611,15 @@ class ContinuousBatchingScheduler:
                                     f"shed for request {req.rid}")
                 return AdmissionVerdict(
                     True, shed_rid=victims[-1].rid if victims else None)
-        over_depth = (self.max_queue is not None
-                      and len(self.queue) >= self.max_queue)
+        over_depth = max_q is not None and len(pool) >= max_q
         reason = "queue_full" if over_depth else "token_backlog"
         detail = (
-            f"request {req.rid} rejected ({reason}): queue depth "
-            f"{len(self.queue)}" + (f"/{self.max_queue}" if self.max_queue
-                                    is not None else "")
-            + f", queued work {self.queued_tokens} tokens"
-            + (f"/{self.max_queued_tokens}" if self.max_queued_tokens
-               is not None else ""))
+            f"request {req.rid} rejected ({reason}): "
+            + (f"tier {req.tier!r} " if tcfg is not None else "")
+            + f"queue depth {len(pool)}"
+            + (f"/{max_q}" if max_q is not None else "")
+            + f", queued work {sum(r.work_tokens for r in pool)} tokens"
+            + (f"/{max_t}" if max_t is not None else ""))
         self._mark_shed(req, reason, detail)
         return AdmissionVerdict(False, reason, detail)
 
@@ -479,17 +646,32 @@ class ContinuousBatchingScheduler:
         req.state = RequestState.FINISHED
         req.t_done = self.clock()
         self.finished.append(req)
+        # the per-tenant goodput row (value = tokens delivered): with the
+        # shed/miss/preemption events this completes the Serving/* ledger's
+        # by-tenant accounting (docs/SERVING.md "Multi-tenancy & SLO tiers")
+        self._record("request_finished", value=float(len(req.tokens)),
+                     rid=req.rid, tokens=len(req.tokens),
+                     **self._tenant_fields(req))
         self._release(slot)
 
-    def _preempt(self, slot: int) -> None:
+    def _preempt(self, slot: int, why: str = "pool") -> None:
         """Recompute-style preemption: pages freed, generated tokens KEPT;
         re-admission prefills prompt+tokens (greedy decode reproduces the
-        exact state, no quality loss — only recomputed FLOPs)."""
+        exact state, no quality loss — only recomputed FLOPs).
+
+        ``why="pool"`` is page pressure; ``why="latency"`` is tier-aware
+        displacement by a protected request, charged against the victim's
+        bounded yield budget (:attr:`latency_preempt_budget`). Either way
+        the victim requeues at the front with its original SFQ tags —
+        oldest work still completes."""
         req = self.slots[slot]
         req.preemptions += 1
+        if why == "latency":
+            req._latency_preempts = getattr(req, "_latency_preempts", 0) + 1
         req.state = RequestState.QUEUED
-        self._record("preemption", rid=req.rid,
-                     tokens_done=len(req.tokens))
+        self._record("preemption", rid=req.rid, why=why,
+                     tokens_done=len(req.tokens),
+                     **self._tenant_fields(req))
         self._release(slot)
         self.queue.appendleft(req)
 
@@ -499,9 +681,12 @@ class ContinuousBatchingScheduler:
         req.reject_reason = f"deadline_{where}"
         self.expired.append(req)
         t0 = req.t_submit if req.t_submit is not None else now
+        if self.brownout is not None:
+            self.brownout.observe("miss", now)
         self._record("deadline_miss", value=now - t0,
                      rid=req.rid, where=where,
-                     tokens_done=len(req.tokens))
+                     tokens_done=len(req.tokens),
+                     **self._tenant_fields(req))
 
     def _sweep_deadlines(self) -> int:
         """Evict expired requests (queued: TTFT or e2e deadline already
@@ -715,18 +900,125 @@ class ContinuousBatchingScheduler:
         self.page_stats["shared"] += len(shared)
         return shared + own, len(shared)
 
+    def _peek_queued(self, blocked: Set[str]) -> Optional[Request]:
+        """Non-mutating admission pick: the request :meth:`_pick_queued`
+        would return, WITHOUT advancing SFQ virtual time."""
+        if self.tiers is None:
+            return self.queue[0] if self.queue else None
+        best: Optional[Request] = None
+        best_key = None
+        for r in self.queue:
+            tier = r.tier or DEFAULT_TIER
+            if tier in blocked:
+                continue
+            if self.brownout_stage >= 3 and tier_rank(tier) > 0:
+                continue
+            key = (getattr(r, "_wfq_start", 0.0),
+                   getattr(r, "_wfq_finish", 0.0), r.rid)
+            if best_key is None or key < best_key:
+                best, best_key = r, key
+        return best
+
+    def _pick_queued(self, blocked: Set[str]) -> Optional[Request]:
+        """The next queued request to admit. Untiered: the FIFO head.
+        Tiered: the minimum SFQ virtual-time tag (start, finish, rid) among
+        requests whose tier is neither pool-blocked this cycle (``blocked``
+        — per-tier head-of-line, so a pool-blocked batch head cannot block
+        interactive admission) nor held by the brownout ladder
+        (``hold_standard``: only interactive reaches a slot)."""
+        best = self._peek_queued(blocked)
+        if best is not None and self._wfq is not None:
+            self._wfq.on_select(getattr(best, "_wfq_start", 0.0))
+        return best
+
+    def _reserve_shortfall(self, tier: str) -> int:
+        """Free slots that must be LEFT OPEN when admitting ``tier``: the
+        summed ``reserved_slots`` of every more-protected tier. Strict
+        headroom — a protected tier's RUNNING requests do not repay its
+        reservation (crediting them would let lower tiers fill every other
+        slot the moment one interactive request runs, putting the next
+        arrival right back behind a standard decode). Protected tiers
+        admit past their reservation through the normal queue: the reserve
+        is a floor on instant availability, not a cap on use."""
+        if self.tiers is None:
+            return 0
+        rank = tier_rank(tier)
+        return sum(tc.reserved_slots for name, tc in self.tiers.items()
+                   if tc.reserved_slots and tier_rank(name) < rank)
+
+    def _latency_preempt(self, blocked: Set[str],
+                         pending: Set[int]) -> Optional[Tuple[int, Request]]:
+        """Tier-aware latency preemption: every slot is busy but the fair-
+        queue head is an INTERACTIVE request — sacrifice the newest
+        batch-tier slot (kept-token requeue) rather than make the
+        protected tier wait out a batch decode. Only interactive
+        displaces, and only batch is ever displaced: standard queues like
+        everyone else, and an interactive-vs-interactive conflict is real
+        contention, not a noisy neighbor. A victim already displaced
+        :attr:`latency_preempt_budget` times is IMMUNE — that bound is
+        what keeps the WFQ starvation-freedom property: under a sustained
+        interactive storm a batch request yields at most budget times,
+        then holds its slot to completion. Returns the freed slot and the
+        request it was freed FOR (force-admitted by the caller — the
+        displaced victim keeps its original minimal SFQ tag, so selection
+        alone cannot be trusted to not hand the slot straight back)."""
+        if (self.tiers is None or not self.queue
+                or self.latency_preempt_budget <= 0):
+            return None
+        head = self._peek_queued(blocked)
+        if head is None or tier_rank(head.tier or DEFAULT_TIER) != 0:
+            return None
+        # ``pending`` excludes slots claimed earlier in THIS admission
+        # cycle: they sit in the phase-2 prefill batch, and evicting one
+        # would leave a stale batch entry prefilling into a slot that no
+        # longer belongs to its request
+        victims = [s for s in self.active_slots
+                   if s not in pending
+                   and tier_rank(self.slots[s].tier) >= tier_rank("batch")
+                   and (getattr(self.slots[s], "_latency_preempts", 0)
+                        < self.latency_preempt_budget)]
+        if not victims:
+            return None
+        victim = max(victims, key=self._victim_key)
+        self._preempt(victim, why="latency")
+        self._wfq.on_select(getattr(head, "_wfq_start", 0.0))
+        self._audit_after_recovery("latency_preempt")
+        return victim, head
+
     def _admit(self) -> int:
         # phase 1: claim slots + pages for everything that fits this cycle
         batch = []  # (slot, context tokens, first unshared position)
-        for slot in range(self.num_slots):
-            if self.slots[slot] is not None or not self.queue:
+        free = deque(s for s in range(self.num_slots)
+                     if self.slots[s] is None)
+        blocked: Set[str] = set()  # tiers pool-blocked this cycle
+        forced: Optional[Request] = None  # latency-preempt beneficiary
+        while True:
+            if not free:
+                grab = self._latency_preempt(
+                    blocked, {slot for slot, _, _ in batch})
+                if grab is None:
+                    break
+                slot, forced = grab
+                free.append(slot)
+            slot = free[0]
+            req = forced if forced is not None else self._pick_queued(blocked)
+            forced = None
+            if req is None:
+                break
+            if len(free) <= self._reserve_shortfall(req.tier or DEFAULT_TIER):
+                # admitting would eat a more-protected tier's reserved
+                # slot — this tier sits the cycle out, the slot stays open
+                blocked.add(req.tier or DEFAULT_TIER)
                 continue
-            req = self.queue[0]
             if req.kv_payload is not None:
                 # disaggregated handoff arrival: admit by IMPORTING the
                 # prefill replica's exported pages — no prefill dispatch
-                if not self._admit_import(slot, req):
+                if self._admit_import(slot, req):
+                    free.popleft()
+                elif self.tiers is None:
                     break  # pool-blocked (FIFO) or the import failed
+                else:
+                    blocked.add(req.tier or DEFAULT_TIER)
                 continue
             ctx = req.context_len
             # +1: the first decode step appends its token's KV at position
@@ -734,9 +1026,14 @@ class ContinuousBatchingScheduler:
             need = pages_for(ctx + 1, self.page_size)
             claim = self._claim_pages(req, need)
             if claim is None:
-                break  # head-of-line blocking keeps FIFO order under pressure
+                if self.tiers is None:
+                    # head-of-line blocking keeps FIFO order under pressure
+                    break
+                blocked.add(req.tier or DEFAULT_TIER)
+                continue
+            free.popleft()
             pages, shared = claim
-            self.queue.popleft()
+            self.queue.remove(req)
             self._slot_pages[slot] = pages
             self._slot_shared[slot] = shared
             self.tables[slot] = 0
@@ -886,7 +1183,7 @@ class ContinuousBatchingScheduler:
                  if self.allocator.can_alloc(need) else None)
         if pages is None:
             return False
-        self.queue.popleft()
+        self.queue.remove(req)
         live = ctx - 1
         n_kv = pages_for(live, self.page_size) if live else 0
         try:
@@ -965,6 +1262,9 @@ class ContinuousBatchingScheduler:
         (or one safe decode BLOCK, or — with a drafter armed — one
         speculative verify window) over the slot array. Returns tokens
         produced."""
+        self._maybe_tenant_flood()
+        if self.brownout is not None:
+            self._brownout_tick()
         self._sweep_deadlines()
         self._admit()
         if not self.active_slots:
@@ -977,6 +1277,51 @@ class ContinuousBatchingScheduler:
             # plain decode path (speculation must never cost a step)
             self.spec_stats["fallback_steps"] += 1
         return self._decode_step()
+
+    def _brownout_tick(self) -> None:
+        """Poll the degradation ladder; on a transition, record the typed
+        ``tier_brownout`` event, apply the stage's mechanics, and prove
+        page conservation (every ladder transition is a recovery action)."""
+        stage = self.brownout.decide(self.clock())
+        if stage == self.brownout_stage:
+            return
+        prev, self.brownout_stage = self.brownout_stage, stage
+        if stage >= 2 and prev < 2:
+            # clamp_batch: cap the EXISTING batch backlog's generation
+            # budget so it drains capacity back faster (new batch work is
+            # already shed at stage >= 1). Never below what is already
+            # generated — a clamped running request simply finishes now.
+            for req in list(self.queue) + [self.slots[s]
+                                           for s in self.active_slots]:
+                if req is None or req.tier != "batch":
+                    continue
+                clamp = self.tiers["batch"].brownout_max_new
+                if clamp is not None and req.max_new_tokens > clamp:
+                    req.max_new_tokens = max(clamp, len(req.tokens), 1)
+        self._record("tier_brownout", value=float(stage), stage=stage,
+                     stage_name=BROWNOUT_STAGES[stage], prev=prev,
+                     direction="enter" if stage > prev else "exit")
+        self._audit_after_recovery("tier_brownout")
+
+    def _maybe_tenant_flood(self) -> None:
+        """Noisy-neighbor chaos: an armed ``FaultPlan.tenant_flood_at``
+        injects a one-shot burst of batch-tier submissions from one tenant
+        through the REAL ``submit()`` path at this step."""
+        burst = serving_tenant_flood(self.steps)
+        if burst is None:
+            return
+        vocab = max(int(burst["vocab"]), 2)
+        p_len = max(int(burst["prompt_tokens"]), 1)
+        for i in range(int(burst["requests"])):
+            prompt = (np.arange(1, p_len + 1, dtype=np.int32)
+                      * (i + 3)) % (vocab - 1) + 1
+            self.submit(Request(prompt=prompt.astype(np.int32),
+                                max_new_tokens=int(burst["max_new"]),
+                                tenant_id=burst["tenant_id"],
+                                tier="batch"))
+        self._record("tenant_flood", value=float(burst["requests"]),
+                     requests=int(burst["requests"]),
+                     tenant_id=burst["tenant_id"])
 
     def _spec_step(self) -> Optional[int]:
         """One speculation window: draft up to k tokens per active slot,
@@ -1009,8 +1354,7 @@ class ContinuousBatchingScheduler:
                 continue
             horizon = max(min(W, req.max_new_tokens - len(req.tokens)), 1)
             while not self._ensure_page(slot, horizon=horizon):
-                victim = max(self.active_slots,
-                             key=lambda s: self._admit_seq[s])
+                victim = max(self.active_slots, key=self._victim_key)
                 self._preempt(victim)
                 if victim == slot:
                     break
@@ -1097,9 +1441,10 @@ class ContinuousBatchingScheduler:
             while not self._ensure_page(slot, horizon=block):
                 # newest-admitted work yields FIRST — including the growing
                 # slot itself, so an old request is never evicted by a
-                # younger grower (oldest work always completes)
-                victim = max(self.active_slots,
-                             key=lambda s: self._admit_seq[s])
+                # younger grower (oldest work always completes). With tiers
+                # armed, batch slots are sacrificed before interactive ones
+                # (newest-first within a tier)
+                victim = max(self.active_slots, key=self._victim_key)
                 self._preempt(victim)
                 if victim == slot:
                     break

@@ -1,0 +1,345 @@
+"""SLO tiers and multi-tenant fairness for the serving stack.
+
+The overload controls of the admission-control PR treat all traffic as one
+class: a batch tenant flooding ``submit()`` degrades every interactive user
+identically.  This module gives the scheduler the vocabulary to honor
+per-SLO capacity contracts instead (docs/SERVING.md "Multi-tenancy & SLO
+tiers"):
+
+- :class:`TierConfig` — one service class (``interactive`` / ``standard`` /
+  ``batch``): WFQ weight, per-tier TTFT/e2e deadline defaults, per-tier
+  admission partitions, the brownout ``max_new`` clamp, and the default
+  per-tenant token-bucket rate.
+- :class:`TenantConfig` — one tenant: its tier plus optional rate overrides.
+- :class:`StartTimeFairQueue` — start-time fair queueing (SFQ) virtual-time
+  tags: per-tenant flows weighted by tier, provably starvation-free (every
+  backlogged flow's start tags advance, so min-tag selection serves each
+  flow within a weight-proportional bound).
+- :class:`TokenBucket` — per-tenant admission rate limit.
+- :class:`BrownoutController` — the degradation ladder: under sustained
+  pressure (shed-rate / deadline-miss trend over a sliding window) degrade
+  in tier order — shed batch first, then clamp batch ``max_new``, then hold
+  standard in the queue; interactive is protected until last.  Every
+  transition is reversible (exit hysteresis) and recorded as a typed
+  ``Serving/tier_brownout`` event by the scheduler.
+
+Nothing here touches a device: pure host-side bookkeeping the scheduler
+consults between dispatches.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+#: Canonical tier names, most- to least-protected.  Degradation walks this
+#: tuple from the right (batch sacrificed first); preemption victim
+#: selection uses the same order.
+TIER_ORDER: Tuple[str, str, str] = ("interactive", "standard", "batch")
+
+#: Degradation-ladder stage names, index == stage number.
+BROWNOUT_STAGES: Tuple[str, str, str, str] = (
+    "normal", "shed_batch", "clamp_batch", "hold_standard")
+
+#: Tier assumed for requests that carry no tier (and for unknown tenants).
+DEFAULT_TIER = "standard"
+
+
+@dataclass(frozen=True)
+class TierConfig:
+    """One service class. ``weight`` is the WFQ share under contention;
+    deadline fields are *defaults* applied at submit when the request
+    carries none (request-specified deadlines always win)."""
+
+    name: str
+    weight: float = 1.0
+    ttft_deadline_s: Optional[float] = None
+    deadline_s: Optional[float] = None
+    #: per-tier admission partition (falls back to the scheduler's global
+    #: ``max_queue`` / ``max_queued_tokens`` when None)
+    max_queue: Optional[int] = None
+    max_queued_tokens: Optional[int] = None
+    #: ``max_new_tokens`` clamp applied to this tier while the brownout
+    #: ladder is at ``clamp_batch`` or deeper (only meaningful for batch)
+    brownout_max_new: Optional[int] = None
+    #: default per-tenant token-bucket refill rate / capacity, in request
+    #: work-tokens per second (None = unlimited)
+    rate_tokens_per_s: Optional[float] = None
+    rate_burst_tokens: Optional[float] = None
+    #: decode slots held open for THIS tier: less-protected tiers are only
+    #: admitted while at least this many slots stay free (strict headroom
+    #: — running requests of this tier do NOT repay the reservation), so
+    #: an arrival in the protected tier finds a slot without waiting out
+    #: (or displacing) anyone. Capacity cost: lower tiers utilize at most
+    #: ``num_slots - reserved`` slots under sustained load. The scheduler
+    #: rejects tables whose total reservation eats every slot.
+    reserved_slots: int = 0
+
+    def validate(self) -> None:
+        if self.name not in TIER_ORDER:
+            raise ValueError(
+                f"unknown tier {self.name!r}: tiers are {TIER_ORDER}")
+        if not (self.weight > 0):
+            raise ValueError(
+                f"tier {self.name!r}: weight must be > 0, got {self.weight}")
+        for knob in ("ttft_deadline_s", "deadline_s", "rate_tokens_per_s",
+                     "rate_burst_tokens"):
+            v = getattr(self, knob)
+            if v is not None and not (float(v) > 0):
+                raise ValueError(
+                    f"tier {self.name!r}: {knob} must be > 0, got {v}")
+        for knob in ("max_queue", "max_queued_tokens", "brownout_max_new"):
+            v = getattr(self, knob)
+            if v is not None and int(v) < 1:
+                raise ValueError(
+                    f"tier {self.name!r}: {knob} must be >= 1, got {v}")
+        if int(self.reserved_slots) < 0:
+            raise ValueError(f"tier {self.name!r}: reserved_slots must be "
+                             f">= 0, got {self.reserved_slots}")
+
+
+@dataclass(frozen=True)
+class TenantConfig:
+    """One tenant: which tier it bills to, plus optional per-tenant
+    token-bucket overrides (None = the tier's default)."""
+
+    tenant_id: str
+    tier: str = DEFAULT_TIER
+    rate_tokens_per_s: Optional[float] = None
+    rate_burst_tokens: Optional[float] = None
+
+    def validate(self, tiers: Mapping[str, "TierConfig"]) -> None:
+        if self.tier not in tiers:
+            raise ValueError(
+                f"tenant {self.tenant_id!r}: unknown tier {self.tier!r} "
+                f"(configured: {sorted(tiers)})")
+        for knob in ("rate_tokens_per_s", "rate_burst_tokens"):
+            v = getattr(self, knob)
+            if v is not None and not (float(v) > 0):
+                raise ValueError(
+                    f"tenant {self.tenant_id!r}: {knob} must be > 0, got {v}")
+
+
+def default_tiers() -> Dict[str, TierConfig]:
+    """The shipped 3-tier contract: interactive holds its TTFT under load,
+    batch has no deadline and absorbs the shed."""
+    return {
+        "interactive": TierConfig("interactive", weight=8.0,
+                                  ttft_deadline_s=2.0, deadline_s=30.0),
+        "standard": TierConfig("standard", weight=3.0,
+                               ttft_deadline_s=10.0, deadline_s=120.0),
+        "batch": TierConfig("batch", weight=1.0, brownout_max_new=16),
+    }
+
+
+def resolve_tiers(spec: Any) -> Optional[Dict[str, TierConfig]]:
+    """Normalize a ``ServingConfig.tiers`` value into a validated
+    ``{name: TierConfig}`` table.
+
+    ``None`` → untiered (the scheduler keeps its FIFO semantics);
+    ``True`` or ``"default"`` → :func:`default_tiers`; a mapping of
+    ``{name: TierConfig | dict}`` → per-tier overrides merged over the
+    defaults (a dict value may omit ``name``).
+    """
+    if spec is None:
+        return None
+    if spec is True or spec == "default":
+        return default_tiers()
+    if not isinstance(spec, Mapping):
+        raise ValueError(
+            f"tiers must be None, True, 'default' or a mapping, "
+            f"got {type(spec).__name__}")
+    table = default_tiers()
+    for name, value in spec.items():
+        if isinstance(value, TierConfig):
+            cfg = value
+        elif isinstance(value, Mapping):
+            kw = dict(value)
+            kw.setdefault("name", name)
+            cfg = TierConfig(**kw)
+        else:
+            raise ValueError(
+                f"tier {name!r}: expected TierConfig or dict, "
+                f"got {type(value).__name__}")
+        if cfg.name != name:
+            raise ValueError(
+                f"tier key {name!r} != TierConfig.name {cfg.name!r}")
+        table[name] = cfg
+    for cfg in table.values():
+        cfg.validate()
+    return table
+
+
+def resolve_tenants(spec: Any,
+                    tiers: Mapping[str, TierConfig]) -> Dict[str, TenantConfig]:
+    """Normalize a ``ServingConfig.tenants`` value into a validated
+    ``{tenant_id: TenantConfig}`` table (unknown tenants default to
+    :data:`DEFAULT_TIER` at submit time — the table is a contract, not a
+    gate)."""
+    if spec is None:
+        return {}
+    if not isinstance(spec, Mapping):
+        raise ValueError(
+            f"tenants must be None or a mapping, got {type(spec).__name__}")
+    table: Dict[str, TenantConfig] = {}
+    for tenant_id, value in spec.items():
+        if isinstance(value, TenantConfig):
+            cfg = value
+        elif isinstance(value, Mapping):
+            kw = dict(value)
+            kw.setdefault("tenant_id", tenant_id)
+            cfg = TenantConfig(**kw)
+        elif isinstance(value, str):
+            cfg = TenantConfig(tenant_id, tier=value)
+        else:
+            raise ValueError(
+                f"tenant {tenant_id!r}: expected TenantConfig, dict or "
+                f"tier name, got {type(value).__name__}")
+        if cfg.tenant_id != tenant_id:
+            raise ValueError(f"tenant key {tenant_id!r} != "
+                             f"TenantConfig.tenant_id {cfg.tenant_id!r}")
+        cfg.validate(tiers)
+        table[tenant_id] = cfg
+    return table
+
+
+def tier_rank(tier: Optional[str]) -> int:
+    """Protection rank: 0 = interactive (most protected). Unknown/None
+    ranks as :data:`DEFAULT_TIER`."""
+    try:
+        return TIER_ORDER.index(tier)  # type: ignore[arg-type]
+    except ValueError:
+        return TIER_ORDER.index(DEFAULT_TIER)
+
+
+def sacrifice_key(tier: Optional[str], admit_seq: int) -> Tuple[int, int]:
+    """Preemption-victim ordering: batch slots die before interactive ones,
+    newest-first within a tier (``max()`` over this key picks the victim,
+    preserving the growing-slot rule — the grower itself can win)."""
+    return (tier_rank(tier), admit_seq)
+
+
+class TokenBucket:
+    """Per-tenant admission rate limit in work-tokens/s. ``try_take``
+    refills lazily from the wall clock it is handed (the scheduler's
+    injectable clock, so tests drive it manually)."""
+
+    def __init__(self, rate_tokens_per_s: float,
+                 burst_tokens: Optional[float] = None):
+        self.rate = float(rate_tokens_per_s)
+        self.burst = float(burst_tokens if burst_tokens is not None
+                           else max(self.rate, 1.0))
+        self.tokens = self.burst
+        self._last: Optional[float] = None
+
+    def _refill(self, now: float) -> None:
+        if self._last is not None and now > self._last:
+            self.tokens = min(self.burst,
+                              self.tokens + (now - self._last) * self.rate)
+        self._last = now if self._last is None else max(self._last, now)
+
+    def try_take(self, n: float, now: float) -> bool:
+        self._refill(now)
+        if self.tokens + 1e-9 >= n:
+            self.tokens -= n
+            return True
+        return False
+
+
+class StartTimeFairQueue:
+    """Start-time fair queueing (SFQ) virtual-time tags.
+
+    Flows are tenants; a flow's weight is its tier's WFQ weight.  At submit,
+    a request is stamped ``start = max(V, finish[flow])``,
+    ``finish = start + cost/weight`` (cost = work tokens), which chains a
+    tenant's backlog behind itself — a deep batch backlog pushes only its
+    *own* tags out, never another tenant's.  Selection takes the minimum
+    start tag and advances ``V`` to it, so every backlogged flow is served
+    within a weight-proportional bound (the WFQ starvation-freedom
+    property tested in tests/test_tenancy.py)."""
+
+    def __init__(self) -> None:
+        self.vtime = 0.0
+        self._finish: Dict[str, float] = {}
+
+    def stamp(self, flow: str, weight: float,
+              cost: float) -> Tuple[float, float]:
+        start = max(self.vtime, self._finish.get(flow, 0.0))
+        finish = start + max(float(cost), 1.0) / max(float(weight), 1e-9)
+        self._finish[flow] = finish
+        return start, finish
+
+    def on_select(self, start: float) -> None:
+        self.vtime = max(self.vtime, start)
+
+
+@dataclass
+class BrownoutConfig:
+    """Ladder thresholds. Pressure = organic shed rate (sheds NOT caused by
+    the ladder itself) or deadline misses over the sliding window; the
+    dwell time is the enter/exit hysteresis."""
+
+    window_s: float = 5.0
+    enter_shed_rate: float = 0.25
+    enter_misses: int = 2
+    #: exit when the window's shed rate is below this AND misses are quiet
+    exit_shed_rate: float = 0.05
+    #: minimum seconds between any two stage transitions (hysteresis)
+    min_dwell_s: float = 1.0
+
+
+@dataclass
+class BrownoutController:
+    """The degradation ladder's brain: feed it organic pressure events,
+    poll :meth:`decide` for the stage. One stage step per transition, both
+    directions, with ``min_dwell_s`` hysteresis so the ladder cannot
+    flap inside a window."""
+
+    cfg: BrownoutConfig = field(default_factory=BrownoutConfig)
+    stage: int = 0
+    _events: List[Tuple[float, str]] = field(default_factory=list)
+    _last_transition: Optional[float] = None
+
+    MAX_STAGE = len(BROWNOUT_STAGES) - 1
+
+    def observe(self, kind: str, now: float) -> None:
+        """``kind``: 'submit' | 'shed' (organic only) | 'miss'."""
+        self._events.append((float(now), kind))
+
+    def _window(self, now: float) -> Tuple[int, int, int]:
+        lo = now - self.cfg.window_s
+        self._events = [(t, k) for (t, k) in self._events if t >= lo]
+        submits = sum(1 for _, k in self._events if k == "submit")
+        sheds = sum(1 for _, k in self._events if k == "shed")
+        misses = sum(1 for _, k in self._events if k == "miss")
+        return submits, sheds, misses
+
+    def decide(self, now: float) -> int:
+        """Returns the (possibly new) stage; at most one step per call."""
+        if (self._last_transition is not None
+                and now - self._last_transition < self.cfg.min_dwell_s):
+            return self.stage
+        submits, sheds, misses = self._window(now)
+        shed_rate = sheds / max(submits, 1)
+        pressured = (shed_rate >= self.cfg.enter_shed_rate
+                     or misses >= self.cfg.enter_misses)
+        quiet = shed_rate < self.cfg.exit_shed_rate and misses == 0
+        if pressured and self.stage < self.MAX_STAGE:
+            self.stage += 1
+            self._last_transition = now
+        elif quiet and self.stage > 0:
+            self.stage -= 1
+            self._last_transition = now
+        return self.stage
+
+    @property
+    def stage_name(self) -> str:
+        return BROWNOUT_STAGES[self.stage]
+
+
+__all__ = [
+    "TIER_ORDER", "BROWNOUT_STAGES", "DEFAULT_TIER",
+    "TierConfig", "TenantConfig", "default_tiers", "resolve_tiers",
+    "resolve_tenants", "tier_rank", "sacrifice_key", "TokenBucket",
+    "StartTimeFairQueue", "BrownoutConfig", "BrownoutController",
+]

@@ -103,6 +103,15 @@ class FaultPlan:
       ``PageAllocator.alloc`` call reports pool exhaustion (returns None) —
       admission must queue (head-of-line) and growth must preempt, exactly
       as under real pool pressure.
+    - ``tenant_flood_at`` (+ ``tenant_flood_requests`` /
+      ``tenant_flood_prompt`` / ``tenant_flood_max_new`` /
+      ``tenant_flood_vocab`` / ``tenant_flood_tenant``): the noisy-neighbor
+      injection (docs/SERVING.md "Multi-tenancy & SLO tiers") — at scheduler
+      step ``tenant_flood_at`` a burst of batch-tier submissions from one
+      tenant hits ``submit()`` mid-stream. One-shot. A tiered scheduler must
+      keep interactive outputs greedy-identical to the un-flooded run while
+      the flood absorbs the shed; an untiered one degrades everybody (the
+      A/B the bench row measures).
 
     Offload-path injectors (docs/OFFLOAD.md; consumed by the streaming
     offload engine via :func:`offload_fetch_fault` at every blocking
@@ -139,6 +148,13 @@ class FaultPlan:
     dispatch_stall_seconds: float = 0.0
     alloc_fail_at: Optional[int] = None
     alloc_fail_times: int = 1
+    # noisy-neighbor injection (multi-tenant serving)
+    tenant_flood_at: Optional[int] = None
+    tenant_flood_requests: int = 8
+    tenant_flood_prompt: int = 8
+    tenant_flood_max_new: int = 8
+    tenant_flood_vocab: int = 64
+    tenant_flood_tenant: str = "flooder"
     # offload-path injectors
     stall_offload_at: Optional[int] = None
     stall_offload_seconds: float = 0.0
@@ -151,6 +167,7 @@ class FaultPlan:
     _collective_stall_fired: bool = dataclasses.field(default=False, repr=False)
     _ef_overflows_left: int = dataclasses.field(default=0, repr=False)
     _offload_stall_fired: bool = dataclasses.field(default=False, repr=False)
+    _tenant_flood_fired: bool = dataclasses.field(default=False, repr=False)
 
     def __post_init__(self) -> None:
         self._io_failures_left = int(self.fail_io_times)
@@ -275,6 +292,20 @@ class FaultPlan:
             return 0.0
         self._offload_stall_fired = True
         return float(self.stall_offload_seconds)
+
+    def serving_tenant_flood(self, step: int) -> Optional[Dict[str, Any]]:
+        """The noisy-neighbor burst spec armed for scheduler step ``step``,
+        or None. One-shot: a scheduler polling every step fires it exactly
+        once, at the first step >= ``tenant_flood_at``."""
+        if (self.tenant_flood_at is None or self._tenant_flood_fired
+                or step < int(self.tenant_flood_at)):
+            return None
+        self._tenant_flood_fired = True
+        return {"requests": int(self.tenant_flood_requests),
+                "prompt_tokens": int(self.tenant_flood_prompt),
+                "max_new": int(self.tenant_flood_max_new),
+                "vocab": int(self.tenant_flood_vocab),
+                "tenant_id": str(self.tenant_flood_tenant)}
 
     def serving_alloc(self, index: int) -> bool:
         """Whether ``PageAllocator.alloc`` call ``index`` should report pool
@@ -405,6 +436,25 @@ def offload_fetch_fault(index: int) -> None:
         time.sleep(stall)
 
 
+def serving_tenant_flood(step: int) -> Optional[Dict[str, Any]]:
+    """The noisy-neighbor burst spec armed for scheduler step ``step`` (None
+    when no plan is installed or the flood already fired). Consumed by the
+    continuous-batching scheduler at the top of ``step()``: the burst's
+    batch-tier requests go through the REAL ``submit()`` path — admission
+    partitions, WFQ tags, token buckets, and the brownout ladder all see
+    them exactly as organic traffic."""
+    plan = get_fault_plan()
+    if plan is None:
+        return None
+    burst = plan.serving_tenant_flood(step)
+    if burst is not None:
+        logger.warning(
+            f"chaos: tenant flood at scheduler step #{step}: "
+            f"{burst['requests']} batch-tier requests from tenant "
+            f"{burst['tenant_id']!r}")
+    return burst
+
+
 def serving_alloc_fault(index: int) -> bool:
     """Whether the armed plan wants ``PageAllocator.alloc`` call ``index``
     to report exhaustion (False when no plan is installed)."""
@@ -422,4 +472,4 @@ __all__ = ["FaultPlan", "TrainingFaults", "ServingFault",
            "InjectedDispatchError", "FAULT_PLAN_ENV", "install_plan",
            "get_fault_plan", "fault_point", "training_faults",
            "serving_dispatch_fault", "serving_alloc_fault",
-           "offload_fetch_fault"]
+           "serving_tenant_flood", "offload_fetch_fault"]

@@ -53,6 +53,15 @@ quantized KV pages off over the wire (ownership transfer — the prefill
 side frees only after the decode side imports), and finish decoding on
 the other, generate-identical, with BOTH replicas' page audits clean
 after the drain.
+
+``--tiers`` (docs/SERVING.md "Multi-tenancy & SLO tiers") runs a 3-tier
+mixed-tenant stream on the REAL engine and injects a noisy-neighbor batch
+flood (``FaultPlan.tenant_flood_at``) mid-stream: interactive/standard
+outputs must stay generate-identical through the flood, the degradation
+ladder must run >= 1 full brownout cycle (typed ``tier_brownout``
+enter AND exit events, each page-audited), the flood must be bounded —
+shed with typed verdicts but never fully starved — the per-tenant ledger
+must attribute every tenant, and the pools must drain to zero.
 """
 
 import os
@@ -553,6 +562,124 @@ def disagg_main() -> int:
     return 0
 
 
+def tiers_main() -> int:
+    """SLO-tiered multi-tenancy end to end on the real engine
+    (docs/SERVING.md "Multi-tenancy & SLO tiers"): a 3-tier mixed stream
+    with one injected batch flood (``FaultPlan.tenant_flood_at``). Asserts
+    interactive/standard outputs stay generate-identical through the
+    flood, the degradation ladder runs >= 1 full brownout cycle (enter AND
+    exit), every ladder transition passes the page-conservation audit, and
+    the pools drain to zero."""
+    import tempfile
+    import time
+
+    from deepspeed_tpu.inference.serving import RequestState
+    from deepspeed_tpu.resilience import (FaultPlan, RecoveryLog,
+                                          install_plan, read_events)
+
+    cfg = G.GPTConfig(vocab_size=64, d_model=32, n_layer=2, n_head=4,
+                      max_seq_len=128)
+    params = G.init_params(cfg, jax.random.PRNGKey(0))
+    # a tight batch partition (max_queue=2) turns the flood into organic
+    # queue_full sheds — the pressure signal that latches the ladder; the
+    # short window/dwell lets the exit half of the cycle land in CI time
+    eng = ServingEngine(cfg, params, ServingConfig(
+        num_slots=3, page_size=8, max_model_len=64, prefill_chunk=16,
+        dtype="float32", decode_block=4, max_queue=32,
+        tiers={"batch": {"max_queue": 2, "brownout_max_new": 4}},
+        tenants={"alice": "interactive", "bob": "standard",
+                 "carl": "batch"},
+        brownout_window_s=0.8, brownout_enter_shed_rate=0.25,
+        brownout_enter_misses=99, brownout_exit_shed_rate=0.05,
+        brownout_min_dwell_s=0.05))
+    eng.warmup()
+
+    rng = np.random.default_rng(31)
+    wl = []
+    for tenant in ("alice", "alice", "alice", "bob", "bob", "carl"):
+        r = Request(prompt=rng.integers(0, 64,
+                                        (int(rng.integers(4, 20)),))
+                    .astype(np.int32),
+                    max_new_tokens=int(rng.integers(5, 12)),
+                    tenant_id=tenant)
+        wl.append(r)
+    protected = [r for r in wl if r.tenant_id in ("alice", "bob")]
+
+    tmpdir = tempfile.mkdtemp(prefix="serving_tiers_")
+    log = RecoveryLog.for_dir(tmpdir, role="serving", prefix="Serving")
+    install_plan(FaultPlan(tenant_flood_at=2, tenant_flood_requests=8,
+                           tenant_flood_prompt=8, tenant_flood_max_new=8,
+                           tenant_flood_vocab=64))
+    try:
+        sched = eng.make_scheduler(recovery_log=log)
+        for r in wl:
+            assert sched.submit(r).admitted, r.rid
+        sched.run_to_completion()
+    finally:
+        install_plan(None)
+    assert sched.counters.get("tenant_flood") == 1, sched.counters
+    # idle ticks let the window drain: the ladder must step fully back
+    # down (the reversibility half of the cycle)
+    deadline = time.monotonic() + 30.0
+    while sched.brownout_stage > 0:
+        assert time.monotonic() < deadline, "brownout never exited"
+        time.sleep(0.05)
+        sched.step()
+    events = read_events(tmpdir)
+    enters = sum(1 for e in events if e["event"] == "tier_brownout"
+                 and e.get("direction") == "enter")
+    exits = sum(1 for e in events if e["event"] == "tier_brownout"
+                and e.get("direction") == "exit")
+    assert enters >= 1 and exits >= 1, (enters, exits)
+    print(f"[tiers] brownout cycle complete: {enters} enter / {exits} exit "
+          f"transitions, every one page-audited")
+
+    # the flood drew typed verdicts (queue_full / brownout), never silence;
+    # the admitted slice of the flood was served, not starved
+    flood = [r for r in sched.finished + sched.shed
+             if r.tenant_id == "flooder"]
+    assert len(flood) == 8, len(flood)
+    served = [r for r in flood if r.state is RequestState.FINISHED]
+    assert served, "batch-tier flood fully starved"
+    assert all(r.reject_reason in ("queue_full", "token_backlog",
+                                   "brownout")
+               for r in flood if r.state is RequestState.REJECTED)
+    print(f"[tiers] flood of 8: {len(served)} served, "
+          f"{len(flood) - len(served)} shed with typed verdicts")
+
+    # interactive/standard rode through the flood untouched: every
+    # protected request finished, greedy-identical to generate
+    assert all(r.state is RequestState.FINISHED for r in protected), \
+        [(r.rid, r.state) for r in protected]
+    ie = InferenceEngine(for_gpt(cfg, params), DeepSpeedInferenceConfig(
+        dtype="float32", max_out_tokens=64))
+    for r in protected + served:
+        ref = np.asarray(ie.generate(
+            np.asarray(r.prompt)[None],
+            max_new_tokens=r.max_new_tokens))[0, len(r.prompt):]
+        got = np.asarray(r.tokens[:r.max_new_tokens])
+        assert np.array_equal(ref, got), (r.rid, ref, got)
+    print("[tiers] interactive/standard outputs identical to "
+          "InferenceEngine.generate through the flood")
+
+    # per-tenant accounting flowed: every tenant attributable in the ledger
+    assert sched.tenants_seen >= {"alice", "bob", "carl", "flooder"}, \
+        sched.tenants_seen
+    shed_tenants = {e.get("tenant_id") for e in events
+                    if e["event"] == "request_shed"}
+    assert "flooder" in shed_tenants, shed_tenants
+    fin_tiers = {e.get("tier") for e in events
+                 if e["event"] == "request_finished"}
+    assert {"interactive", "standard"} <= fin_tiers, fin_tiers
+    rep = sched.audit()
+    assert rep["ok"] and sched.allocator.allocated_pages == 0, rep
+    print("[tiers] per-tenant ledger attributable, pool drained, "
+          "audit clean")
+
+    print("serving_smoke[tiers]: PASS")
+    return 0
+
+
 if __name__ == "__main__":
     if "--chaos" in sys.argv[1:]:
         sys.exit(chaos_main())
@@ -564,4 +691,6 @@ if __name__ == "__main__":
         sys.exit(fleet_main())
     if "--disagg" in sys.argv[1:]:
         sys.exit(disagg_main())
+    if "--tiers" in sys.argv[1:]:
+        sys.exit(tiers_main())
     sys.exit(main())

@@ -97,6 +97,7 @@ if ! timeout -k 10 480 env JAX_PLATFORMS=cpu \
         python -m pytest tests/test_serving.py tests/test_serving_chaos.py \
         tests/test_paged_kv.py tests/test_fleet.py tests/test_speculation.py \
         tests/test_decode_attention.py tests/test_tp_serving.py \
+        tests/test_tenancy.py \
         -q -m 'not slow' \
         -p no:cacheprovider -p no:randomly > /tmp/_t1_serving.log 2>&1; then
     echo "verify_tier1: FAIL — serving/paged-KV tests:" >&2
@@ -186,6 +187,21 @@ if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
     exit 1
 fi
 grep -a "serving_smoke\[disagg\]: PASS" /tmp/_t1_serving_disagg.log || true
+
+# the multi-tenancy smoke (docs/SERVING.md "Multi-tenancy & SLO tiers"):
+# a 3-tier mixed-tenant stream with an injected noisy-neighbor batch
+# flood — interactive/standard outputs generate-identical, >= 1 full
+# brownout enter/exit cycle with every transition page-audited, the flood
+# shed with typed verdicts but never fully starved, pools drained.
+if ! timeout -k 10 300 env JAX_PLATFORMS=cpu \
+        python scripts/serving_smoke.py --tiers \
+        > /tmp/_t1_serving_tiers.log 2>&1; then
+    echo "verify_tier1: FAIL — serving multi-tenancy smoke" \
+         "(scripts/serving_smoke.py --tiers):" >&2
+    tail -30 /tmp/_t1_serving_tiers.log >&2
+    exit 1
+fi
+grep -a "serving_smoke\[tiers\]: PASS" /tmp/_t1_serving_tiers.log || true
 
 # --- offload gate (docs/OFFLOAD.md) ---------------------------------------
 # the streamed host<->HBM DMA pipeline: streamed-vs-inline bitwise

@@ -1,0 +1,536 @@
+"""SLO-tier multi-tenancy tests (docs/SERVING.md "Multi-tenancy & SLO
+tiers"): WFQ starvation-freedom, tier-aware preemption ordering, brownout
+enter/exit hysteresis, token-bucket refill, per-tenant ledger schema, the
+noisy-neighbor chaos injection, fleet-wide per-tenant event attribution,
+and the ``serving/untiered-multi-tenant`` dslint rule — all device-free on
+the fake executor like tests/test_serving_chaos.py."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.analysis import analyze_compile_log
+from deepspeed_tpu.inference.serving import (BrownoutConfig,
+                                             BrownoutController,
+                                             ContinuousBatchingScheduler,
+                                             Request, RequestState,
+                                             ServingConfig,
+                                             StartTimeFairQueue, TierConfig,
+                                             TokenBucket, default_tiers,
+                                             resolve_tenants, resolve_tiers,
+                                             sacrifice_key, tier_rank)
+from deepspeed_tpu.resilience import FaultPlan, RecoveryLog, install_plan
+
+
+class FakeExecutor:
+    """Same arithmetic executor as tests/test_serving_chaos.py: greedy
+    outputs are a pure function of the prompt, so tiered/untiered/flooded
+    runs are directly comparable."""
+
+    def prefill(self, slot, tokens, table_row):
+        return (int(tokens[-1]) + 1) % 97
+
+    def decode(self, tokens, tables, lengths, active, steps=1):
+        return np.stack([(tokens + k + 1) % 97 for k in range(steps)])
+
+
+class ManualClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _tiered_kw(tiers_spec=True, tenants_spec=None):
+    tiers = resolve_tiers(tiers_spec)
+    return dict(tiers=tiers,
+                tenants=resolve_tenants(tenants_spec, tiers))
+
+
+def _sched(num_slots=2, num_pages=64, page_size=4, pages_per_seq=8,
+           decode_block=1, **kw):
+    return ContinuousBatchingScheduler(
+        FakeExecutor(), num_slots=num_slots, num_pages=num_pages,
+        page_size=page_size, pages_per_seq=pages_per_seq,
+        decode_block=decode_block, **kw)
+
+
+def _req(n=3, m=4, tenant=None, tier=None):
+    return Request(prompt=np.arange(1, n + 1, dtype=np.int32),
+                   max_new_tokens=m, tenant_id=tenant, tier=tier)
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_plan():
+    install_plan(None)
+    yield
+    install_plan(None)
+
+
+# ------------------------------------------------------------------ WFQ
+def test_wfq_tags_chain_per_flow():
+    """A deep backlog pushes only its OWN flow's tags out: another flow
+    submitting later still stamps near the virtual time, not behind the
+    backlog."""
+    q = StartTimeFairQueue()
+    for _ in range(10):
+        q.stamp("bulk", 1.0, 8.0)          # finish tags reach 80
+    s, f = q.stamp("fresh", 8.0, 8.0)
+    assert s == 0.0 and f == 1.0           # cost/weight, not behind bulk
+
+
+def test_wfq_starvation_freedom_under_interactive_saturation():
+    """Sustained interactive saturation: a batch request submitted into the
+    storm still completes while interactive backlog remains — and within a
+    weight-proportional number of interactive completions (w_i/w_b = 8)."""
+    s = _sched(num_slots=1, **_tiered_kw(
+        tenants_spec={"i": "interactive", "b": "batch"}))
+    interactive = [_req(3, 4, tenant="i") for _ in range(24)]
+    for r in interactive[:4]:
+        s.submit(r)
+    for _ in range(6):
+        s.step()
+    batch = _req(3, 4, tenant="b")
+    assert s.submit(batch).admitted
+    done_at_batch_finish = None
+    i = 4
+    for _ in range(2000):
+        # keep the interactive flow saturated: top the queue back up the
+        # moment it dips, so batch never sees an idle slot for free
+        while i < len(interactive) and len(s.queue) < 3:
+            s.submit(interactive[i])
+            i += 1
+        s.step()
+        if (batch.state is RequestState.FINISHED
+                and done_at_batch_finish is None):
+            done_at_batch_finish = sum(
+                r.state is RequestState.FINISHED for r in interactive)
+        if s.idle and i >= len(interactive):
+            break
+    assert batch.state is RequestState.FINISHED
+    assert done_at_batch_finish is not None
+    # not starved until the storm ended...
+    assert done_at_batch_finish < len(interactive)
+    # ...and served within the weight-proportional bound (8x weight ratio
+    # at equal cost, +2 slack for the requests already in flight)
+    assert done_at_batch_finish <= 10, done_at_batch_finish
+    assert s.audit()["ok"]
+
+
+def test_untiered_scheduler_keeps_fifo_order():
+    """tiers=None is the seed scheduler: strict FIFO service order."""
+    s = _sched(num_slots=1)
+    reqs = [_req(3, 2), _req(4, 2), _req(5, 2)]
+    for r in reqs:
+        s.submit(r)
+    s.run_to_completion(max_steps=200)
+    finishes = [r.rid for r in sorted(reqs, key=lambda r: r.t_done)]
+    assert finishes == [r.rid for r in reqs]
+
+
+# ----------------------------------------------------- tiered preemption
+def test_preemption_sacrifices_batch_before_interactive():
+    """Pool pressure preempts the batch slot even when the interactive slot
+    is newer — tier rank outranks admit recency (untiered keeps pure
+    newest-first via the same key shape)."""
+    assert sacrifice_key("batch", 0) > sacrifice_key("interactive", 99)
+    assert tier_rank(None) == tier_rank("standard")
+    # pool: 1 reserved + 6 usable pages; two requests of 1 prompt page each
+    # growing 3+ pages force an allocation failure mid-decode
+    s = _sched(num_slots=2, num_pages=7, page_size=4, pages_per_seq=6,
+               **_tiered_kw(tenants_spec={"i": "interactive",
+                                          "b": "batch"}))
+    batch = _req(4, 14, tenant="b")
+    inter = _req(4, 14, tenant="i")
+    s.submit(batch)   # batch admitted FIRST (oldest — seed policy would
+    s.submit(inter)   # have preempted the newer interactive request)
+    s.run_to_completion(max_steps=500)
+    assert batch.state is RequestState.FINISHED
+    assert inter.state is RequestState.FINISHED
+    assert batch.preemptions >= 1
+    assert inter.preemptions == 0
+    assert s.audit()["ok"]
+
+
+def test_latency_preemption_displaces_batch_within_budget():
+    """A queued interactive request does not wait out a batch decode: the
+    batch slot is displaced (kept-token requeue, tokens unchanged), but
+    only ``latency_preempt_budget`` times — after that the victim is
+    immune and finishes ahead of later interactive arrivals (the WFQ
+    starvation-freedom bound), and standard-tier arrivals never displace
+    anyone."""
+    def build(budget):
+        return _sched(num_slots=1, latency_preempt_budget=budget,
+                      **_tiered_kw(tenants_spec={"i": "interactive",
+                                                 "s": "standard",
+                                                 "b": "batch"}))
+
+    # clean reference: the arithmetic executor's outputs are a pure
+    # function of the prompt, so the displaced run must reproduce them
+    ref = build(1)
+    ref_batch = _req(3, 12, tenant="b")
+    ref.submit(ref_batch)
+    ref.run_to_completion(max_steps=200)
+
+    s = build(1)
+    batch = _req(3, 12, tenant="b")
+    s.submit(batch)
+    s.step()                      # batch running, holds the only slot
+    inter1 = _req(4, 3, tenant="i")
+    s.submit(inter1)
+    s.step()
+    assert batch.state is RequestState.QUEUED      # displaced...
+    assert inter1.state is RequestState.RUNNING    # ...same cycle
+    assert batch.preemptions == 1
+    # drive until the batch request is back in its slot
+    for _ in range(50):
+        s.step()
+        if batch.state is RequestState.RUNNING:
+            break
+    assert batch.state is RequestState.RUNNING
+    inter2 = _req(4, 3, tenant="i")
+    s.submit(inter2)
+    s.step()
+    # budget spent: the victim is immune, the new interactive waits
+    assert batch.state is RequestState.RUNNING
+    assert batch.preemptions == 1
+    s.run_to_completion(max_steps=500)
+    assert all(r.state is RequestState.FINISHED
+               for r in (batch, inter1, inter2))
+    assert batch.t_done < inter2.t_done
+    assert list(batch.tokens) == list(ref_batch.tokens)
+    assert s.audit()["ok"]
+
+    # standard never triggers displacement
+    s2 = build(8)
+    b2 = _req(3, 12, tenant="b")
+    s2.submit(b2)
+    s2.step()
+    s2.submit(_req(4, 3, tenant="s"))
+    s2.step()
+    assert b2.state is RequestState.RUNNING
+    assert b2.preemptions == 0
+    s2.run_to_completion(max_steps=500)
+    assert s2.audit()["ok"]
+
+
+def test_reserved_slots_hold_capacity_for_interactive():
+    """``TierConfig.reserved_slots``: lower tiers are admitted only while
+    enough free slots remain to cover the protected tier's unmet
+    reservation — an interactive arrival finds a slot open without
+    displacing anyone, and the reserved slot is a floor on availability,
+    not a cap on interactive's use of the rest."""
+    tiers = resolve_tiers({"interactive": {"reserved_slots": 1}})
+    kw = dict(tiers=tiers,
+              tenants=resolve_tenants({"i": "interactive", "b": "batch"},
+                                      tiers))
+    s = _sched(num_slots=2, **kw)
+    b1, b2 = _req(3, 10, tenant="b"), _req(3, 10, tenant="b")
+    s.submit(b1)
+    s.submit(b2)
+    s.step()
+    # only one batch slot admitted: the other slot is interactive's floor
+    assert b1.state is RequestState.RUNNING
+    assert b2.state is RequestState.QUEUED
+    inter = _req(4, 3, tenant="i")
+    s.submit(inter)
+    s.step()
+    assert inter.state is RequestState.RUNNING   # no wait, no displacement
+    assert b1.preemptions == 0
+    s.run_to_completion(max_steps=500)
+    assert all(r.state is RequestState.FINISHED for r in (b1, b2, inter))
+    assert s.audit()["ok"]
+
+    # a reservation table that eats every slot is a config error
+    with pytest.raises(ValueError):
+        _sched(num_slots=1, **dict(
+            kw, tiers=resolve_tiers({"interactive": {"reserved_slots": 1}})))
+
+
+# ------------------------------------------------------------- brownout
+def test_brownout_enters_and_exits_with_hysteresis():
+    ctl = BrownoutController(BrownoutConfig(
+        window_s=5.0, enter_shed_rate=0.25, enter_misses=2,
+        exit_shed_rate=0.05, min_dwell_s=1.0))
+    for _ in range(8):
+        ctl.observe("submit", 0.0)
+    for _ in range(4):
+        ctl.observe("shed", 0.0)           # shed rate 0.5
+    assert ctl.decide(0.5) == 1            # escalate one stage
+    assert ctl.decide(1.0) == 1            # dwell gate: no double-step
+    assert ctl.decide(1.6) == 2            # still pressured: next stage
+    assert ctl.stage_name == "clamp_batch"
+    # window drains; quiet -> step back DOWN one stage per dwell
+    assert ctl.decide(10.0) == 1
+    assert ctl.decide(10.5) == 1           # dwell gates the exit too
+    assert ctl.decide(11.5) == 0
+    assert ctl.stage_name == "normal"
+
+
+def test_brownout_miss_trigger_and_max_stage():
+    ctl = BrownoutController(BrownoutConfig(min_dwell_s=0.1))
+    ctl.observe("miss", 0.0)
+    ctl.observe("miss", 0.0)
+    for i, expect in enumerate((1, 2, 3)):
+        # misses stay in the window: the ladder walks to its ceiling and
+        # stops (never past hold_standard)
+        ctl.observe("miss", i * 0.2)
+        assert ctl.decide(0.15 + i * 0.2) == expect
+    ctl.observe("miss", 1.0)
+    ctl.observe("miss", 1.0)
+    assert ctl.decide(1.0) == 3            # MAX_STAGE is a ceiling
+
+
+def test_brownout_scheduler_sheds_batch_and_recovers():
+    """Integration: organic sheds latch the ladder, batch admissions draw
+    'brownout' verdicts while interactive stays open, and the ladder steps
+    back down when pressure clears — each transition audited."""
+    ck = ManualClock()
+    tiers = resolve_tiers({"batch": {"max_queue": 1}})
+    s = _sched(num_slots=1, clock=ck, tiers=tiers,
+               tenants=resolve_tenants({"b": "batch", "i": "interactive"},
+                                       tiers),
+               brownout=BrownoutConfig(window_s=5.0, enter_shed_rate=0.25,
+                                       enter_misses=99, min_dwell_s=1.0))
+    # saturate the batch partition (max_queue=1): organic queue_full sheds
+    verdicts = [s.submit(_req(3, 4, tenant="b")) for _ in range(6)]
+    assert sum(v.admitted for v in verdicts) <= 2
+    assert any(v.reason == "queue_full" for v in verdicts)
+    ck.t = 1.0
+    s.step()
+    assert s.brownout_stage >= 1
+    assert s.counters.get("tier_brownout", 0) >= 1
+    # batch now shed at the front door with the BROWNOUT verdict...
+    v = s.submit(_req(3, 4, tenant="b"))
+    assert not v.admitted and v.reason == "brownout"
+    # ...while interactive admission stays open
+    inter = _req(3, 4, tenant="i")
+    assert s.submit(inter).admitted
+    s.run_to_completion(max_steps=300)
+    assert inter.state is RequestState.FINISHED
+    # pressure cleared: the ladder steps fully back down
+    for k in range(1, 30):
+        ck.t = 10.0 + k
+        s.step()
+        if s.brownout_stage == 0:
+            break
+    assert s.brownout_stage == 0
+    assert s.audit()["ok"]
+
+
+# ----------------------------------------------------------- token bucket
+def test_token_bucket_refill_and_burst_cap():
+    b = TokenBucket(rate_tokens_per_s=10.0, burst_tokens=20.0)
+    assert b.try_take(20, now=0.0)          # full burst available
+    assert not b.try_take(1, now=0.0)       # empty
+    assert b.try_take(10, now=1.0)          # 1s refilled exactly 10
+    assert not b.try_take(1, now=1.0)
+    assert b.try_take(20, now=100.0)        # refill is capped at burst
+    assert not b.try_take(25, now=200.0)    # can never exceed burst
+
+
+def test_scheduler_rate_limits_per_tenant():
+    ck = ManualClock()
+    tiers = resolve_tiers(True)
+    s = _sched(clock=ck, tiers=tiers, tenants=resolve_tenants(
+        {"slow": {"tier": "standard", "rate_tokens_per_s": 7.0,
+                  "rate_burst_tokens": 7.0}}, tiers))
+    assert s.submit(_req(3, 4, tenant="slow")).admitted   # cost 7 = burst
+    v = s.submit(_req(3, 4, tenant="slow"))
+    assert not v.admitted and v.reason == "rate_limited"
+    assert s.counters["request_shed"] == 1
+    ck.t = 1.0                                            # refill 7 tokens
+    assert s.submit(_req(3, 4, tenant="slow")).admitted
+    # other tenants are not throttled by the slow tenant's bucket
+    assert s.submit(_req(3, 4, tenant="other")).admitted
+
+
+# ------------------------------------------------------ per-tenant ledger
+def test_per_tenant_ledger_schema(tmp_path):
+    """Recovery events carry tenant_id/tier for tenanted traffic and keep
+    the pre-tier schema (no tenant keys at all) for untenanted traffic."""
+    from deepspeed_tpu.resilience.events import read_events
+
+    log = RecoveryLog(str(tmp_path / "ev.jsonl"), role="serving",
+                      prefix="Serving")
+    s = _sched(recovery_log=log,
+               **_tiered_kw(tenants_spec={"a": "interactive"}))
+    r1 = _req(3, 4, tenant="a")
+    r2 = _req(4, 3)                        # untenanted rides along
+    s.submit(r1)
+    s.submit(r2)
+    s.run_to_completion(max_steps=200)
+    evs = read_events(str(tmp_path / "ev.jsonl"))
+    fin = {e.get("rid"): e for e in evs if e["event"] == "request_finished"}
+    assert fin[r1.rid]["tenant_id"] == "a"
+    assert fin[r1.rid]["tier"] == "interactive"
+    assert fin[r1.rid]["tokens"] == len(r1.tokens)
+    assert "tenant_id" not in fin[r2.rid]
+    assert s.tenants_seen == {"a"}
+
+
+def test_report_breaks_down_by_tier_and_tenant():
+    """_report: REJECTED requests count against their OWN group's shed
+    rate; a victim tier's misses stay its own."""
+    from deepspeed_tpu.inference.serving.bench import _report
+
+    reqs = []
+    for k in range(4):
+        r = _req(3, 4, tenant="flood", tier="batch")
+        r.arrival_time = 0.0
+        if k < 3:
+            r.state = RequestState.REJECTED   # the flooder eats its sheds
+        reqs.append(r)
+    ok = _req(3, 4, tenant="vip", tier="interactive")
+    ok.arrival_time = 0.0
+    ok.t_first_token, ok.t_done = 0.1, 0.2
+    ok.tokens = [1, 2, 3, 4]
+    reqs.append(ok)
+    row = _report(reqs, t0=0.0, t_end=1.0, mode="continuous", slo_s=5.0)
+    assert row["by_tenant"]["flood"]["shed"] == 3
+    assert row["by_tenant"]["flood"]["shed_rate"] == 0.75
+    assert row["by_tenant"]["vip"]["shed"] == 0
+    assert row["by_tenant"]["vip"]["deadline_misses"] == 0
+    assert row["by_tier"]["interactive"]["goodput_tokens"] == 4
+    # the fleet aggregate still counts every shed once
+    assert row["shed"] == 3
+
+
+# -------------------------------------------------- noisy-neighbor chaos
+def test_tenant_flood_chaos_interactive_unharmed():
+    """FaultPlan.tenant_flood_at injects a batch burst mid-stream: the
+    interactive outputs are greedy-identical to an un-flooded run, the
+    flood is not fully starved, and the allocator audit is clean."""
+    def build(tiered=True):
+        kw = _tiered_kw(tenants_spec={"i": "interactive"}) if tiered else {}
+        s = _sched(num_slots=2, num_pages=64, **kw)
+        reqs = [_req(3, 6, tenant="i"), _req(5, 4, tenant="i"),
+                _req(2, 8, tenant="i")]
+        return s, reqs
+
+    # clean run: no plan installed
+    s0, clean = build()
+    for r in clean:
+        s0.submit(r)
+    s0.run_to_completion(max_steps=500)
+
+    install_plan(FaultPlan(tenant_flood_at=2, tenant_flood_requests=5,
+                           tenant_flood_prompt=6, tenant_flood_max_new=4))
+    s1, reqs = build()
+    for r in reqs:
+        s1.submit(r)
+    s1.run_to_completion(max_steps=2000)
+    assert s1.counters.get("tenant_flood") == 1
+    assert [list(r.tokens) for r in reqs] == [list(r.tokens) for r in clean]
+    assert all(r.state is RequestState.FINISHED for r in reqs)
+    # bounded wait: the flood's batch-tier requests were served (or shed
+    # with a typed verdict), never silently starved in the queue
+    flood = [r for r in s1.finished + s1.shed
+             if r.tenant_id == "flooder"]
+    assert len(flood) == 5
+    assert any(r.state is RequestState.FINISHED for r in flood)
+    assert s1.audit()["ok"]
+    assert s1.allocator.allocated_pages == 0
+    assert "flooder" in s1.tenants_seen
+
+
+def test_fleet_summary_attributes_by_tenant():
+    """summarize_events merges tenant-stamped rows fleet-wide, and the
+    AutoscalePolicy scale-up trigger reads the interactive-tier miss trend
+    specifically."""
+    from deepspeed_tpu.inference.fleet.autoscale import (AutoscalePolicy,
+                                                         summarize_events)
+
+    now = 100.0
+    events = [
+        {"unix_time": 99.0, "event": "request_finished", "tokens": 8,
+         "tenant_id": "a", "tier": "interactive"},
+        {"unix_time": 99.0, "event": "request_shed",
+         "tenant_id": "b", "tier": "batch"},
+        {"unix_time": 92.0, "event": "deadline_miss",
+         "tenant_id": "a", "tier": "interactive"},
+        {"unix_time": 99.5, "event": "deadline_miss",
+         "tenant_id": "a", "tier": "interactive"},
+        {"unix_time": 99.6, "event": "deadline_miss",
+         "tenant_id": "a", "tier": "interactive"},
+    ]
+    s = summarize_events(events, now, window_s=10.0)
+    assert s["by_tenant"]["a"]["goodput_tokens"] == 8.0
+    assert s["by_tenant"]["b"]["shed"] == 1
+    assert s["by_tier"]["interactive"]["deadline_misses"] == 3
+    assert s["interactive_misses"] == 3
+    assert s["interactive_miss_trend"] == 2 - 1
+    pol = AutoscalePolicy(miss_floor=2, shed_rate_up=1.0)
+    assert pol.decide(s, num_replicas=1, occupancy=0.5,
+                      now=now) == "scale_up"
+    # flat interactive trend (and quiet fleet trend): hold
+    quiet = summarize_events(
+        [{"unix_time": 92.0, "event": "deadline_miss",
+          "tier": "interactive"}], now, 10.0)
+    assert pol.decide(quiet, 1, 0.9, now) == "hold"
+
+
+def test_tier_rides_fleet_wire_spec():
+    """request_spec/LocalReplica.submit round-trip tenant_id + tier, so a
+    re-route or handoff keeps the request's SLO class."""
+    from deepspeed_tpu.inference.fleet.replica import (LocalReplica,
+                                                       request_spec)
+
+    req = _req(3, 4, tenant="gold", tier="interactive")
+    spec = request_spec(req)
+    assert spec["tenant_id"] == "gold" and spec["tier"] == "interactive"
+    rep = LocalReplica("r0", scheduler=_sched(**_tiered_kw()))
+    assert rep.submit(spec)["admitted"]
+    inner = rep.sched.queue[0]
+    assert inner.tenant_id == "gold" and inner.tier == "interactive"
+
+
+# ----------------------------------------------------------- dslint rule
+def test_untiered_multi_tenant_rule_fires_and_stays_silent():
+    """serving/untiered-multi-tenant: WARNING when >=2 tenants were served
+    with no tier config armed; silent with tiers armed, with <2 tenants,
+    and on engines that never built a scheduler."""
+    class Eng:
+        compile_log = []
+
+        def __init__(self, cfg, sched=None):
+            self.serving = cfg
+            self.last_scheduler = sched
+
+    class Sched:
+        def __init__(self, tenants):
+            self.tenants_seen = set(tenants)
+            self.tiers = None
+
+    safe = dict(max_queue=8)  # keep unbounded-admission out of the frame
+    f = analyze_compile_log(
+        Eng(ServingConfig(**safe), Sched({"a", "b"}))).findings
+    assert [x.rule_id for x in f] == ["serving/untiered-multi-tenant"]
+    assert f[0].severity.name == "WARNING"
+    # tiers armed -> silent
+    assert not analyze_compile_log(
+        Eng(ServingConfig(tiers=True, **safe), Sched({"a", "b"}))).findings
+    # single tenant -> silent
+    assert not analyze_compile_log(
+        Eng(ServingConfig(**safe), Sched({"a"}))).findings
+    # no scheduler ever built -> silent
+    assert not analyze_compile_log(Eng(ServingConfig(**safe))).findings
+    # live tiered scheduler with two tenants seen -> silent end to end
+    live = _sched(**_tiered_kw())
+    for t in ("a", "b"):
+        live.submit(_req(3, 2, tenant=t))
+    live.run_to_completion(max_steps=100)
+    assert not analyze_compile_log(
+        Eng(ServingConfig(tiers=True, **safe), live)).findings
+
+
+def test_tier_config_validation():
+    with pytest.raises(ValueError):
+        resolve_tiers({"interactive": {"weight": -1.0}})
+    with pytest.raises(ValueError):
+        resolve_tiers({"gold": {}})       # unknown tier name
+    tiers = default_tiers()
+    with pytest.raises(ValueError):
+        resolve_tenants({"a": "gold"}, tiers)   # unknown tier for tenant
+    assert isinstance(tiers["batch"], TierConfig)
+    assert tiers["interactive"].weight > tiers["batch"].weight
