@@ -39,16 +39,17 @@ from .rules_hostsync import hostsync_rules
 from .rules_offload import offload_rules
 from .rules_pipeline import pipeline_rules
 from .rules_precision import precision_rules
+from .rules_resilience import resilience_rules
 from .rules_serving import serving_rules
 from .rules_sharding import sharding_rules
 from .schedule import ScheduleIR, prove_schedule, schedule_report
 
 
 def default_rules() -> List[Rule]:
-    """The shipped rule set, all eight families."""
+    """The shipped rule set, all nine families."""
     return (sharding_rules() + precision_rules() + hostsync_rules()
             + collective_rules() + config_rules() + serving_rules()
-            + offload_rules() + pipeline_rules())
+            + offload_rules() + pipeline_rules() + resilience_rules())
 
 
 def options_from_config(block) -> AnalysisOptions:
@@ -212,6 +213,6 @@ __all__ = [
     "AnalysisOptions", "AnalysisError", "ProgramIR", "capture",
     "default_rules", "options_from_config", "analyze_engine", "analyze_fn",
     "analyze_compile_log", "analyze_schedule", "synthesize_batch",
-    "offload_rules", "pipeline_rules", "ScheduleIR", "prove_schedule",
-    "schedule_report",
+    "offload_rules", "pipeline_rules", "resilience_rules", "ScheduleIR",
+    "prove_schedule", "schedule_report",
 ]

@@ -1,0 +1,106 @@
+"""Resilience rules: configurations whose data crosses a trust boundary
+with no integrity check on the other side.
+
+Silent data corruption (a DRAM bit flip in a host-offload shard, a rotted
+KV page served to a second request, a torn handoff payload) produces no
+exception — just wrong numbers, discovered hours later as a diverged loss
+or a garbage completion. The defense (docs/RESILIENCE.md "Data integrity")
+is cheap and opt-in: blockwise fingerprints over the mutable-at-rest state
+plus mandatory verification wherever bytes change owner. These rules flag
+configs that arm a sharing/streaming surface but leave its verification
+off — the exact shape in which SDC goes undetected.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from .core import AnalysisContext, Finding, Rule, Severity
+
+
+class UnverifiedTrustBoundaryRule(Rule):
+    """A config arms a surface where bytes are handed to another consumer —
+    KV pages shared across requests (``enable_prefix_cache``), KV payloads
+    shipped across replicas (disaggregated prefill/decode), or master/opt
+    shards streamed through host RAM every step — without the matching
+    fingerprint verification, so a silent flip propagates instead of being
+    contained at the boundary."""
+
+    rule_id = "resilience/unverified-trust-boundary"
+    default_severity = Severity.WARNING
+    description = "shared/streamed state crosses a trust boundary unverified"
+
+    def check_context(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        yield from self._check_serving(ctx)
+        yield from self._check_offload(ctx)
+
+    # -------------------------------------------------------------- serving
+    def _check_serving(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        cfg = getattr(ctx.engine, "serving", None) \
+            if ctx.engine is not None else None
+        if cfg is None or not hasattr(cfg, "page_fingerprints"):
+            return  # not a serving engine (or a pre-integrity one)
+        if getattr(cfg, "page_fingerprints", False):
+            return
+        surfaces = []
+        if getattr(cfg, "enable_prefix_cache", False):
+            surfaces.append(
+                "enable_prefix_cache shares immutable KV pages across "
+                "requests (one rotted page poisons every borrower)")
+        if getattr(cfg, "role", "both") in ("prefill", "decode"):
+            surfaces.append(
+                f"role={cfg.role!r} ships KV payloads across replicas "
+                f"(a torn transfer decodes into garbage tokens)")
+        if not surfaces:
+            return
+        yield self.finding(
+            "KV bytes cross a trust boundary unverified: "
+            + "; ".join(surfaces)
+            + " — with page_fingerprints off there is no stamp to check at "
+              "share, scan, or import time, so silent corruption is served "
+              "as if it were canonical KV",
+            location="ServingConfig.page_fingerprints",
+            suggestion="set ServingConfig(page_fingerprints=True) — pages "
+                       "are stamped once when they become immutable and "
+                       "re-verified at share/import/scan/audit; a mismatch "
+                       "evicts the page and re-prefills borrowers "
+                       "(docs/RESILIENCE.md 'Data integrity')",
+        )
+
+    # -------------------------------------------------------------- offload
+    def _check_offload(self, ctx: AnalysisContext) -> Iterable[Finding]:
+        zero = getattr(ctx.config, "zero_optimization", None)
+        if zero is None:
+            return
+        surfaces = []
+        for field in ("offload_optimizer", "offload_param"):
+            blk = getattr(zero, field, None)
+            device = getattr(getattr(blk, "device", None), "value",
+                             getattr(blk, "device", None))
+            if device in ("cpu", "nvme"):
+                surfaces.append(f"{field} ({device})")
+        if not surfaces:
+            return
+        res = getattr(ctx.config, "resilience", None)
+        integ = getattr(res, "integrity", None)
+        if integ is not None and getattr(integ, "enabled", False):
+            return
+        yield self.finding(
+            f"host-offloaded optimizer state ({', '.join(surfaces)}) sits "
+            f"in plain host RAM between steps with no integrity scan armed "
+            f"— a DRAM bit flip in a master/opt shard is consumed by the "
+            f"next optimizer step and silently diverges training",
+            location="config.resilience.integrity",
+            suggestion="arm resilience.integrity (enabled: true) — the "
+                       "budgeted background scan fingerprints shard blocks "
+                       "between steps and a detected flip rolls back to a "
+                       "verified anchor instead of training on corrupt "
+                       "state (docs/RESILIENCE.md 'Data integrity')",
+        )
+
+
+def resilience_rules() -> List[Rule]:
+    return [UnverifiedTrustBoundaryRule()]
+
+
+__all__ = ["UnverifiedTrustBoundaryRule", "resilience_rules"]

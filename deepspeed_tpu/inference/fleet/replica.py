@@ -90,7 +90,7 @@ def encode_kv_payload(payload: Dict[str, Any]) -> Dict[str, Any]:
     base64 text. Quantized pools keep their wire advantage — the int8/int4
     payload plus fp32 per-page scales is what gets encoded, 2-4x smaller
     than fp32 pages before base64's constant 4/3."""
-    return {
+    wire = {
         "page_ids": [int(p) for p in payload["page_ids"]],
         "tensors": {
             k: {"dtype": str(t["dtype"]),
@@ -98,6 +98,11 @@ def encode_kv_payload(payload: Dict[str, Any]) -> Dict[str, Any]:
                 "data": base64.b64encode(t["data"]).decode("ascii")}
             for k, t in payload["tensors"].items()},
     }
+    if "fingerprints" in payload:
+        # integrity stamp (algo + per-pool ints) is already JSON-safe; it
+        # must survive the wire so the importer can refuse a torn transfer
+        wire["fingerprints"] = payload["fingerprints"]
+    return wire
 
 
 def decode_kv_payload(wire: Dict[str, Any]) -> Dict[str, Any]:
@@ -109,8 +114,11 @@ def decode_kv_payload(wire: Dict[str, Any]) -> Dict[str, Any]:
             data = base64.b64decode(data)
         tensors[k] = {"dtype": t["dtype"],
                       "shape": [int(x) for x in t["shape"]], "data": data}
-    return {"page_ids": [int(p) for p in wire["page_ids"]],
-            "tensors": tensors}
+    out = {"page_ids": [int(p) for p in wire["page_ids"]],
+           "tensors": tensors}
+    if "fingerprints" in wire:
+        out["fingerprints"] = wire["fingerprints"]
+    return out
 
 
 def _verdict_dict(v) -> Dict[str, Any]:

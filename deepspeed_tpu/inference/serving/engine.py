@@ -70,6 +70,14 @@ class ServingConfig:
     # with the same page-aligned token blocks share physical pages through
     # the allocator refcounts + PrefixIndex hash chains
     enable_prefix_cache: bool = False
+    # KV-page integrity (docs/RESILIENCE.md "Data integrity"): fingerprint
+    # pages as they freeze behind the write frontier (prefix registration,
+    # handoff staging) and verify at every trust boundary — prefix share,
+    # handoff import, recovery audits, plus a budgeted background sweep of
+    # pages_scan_per_step stamped pages per scheduler step. A mismatch
+    # evicts the page and re-prefills its borrowers (greedy-identical heal)
+    page_fingerprints: bool = False
+    pages_scan_per_step: int = 1
     # decode block: when no scheduling event (admission, page growth, eos,
     # slot finish) can occur within the next K steps, the scheduler runs K
     # decode steps as ONE compiled scan — K-1 host round-trips saved per
@@ -646,8 +654,15 @@ class ServingEngine:
             tensors[key] = {"dtype": sel.dtype.name,
                             "shape": list(sel.shape),
                             "data": sel.tobytes()}
-        return {"page_ids": [int(p) for p in np.asarray(page_ids)],
-                "tensors": tensors}
+        payload = {"page_ids": [int(p) for p in np.asarray(page_ids)],
+                   "tensors": tensors}
+        if self.serving.page_fingerprints:
+            # stamp the exact bytes crossing the trust boundary; the
+            # importer re-fingerprints and refuses a torn transfer
+            from ...resilience.integrity import payload_fingerprints
+
+            payload["fingerprints"] = payload_fingerprints(tensors)
+        return payload
 
     def import_pages(self, page_ids, payload: dict) -> None:
         """Install a handoff payload (``export_pages`` on the prefill side)
@@ -660,6 +675,18 @@ class ServingEngine:
                 f"handoff pool mismatch: payload has {sorted(src)}, engine "
                 f"pools are {sorted(self.paged_cache)} (kv_bits must match "
                 f"across prefill and decode replicas)")
+        stamp = payload.get("fingerprints")
+        if stamp:
+            # any stamped payload is verified regardless of the local flag:
+            # the exporter paid for the stamp precisely so a torn transfer
+            # is refused here rather than decoded into garbage tokens
+            from ...resilience.integrity import verify_payload_fingerprints
+
+            bad = verify_payload_fingerprints(src, stamp)
+            if bad:
+                raise ValueError(
+                    "handoff payload failed fingerprint verification "
+                    f"({bad}) — refusing the transfer")
         ids = jnp.asarray(np.asarray(page_ids, np.int32))
         cache = dict(self.paged_cache)
         for key, rec in src.items():
@@ -674,6 +701,46 @@ class ServingEngine:
         if self.tp_context is not None:
             # the functional .at[].set above may drop the NamedSharding —
             # pin the pools back onto the tp mesh before the next dispatch
+            cache = self.tp_context.shard_cache(cache)
+        self.paged_cache = cache
+
+    def fingerprint_pages(self, page_ids) -> list:
+        """Fingerprint the CURRENT pool contents of ``page_ids``: one crc
+        per page, chained across every pool tensor in sorted-key order so a
+        flip in any of k/v (or their quantization scales) changes the page's
+        print. This is the scheduler's scan/audit primitive — pulled to host
+        once per call, so callers budget the page count."""
+        from ...resilience.fingerprint import CHECKSUMS, preferred_checksum
+
+        fn = CHECKSUMS[preferred_checksum()]
+        ids = np.asarray(page_ids, np.int32)
+        if ids.size == 0:
+            return []
+        host = {key: np.asarray(arr[:, :, jnp.asarray(ids)])
+                for key, arr in sorted(self.paged_cache.items())}
+        out = []
+        for j in range(ids.size):
+            crc = 0
+            for key in sorted(host):
+                crc = fn(np.ascontiguousarray(host[key][:, :, j]).tobytes(),
+                         crc)
+            out.append(int(crc))
+        return out
+
+    def corrupt_page_bit(self, page: int) -> None:
+        """Chaos-only: flip one real bit in ``page``'s content in the first
+        pool tensor — the scheduler's ``flip_bit_at`` (domain ``kv_page``)
+        injection lands here so SDC detection is exercised against genuine
+        pool bytes, not a mocked flag."""
+        key = sorted(self.paged_cache)[0]
+        arr = self.paged_cache[key]
+        host = np.array(arr[:, :, int(page)])  # forced writable host copy
+        flat = host.reshape(-1).view(np.uint8)
+        flat[flat.size // 2] ^= 0x01
+        cache = dict(self.paged_cache)
+        cache[key] = arr.at[:, :, int(page)].set(
+            jnp.asarray(host, arr.dtype))
+        if self.tp_context is not None:
             cache = self.tp_context.shard_cache(cache)
         self.paged_cache = cache
 
@@ -801,7 +868,9 @@ class ServingEngine:
             recovery_log=recovery_log, watchdog=watchdog,
             prefix_cache=prefix_cache, drafter=drafter, spec_k=s.spec_k,
             spec_adaptive=s.spec_adaptive, role=s.role,
-            tiers=tiers, tenants=tenants, brownout=brownout)
+            tiers=tiers, tenants=tenants, brownout=brownout,
+            page_fingerprints=s.page_fingerprints,
+            pages_scan_per_step=s.pages_scan_per_step)
         sched._owns_watchdog = owns
         self.last_scheduler = sched
         return sched

@@ -48,7 +48,7 @@ Two robustness hooks (docs/SERVING.md "Overload & failure"):
 from __future__ import annotations
 
 import hashlib
-from typing import Dict, FrozenSet, List, Optional, Sequence
+from typing import Callable, Dict, FrozenSet, List, Optional, Sequence
 
 import numpy as np
 
@@ -158,15 +158,41 @@ class PageAllocator:
         self._ref[page] -= 1
         return fresh[0]
 
-    def audit(self) -> Dict[str, object]:
+    def audit(self, expected_fingerprints: Optional[Dict[int, int]] = None,
+              fingerprint_fn: Optional[Callable[[Sequence[int]], List[int]]]
+              = None) -> Dict[str, object]:
         """Conservation invariant over the pool: every page id 1..N-1 is in
         exactly one of {free list, allocated set}, with no duplicates, no
         reserved-page escapes, and every allocated page holding >= 1 live
         reference. Returns ``{"ok", "free", "allocated", "total", "refs",
-        "errors"}`` — ``errors`` names each violated invariant. Run by the
-        scheduler after every recovery action; a non-clean audit there is a
-        page leak in the fault-handling path."""
+        "errors", "fingerprinted", "mismatches"}`` — ``errors`` names each
+        violated invariant. Run by the scheduler after every recovery
+        action; a non-clean audit there is a page leak in the fault-handling
+        path.
+
+        Opt-in fingerprint sweep (docs/RESILIENCE.md "Data integrity"):
+        given ``expected_fingerprints`` (page id → fingerprint, stamped when
+        the page froze behind the write frontier) and ``fingerprint_fn``
+        (page ids → current content fingerprints), every SHARED page
+        (refcount > 1 — the pages more than one request reads verbatim) with
+        a stamp is re-fingerprinted; a mismatch is silent corruption of an
+        immutable page and fails the audit by name."""
         errors: List[str] = []
+        fingerprinted = 0
+        fp_mismatches: List[int] = []
+        if expected_fingerprints and fingerprint_fn is not None:
+            shared = sorted(p for p, c in self._ref.items()
+                            if c > 1 and p in expected_fingerprints)
+            if shared:
+                actual = fingerprint_fn(shared)
+                fingerprinted = len(shared)
+                for p, fp in zip(shared, actual):
+                    if int(fp) != int(expected_fingerprints[p]):
+                        fp_mismatches.append(p)
+                if fp_mismatches:
+                    errors.append(
+                        f"shared-page fingerprint mismatch (silent "
+                        f"corruption of immutable pages): {fp_mismatches}")
         free_set = set(self._free)
         if len(free_set) != len(self._free):
             errors.append("duplicate ids in the free list")
@@ -191,7 +217,9 @@ class PageAllocator:
                 f"allocated {len(self._ref)} != total {total}")
         return {"ok": not errors, "free": len(free_set),
                 "allocated": len(self._ref), "total": total,
-                "refs": sum(self._ref.values()), "errors": errors}
+                "refs": sum(self._ref.values()), "errors": errors,
+                "fingerprinted": fingerprinted,
+                "mismatches": fp_mismatches}
 
     def free(self, pages: Sequence[int]) -> List[int]:
         """Drop one reference per page. Pages whose LAST reference died are

@@ -72,7 +72,8 @@ from typing import Any, Deque, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-from ...resilience.chaos import serving_dispatch_fault, serving_tenant_flood
+from ...resilience.chaos import (sdc_flip_fault, serving_dispatch_fault,
+                                 serving_tenant_flood)
 from ...resilience.retry import backoff_delay
 from .paging import (PageAllocator, PrefixIndex, pages_for,
                      prefix_chain_hashes)
@@ -228,7 +229,9 @@ class ContinuousBatchingScheduler:
                  tiers: Optional[Dict[str, TierConfig]] = None,
                  tenants: Optional[Dict[str, TenantConfig]] = None,
                  brownout: Optional[BrownoutConfig] = None,
-                 latency_preempt_budget: int = 2):
+                 latency_preempt_budget: int = 2,
+                 page_fingerprints: bool = False,
+                 pages_scan_per_step: int = 1):
         if num_slots < 1:
             raise ValueError("num_slots must be >= 1")
         if shed_policy not in SHED_POLICIES:
@@ -271,6 +274,17 @@ class ContinuousBatchingScheduler:
         # looks the prompt's page-aligned prefix up in the index and SHAREs
         # those physical pages instead of allocating fresh ones
         self.prefix_cache = prefix_cache
+        # silent-corruption defense for immutable KV (docs/RESILIENCE.md
+        # "Data integrity"): pages behind the write frontier are stamped
+        # with a content fingerprint when they become shareable (prefix
+        # registration, handoff staging) and re-verified at every trust
+        # boundary (share-time claim, background scan, recovery audit). A
+        # mismatch evicts the page from the prefix index and unwinds
+        # borrowers to a clean re-prefill — never a blind retry.
+        self.page_fingerprints = bool(page_fingerprints)
+        self.pages_scan_per_step = max(0, int(pages_scan_per_step))
+        self._page_fp: Dict[int, int] = {}
+        self._page_scan_rr = 0  # round-robin cursor over stamped pages
         # cumulative page accounting: logical = pages every admission asked
         # for, physical = pages actually allocated, shared = pages served
         # from the prefix index — physical/logical is the bench row's
@@ -634,6 +648,9 @@ class ContinuousBatchingScheduler:
             # a page whose LAST reference died is about to be recycled — it
             # must never serve another request's prefix lookup
             self.prefix_cache.forget(released)
+        for p in released:
+            # recycled page: its old content stamp is meaningless
+            self._page_fp.pop(p, None)
         self._slot_pages[slot] = []
         self._slot_shared[slot] = 0
         self.tables[slot] = 0
@@ -795,7 +812,11 @@ class ContinuousBatchingScheduler:
         a shared page: every page referenced by more than one slot must lie
         entirely below each referencing slot's write frontier (a full
         prefix page), because the next append lands at ``lengths[slot]``."""
-        rep = self.allocator.audit()
+        fp_fn = (getattr(self.executor, "fingerprint_pages", None)
+                 if self.page_fingerprints else None)
+        rep = (self.allocator.audit(expected_fingerprints=self._page_fp,
+                                    fingerprint_fn=fp_fn)
+               if fp_fn is not None else self.allocator.audit())
         errors: List[str] = list(rep["errors"])
         refs: Dict[int, int] = {}
         for s_idx, pages in enumerate(self._slot_pages):
@@ -853,6 +874,85 @@ class ContinuousBatchingScheduler:
             raise RuntimeError(
                 f"page conservation broken after {context}: {rep['errors']}")
 
+    # ---------------------------------------------- KV-page data integrity
+    def _stamp_pages(self, pages: List[int]) -> None:
+        """Fingerprint pages whose content just became IMMUTABLE (full
+        prefix pages at registration, staged handoff pages). Stamp-once:
+        a page already stamped keeps its first-writer fingerprint — a
+        re-stamp would bless whatever bytes are there now, corrupt or not.
+        Stamps die with the page in :meth:`_release`."""
+        if not self.page_fingerprints:
+            return
+        fn = getattr(self.executor, "fingerprint_pages", None)
+        todo = [p for p in pages if p not in self._page_fp]
+        if fn is None or not todo:
+            return
+        for p, fp in zip(todo, fn(todo)):
+            self._page_fp[p] = int(fp)
+
+    def _verify_pages(self, pages: List[int], context: str) -> List[int]:
+        """Re-fingerprint stamped pages and return the mismatches (each
+        recorded as a typed ``sdc_detected`` event). Unstamped pages are
+        skipped — they are still behind an active write frontier."""
+        fn = getattr(self.executor, "fingerprint_pages", None)
+        check = [p for p in pages if p in self._page_fp]
+        if fn is None or not check:
+            return []
+        bad = [p for p, fp in zip(check, fn(check))
+               if int(fp) != self._page_fp[p]]
+        for p in bad:
+            self._record("sdc_detected", domain="kv_page", page=int(p),
+                         context=context,
+                         refcount=self.allocator.refcount(p))
+        return bad
+
+    def _quarantine_page(self, page: int, context: str) -> None:
+        """Containment + healing for a corrupt KV page: forget it in the
+        prefix index (no future admission borrows it), void its stamp, and
+        preempt every slot referencing it — recompute-style, so each victim
+        re-prefills prompt + kept tokens into clean pages and greedy decode
+        reproduces the exact stream. Never a blind retry over rotten KV."""
+        if self.prefix_cache is not None:
+            self.prefix_cache.forget([page])
+        self._page_fp.pop(page, None)
+        victims = [i for i, pages in enumerate(self._slot_pages)
+                   if page in pages and self.slots[i] is not None
+                   and i not in self._handoff_slots]
+        for i in victims:
+            self._preempt(i, why="sdc")
+        self._record("sdc_healed", domain="kv_page", page=int(page),
+                     context=context, victims=len(victims))
+        self._audit_after_recovery(f"sdc_{context}")
+
+    def _integrity_scan(self) -> None:
+        """Budgeted background sweep: verify up to ``pages_scan_per_step``
+        stamped pages round-robin per scheduler step, quarantining any
+        mismatch. Also the serving consumption point for the chaos plan's
+        ``flip_bit_at`` (domain ``kv_page``): the flip lands in a real
+        stamped page's pool content so detection exercises the same path
+        production corruption would take."""
+        flip = sdc_flip_fault(self.steps, scope="serving")
+        if flip is not None and self._page_fp:
+            corrupt = getattr(self.executor, "corrupt_page_bit", None)
+            if corrupt is not None:
+                # prefer a SHARED page: the worst blast radius (several
+                # borrowers) is the one worth rehearsing
+                shared = [p for p in sorted(self._page_fp)
+                          if self.allocator.refcount(p) > 1]
+                target = (shared or sorted(self._page_fp))[0]
+                corrupt(target)
+                self._record("chaos_injected", kind="sdc_flip",
+                             page=int(target))
+        stamped = sorted(self._page_fp)
+        if not stamped or self.pages_scan_per_step <= 0:
+            return
+        k = min(self.pages_scan_per_step, len(stamped))
+        start = self._page_scan_rr % len(stamped)
+        batch = [stamped[(start + j) % len(stamped)] for j in range(k)]
+        self._page_scan_rr = (start + k) % len(stamped)
+        for p in self._verify_pages(batch, "scan"):
+            self._quarantine_page(p, "scan")
+
     def close(self) -> None:
         """Stop a watchdog the engine created for this scheduler (no-op for
         caller-owned or absent watchdogs)."""
@@ -884,6 +984,19 @@ class ContinuousBatchingScheduler:
                                              self.page_size)
                 req._prefix_hashes = hashes
             shared = self.prefix_cache.lookup_chain(hashes)[:need]
+        if shared and self.page_fingerprints:
+            # trust boundary: these pages are about to serve ANOTHER
+            # request's prefix — re-fingerprint before the refcount bump.
+            # A mismatch truncates the borrow at the first corrupt page
+            # (its suffix chains through it, so it is unusable too) and
+            # quarantines: index eviction + borrower unwind, then this
+            # admission proceeds as a partial/complete cache miss.
+            bad = self._verify_pages(shared, "share")
+            if bad:
+                cut = min(shared.index(p) for p in bad)
+                for p in bad:
+                    self._quarantine_page(p, "share")
+                shared = shared[:cut]
         if not self.allocator.can_alloc(need - len(shared)):
             return None
         if shared:
@@ -1096,6 +1209,11 @@ class ContinuousBatchingScheduler:
                 # (first writer wins; entries die with the page)
                 self.prefix_cache.register(np.asarray(req.prompt),
                                            self._slot_pages[slot])
+                # the registered full-prefix pages are immutable from here
+                # (every position written, frontier past them) — stamp them
+                # so share/scan/audit can prove the bytes never drift
+                n_full = len(np.asarray(req.prompt)) // self.page_size
+                self._stamp_pages(self._slot_pages[slot][:n_full])
             if req.done:
                 self._finish(slot)
             elif self.role == "prefill":
@@ -1125,6 +1243,10 @@ class ContinuousBatchingScheduler:
         self.tables[slot] = 0
         self.lengths[slot] = 0
         self.next_input[slot] = 0
+        # staged pages are read-only until the decode side acks — stamp
+        # them so the background scan covers the staging window and the
+        # export's payload fingerprints attest bytes that were still clean
+        self._stamp_pages(self._slot_pages[slot][:n_pages])
         self._record("handoff_staged", rid=req.rid, pages=n_pages,
                      context_len=live)
 
@@ -1266,6 +1388,10 @@ class ContinuousBatchingScheduler:
         if self.brownout is not None:
             self._brownout_tick()
         self._sweep_deadlines()
+        if self.page_fingerprints:
+            # scan BEFORE admission so a rotted page is quarantined before
+            # this step's admissions could borrow it
+            self._integrity_scan()
         self._admit()
         if not self.active_slots:
             return 0

@@ -19,6 +19,13 @@ The subsystem (see ``docs/RESILIENCE.md``) turns "a checkpoint exists" into
 - :mod:`.watchdog` — :class:`HealthWatchdog`: per-phase deadlines over the
   step loop (compile/step/collective/checkpoint); stall → stack dump + wire
   ledger + recovery event + drain escalation; straggler identification.
+- :mod:`.fingerprint` — the ONE checksum primitive (crc32c dispatch +
+  blockwise helpers) shared by the manifest and live-state integrity.
+- :mod:`.integrity` — :class:`IntegrityMonitor`: silent-data-corruption
+  defense — budgeted blockwise fingerprint scans over live state domains,
+  redundant-compute spot checks, dp fingerprint majority vote, chaos bit
+  flips; detection escalates through containment + healing, never blind
+  retry.
 - :mod:`.rollback` — :class:`SpikeDetector` (EMA z-score divergence
   sentinel), :class:`HealthController` (auto-rollback to the newest
   committed checkpoint + deterministic data-cursor skip, in-memory anchor
@@ -38,11 +45,25 @@ from .chaos import (
     fault_point,
     get_fault_plan,
     install_plan,
+    sdc_flip_fault,
     serving_alloc_fault,
     serving_dispatch_fault,
     training_faults,
 )
 from .events import EVENTS_FILENAME, RecoveryLog, read_events, rotate_jsonl
+from .fingerprint import (
+    DEFAULT_BLOCK_BYTES,
+    blockwise_fingerprints,
+    fingerprint_array,
+    fingerprint_bytes,
+)
+from .integrity import (
+    IntegrityMonitor,
+    SDCError,
+    fingerprint_vote,
+    payload_fingerprints,
+    verify_payload_fingerprints,
+)
 from .manifest import (
     CHECKSUMS,
     COMMIT_NAME,
@@ -96,7 +117,12 @@ __all__ = [
     "RecoveryLog", "read_events", "EVENTS_FILENAME",
     "RetryingWriter", "RetryBudgetExceeded", "DEFAULT_WRITER",
     "crc32c", "crc32c_file", "checksum_file", "CHECKSUMS",
-    "preferred_checksum", "build_manifest", "commit_tag", "verify_tag",
+    "preferred_checksum", "fingerprint_bytes", "fingerprint_array",
+    "blockwise_fingerprints", "DEFAULT_BLOCK_BYTES",
+    "IntegrityMonitor", "SDCError", "fingerprint_vote",
+    "payload_fingerprints", "verify_payload_fingerprints",
+    "sdc_flip_fault",
+    "build_manifest", "commit_tag", "verify_tag",
     "is_committed", "invalidate_tag", "committed_tags", "read_latest",
     "write_latest",
     "resolve_tag_for_load", "quarantine_tag",

@@ -126,6 +126,16 @@ class FaultPlan:
       SIGKILL right after host-optimizer shard ``N`` hits the checkpoint
       directory — a preemption mid-flush. The tag has no COMMIT marker, so
       resume must fall back to the newest committed one, step-exact.
+
+    Silent-data-corruption injector (docs/RESILIENCE.md "Data integrity";
+    consumed via :func:`sdc_flip_fault`):
+
+    - ``flip_bit_at`` + ``flip_bit_domain``: flip ONE real bit in the named
+      integrity domain when the training data cursor (training domains) or
+      the scheduler step (the ``"kv_page"`` domain) reaches ``flip_bit_at``.
+      The flip lands inside a fingerprint-stamped block — modelling rot in
+      the quiescent window the integrity monitor covers — so detection is
+      the monitor's job, not luck. One-shot.
     """
 
     kill_at_phase: Optional[str] = None
@@ -158,6 +168,9 @@ class FaultPlan:
     # offload-path injectors
     stall_offload_at: Optional[int] = None
     stall_offload_seconds: float = 0.0
+    # silent-data-corruption injector
+    flip_bit_at: Optional[int] = None
+    flip_bit_domain: str = "host_shards"
 
     # runtime counters (not part of the plan spec)
     _save_index: int = dataclasses.field(default=-1, repr=False)
@@ -168,6 +181,7 @@ class FaultPlan:
     _ef_overflows_left: int = dataclasses.field(default=0, repr=False)
     _offload_stall_fired: bool = dataclasses.field(default=False, repr=False)
     _tenant_flood_fired: bool = dataclasses.field(default=False, repr=False)
+    _flip_bit_fired: bool = dataclasses.field(default=False, repr=False)
 
     def __post_init__(self) -> None:
         self._io_failures_left = int(self.fail_io_times)
@@ -306,6 +320,21 @@ class FaultPlan:
                 "max_new": int(self.tenant_flood_max_new),
                 "vocab": int(self.tenant_flood_vocab),
                 "tenant_id": str(self.tenant_flood_tenant)}
+
+    def sdc_flip(self, index: int, scope: str) -> Optional[str]:
+        """The integrity-domain name to bit-flip at training cursor /
+        scheduler step ``index``, or None. ``scope`` routes the injector:
+        the training engine consumes every domain except ``"kv_page"``;
+        the serving scheduler consumes only ``"kv_page"``. One-shot — fires
+        at the first matching index >= ``flip_bit_at``."""
+        if (self.flip_bit_at is None or self._flip_bit_fired
+                or index < int(self.flip_bit_at)):
+            return None
+        is_kv = self.flip_bit_domain == "kv_page"
+        if (scope == "serving") != is_kv:
+            return None
+        self._flip_bit_fired = True
+        return str(self.flip_bit_domain)
 
     def serving_alloc(self, index: int) -> bool:
         """Whether ``PageAllocator.alloc`` call ``index`` should report pool
@@ -455,6 +484,24 @@ def serving_tenant_flood(step: int) -> Optional[Dict[str, Any]]:
     return burst
 
 
+def sdc_flip_fault(index: int, scope: str = "training") -> Optional[str]:
+    """The integrity-domain name armed for a bit flip at ``index`` (None
+    when no plan is installed or the flip already fired). Consumed by the
+    training engine once per ``train_batch`` (``scope="training"``, indexed
+    by data cursor) and by the serving scheduler once per ``step()``
+    (``scope="serving"``, the ``"kv_page"`` domain only). The caller
+    performs the actual flip — through the integrity monitor, so the flip
+    provably lands in a fingerprint-covered window."""
+    plan = get_fault_plan()
+    if plan is None:
+        return None
+    domain = plan.sdc_flip(index, scope)
+    if domain is not None:
+        logger.warning(f"chaos: arming bit flip in integrity domain "
+                       f"{domain!r} at {scope} index {index}")
+    return domain
+
+
 def serving_alloc_fault(index: int) -> bool:
     """Whether the armed plan wants ``PageAllocator.alloc`` call ``index``
     to report exhaustion (False when no plan is installed)."""
@@ -472,4 +519,4 @@ __all__ = ["FaultPlan", "TrainingFaults", "ServingFault",
            "InjectedDispatchError", "FAULT_PLAN_ENV", "install_plan",
            "get_fault_plan", "fault_point", "training_faults",
            "serving_dispatch_fault", "serving_alloc_fault",
-           "serving_tenant_flood", "offload_fetch_fault"]
+           "serving_tenant_flood", "offload_fetch_fault", "sdc_flip_fault"]

@@ -37,6 +37,14 @@ from typing import Dict, List, Optional, Tuple
 
 from ..utils.logging import logger
 from .chaos import fault_point
+from .fingerprint import (  # noqa: F401  (re-exported public surface)
+    CHECKSUMS,
+    _CRC32C_IS_NATIVE,
+    checksum_file,
+    crc32c,
+    crc32c_file,
+    preferred_checksum,
+)
 from .retry import RetryingWriter
 
 MANIFEST_NAME = "MANIFEST.json"
@@ -49,101 +57,9 @@ MANIFEST_VERSION = 1
 _NON_CONTENT = {MANIFEST_NAME, COMMIT_NAME, QUARANTINE_NAME}
 
 
-# --------------------------------------------------------------------- crc32c
-def _make_crc32c_table() -> List[int]:
-    poly = 0x82F63B78  # Castagnoli, reflected
-    table = []
-    for i in range(256):
-        crc = i
-        for _ in range(8):
-            crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
-        table.append(crc)
-    return table
-
-
-_CRC32C_TABLE = _make_crc32c_table()
-
-
-def _crc32c_py(data: bytes, value: int = 0) -> int:
-    crc = value ^ 0xFFFFFFFF
-    table = _CRC32C_TABLE
-    for b in data:
-        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
-    return crc ^ 0xFFFFFFFF
-
-
-def _resolve_crc32c() -> Tuple[object, bool]:
-    """(impl, is_native). Prefer a C implementation when the image has one;
-    the pure-Python fallback computes the identical CRC-32C (Castagnoli), so
-    the two interoperate freely on the same checkpoint — but at single-digit
-    MB/s it cannot hash multi-GB checkpoints in production."""
-    try:  # google-crc32c
-        import google_crc32c
-
-        return (lambda data, value=0:
-                int(google_crc32c.extend(value, bytes(data)))), True
-    except Exception:
-        pass
-    try:  # crc32c (ICRAR)
-        import crc32c as _c
-
-        return (lambda data, value=0:
-                int(_c.crc32c(bytes(data), value))), True
-    except Exception:
-        pass
-    return _crc32c_py, False
-
-
-crc32c, _CRC32C_IS_NATIVE = _resolve_crc32c()
-
-
-def _crc32(data: bytes, value: int = 0) -> int:
-    import zlib
-
-    return zlib.crc32(data, value) & 0xFFFFFFFF
-
-
-#: checksum registry: every algorithm a manifest may record. The manifest
-#: stamps which one it used, so readers and writers never have to agree on a
-#: default — a checkpoint written with crc32 verifies on a host that has a
-#: native crc32c and vice versa.
-CHECKSUMS = {"crc32c": crc32c, "crc32": _crc32}
-
-
-def preferred_checksum() -> str:
-    """CRC32C when a C implementation is importable (storage-standard,
-    matches GCS object checksums); otherwise stdlib zlib.crc32 — also
-    C-speed, because hashing a multi-GB checkpoint through the pure-Python
-    CRC32C table (~5 MB/s) would turn every save and verified load into
-    minutes of CPU. Overridable via ``DS_CHECKPOINT_CHECKSUM``."""
-    forced = os.environ.get("DS_CHECKPOINT_CHECKSUM", "").strip().lower()
-    if forced:
-        if forced not in CHECKSUMS:
-            raise ValueError(
-                f"DS_CHECKPOINT_CHECKSUM={forced!r}; known: {sorted(CHECKSUMS)}")
-        return forced
-    return "crc32c" if _CRC32C_IS_NATIVE else "crc32"
-
-
-def checksum_file(path: str, algo: str,
-                  chunk_bytes: int = 4 << 20) -> Tuple[int, int]:
-    """(checksum, byte size) of a file, streamed."""
-    fn = CHECKSUMS[algo]
-    crc = 0
-    n = 0
-    with open(path, "rb") as f:
-        while True:
-            chunk = f.read(chunk_bytes)
-            if not chunk:
-                break
-            crc = fn(chunk, crc)
-            n += len(chunk)
-    return crc, n
-
-
-def crc32c_file(path: str, chunk_bytes: int = 1 << 20) -> Tuple[int, int]:
-    """(crc32c, byte size) of a file, streamed."""
-    return checksum_file(path, "crc32c", chunk_bytes)
+# crc32c/crc32 dispatch lives in resilience/fingerprint.py (one checksum
+# implementation for checkpoints AND live-state integrity); the names are
+# re-imported above so this module's public surface is unchanged.
 
 
 # ------------------------------------------------------------------ exceptions

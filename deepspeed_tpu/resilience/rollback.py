@@ -313,6 +313,27 @@ class HealthController:
         eng.data_cursor = int(snap["data_cursor"])
 
     # ------------------------------------------------------------ rollback
+    def sdc_rollback(self, detail: Dict[str, Any]) -> Dict[str, Any]:
+        """Containment for a verified silent-data-corruption detection
+        (docs/RESILIENCE.md "Data integrity"): restore the newest anchor —
+        re-verified before trust, a corrupt anchor falls back older — but
+        unlike a divergence rollback the DATA was never at fault, the state
+        was. The consumed batches are therefore replayed, not skipped: a
+        deterministic dataloader reproduces the exact fault-free
+        trajectory, making the heal step-exact."""
+        reason = (f"sdc:{detail.get('domain')}:{detail.get('unit')}"
+                  f":block{detail.get('block')}")
+        info = self._rollback(reason)
+        self._skip_until = None  # replay, don't skip: the data was clean
+        info["skip_cursors"] = []
+        info["sdc"] = dict(detail)
+        if self.recovery_log is not None:
+            self.recovery_log.record(
+                "sdc_rollback", step=info.get("to_step"),
+                domain=detail.get("domain"), unit=detail.get("unit"),
+                block=detail.get("block"))
+        return info
+
     def _rollback(self, reason: str) -> Dict[str, Any]:
         eng = self.engine
         if self.rollbacks >= self.cfg.max_rollbacks:

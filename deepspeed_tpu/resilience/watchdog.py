@@ -255,12 +255,19 @@ def identify_stragglers(
             if d > max(med * factor, med + floor_s)]
 
 
-def allgather_host_stats(duration_s: float) -> Optional[List[dict]]:
-    """Allgather ``{process_index, hostname, step_s}`` across hosts.
+def allgather_host_stats(duration_s: float,
+                         fingerprint: Optional[int] = None
+                         ) -> Optional[List[dict]]:
+    """Allgather ``{process_index, hostname, step_s[, fingerprint]}`` across
+    hosts.
 
     Call ONLY from the main thread at a step boundary (it is a collective).
     Returns None in single-process runs. Hostnames travel as fixed-width
-    byte rows so the exchange is one array allgather.
+    byte rows so the exchange is one array allgather. ``fingerprint``
+    (optional, uint32) piggybacks the integrity monitor's per-boundary
+    state fingerprint on the same exchange — one collective serves both the
+    straggler check and the SDC majority vote. All hosts must agree on
+    whether a fingerprint is passed (same config ⇒ same row layout).
     """
     import socket
 
@@ -272,18 +279,26 @@ def allgather_host_stats(duration_s: float) -> Optional[List[dict]]:
         return None
     from jax.experimental import multihost_utils
 
+    width = 80 if fingerprint is not None else 72
     name = socket.gethostname().encode()[:64]
-    row = np.zeros(72, np.uint8)
+    row = np.zeros(width, np.uint8)
     row[:len(name)] = np.frombuffer(name, np.uint8)
     row[64:72] = np.frombuffer(
         np.asarray([duration_s], np.float64).tobytes(), np.uint8)
+    if fingerprint is not None:
+        row[72:80] = np.frombuffer(
+            np.asarray([fingerprint], np.uint64).tobytes(), np.uint8)
     rows = np.asarray(multihost_utils.process_allgather(row))
-    rows = rows.reshape(-1, 72)
+    rows = rows.reshape(-1, width)
     out = []
     for i, r in enumerate(rows):
         host = bytes(r[:64]).rstrip(b"\0").decode(errors="replace")
         dur = float(np.frombuffer(bytes(r[64:72]), np.float64)[0])
-        out.append({"process_index": i, "hostname": host, "step_s": dur})
+        entry = {"process_index": i, "hostname": host, "step_s": dur}
+        if fingerprint is not None:
+            entry["fingerprint"] = int(
+                np.frombuffer(bytes(r[72:80]), np.uint64)[0])
+        out.append(entry)
     return out
 
 

@@ -252,6 +252,39 @@ class WatchdogConfig(DeepSpeedConfigModel):
     straggler_factor: float = Field(2.0, gt=1)
 
 
+class IntegrityConfig(DeepSpeedConfigModel):
+    """Silent-data-corruption defense
+    (:mod:`deepspeed_tpu.resilience.integrity`; ``docs/RESILIENCE.md``
+    "Data integrity").
+
+    When ``enabled`` (requires the parent ``resilience`` block), the engine
+    registers its long-lived state domains (ZeRO master/opt leaves, in-RAM
+    host-offload shards) with an :class:`IntegrityMonitor` and runs the
+    budgeted stamp→verify rotation: every ``scan_interval`` steps,
+    ``blocks_per_scan`` blocks of ``block_bytes`` are fingerprinted after
+    the step and re-verified before the next one mutates state — the
+    inter-step quiescent window where RAM rot bites. A mismatch raises
+    through the :class:`HealthController` rollback path (``sdc_detected``
+    event; anchors re-verified by ``deep_verify`` before trust).
+
+    ``spot_check_interval`` > 0 re-dispatches one micro-batch every N steps
+    through the already-jitted step and compares loss/grad-fingerprint
+    bitwise (same-chip SDC canary); on a dp mesh the boundary fingerprint
+    rides the straggler allgather and a majority vote names a deviating
+    host in an ``sdc_suspect`` event. ``verify_anchors`` forces deep
+    verification of rollback anchors even when the global ``deep_verify``
+    is off. Serving-side page fingerprints are armed separately
+    (``ServingConfig.page_fingerprints``).
+    """
+
+    enabled: bool = False
+    scan_interval: int = Field(16, ge=1)
+    blocks_per_scan: int = Field(4, ge=1)
+    block_bytes: int = Field(1 << 20, ge=256)
+    spot_check_interval: int = Field(0, ge=0)  # 0 disables spot checks
+    verify_anchors: bool = True
+
+
 class DegradedModeConfig(DeepSpeedConfigModel):
     """Graceful-degradation policy (``docs/RESILIENCE.md`` "In-run health").
 
@@ -296,6 +329,7 @@ class ResilienceConfig(DeepSpeedConfigModel):
     sentinel: SentinelConfig = Field(default_factory=SentinelConfig)
     watchdog: WatchdogConfig = Field(default_factory=WatchdogConfig)
     degraded: DegradedModeConfig = Field(default_factory=DegradedModeConfig)
+    integrity: IntegrityConfig = Field(default_factory=IntegrityConfig)
 
     @model_validator(mode="after")
     def _check(self) -> "ResilienceConfig":
@@ -308,6 +342,10 @@ class ResilienceConfig(DeepSpeedConfigModel):
                 "resilience.sentinel / resilience.watchdog require "
                 "resilience.enabled (rollback anchors and drain escalation "
                 "both live in resilience.save_dir)")
+        if self.integrity.enabled and not self.enabled:
+            raise ValueError(
+                "resilience.integrity requires resilience.enabled (SDC "
+                "containment rolls back to anchors in resilience.save_dir)")
         if not (0 < self.exit_code < 256):
             raise ValueError(
                 f"resilience.exit_code must be in 1..255, got {self.exit_code}")

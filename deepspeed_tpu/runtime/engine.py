@@ -552,6 +552,15 @@ class DeepSpeedEngine:
 
                 self._health = HealthController(self)
 
+        # silent-data-corruption defense (docs/RESILIENCE.md "Data
+        # integrity"): blockwise fingerprint scans over the long-lived state
+        # domains, redundant-compute spot checks, dp fingerprint vote. Built
+        # AFTER auto-resume so the first stamps cover the resumed state.
+        self._integrity = None
+        self._integrity_boundary_fp = None
+        if res.enabled and res.integrity.enabled:
+            self._init_integrity()
+
         # opt-in static analysis (deepspeed_tpu.analysis): lint the fused
         # step's jaxpr/HLO before anything executes. Runs here when a batch
         # can be synthesized (GPT-family models); otherwise at the first
@@ -978,6 +987,7 @@ class DeepSpeedEngine:
         self._boundary_jit = None  # forward()/step() use (train_batch never pays)
         self._zero_jit = None
         self._grad_acc = None
+        self._spot_jit = None    # integrity spot-check canary (lazy)
 
         def fused(state, batch, rng):
             # single-program micro+boundary; grad buffer lives only in-program
@@ -1206,6 +1216,13 @@ class DeepSpeedEngine:
         from ..resilience.chaos import training_faults
 
         inj = training_faults(self.data_cursor)
+        if self._integrity is not None:
+            # verify the blocks stamped at the last scan boundary BEFORE the
+            # optimizer mutates state again — the stamp→verify window is the
+            # inter-step quiescent interval where RAM rot bites
+            sdc_metrics = self._integrity_prestep()
+            if sdc_metrics is not None:
+                return sdc_metrics
         self.tput_timer.start()
         if self._analysis_pending:
             # deferred init-time analysis: the first real batch supplies the
@@ -1269,6 +1286,8 @@ class DeepSpeedEngine:
             # parity: the step-end timer breakdown (engine.py:2226-2241)
             log_dist(self.timers.log(["batch_input", "train_batch"]))
         self.tput_timer.stop(sync_on=metrics["loss"])
+        if self._integrity is not None:
+            self._integrity_poststep(batch, time.monotonic() - t_step)
         self._straggler_poll(time.monotonic() - t_step)
         self._maybe_drain()
         return metrics
@@ -1296,6 +1315,10 @@ class DeepSpeedEngine:
                 "1-bit/offload/param-stream runners interleave host work per "
                 "step — call train_batch per step instead")
         k = int(jax.tree_util.tree_leaves(batch)[0].shape[0])
+        if self._integrity is not None:
+            # the fused window mutates state k times with no pre-step
+            # boundary in between: pending stamps are void, not stale
+            self._integrity.invalidate("train-batches-window")
         if self._health is not None and any(
                 self._health.should_skip(self.data_cursor + i)
                 for i in range(k)):
@@ -1653,6 +1676,177 @@ class DeepSpeedEngine:
         except Exception as e:  # escalation must never kill the watchdog
             logger.error(f"watchdog escalation failed: {e}")
 
+    # ------------------------------------------------------------ integrity
+    def _init_integrity(self) -> None:
+        """Build the SDC monitor and register the engine's long-lived state
+        domains (docs/RESILIENCE.md "Data integrity"): in-RAM host-offload
+        shards for the offload/param-stream runners, the HBM-resident ZeRO
+        master/opt leaves otherwise."""
+        from ..resilience.integrity import IntegrityMonitor
+
+        icfg = self.config.resilience.integrity
+        mon = IntegrityMonitor(
+            scan_interval=icfg.scan_interval,
+            blocks_per_scan=icfg.blocks_per_scan,
+            block_bytes=icfg.block_bytes,
+            recovery_log=self._recovery_log)
+        runner = self._offload or self._param_stream
+        if runner is not None:
+            mon.register_domain(
+                "host_shards", lambda: self._host_shard_units(runner))
+        else:
+            mon.register_domain("master", self._device_master_units,
+                                self._device_master_write)
+        self._integrity = mon
+        log_dist(f"integrity: armed ({mon.algo}, scan every "
+                 f"{mon.scan_interval} steps x {mon.blocks_per_scan} "
+                 f"blocks of {mon.block_bytes} B, domains {mon.domains})")
+
+    @staticmethod
+    def _host_shard_units(runner) -> Dict[str, Any]:
+        """The in-RAM host-optimizer shards as integrity units — mutable
+        numpy, so a chaos flip is a real in-place RAM bit flip. NVMe-backed
+        state is not RAM-resident and is excluded from the scan."""
+        out: Dict[str, Any] = {}
+        if getattr(runner, "store", None) is not None:
+            return out
+        state = getattr(runner, "_state", None)
+        if isinstance(state, list):  # ParamStreamRunner (ZeRO-Infinity RAM)
+            for i, entry in enumerate(state):
+                if entry is None:
+                    continue
+                ms, mm, vv = entry
+                out[f"master_{i}"] = ms
+                out[f"m_{i}"] = mm
+                out[f"v_{i}"] = vv
+            return out
+        master = getattr(runner, "master", None)
+        if isinstance(master, list):  # HostOffloadRunner (RAM mode)
+            for i, (ms, mm, vv) in enumerate(
+                    zip(master, runner.m, runner.v)):
+                if ms is None:
+                    continue
+                out[f"master_{i}"] = ms
+                out[f"m_{i}"] = mm
+                out[f"v_{i}"] = vv
+        return out
+
+    def _device_master_units(self) -> Dict[str, Any]:
+        """HBM-resident ZeRO master/opt leaves keyed by tree path."""
+        out: Dict[str, Any] = {}
+        for name in ("master", "opt"):
+            tree = self.state.get(name)
+            if not tree:
+                continue
+            flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+            for path, leaf in flat:
+                out[f"{name}{jax.tree_util.keystr(path)}"] = leaf
+        return out
+
+    def _device_master_write(self, key: str, arr) -> None:
+        """Replace one master/opt leaf wholesale (device arrays are
+        immutable — this is the chaos flip's write path)."""
+        name = "master" if key.startswith("master") else "opt"
+        tree = self.state.get(name)
+
+        def rep(path, leaf):
+            if f"{name}{jax.tree_util.keystr(path)}" == key:
+                return jax.device_put(
+                    jnp.asarray(arr).astype(leaf.dtype), leaf.sharding)
+            return leaf
+
+        self.state = dict(self.state)
+        self.state[name] = jax.tree_util.tree_map_with_path(rep, tree)
+
+    def _integrity_prestep(self) -> Optional[Dict[str, Any]]:
+        """Pre-step verification of the stamped blocks; consumes an armed
+        chaos bit flip first, so injected rot provably lands inside the
+        covered window. On detection: contain through the HealthController
+        rollback (anchors re-verified before trust; the consumed batches
+        are replayed, not skipped — step-exact heal), or raise
+        :class:`SDCError` when no rollback machinery is armed."""
+        from ..resilience.chaos import sdc_flip_fault
+        from ..resilience.integrity import SDCError
+
+        mon = self._integrity
+        domain = sdc_flip_fault(self.data_cursor, scope="training")
+        if domain is not None:
+            mon.inject_flip(domain)
+        mismatches = mon.verify_pending()
+        if not mismatches:
+            return None
+        if self._health is None:
+            raise SDCError(mismatches)
+        info = self._health.sdc_rollback(mismatches[0])
+        m = dict(self._last_metrics) if self._last_metrics else {
+            "loss": float("nan")}
+        m["health"] = {"rolled_back": info}
+        m["sdc"] = mismatches
+        return m
+
+    def _integrity_poststep(self, batch, step_dt: float) -> None:
+        """Post-step integrity work: budgeted stamp of the next rotation
+        blocks (verified by the next pre-step), the redundant-compute spot
+        check, and the dp-boundary fingerprint for the majority vote."""
+        mon = self._integrity
+        mon.note_step_time(step_dt)
+        if mon.scan_due(self.global_steps):
+            stamped = mon.stamp_next()
+            if stamped and self._recovery_log is not None:
+                self._recovery_log.record(
+                    "integrity_scan", value=float(stamped),
+                    step=self.global_steps, pending=mon.pending_blocks)
+        icfg = self.config.resilience.integrity
+        sci = int(icfg.spot_check_interval or 0)
+        if (sci > 0 and self.global_steps % sci == 0
+                and self._offload is None and self._param_stream is None
+                and self._onebit is None and not self._qcomm.gradients):
+            # the canary needs the standard in-HBM grads path; host-runner
+            # and shard_map'd wires have no non-donating re-dispatch surface
+            self._integrity_spot_check(batch)
+        elif self._last_loss is not None:
+            from ..resilience.fingerprint import fingerprint_bytes
+
+            self._integrity_boundary_fp = fingerprint_bytes(
+                np.asarray(self._last_loss).tobytes())
+
+    def _integrity_spot_check(self, batch) -> None:
+        """Redundant-compute canary: dispatch one micro-batch twice through
+        a dedicated non-donating jitted loss+grad program and compare
+        loss/grad-fingerprint bitwise — a same-chip SDC and nondeterminism
+        check. The result fingerprint doubles as the dp-boundary vote
+        value."""
+        from ..resilience.fingerprint import fingerprint_bytes
+
+        mon = self._integrity
+        t0 = time.monotonic()
+        if self._spot_jit is None:
+            def canary(state, mb, rng):
+                scale = (state["scaler"].scale if self.pc.loss_scaling
+                         else jnp.float32(1.0))
+                loss, _aux, grads = self._loss_and_grads(
+                    state["params"], mb, scale, {"dropout": rng},
+                    step=state["step"], curvature=state.get("curvature"))
+                return loss, global_norm(grads)
+
+            self._spot_jit = jax.jit(canary)
+        mb = (jax.tree_util.tree_map(lambda x: x[0], batch)
+              if self.gas > 1 else batch)
+        key = jax.random.PRNGKey(int(self.global_steps) & 0x7FFFFFFF)
+        with mesh_context(self.mesh):
+            a = self._spot_jit(self.state, mb, key)
+            b = self._spot_jit(self.state, mb, key)
+        fp_a = fingerprint_bytes(
+            b"".join(np.asarray(x).tobytes() for x in a))
+        fp_b = fingerprint_bytes(
+            b"".join(np.asarray(x).tobytes() for x in b))
+        self._integrity_boundary_fp = fp_a
+        mon.record_spot_check(
+            fp_a == fp_b, self.global_steps,
+            detail=None if fp_a == fp_b else
+            {"check": "spot", "fp_a": int(fp_a), "fp_b": int(fp_b)})
+        mon.add_overhead(time.monotonic() - t0)
+
     def _skip_poisoned_batch(self) -> Dict[str, Any]:
         """Consume one data cursor without executing (post-rollback poison
         window). Returns marker metrics; no optimizer step happens."""
@@ -1681,9 +1875,29 @@ class DeepSpeedEngine:
             return
         from ..resilience.watchdog import allgather_host_stats, identify_stragglers
 
-        stats = allgather_host_stats(step_duration_s)
+        fp = (self._integrity_boundary_fp
+              if self._integrity is not None else None)
+        stats = allgather_host_stats(step_duration_s, fingerprint=fp)
         if not stats:
             return
+        if fp is not None:
+            # SDC majority vote rides the same collective: after the dp
+            # boundary every host holds bitwise-identical reduced state, so
+            # a deviating fingerprint names a host computing wrong bits
+            from ..resilience.integrity import fingerprint_vote
+
+            _majority, deviants = fingerprint_vote(stats)
+            for d in deviants:
+                logger.error(
+                    f"integrity: host {d['hostname']!r} (process "
+                    f"{d['process_index']}) deviates from the pod-majority "
+                    f"boundary fingerprint at step {self.global_steps} — "
+                    f"SDC suspect")
+                if self._recovery_log is not None:
+                    self._recovery_log.record(
+                        "sdc_suspect", step=self.global_steps,
+                        hostname=d["hostname"],
+                        process_index=d["process_index"])
         slow = identify_stragglers([s["step_s"] for s in stats],
                                    factor=wd.straggler_factor)
         for idx in slow:
@@ -1703,6 +1917,15 @@ class DeepSpeedEngine:
                         client_state: Optional[dict] = None, save_latest: bool = True) -> str:
         from ..checkpoint import save_checkpoint as _save
 
+        if self._integrity is not None:
+            # fingerprint the bytes about to be blessed: stamped blocks
+            # must still verify — committing rotten state would poison the
+            # whole anchor chain the heal path depends on
+            from ..resilience.integrity import SDCError
+
+            mismatches = self._integrity.verify_pending()
+            if mismatches:
+                raise SDCError(mismatches)
         with self._watch_phase("checkpoint"):
             return _save(self, save_dir, tag=tag,
                          client_state=client_state or {},
@@ -1712,7 +1935,12 @@ class DeepSpeedEngine:
                         load_optimizer_states: bool = True) -> Tuple[Optional[str], dict]:
         from ..checkpoint import load_checkpoint as _load
 
-        return _load(self, load_dir, tag=tag, load_optimizer_states=load_optimizer_states)
+        out = _load(self, load_dir, tag=tag,
+                    load_optimizer_states=load_optimizer_states)
+        mon = getattr(self, "_integrity", None)  # init-time resume predates it
+        if mon is not None:
+            mon.invalidate("checkpoint-load")  # stamps over replaced state
+        return out
 
     def save_16bit_model(self, save_dir: str,
                          save_filename: str = "pytorch_model.npz") -> str:

@@ -11,10 +11,20 @@ Two heal cycles against the real training worker on the CPU mesh:
    committed checkpoint, skip the poisoned data cursor, and finish all steps
    with a finite loss IN THE SAME PROCESS (exit 0 = the run self-healed).
 
-This is the cheap end of the resilience test pyramid — the full phase matrix
-with bitwise state comparison lives in
-``tests/test_resilience.py::test_sigkill_at_every_phase_resumes_bitwise``,
-and the in-run health acceptance suite in ``tests/test_watchdog.py``.
+With ``--sdc`` it instead runs the silent-data-corruption pair
+(docs/RESILIENCE.md "Data integrity") in-process:
+
+3. **host-shard bit flip → rollback, step-exact** — a real bit is flipped
+   in a cpu-offloaded optimizer shard mid-run; the integrity scan must
+   detect it at the next step boundary, roll back to the newest verified
+   anchor, replay the same batches, and land on the SAME final loss as a
+   fault-free reference run (the data was never at fault — nothing is
+   skipped).
+4. **shared KV page bit flip → re-prefill, generate-identical** — a real
+   bit is flipped in a prefix-cache-shared page on a live serving engine;
+   the background scan must quarantine the page, preempt the borrowers,
+   and the re-prefilled requests must emit exactly the fault-free token
+   streams with every page audit clean.
 """
 
 import json
@@ -62,8 +72,145 @@ def nan_rollback_cycle(worker: str) -> int:
     return 0
 
 
+def sdc_training_cycle() -> int:
+    """Bit flip in a cpu-offloaded optimizer shard: detect -> rollback to
+    the verified anchor -> replay -> bitwise-identical final loss."""
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import build_gpt
+    from deepspeed_tpu.models.gpt import GPTConfig
+    from deepspeed_tpu.resilience.chaos import FaultPlan, install_plan
+
+    steps = 6
+    cfg = GPTConfig(vocab_size=128, d_model=32, n_layer=2, n_head=2,
+                    max_seq_len=32)
+
+    def make_batch(cursor: int):
+        r = np.random.default_rng(1000 + cursor)
+        return {"input_ids": r.integers(
+            0, cfg.vocab_size, size=(2, 16), dtype=np.int32)}
+
+    def run(td: str, flip_at=None):
+        install_plan(FaultPlan(flip_bit_at=flip_at,
+                               flip_bit_domain="host_shards")
+                     if flip_at is not None else None)
+        model, _ = build_gpt(cfg)
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "steps_per_print": 0,
+            "zero_optimization": {
+                "stage": 2, "offload_optimizer": {"device": "cpu"}},
+            "resilience": {
+                "enabled": True, "save_dir": td,
+                "sentinel": {"enabled": True, "checkpoint_interval": 2,
+                             "cursor_checkpointable": True},
+                "integrity": {"enabled": True, "scan_interval": 1,
+                              "blocks_per_scan": 8, "block_bytes": 4096},
+            }})
+        rolled = 0
+        while engine.global_steps < steps:
+            m = engine.train_batch(make_batch(engine.data_cursor))
+            if "sdc" in m:
+                rolled += 1
+        loss = float(m["loss"])
+        counters = dict(engine._recovery_log.counters)
+        install_plan(None)
+        return loss, rolled, counters
+
+    with tempfile.TemporaryDirectory() as td:
+        ref_loss, ref_rolled, ref_events = run(os.path.join(td, "ref"))
+        if ref_rolled or ref_events.get("sdc_detected"):
+            return fail(f"clean run raised SDC alarms ({ref_events})")
+        if not ref_events.get("integrity_scan"):
+            return fail("integrity scan never ran on the clean run")
+        loss, rolled, events = run(os.path.join(td, "flip"), flip_at=4)
+        if not rolled:
+            return fail("injected host-shard flip was never detected")
+        if not events.get("sdc_detected") or not events.get("sdc_rollback"):
+            return fail(f"missing sdc events after flip ({events})")
+        if loss != ref_loss:
+            return fail(f"replay after SDC rollback is not step-exact: "
+                        f"final loss {loss!r} vs fault-free {ref_loss!r}")
+    print(f"chaos_smoke: PASS — host-shard bit flip detected, rolled back, "
+          f"replayed step-exact (final loss {loss:.6f})")
+    return 0
+
+
+def sdc_serving_cycle() -> int:
+    """Bit flip in a prefix-shared KV page: quarantine + borrower
+    re-prefill -> generate-identical streams, audits clean."""
+    import numpy as np
+
+    import jax
+    from deepspeed_tpu.inference.serving import ServingConfig, ServingEngine
+    from deepspeed_tpu.inference.serving.scheduler import Request
+    from deepspeed_tpu.models import gpt as G
+    from deepspeed_tpu.resilience.chaos import FaultPlan, install_plan
+
+    cfg = G.GPTConfig(vocab_size=64, d_model=32, n_layer=2, n_head=4,
+                      max_seq_len=128)
+    params = G.init_params(cfg, jax.random.PRNGKey(0))
+    eng = ServingEngine(cfg, params, ServingConfig(
+        num_slots=3, page_size=8, max_model_len=64, prefill_chunk=16,
+        dtype="float32", decode_block=1, max_queue=64,
+        enable_prefix_cache=True, page_fingerprints=True,
+        pages_scan_per_step=4))
+    prompt = (np.arange(17, dtype=np.int32) % 63) + 1  # 2 shareable pages
+
+    def run(flip_at=None):
+        install_plan(FaultPlan(flip_bit_at=flip_at,
+                               flip_bit_domain="kv_page")
+                     if flip_at is not None else None)
+        sched = eng.make_scheduler()
+        reqs = [Request(prompt=prompt.copy(), max_new_tokens=6)
+                for _ in range(2)]
+        sched.submit(reqs[0])
+        for _ in range(3):
+            sched.step()
+        sched.submit(reqs[1])  # borrows the registered prefix pages
+        shared_audit = None
+        for _ in range(60):
+            sched.step()
+            if shared_audit is None and sched.page_stats["shared"]:
+                shared_audit = sched.audit()  # audit WHILE pages are shared
+            if all(r.state.value == "finished" for r in reqs):
+                break
+        final_audit = sched.audit()
+        out = ([list(r.tokens) for r in reqs], dict(sched.counters),
+               shared_audit, final_audit)
+        sched.close()
+        install_plan(None)
+        return out
+
+    ref_tokens, ref_counters, ref_shared, ref_final = run(None)
+    if ref_counters.get("sdc_detected"):
+        return fail(f"clean serving run raised SDC alarms ({ref_counters})")
+    if not (ref_shared and ref_shared["ok"] and ref_shared["fingerprinted"]):
+        return fail(f"clean shared-page audit swept nothing ({ref_shared})")
+    tokens, counters, _, final_audit = run(flip_at=2)
+    if not counters.get("chaos_injected"):
+        return fail(f"KV-page flip never fired ({counters})")
+    if not counters.get("sdc_detected") or not counters.get("sdc_healed"):
+        return fail(f"KV-page flip not detected/healed ({counters})")
+    if tokens != ref_tokens:
+        return fail(f"post-heal streams differ from fault-free: "
+                    f"{tokens} vs {ref_tokens}")
+    if not final_audit["ok"]:
+        return fail(f"page audit dirty after heal: {final_audit['errors']}")
+    print(f"chaos_smoke: PASS — shared KV page flip quarantined "
+          f"({counters.get('preemption', 0)} borrower preemption(s)), "
+          f"re-prefill generate-identical, audits clean")
+    return 0
+
+
 def main() -> int:
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if "--sdc" in sys.argv[1:]:
+        sys.path.insert(0, root)  # the SDC cycles run in-process
+        rc = sdc_training_cycle()
+        return rc if rc else sdc_serving_cycle()
     worker = os.path.join(root, "tests", "resilience_worker.py")
     with tempfile.TemporaryDirectory() as td:
         ckpt = os.path.join(td, "ckpt")

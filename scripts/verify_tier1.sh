@@ -267,6 +267,20 @@ if ! timeout -k 10 420 env JAX_PLATFORMS=cpu \
 fi
 grep -a "chaos_smoke: PASS" /tmp/_t1_chaos.log || true
 
+# --- silent-data-corruption smoke (docs/RESILIENCE.md "Data integrity") ---
+# a REAL bit flip in a cpu-offloaded optimizer shard must be detected and
+# healed step-exact (rollback + replay, same final loss), and a flip in a
+# prefix-shared KV page must be quarantined with borrowers re-prefilled to
+# identical token streams — both on real engines, with clean runs raising
+# zero sdc_detected events.
+if ! timeout -k 10 420 env JAX_PLATFORMS=cpu \
+        python scripts/chaos_smoke.py --sdc > /tmp/_t1_sdc.log 2>&1; then
+    echo "verify_tier1: FAIL — SDC smoke (scripts/chaos_smoke.py --sdc):" >&2
+    tail -40 /tmp/_t1_sdc.log >&2
+    exit 1
+fi
+grep -a "chaos_smoke: PASS" /tmp/_t1_sdc.log || true
+
 # --- lint gate (ruff.toml: analysis subsystem + its tests) ----------------
 # advisory where the interpreter lacks ruff (this image does not bundle it);
 # CI lanes that have it get the real check.
